@@ -1,0 +1,42 @@
+"""graphdyn — a TPU-native framework for graph dynamics, strategic
+initialization search, and backtracking-dynamical-cavity (BDCM) inference.
+
+Re-designed from scratch for JAX/XLA/Pallas on TPU, with the capabilities of
+the reference thesis codebase (simulated-annealing initialization search,
+History-Passing reinforcement, BDCM entropy curves — see SURVEY.md):
+
+- ``graphdyn.graphs``      — graph ensembles (RRG, Erdős–Rényi) and the padded
+  neighbor-table / directed-edge-table representation (L1 of SURVEY.md §1).
+- ``graphdyn.ops``         — jitted dynamics kernels (majority/minority ×
+  stay/change tie-breaking), the BDCM message-passing sweep, and Pallas TPU
+  kernels (L3).
+- ``graphdyn.attractors``  — (p,c) backtracking-attractor combinatorics and
+  factor-tensor precomputation (L2).
+- ``graphdyn.observe``     — observables: magnetization, consensus fraction,
+  Bethe free entropy, tilted entropy (L4).
+- ``graphdyn.models``      — solvers: SA-MCMC, HPr reinforced BP, BDCM entropy
+  λ-sweep (L5).
+- ``graphdyn.parallel``    — device-mesh sharding, psum ensemble reductions,
+  node-sharded dynamics for giant graphs.
+- ``graphdyn.utils``       — PRNG, IO (npz + orbax checkpoints), profiling.
+"""
+
+from graphdyn.graphs import (  # noqa: F401
+    Graph,
+    EdgeTables,
+    random_regular_graph,
+    erdos_renyi_graph,
+    graph_from_edges,
+    build_edge_tables,
+)
+from graphdyn.ops.dynamics import (  # noqa: F401
+    Rule,
+    TieBreak,
+    step_spins,
+    run_dynamics,
+    end_state,
+)
+from graphdyn.observe import magnetization, consensus_fraction  # noqa: F401
+from graphdyn.config import DynamicsConfig, SAConfig, HPRConfig, EntropyConfig  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,9 @@
+"""Native (C++) host-side components, loaded via ctypes.
+
+The reference has no native code (SURVEY.md §2: "Native components: NONE"),
+but graph construction at N=10⁶ is a real host-side bottleneck for the TPU
+pipeline, so the builder is implemented in C++ (``graphgen.cpp``) with a
+transparent numpy fallback when no toolchain is available.
+"""
+
+from graphdyn._native.build import native_available, native_random_regular, native_erdos_renyi  # noqa: F401

@@ -1,0 +1,15 @@
+"""Build/load shim for the C++ graph builder (filled in by milestone M9)."""
+
+from __future__ import annotations
+
+
+def native_available() -> bool:
+    return False
+
+
+def native_random_regular(n: int, d: int, seed):
+    raise NotImplementedError("native graph builder not built yet; use method='pairing'")
+
+
+def native_erdos_renyi(n: int, p: float, seed):
+    raise NotImplementedError("native graph builder not built yet; use method='numpy'")

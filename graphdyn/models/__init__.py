@@ -1,0 +1,2 @@
+"""L5 solvers ("model families"): SA-MCMC initialization search, HPr
+reinforced BP, BDCM entropy λ-sweep."""

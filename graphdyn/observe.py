@@ -1,0 +1,49 @@
+"""L4 observables: magnetization, consensus, entropy functionals, throughput.
+
+The observable set preserved from the reference (SURVEY.md §5.5): ``m``,
+``m_final``/consensus fraction, ``mag_reached``, ``num_steps``, Bethe free
+entropy ``φ``, BP mean initial magnetization ``m_init``, tilted entropy
+``s(m) = φ + λ·m`` (`ER_BDCM_entropy.ipynb:436`), per-graph stats. Mesh-wide
+variants reduce with ``lax.psum`` (see ``graphdyn.parallel``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def magnetization(s) -> jnp.ndarray:
+    """m(s) = Σ s_i / n (`SA_RRG.py:39-40`); works on batched spins
+    (reduces the trailing axis)."""
+    s = jnp.asarray(s)
+    return jnp.mean(s.astype(jnp.float32), axis=-1)
+
+
+def consensus_fraction(s_end, target: int = 1) -> jnp.ndarray:
+    """Fraction of replicas whose end state is the homogeneous ``target``
+    consensus (``target`` matches ``DynamicsConfig.attr_value``).
+
+    ``s_end``: int[..., n]; reduces the trailing (node) axis to a bool per
+    replica, then averages the leading axes.
+    """
+    s_end = jnp.asarray(s_end)
+    reached = jnp.all(s_end == target, axis=-1)
+    return jnp.mean(reached.astype(jnp.float32))
+
+
+def consensus_fraction_psum(s_end, axis_name: str, target: int = 1) -> jnp.ndarray:
+    """Mesh-wide consensus fraction: mean over the local batch, then
+    ``lax.pmean`` over the named mesh axis (ICI collective)."""
+    local = consensus_fraction(s_end, target)
+    return lax.pmean(local, axis_name)
+
+
+def tilted_entropy(phi, lmbd, m_init) -> jnp.ndarray:
+    """Legendre transform s(m_init) = φ + λ·m_init (`ipynb:436`)."""
+    return phi + lmbd * m_init
+
+
+def spin_updates_per_sec(n_spins: int, n_replicas: int, steps: int, seconds: float) -> float:
+    """The BASELINE.json headline metric: spin-updates/sec/chip."""
+    return n_spins * n_replicas * steps / seconds

@@ -1,0 +1,10 @@
+"""L3 kernels: synchronous spin dynamics, BDCM message passing, Pallas TPU
+kernels."""
+
+from graphdyn.ops.dynamics import (  # noqa: F401
+    Rule,
+    TieBreak,
+    step_spins,
+    run_dynamics,
+    end_state,
+)

@@ -1,0 +1,147 @@
+"""Synchronous spin dynamics on graphs — the L3 hot kernel.
+
+The reference implements the majority/always-stay step three times
+(`SA_RRG.py:18-20`, `HPR_pytorch_RRG.py:169-171`, degree-grouped as
+``np.sign(2*sums + s)`` at `ER_BDCM_entropy.ipynb:113-117`) and sketches
+minority / always-change variants in comments (`HPR_pytorch_RRG.py:22,25`,
+`ipynb:70,74`). Here the rule axis is explicit and closed-form:
+
+    out = R * sign(2 * Σ_{j∈∂i} s_j + C * s_i)
+
+with ``R = -1`` for minority dynamics (else ``+1``) and
+``C = R * (+1 for tie→stay, -1 for tie→change)``. The ``2Σ + C·s`` trick folds
+tie-breaking into a single integer sign, so one fused gather→sum→sign XLA
+program covers every (rule, tie) pair and every degree sequence (ghost-padded
+neighbor rows contribute 0). Equivalence with the reference's
+``(1-|sign Σ|)·s + sign Σ`` form is covered by tests.
+
+Spins are int8 on device (HBM-bandwidth-bound workload: 1 byte/spin), neighbor
+sums int32. All functions are jit/vmap-friendly: static shapes, `lax` control
+flow only.
+"""
+
+from __future__ import annotations
+
+import enum
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class Rule(str, enum.Enum):
+    MAJORITY = "majority"
+    MINORITY = "minority"
+
+
+class TieBreak(str, enum.Enum):
+    STAY = "stay"
+    CHANGE = "change"
+
+
+def rule_coefficients(rule: Rule | str, tie: TieBreak | str) -> tuple[int, int]:
+    """(R, C) such that one step is ``R * sign(2*sums + C*s)``."""
+    rule = Rule(rule)
+    tie = TieBreak(tie)
+    R = -1 if rule == Rule.MINORITY else 1
+    C = R * (1 if tie == TieBreak.STAY else -1)
+    return R, C
+
+
+def neighbor_sums(nbr: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
+    """Σ_{j∈∂i} s_j via the ghost-padded gather. ``s``: int8[n] (±1),
+    ``nbr``: int32[n, dmax] padded with n. Returns int32[n]."""
+    s_ext = jnp.concatenate([s.astype(jnp.int32), jnp.zeros((1,), jnp.int32)])
+    return jnp.sum(jnp.take(s_ext, nbr, axis=0), axis=1)
+
+
+def step_spins(
+    nbr: jnp.ndarray,
+    s: jnp.ndarray,
+    rule: Rule | str = Rule.MAJORITY,
+    tie: TieBreak | str = TieBreak.STAY,
+) -> jnp.ndarray:
+    """One synchronous update. Exact integer arithmetic, any degree."""
+    R, C = rule_coefficients(rule, tie)
+    sums = neighbor_sums(nbr, s)
+    t = 2 * sums + C * s.astype(jnp.int32)
+    return (R * jnp.sign(t)).astype(s.dtype)
+
+
+@partial(jax.jit, static_argnames=("steps", "rule", "tie"))
+def _run_jax(nbr, s0, steps: int, rule: str, tie: str):
+    if steps <= 0:
+        return s0
+
+    def body(_, s):
+        return step_spins(nbr, s, rule, tie)
+
+    return lax.fori_loop(0, steps, body, s0)
+
+
+def _run_numpy(nbr, s0, steps, rule, tie):
+    R, C = rule_coefficients(rule, tie)
+    nbr = np.asarray(nbr)
+    s = np.asarray(s0).astype(np.int64)
+    s_ext = np.zeros(nbr.shape[0] + 1, dtype=np.int64)
+    for _ in range(steps):
+        s_ext[:-1] = s
+        sums = s_ext[nbr].sum(axis=1)
+        s = R * np.sign(2 * sums + C * s)
+    return s.astype(np.asarray(s0).dtype)
+
+
+def _run_torch(nbr, s0, steps, rule, tie):
+    import torch
+
+    R, C = rule_coefficients(rule, tie)
+    nbr_t = torch.as_tensor(np.asarray(nbr), dtype=torch.long)
+    s = torch.as_tensor(np.asarray(s0), dtype=torch.long)
+    s_ext = torch.zeros(nbr_t.shape[0] + 1, dtype=torch.long)
+    for _ in range(steps):
+        s_ext[:-1] = s
+        sums = s_ext[nbr_t].sum(dim=1)
+        s = R * torch.sign(2 * sums + C * s)
+    return s.numpy().astype(np.asarray(s0).dtype)
+
+
+def run_dynamics(
+    graph,
+    init_spins,
+    steps: int,
+    rule: Rule | str = Rule.MAJORITY,
+    tie: TieBreak | str = TieBreak.STAY,
+    backend: str = "jax_tpu",
+):
+    """The BASELINE.json entry point: roll ``steps`` synchronous updates.
+
+    ``graph`` is a ``graphdyn.Graph`` or a raw neighbor table; ``backend`` is
+    one of ``{'cpu', 'torch', 'jax_tpu', 'jax'}`` — 'cpu' is the numpy parity
+    oracle, 'torch' the torch oracle; both JAX names dispatch to the jitted
+    path on whatever devices JAX sees.
+    """
+    nbr = graph.nbr if hasattr(graph, "nbr") else graph
+    rule, tie = Rule(rule).value, TieBreak(tie).value
+    if backend == "cpu":
+        return _run_numpy(nbr, init_spins, steps, rule, tie)
+    if backend == "torch":
+        return _run_torch(nbr, init_spins, steps, rule, tie)
+    if backend in ("jax", "jax_tpu"):
+        return _run_jax(jnp.asarray(nbr), jnp.asarray(init_spins), steps, rule, tie)
+    raise ValueError(f"unknown backend {backend!r}")
+
+
+def end_state(
+    graph,
+    s0,
+    p: int,
+    c: int,
+    rule: Rule | str = Rule.MAJORITY,
+    tie: TieBreak | str = TieBreak.STAY,
+    backend: str = "jax_tpu",
+):
+    """``s_endstate``: p+c-1 synchronous steps (`SA_RRG.py:23-26`)."""
+    return run_dynamics(graph, s0, p + c - 1, rule, tie, backend)

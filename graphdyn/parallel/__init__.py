@@ -1,0 +1,4 @@
+"""Device-mesh parallelism: replica/temperature sharding, psum ensemble
+reductions, node-sharded dynamics for giant graphs."""
+
+from graphdyn.parallel.mesh import make_mesh, replicate, shard_batch  # noqa: F401

@@ -1,0 +1,34 @@
+"""Mesh construction and sharding helpers.
+
+The reference is single-process single-device (SURVEY.md §5.8); here the
+replica × temperature ensemble axes shard over a ``jax.sharding.Mesh`` and
+observables reduce over ICI with psum/pmean. Works identically on real TPU
+meshes and on CPU-simulated meshes (``--xla_force_host_platform_device_count``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(shape: tuple[int, ...] | None = None, axis_names: tuple[str, ...] = ("replica",)) -> Mesh:
+    """Build a mesh over all visible devices. Default: 1-D 'replica' axis."""
+    devices = np.array(jax.devices())
+    if shape is None:
+        shape = (devices.size,) + (1,) * (len(axis_names) - 1)
+    return Mesh(devices.reshape(shape), axis_names)
+
+
+def shard_batch(mesh: Mesh, x, axis: str = "replica"):
+    """Place array with its leading axis sharded over ``axis``."""
+    spec = P(axis, *([None] * (np.ndim(x) - 1)))
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def replicate(mesh: Mesh, x):
+    """Place array fully replicated over the mesh."""
+    spec = P(*([None] * np.ndim(x)))
+    return jax.device_put(x, NamedSharding(mesh, spec))

@@ -1,0 +1,1 @@
+"""Utilities: PRNG helpers, IO (npz + checkpoints), profiling hooks."""

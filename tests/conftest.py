@@ -1,0 +1,17 @@
+"""Test harness: force CPU JAX with an 8-device simulated mesh (SURVEY.md §4.4
+— the TPU-native analogue of a fake backend). Must run before jax imports."""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

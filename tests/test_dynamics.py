@@ -1,0 +1,101 @@
+"""Dynamics-kernel tests: reference-formula equivalence, rule registry,
+backend parity (SURVEY.md §4 items 1-2)."""
+
+import numpy as np
+import pytest
+
+from graphdyn.graphs import erdos_renyi_graph, random_regular_graph
+from graphdyn.ops.dynamics import end_state, run_dynamics, step_spins
+
+
+def reference_majority_stay(nbr_regular, s):
+    """The reference's exact formula, valid for regular graphs
+    (`SA_RRG.py:18-20`): (1-|sign Σ|)·s + sign Σ."""
+    sums = np.sum(s[nbr_regular], axis=1)
+    return ((1 - np.abs(np.sign(sums))) * s + np.sign(sums)).astype(s.dtype)
+
+
+def brute_force_step(g, s, rule, tie):
+    """Direct per-node semantics: rule applied to the neighbor sum with an
+    explicit tie branch."""
+    out = np.empty_like(s)
+    for i in range(g.n):
+        nbrs = g.nbr[i][g.nbr[i] != g.n]
+        tot = int(s[nbrs].sum())
+        if tot != 0:
+            val = np.sign(tot)
+            if rule == "minority":
+                val = -val
+        else:
+            val = s[i] if tie == "stay" else -s[i]
+        out[i] = val
+    return out
+
+
+@pytest.mark.parametrize("rule", ["majority", "minority"])
+@pytest.mark.parametrize("tie", ["stay", "change"])
+def test_rule_registry_matches_brute_force(rule, tie, rng):
+    g = erdos_renyi_graph(120, 3.0 / 119, seed=21)
+    s = rng.choice(np.array([-1, 1], dtype=np.int8), size=g.n)
+    got = np.asarray(step_spins(g.nbr, s, rule, tie))
+    want = brute_force_step(g, s, rule, tie)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_matches_reference_formula_on_rrg(rng):
+    g = random_regular_graph(300, 4, seed=5)
+    s = rng.choice(np.array([-1, 1], dtype=np.int64), size=g.n)
+    # reference formula needs the unpadded table (regular: no ghosts)
+    assert np.all(g.nbr < g.n)
+    want = reference_majority_stay(g.nbr, s)
+    got = np.asarray(step_spins(g.nbr, s.astype(np.int8)))
+    np.testing.assert_array_equal(got, want.astype(np.int8))
+
+
+def test_degree_grouped_form_equivalence(rng):
+    """sign(2Σ + s) (notebook, `ipynb:113-117`) == gather form, incl.
+    isolated nodes."""
+    g = erdos_renyi_graph(200, 1.0 / 199, seed=8)
+    s = rng.choice(np.array([-1, 1], dtype=np.int8), size=g.n)
+    got = np.asarray(step_spins(g.nbr, s))
+    s_ext = np.concatenate([s.astype(np.int64), [0]])
+    sums = s_ext[g.nbr].sum(axis=1)
+    want = np.sign(2 * sums + s).astype(np.int8)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("backend", ["cpu", "torch", "jax"])
+def test_backend_parity(backend, rng):
+    g = random_regular_graph(500, 3, seed=13)
+    s = rng.choice(np.array([-1, 1], dtype=np.int8), size=g.n)
+    ref = run_dynamics(g, s, 7, backend="cpu")
+    got = np.asarray(run_dynamics(g, s, 7, backend=backend))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_end_state_reaches_consensus_from_near_consensus(rng):
+    g = random_regular_graph(400, 5, seed=17)
+    s = np.ones(g.n, dtype=np.int8)
+    flip = rng.choice(g.n, size=5, replace=False)
+    s[flip] = -1
+    out = np.asarray(end_state(g, s, p=3, c=1))
+    assert np.all(out == 1)
+
+
+def test_all_plus_is_fixed_point():
+    g = random_regular_graph(100, 3, seed=23)
+    s = np.ones(g.n, dtype=np.int8)
+    np.testing.assert_array_equal(np.asarray(run_dynamics(g, s, 4)), s)
+
+
+def test_vmap_over_replicas(rng):
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    g = random_regular_graph(150, 4, seed=3)
+    S = rng.choice(np.array([-1, 1], dtype=np.int8), size=(8, g.n))
+    step = jax.vmap(partial(step_spins, jnp.asarray(g.nbr)))
+    got = np.asarray(step(jnp.asarray(S)))
+    for r in range(8):
+        np.testing.assert_array_equal(got[r], np.asarray(step_spins(g.nbr, S[r])))

@@ -1,0 +1,145 @@
+"""Graph-layer tests: ensemble validity, table consistency."""
+
+import numpy as np
+import pytest
+
+from graphdyn.graphs import (
+    Graph,
+    build_edge_tables,
+    degree_classes,
+    erdos_renyi_graph,
+    graph_from_edges,
+    random_regular_graph,
+    remove_isolates,
+)
+
+
+def _assert_simple(g: Graph):
+    e = g.edges
+    assert np.all(e[:, 0] != e[:, 1]), "self-loop"
+    code = np.minimum(e[:, 0], e[:, 1]) * g.n + np.maximum(e[:, 0], e[:, 1])
+    assert np.unique(code).size == code.size, "multi-edge"
+
+
+@pytest.mark.parametrize("n,d", [(10, 3), (100, 4), (501, 2), (2000, 5)])
+def test_rrg_is_simple_and_regular(n, d):
+    g = random_regular_graph(n, d, seed=7)
+    assert g.n == n
+    assert np.all(g.deg == d)
+    _assert_simple(g)
+    assert g.num_edges == n * d // 2
+
+
+def test_rrg_matches_networkx_degree_structure():
+    g = random_regular_graph(60, 3, seed=0, method="networkx")
+    assert np.all(g.deg == 3)
+    _assert_simple(g)
+
+
+def test_er_mean_degree():
+    n, mean_deg = 4000, 3.0
+    g = erdos_renyi_graph(n, mean_deg / (n - 1), seed=3)
+    _assert_simple(g)
+    assert abs(g.deg.mean() - mean_deg) < 0.3
+
+
+def test_er_networkx_backend():
+    g = erdos_renyi_graph(300, 2.0 / 299, seed=5, method="networkx")
+    _assert_simple(g)
+
+
+def test_neighbor_table_round_trip():
+    edges = np.array([[0, 1], [1, 2], [2, 0], [2, 3]])
+    g = graph_from_edges(5, edges)
+    assert g.n == 5
+    assert list(g.deg) == [2, 2, 3, 1, 0]
+    # ghost-padded rows
+    assert g.nbr.shape == (5, 3)
+    assert set(g.nbr[2]) == {0, 1, 3}
+    assert g.nbr[3, 0] == 2 and g.nbr[3, 1] == 5 and g.nbr[3, 2] == 5
+    assert np.all(g.nbr[4] == 5)
+
+
+def test_edge_tables_consistency():
+    g = random_regular_graph(40, 4, seed=11)
+    t = build_edge_tables(g)
+    E = g.num_edges
+    assert t.src.shape == (2 * E,)
+    # reverse convention
+    np.testing.assert_array_equal(t.src[:E], t.dst[E:])
+    np.testing.assert_array_equal(t.dst[:E], t.src[E:])
+    ghost = 2 * E
+    for e in range(2 * E):
+        i, j = t.src[e], t.dst[e]
+        rows = t.in_edges[e]
+        real = rows[rows != ghost]
+        assert real.size == t.edge_deg[e] == g.deg[i] - 1
+        for k_e in real:
+            assert t.dst[k_e] == i, "incoming message must end at src"
+            assert t.src[k_e] != j, "must exclude the reverse edge"
+        # distinct sources
+        assert np.unique(t.src[real]).size == real.size
+
+
+def test_node_edge_tables():
+    g = erdos_renyi_graph(200, 2.5 / 199, seed=9)
+    t = build_edge_tables(g)
+    ghost = 2 * g.num_edges
+    for i in range(g.n):
+        ins = t.node_in_edges[i]
+        ins = ins[ins != ghost]
+        outs = t.node_out_edges[i]
+        outs = outs[outs != ghost]
+        assert ins.size == outs.size == g.deg[i]
+        assert np.all(t.dst[ins] == i)
+        assert np.all(t.src[outs] == i)
+
+
+def test_degree_classes_partition():
+    g = erdos_renyi_graph(500, 2.0 / 499, seed=2)
+    t = build_edge_tables(g)
+    classes = degree_classes(t.edge_deg)
+    total = sum(v.size for v in classes.values())
+    assert total == 2 * g.num_edges
+    for d, idx in classes.items():
+        assert np.all(t.edge_deg[idx] == d)
+
+
+def test_remove_isolates():
+    edges = np.array([[0, 2], [2, 4]])
+    g = graph_from_edges(6, edges)
+    sub, n_iso = remove_isolates(g)
+    assert n_iso == 3
+    assert sub.n == 3
+    assert sub.num_edges == 2
+    assert sorted(sub.deg.tolist()) == [1, 1, 2]
+
+
+@pytest.mark.parametrize("n,d", [(6, 5), (10, 8), (20, 15), (9, 6)])
+def test_rrg_dense_degrees(n, d):
+    g = random_regular_graph(n, d, seed=1)
+    assert np.all(g.deg == d)
+    _assert_simple(g)
+
+
+def test_er_dense_p():
+    g = erdos_renyi_graph(300, 0.999, seed=4)
+    _assert_simple(g)
+    assert g.num_edges > 0.99 * 300 * 299 / 2
+    g2 = erdos_renyi_graph(50, 1.0, seed=4)
+    assert g2.num_edges == 50 * 49 // 2
+    g3 = erdos_renyi_graph(50, 0.0, seed=4)
+    assert g3.num_edges == 0
+
+
+def test_graph_from_edges_dmax_validation():
+    with pytest.raises(ValueError, match="dmax"):
+        graph_from_edges(4, np.array([[0, 1], [0, 2], [0, 3]]), dmax=2)
+
+
+def test_consensus_fraction_target():
+    from graphdyn.observe import consensus_fraction
+
+    s = -np.ones((4, 10), dtype=np.int8)
+    assert float(consensus_fraction(s)) == 0.0
+    assert float(consensus_fraction(s, target=-1)) == 1.0
