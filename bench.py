@@ -1,0 +1,98 @@
+#!/usr/bin/env python3
+"""Headline benchmark: spin-updates/sec/chip on d=3 RRG (BASELINE.json).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is the speedup over the reference-style torch-CPU dynamics
+kernel (`HPR_pytorch_RRG.py:169-171` semantics) measured on this host.
+
+Usage: python bench.py [--smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def tpu_rate(nbr, n, R, steps, iters=3):
+    import jax
+    import jax.numpy as jnp
+
+    from graphdyn.ops.dynamics import batched_rollout_impl, rule_coefficients
+
+    R_coef, C_coef = rule_coefficients("majority", "stay")
+    nbr_dev = jnp.asarray(nbr)
+
+    @jax.jit
+    def roll(s):
+        # the shipped hot kernel — bench measures the real code path
+        return batched_rollout_impl(nbr_dev, s, steps, R_coef, C_coef)
+
+    rng = np.random.default_rng(0)
+    s = jnp.asarray((2 * rng.integers(0, 2, size=(R, n)) - 1).astype(np.int8))
+    jax.block_until_ready(roll(s))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        s = roll(s)
+    jax.block_until_ready(s)
+    dt = time.perf_counter() - t0
+    return n * R * steps * iters / dt
+
+
+def torch_cpu_rate(nbr, n, steps=3):
+    import torch
+
+    nbr_t = torch.as_tensor(nbr.astype(np.int64))
+    rng = np.random.default_rng(0)
+    s = torch.as_tensor((2 * rng.integers(0, 2, size=n) - 1).astype(np.int64))
+    # warm
+    sums = torch.sum(s[nbr_t], dim=1)
+    _ = (1 - torch.abs(torch.sign(sums))) * s + torch.sign(sums)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        sums = torch.sum(s[nbr_t], dim=1)
+        s = (1 - torch.abs(torch.sign(sums))) * s + torch.sign(sums)
+    dt = time.perf_counter() - t0
+    return n * steps / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="small shapes, fast")
+    ap.add_argument("--replicas", type=int, default=None)
+    ap.add_argument("--steps", type=int, default=None)
+    args = ap.parse_args()
+
+    from graphdyn.graphs import random_regular_graph
+
+    if args.smoke:
+        n, R, steps = 100_000, 8, 5
+    else:
+        n, R, steps = 1_000_000, 64, 20
+    R = args.replicas or R
+    steps = args.steps or steps
+
+    g = random_regular_graph(n, 3, seed=0)
+    nbr = np.asarray(g.nbr)
+
+    value = tpu_rate(nbr, n, R, steps)
+    base = torch_cpu_rate(nbr, n)
+    print(
+        json.dumps(
+            {
+                "metric": "spin_updates_per_sec_per_chip_d3_rrg_n%d" % n,
+                "value": value,
+                "unit": "spin-updates/s",
+                "vs_baseline": value / base,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -90,6 +90,8 @@ class EdgeTables(NamedTuple):
 
     def rev(self, e: np.ndarray) -> np.ndarray:
         E = self.num_edges
+        if E == 0:
+            return np.asarray(e)
         return (e + E) % (2 * E)
 
 
@@ -99,6 +101,11 @@ class EdgeTables(NamedTuple):
 
 
 def _directed_endpoints(n: int, edges: np.ndarray):
+    if edges.size and (edges.min() < 0 or edges.max() >= n):
+        raise ValueError(
+            f"edge endpoints must be in [0, {n}); got range "
+            f"[{edges.min()}, {edges.max()}]"
+        )
     u, v = edges[:, 0], edges[:, 1]
     src = np.concatenate([u, v]).astype(np.int64)
     dst = np.concatenate([v, u]).astype(np.int64)

@@ -1,0 +1,341 @@
+"""Simulated-annealing search for strategic initializations (L5 solver).
+
+Reproduces the semantics of the reference SA chain (`SA_RRG.py:58-88`):
+Metropolis over single-spin flips of the *initial* configuration, energy
+``E = (a·Σs(0) − b·Σs(end))/n``, per-step annealing ``a ← par_a·a`` capped at
+``a_cap`` (cap checked *before* the multiply, as at `SA_RRG.py:80-81`), stop
+when the rolled-out end state hits all-+1, timeout after ``max_steps`` with the
+sentinel ``m_final = 2`` (`SA_RRG.py:84`).
+
+TPU-first redesign (SURVEY.md §3.1 "hot loop"):
+
+- The reference performs **three** full (p+c−1)-step rollouts per MCMC step
+  (`E_delta` twice at `SA_RRG.py:33,36`, stop test at `:85`). Here the
+  end-state sum of the *current* configuration is carried in the loop state, so
+  each step costs exactly **one** rollout (of the flipped candidate) — a 3×
+  algorithmic win before any hardware speedup.
+- Replicas (and temperature-ladder points) are a batched leading axis: the
+  rollout is one ``[R, n, d]`` gather+sum per dynamics step, masked per-replica
+  so finished chains stop changing while the batch runs to completion
+  (`lax.while_loop`, no host round-trips).
+- Two randomness modes: native JAX PRNG (``fold_in`` per step), or injected
+  proposal/uniform streams — common random numbers for bit-parity tests
+  against the numpy oracle (SURVEY.md §4.2).
+
+Acceptance arithmetic is float32 by default (`dtype` arg); the numpy oracle
+mirrors the same dtype so chains are bit-identical under shared streams.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from graphdyn.config import SAConfig
+from graphdyn.ops.dynamics import rule_coefficients
+
+
+class SAResult(NamedTuple):
+    """Per-replica results, mirroring the reference's result arrays
+    (`SA_RRG.py:53-56,86-88`)."""
+
+    s: np.ndarray            # int8[R, n] — configuration at stop
+    mag_reached: np.ndarray  # f32[R] — m(s(0)) at stop (`SA_RRG.py:86`)
+    num_steps: np.ndarray    # int64[R] — MCMC steps taken (`:87`)
+    m_final: np.ndarray      # f32[R] — 1.0 on success, 2.0 sentinel on timeout
+
+
+class _SAState(NamedTuple):
+    s: jnp.ndarray         # int8[R, n]
+    sum_end: jnp.ndarray   # int32[R]
+    a: jnp.ndarray         # f[R]
+    b: jnp.ndarray         # f[R]
+    t: jnp.ndarray         # int64[R]
+    m_final: jnp.ndarray   # f[R]
+    active: jnp.ndarray    # bool[R]
+    key: jnp.ndarray       # PRNG key per replica [R]
+
+
+def _batched_end_sum(nbr, s, steps: int, R_coef: int, C_coef: int):
+    """Σ_i s_endstate(s)_i for a batch of spin configurations, via the shared
+    hot kernel :func:`graphdyn.ops.dynamics.batched_rollout_impl`."""
+    from graphdyn.ops.dynamics import batched_rollout_impl
+
+    s_end = batched_rollout_impl(nbr, s, steps, R_coef, C_coef)
+    return s_end.astype(jnp.int32).sum(axis=1)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "rollout_steps", "R_coef", "C_coef", "max_steps", "injected", "stream_len"
+    ),
+)
+def _sa_run(
+    nbr,
+    s0,
+    key0,
+    a0,
+    b0,
+    par_a,
+    par_b,
+    a_cap,
+    b_cap,
+    proposals,
+    uniforms,
+    *,
+    rollout_steps: int,
+    R_coef: int,
+    C_coef: int,
+    max_steps: int,
+    injected: bool,
+    stream_len: int,
+):
+    R, n = s0.shape
+    dt = a0.dtype
+    sum_end0 = _batched_end_sum(nbr, s0, rollout_steps, R_coef, C_coef)
+    m0 = sum_end0.astype(dt) / n
+    state = _SAState(
+        s=s0,
+        sum_end=sum_end0,
+        a=a0,
+        b=b0,
+        t=jnp.zeros((R,), jnp.int32),
+        m_final=m0,
+        active=m0 < 1.0,
+        key=key0,
+    )
+
+    def cond(st: _SAState):
+        return jnp.any(st.active)
+
+    def body(st: _SAState):
+        if injected:
+            tt = jnp.minimum(st.t, stream_len - 1).astype(jnp.int32)
+            i = jnp.take_along_axis(proposals, tt[:, None], axis=1)[:, 0]
+            u = jnp.take_along_axis(uniforms, tt[:, None], axis=1)[:, 0].astype(dt)
+            key = st.key
+        else:
+            step_keys = jax.vmap(jax.random.fold_in)(st.key, st.t.astype(jnp.uint32))
+            ki, ku = jnp.split(jax.vmap(jax.random.split)(step_keys), 2, axis=1)
+            i = jax.vmap(lambda k: jax.random.randint(k[0], (), 0, n))(ki)
+            u = jax.vmap(lambda k: jax.random.uniform(k[0], (), dt))(ku)
+            key = st.key
+
+        ridx = jnp.arange(R)
+        s_i = st.s[ridx, i].astype(jnp.int32)
+        s_flip = st.s.at[ridx, i].set((-s_i).astype(jnp.int8))
+        sum_end_flip = _batched_end_sum(nbr, s_flip, rollout_steps, R_coef, C_coef)
+
+        # ΔH = (−2a·s_i(0) + b·(Σs_end − Σs_end_flip))/n  (`SA_RRG.py:32-37`)
+        delta_H = (
+            -2.0 * st.a * s_i.astype(dt)
+            + st.b * (st.sum_end - sum_end_flip).astype(dt)
+        ) / n
+        accept = u < jnp.exp(-delta_H)
+
+        do = st.active & accept
+        s_new = jnp.where(do[:, None], s_flip, st.s)
+        sum_end_new = jnp.where(do, sum_end_flip, st.sum_end)
+
+        # anneal (cap checked before multiply, `SA_RRG.py:80-81`)
+        a_new = jnp.where(st.a < a_cap, st.a * par_a, st.a)
+        b_new = jnp.where(st.b < b_cap, st.b * par_b, st.b)
+        a_new = jnp.where(st.active, a_new, st.a)
+        b_new = jnp.where(st.active, b_new, st.b)
+
+        t_new = jnp.where(st.active, st.t + 1, st.t)
+        timeout = t_new > max_steps
+        m_new = jnp.where(
+            timeout, jnp.asarray(2.0, dt), sum_end_new.astype(dt) / n
+        )
+        m_final = jnp.where(st.active, m_new, st.m_final)
+        active = st.active & (m_final < 1.0) & ~timeout
+
+        return _SAState(s_new, sum_end_new, a_new, b_new, t_new, m_final, active, key)
+
+    out = lax.while_loop(cond, body, state)
+    mag = out.s.astype(dt).sum(axis=1) / n
+    return out.s, mag, out.t, out.m_final
+
+
+def simulated_annealing(
+    graph,
+    config: SAConfig | None = None,
+    *,
+    n_replicas: int | None = None,
+    seed: int | None = None,
+    s0: np.ndarray | None = None,
+    a0: np.ndarray | float | None = None,
+    b0: np.ndarray | float | None = None,
+    proposals: np.ndarray | None = None,
+    uniforms: np.ndarray | None = None,
+    max_steps: int | None = None,
+    dtype=jnp.float32,
+    backend: str = "jax_tpu",
+) -> SAResult:
+    """Run batched SA chains.
+
+    ``a0``/``b0`` may be per-replica arrays — that is the temperature-ladder
+    axis of BASELINE.json config 5. ``proposals``/``uniforms`` (``[R, L]``)
+    switch to injected-stream mode for parity testing. ``backend='cpu'`` runs
+    the numpy oracle.
+    """
+    config = config or SAConfig()
+    n = graph.n
+    dyn = config.dynamics
+    R_coef, C_coef = rule_coefficients(dyn.rule, dyn.tie)
+    rollout = dyn.p + dyn.c - 1
+
+    if seed is None:
+        seed = config.seed
+    if n_replicas is None:
+        n_replicas = config.n_replicas if s0 is None else np.shape(s0)[0]
+    R = n_replicas
+
+    rng = np.random.default_rng(seed)
+    if s0 is None:
+        s0 = (2 * rng.integers(0, 2, size=(R, n)) - 1).astype(np.int8)
+    s0 = np.asarray(s0, dtype=np.int8).reshape(R, n)
+
+    a0 = np.broadcast_to(
+        np.asarray(config.a0_frac * n if a0 is None else a0, dtype=np.float64), (R,)
+    )
+    b0 = np.broadcast_to(
+        np.asarray(config.b0_frac * n if b0 is None else b0, dtype=np.float64), (R,)
+    )
+    if max_steps is None:
+        max_steps = config.max_steps if config.max_steps is not None else 2 * n**3
+    # step counters are int32 on device when x64 is off; 2n³ at n=10⁴ (2·10¹²)
+    # is unreachable wall-clock anyway, so clamp the sentinel threshold
+    max_steps = min(int(max_steps), 2**31 - 2)
+
+    injected = proposals is not None
+    if injected:
+        proposals = np.asarray(proposals, dtype=np.int32).reshape(R, -1)
+        uniforms = np.asarray(uniforms, dtype=np.float64).reshape(R, -1)
+        stream_len = proposals.shape[1]
+        max_steps = min(max_steps, stream_len)
+    else:
+        stream_len = 1
+        proposals = np.zeros((R, 1), np.int32)
+        uniforms = np.zeros((R, 1), np.float64)
+
+    if backend == "cpu":
+        np_scalar = np.float32 if dtype == jnp.float32 else np.float64
+        return _sa_reference_numpy(
+            graph, config, s0, a0, b0, proposals if injected else None,
+            uniforms if injected else None, max_steps, np_scalar, seed,
+        )
+
+    np_dt = np.float32 if dtype == jnp.float32 else np.float64
+    keys = jax.vmap(jax.random.PRNGKey)(np.arange(R, dtype=np.uint32) + np.uint32(seed))
+    s, mag, t, m_final = _sa_run(
+        jnp.asarray(graph.nbr),
+        jnp.asarray(s0),
+        keys,
+        jnp.asarray(a0.astype(np_dt)),
+        jnp.asarray(b0.astype(np_dt)),
+        jnp.asarray(np_dt(config.par_a)),
+        jnp.asarray(np_dt(config.par_b)),
+        jnp.asarray(np_dt(config.a_cap_frac * n)),
+        jnp.asarray(np_dt(config.b_cap_frac * n)),
+        jnp.asarray(proposals),
+        jnp.asarray(uniforms.astype(np_dt)),
+        rollout_steps=rollout,
+        R_coef=R_coef,
+        C_coef=C_coef,
+        max_steps=int(max_steps),
+        injected=injected,
+        stream_len=stream_len,
+    )
+    return SAResult(
+        s=np.asarray(s),
+        mag_reached=np.asarray(mag),
+        num_steps=np.asarray(t),
+        m_final=np.asarray(m_final),
+    )
+
+
+def _sa_reference_numpy(
+    graph, config, s0, a0, b0, proposals, uniforms, max_steps, np_dt, seed
+) -> SAResult:
+    """Single-threaded numpy oracle with the reference's exact step structure
+    (three conceptual rollouts folded to one via the same end-sum cache; the
+    chain law is identical). Acceptance arithmetic in ``np_dt`` to match the
+    device path bit-for-bit under injected streams."""
+    from graphdyn.ops.dynamics import rule_coefficients
+
+    dyn = config.dynamics
+    R_coef, C_coef = rule_coefficients(dyn.rule, dyn.tie)
+    rollout = dyn.p + dyn.c - 1
+    nbr = np.asarray(graph.nbr)
+    n = graph.n
+    R = s0.shape[0]
+
+    def end_sum(s):
+        s_cur = s.astype(np.int64)
+        s_ext = np.zeros(n + 1, dtype=np.int64)
+        for _ in range(rollout):
+            s_ext[:-1] = s_cur
+            sums = s_ext[nbr].sum(axis=1)
+            s_cur = R_coef * np.sign(2 * sums + C_coef * s_cur)
+        return int(s_cur.sum())
+
+    rng = np.random.default_rng(seed)
+    out_s = np.empty_like(s0)
+    out_mag = np.empty(R, np.float64)
+    out_t = np.empty(R, np.int64)
+    out_m = np.empty(R, np.float64)
+
+    for r in range(R):
+        s = s0[r].copy()
+        a = np_dt(a0[r])
+        b = np_dt(b0[r])
+        par_a, par_b = np_dt(config.par_a), np_dt(config.par_b)
+        a_cap, b_cap = np_dt(config.a_cap_frac * n), np_dt(config.b_cap_frac * n)
+        t = 0
+        se = end_sum(s)
+        m_final = np_dt(se) / np_dt(n)
+        while m_final < 1:
+            if proposals is not None:
+                i = int(proposals[r, min(t, proposals.shape[1] - 1)])
+                u = np_dt(uniforms[r, min(t, uniforms.shape[1] - 1)])
+            else:
+                i = int(rng.integers(0, n))
+                u = np_dt(rng.random())
+            s_flip = s.copy()
+            s_flip[i] = -s[i]
+            se_flip = end_sum(s_flip)
+            delta_H = (
+                np_dt(-2.0) * a * np_dt(s[i]) + b * np_dt(se - se_flip)
+            ) / np_dt(n)
+            if u < np.exp(-delta_H):
+                s = s_flip
+                se = se_flip
+            if a < a_cap:
+                a = a * par_a
+            if b < b_cap:
+                b = b * par_b
+            t += 1
+            if t > max_steps:
+                m_final = np_dt(2.0)
+            else:
+                m_final = np_dt(se) / np_dt(n)
+        out_s[r] = s
+        out_mag[r] = s.astype(np.float64).sum() / n
+        out_t[r] = t
+        out_m[r] = m_final
+
+    return SAResult(
+        s=out_s,
+        mag_reached=out_mag.astype(np_dt),
+        num_steps=out_t,
+        m_final=out_m.astype(np_dt),
+    )

@@ -1,4 +1,4 @@
 """Device-mesh parallelism: replica/temperature sharding, psum ensemble
 reductions, node-sharded dynamics for giant graphs."""
 
-from graphdyn.parallel.mesh import make_mesh, replicate, shard_batch  # noqa: F401
+from graphdyn.parallel.mesh import make_mesh, device_pool, replicate, shard_batch  # noqa: F401

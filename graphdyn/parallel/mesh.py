@@ -14,12 +14,34 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def make_mesh(shape: tuple[int, ...] | None = None, axis_names: tuple[str, ...] = ("replica",)) -> Mesh:
-    """Build a mesh over all visible devices. Default: 1-D 'replica' axis."""
-    devices = np.array(jax.devices())
+def make_mesh(
+    shape: tuple[int, ...] | None = None,
+    axis_names: tuple[str, ...] = ("replica",),
+    devices=None,
+) -> Mesh:
+    """Build a mesh over ``devices`` (default: all visible). 1-D 'replica'
+    axis by default."""
+    devices = np.array(jax.devices() if devices is None else devices)
     if shape is None:
         shape = (devices.size,) + (1,) * (len(axis_names) - 1)
-    return Mesh(devices.reshape(shape), axis_names)
+    need = int(np.prod(shape))
+    return Mesh(devices[:need].reshape(shape), axis_names)
+
+
+def device_pool(n_devices: int):
+    """Return at least ``n_devices`` devices, preferring the default platform
+    and falling back to the (possibly simulated) CPU host platform — covers
+    environments where a plugin pins the default platform while multi-chip
+    tests run on ``--xla_force_host_platform_device_count`` CPU meshes."""
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        try:
+            devices = jax.devices("cpu")
+        except RuntimeError:
+            pass
+    if len(devices) < n_devices:
+        raise RuntimeError(f"need {n_devices} devices, have {len(devices)}")
+    return devices[:n_devices]
 
 
 def shard_batch(mesh: Mesh, x, axis: str = "replica"):

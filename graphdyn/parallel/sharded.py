@@ -1,0 +1,220 @@
+"""Sharded execution: replica-parallel + node-parallel dynamics over a Mesh.
+
+The reference's "replica axis" is a host for-loop (`SA_RRG.py:58`,
+`HPR_pytorch_RRG.py:259`); its graphs never leave one device. Here the
+ensemble axes (replicas × temperatures) shard over the mesh's ``'replica'``
+axis (embarrassingly parallel, psum/pmean for ensemble observables), and for
+giant single graphs (N=10⁶, BASELINE config 5) the **node axis** shards too:
+each device owns a contiguous node block plus that block's neighbor-table
+rows; one ``all_gather`` of the int8 spin vector (1 MB at N=10⁶ — cheap on
+ICI) per synchronous step replaces any halo bookkeeping.
+
+All collectives are XLA (`all_gather`/`pmean` over named mesh axes inside
+``shard_map``), so the same code runs on a real TPU pod slice or a CPU
+simulated mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from graphdyn.ops.dynamics import rule_coefficients
+
+
+def pad_nodes(graph, n_shards: int):
+    """Pad the node axis to a multiple of ``n_shards``.
+
+    Returns (nbr_padded, n_padded). Padding rows are all-ghost (degree 0), so
+    padded nodes are isolated spins that never change under tie→stay rules and
+    never influence real nodes (no edges point at them).
+    """
+    n, dmax = graph.n, graph.dmax
+    n_pad = (-n) % n_shards
+    nbr = graph.nbr.astype(np.int32)
+    if n_pad:
+        ghost_rows = np.full((n_pad, dmax), n, dtype=np.int32)
+        nbr = np.concatenate([nbr, ghost_rows], axis=0)
+    # ghost index stays n (the zero slot of the extended spin vector); real
+    # rows already use n as the pad, which remains correct after padding
+    return nbr, n + n_pad
+
+
+def _real_mask(node_axis: str, n_block: int, n_real: int):
+    """bool[n_block]: which rows of this shard's node block are real nodes
+    (contiguous blocks ⇒ global index = shard_idx·n_block + row)."""
+    node_idx = lax.axis_index(node_axis)
+    gidx = node_idx * n_block + jnp.arange(n_block)
+    return gidx < n_real
+
+
+def _local_step(nbr_local, s_full, s_local, real_mask, R_coef, C_coef):
+    """One synchronous update of a local node block given the fully gathered
+    spin vector. Padded rows are frozen (they have no edges, but under
+    tie→change they would otherwise oscillate — the mask keeps the pad
+    invariant for every rule). ``s_full``: int8[R, n_pad]; ``nbr_local``:
+    rows for this block with *global* neighbor indices; the ghost slot is
+    appended here."""
+    Rb = s_full.shape[0]
+    s_ext = jnp.concatenate(
+        [s_full.astype(jnp.int32), jnp.zeros((Rb, 1), jnp.int32)], axis=1
+    )
+    g = jnp.take(s_ext, nbr_local.reshape(-1), axis=1).reshape(
+        Rb, nbr_local.shape[0], nbr_local.shape[1]
+    )
+    sums = g.sum(axis=2)
+    out = (R_coef * jnp.sign(2 * sums + C_coef * s_local.astype(jnp.int32))).astype(
+        jnp.int8
+    )
+    return jnp.where(real_mask[None, :], out, s_local)
+
+
+def _masked_block_sum(s_local, real_mask):
+    """Pad-free Σ over this shard's block (padded rows excluded)."""
+    return jnp.where(real_mask[None, :], s_local.astype(jnp.int32), 0).sum(axis=1)
+
+
+def make_sharded_rollout(
+    mesh: Mesh,
+    n_real: int,
+    steps: int,
+    rule: str = "majority",
+    tie: str = "stay",
+    replica_axis: str = "replica",
+    node_axis: str = "node",
+):
+    """Build a jitted rollout ``f(nbr, s) -> s_end`` with replicas sharded over
+    ``replica_axis`` and nodes over ``node_axis``.
+
+    ``s``: int8[R, n_pad] with R divisible by the replica-axis size and n_pad
+    by the node-axis size; rows with global index ≥ ``n_real`` are padding and
+    stay frozen. The ghost slot for the spin gather is appended *after* the
+    all_gather inside each shard.
+    """
+    R_coef, C_coef = rule_coefficients(rule, tie)
+
+    def rollout(nbr_local, s_local):
+        # nbr_local: int32[n_pad/P, dmax]; s_local: int8[R/Q, n_pad/P]
+        mask = _real_mask(node_axis, s_local.shape[1], n_real)
+
+        def body(_, s_loc):
+            s_full = lax.all_gather(s_loc, node_axis, axis=1, tiled=True)
+            return _local_step(nbr_local, s_full, s_loc, mask, R_coef, C_coef)
+
+        return lax.fori_loop(0, steps, body, s_local)
+
+    f = shard_map(
+        rollout,
+        mesh=mesh,
+        in_specs=(P(node_axis, None), P(replica_axis, node_axis)),
+        out_specs=P(replica_axis, node_axis),
+        check_rep=False,
+    )
+    return jax.jit(f)
+
+
+def make_sharded_sa_step(
+    mesh: Mesh,
+    rollout_steps: int,
+    n_real: int,
+    rule: str = "majority",
+    tie: str = "stay",
+    replica_axis: str = "replica",
+    node_axis: str = "node",
+):
+    """Build the full SA training step over the mesh: per-replica proposal,
+    candidate rollout, Metropolis acceptance, annealing, plus a pmean'd
+    ensemble consensus fraction — BASELINE config 5's multi-chip psum path.
+
+    Returns jitted ``step(nbr, s, sum_end, a, b, key, t) ->
+    (s', sum_end', a', b', key', t', consensus_frac)`` with ``s`` sharded
+    ``P(replica, node)`` and scalars-per-replica sharded ``P(replica)``.
+    """
+    R_coef, C_coef = rule_coefficients(rule, tie)
+
+    def step(nbr_local, s_local, sum_end, a, b, key, t,
+             par_a, par_b, a_cap, b_cap):
+        Rl, n_block = s_local.shape
+        node_idx = lax.axis_index(node_axis)
+        mask = _real_mask(node_axis, n_block, n_real)
+
+        # one proposal per replica (global node index), same on every node shard
+        step_keys = jax.vmap(jax.random.fold_in)(key, t.astype(jnp.uint32))
+        pk = jax.vmap(jax.random.split)(step_keys)
+        i = jax.vmap(lambda k: jax.random.randint(k[0], (), 0, n_real))(pk)
+        u = jax.vmap(lambda k: jax.random.uniform(k[1], ()))(pk)
+
+        # flip spin i on the owning shard
+        local_i = i - node_idx * n_block
+        owned = (local_i >= 0) & (local_i < n_block)
+        li = jnp.clip(local_i, 0, n_block - 1)
+        ridx = jnp.arange(Rl)
+        s_i_local = s_local[ridx, li].astype(jnp.int32)
+        flipped = s_local.at[ridx, li].set((-s_i_local).astype(jnp.int8))
+        s_flip = jnp.where(owned[:, None], flipped, s_local)
+        # s_i of the proposed spin, broadcast to all shards
+        s_i = lax.psum(jnp.where(owned, s_i_local, 0), node_axis)
+
+        # candidate rollout (the single rollout per MCMC step; SURVEY §3.1)
+        def body(_, s_loc):
+            s_full = lax.all_gather(s_loc, node_axis, axis=1, tiled=True)
+            return _local_step(nbr_local, s_full, s_loc, mask, R_coef, C_coef)
+
+        s_end_flip = lax.fori_loop(0, rollout_steps, body, s_flip)
+        # pad-free sum: same basis as the caller-seeded sum_end and the
+        # `>= n_real` consensus test below
+        sum_end_flip = lax.psum(_masked_block_sum(s_end_flip, mask), node_axis)
+
+        delta_H = (-2.0 * a * s_i.astype(a.dtype)
+                   + b * (sum_end - sum_end_flip).astype(a.dtype)) / n_real
+        accept = u < jnp.exp(-delta_H)
+
+        s_new = jnp.where(accept[:, None], s_flip, s_local)
+        sum_end_new = jnp.where(accept, sum_end_flip, sum_end)
+        a_new = jnp.where(a < a_cap, a * par_a, a)
+        b_new = jnp.where(b < b_cap, b * par_b, b)
+
+        # ensemble observable over the whole mesh (ICI collective)
+        local_consensus = jnp.mean(
+            (sum_end_new >= n_real).astype(jnp.float32)
+        )
+        consensus = lax.pmean(lax.pmean(local_consensus, replica_axis), node_axis)
+
+        return s_new, sum_end_new, a_new, b_new, key, t + 1, consensus
+
+    f = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(
+            P(node_axis, None),            # nbr
+            P(replica_axis, node_axis),    # s
+            P(replica_axis),               # sum_end
+            P(replica_axis),               # a
+            P(replica_axis),               # b
+            P(replica_axis),               # key
+            P(replica_axis),               # t
+            P(), P(), P(), P(),            # scalars
+        ),
+        out_specs=(
+            P(replica_axis, node_axis),
+            P(replica_axis),
+            P(replica_axis),
+            P(replica_axis),
+            P(replica_axis),
+            P(replica_axis),
+            P(),
+        ),
+        check_rep=False,
+    )
+    return jax.jit(f)
+
+
+def place_sharded(mesh: Mesh, x, spec: P):
+    return jax.device_put(x, NamedSharding(mesh, spec))

@@ -6,7 +6,13 @@ import os
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+# a sitecustomize plugin may have pinned jax_platforms (e.g. 'axon,cpu');
+# force CPU-only so the suite is hermetic and the 8-device mesh is default
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

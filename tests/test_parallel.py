@@ -1,0 +1,91 @@
+"""Mesh-sharding tests on the 8-device simulated CPU mesh (SURVEY.md §4.4):
+sharded == unsharded, pad-basis correctness, psum observables."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from graphdyn.graphs import random_regular_graph
+from graphdyn.ops.dynamics import run_dynamics
+from graphdyn.parallel.mesh import device_pool, make_mesh
+from graphdyn.parallel.sharded import (
+    make_sharded_rollout,
+    make_sharded_sa_step,
+    pad_nodes,
+    place_sharded,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh((4, 2), ("replica", "node"), devices=device_pool(8))
+
+
+def _setup(n, d, R, node_shards=2, seed=0):
+    g = random_regular_graph(n, d, seed=seed)
+    nbr_pad, n_pad = pad_nodes(g, node_shards)
+    rng = np.random.default_rng(seed + 1)
+    s = np.ones((R, n_pad), dtype=np.int8)
+    s[:, : g.n] = (2 * rng.integers(0, 2, size=(R, g.n)) - 1).astype(np.int8)
+    return g, nbr_pad, n_pad, s
+
+
+@pytest.mark.parametrize("n", [256, 253])  # 253: n not divisible by shards
+def test_sharded_rollout_matches_unsharded(mesh, n):
+    g, nbr_pad, n_pad, s = _setup(n, 4, R=8)
+    nbr_d = place_sharded(mesh, jnp.asarray(nbr_pad), P("node", None))
+    s_d = place_sharded(mesh, jnp.asarray(s), P("replica", "node"))
+    rollout = make_sharded_rollout(mesh, n_real=g.n, steps=5)
+    out = np.asarray(rollout(nbr_d, s_d))[:, : g.n]
+    for r in range(s.shape[0]):
+        want = run_dynamics(g, s[r, : g.n], 5, backend="cpu")
+        np.testing.assert_array_equal(out[r], want)
+
+
+@pytest.mark.parametrize("tie", ["stay", "change"])
+def test_pad_rows_frozen(mesh, tie):
+    g, nbr_pad, n_pad, s = _setup(253, 4, R=8)
+    assert n_pad > g.n
+    nbr_d = place_sharded(mesh, jnp.asarray(nbr_pad), P("node", None))
+    s_d = place_sharded(mesh, jnp.asarray(s), P("replica", "node"))
+    rollout = make_sharded_rollout(mesh, n_real=g.n, steps=3, tie=tie)
+    out = np.asarray(rollout(nbr_d, s_d))
+    np.testing.assert_array_equal(out[:, g.n :], s[:, g.n :])
+
+
+def test_sharded_sa_step_pad_free_sums(mesh):
+    g, nbr_pad, n_pad, s = _setup(253, 4, R=8, seed=3)
+    nbr_d = place_sharded(mesh, jnp.asarray(nbr_pad), P("node", None))
+    s_d = place_sharded(mesh, jnp.asarray(s), P("replica", "node"))
+    R = s.shape[0]
+    # seed the cached end-sums pad-free via the sharded rollout
+    rollout = make_sharded_rollout(mesh, n_real=g.n, steps=1)
+    s_end = np.asarray(rollout(nbr_d, s_d))[:, : g.n]
+    sum_end = jnp.asarray(s_end.astype(np.int64).sum(axis=1), jnp.int32)
+
+    step = make_sharded_sa_step(mesh, rollout_steps=1, n_real=g.n)
+    keys = jax.vmap(jax.random.PRNGKey)(np.arange(R, dtype=np.uint32))
+    out = step(
+        nbr_d, s_d,
+        place_sharded(mesh, sum_end, P("replica")),
+        place_sharded(mesh, jnp.full((R,), 0.01 * g.n, jnp.float32), P("replica")),
+        place_sharded(mesh, jnp.full((R,), 0.01 * g.n, jnp.float32), P("replica")),
+        place_sharded(mesh, keys, P("replica")),
+        place_sharded(mesh, jnp.zeros((R,), jnp.int32), P("replica")),
+        jnp.float32(1.0005), jnp.float32(1.0005),
+        jnp.float32(4.5 * g.n), jnp.float32(5.0 * g.n),
+    )
+    s_new, sum_end_new, *_, consensus = out
+    s_new = np.asarray(s_new)
+    # returned end-sums must equal the pad-free rollout of the returned state
+    want = np.asarray(rollout(nbr_d, jnp.asarray(s_new)))[:, : g.n]
+    np.testing.assert_array_equal(
+        np.asarray(sum_end_new), want.astype(np.int64).sum(axis=1)
+    )
+    # consensus flag basis check: no replica is at consensus here
+    assert float(consensus) == 0.0
+    # pads untouched
+    np.testing.assert_array_equal(s_new[:, g.n :], s[:, g.n :])
