@@ -1,0 +1,218 @@
+"""BDCM entropy λ-sweep (L5 solver) — the notebook's procedure, jit-compiled.
+
+Reproduces `BDCM_entropy_procedure_GENERAL_ER` + driver
+(`ER_BDCM_entropy.ipynb:394-515`): for each λ in a ladder, (a) write the
+closed-form leaf messages, (b) iterate the BDCM sweep to a fixed point
+warm-started from the previous λ (the load-bearing trick that keeps sweep
+counts at ~130-160 instead of cold-start, SURVEY.md §3.3), (c) record the
+Bethe free entropy φ, the BP mean initial magnetization, and the tilted
+(Legendre) entropy ``s(m_init) = φ + λ·m_init``; stop early when the entropy
+crosses ``ent_floor`` (no such initializations exist) or on non-convergence
+(the reference's ``counts`` sentinel, `ipynb:429-431,446-447`).
+
+TPU-first: the whole fixed-point iteration is one ``lax.while_loop`` around
+the jitted sweep — λ is a traced scalar, so the entire ladder reuses a single
+compiled program per graph structure; only the host-side ladder loop and
+early-exit logic remain in Python.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from graphdyn.config import EntropyConfig
+from graphdyn.graphs import Graph, erdos_renyi_graph, remove_isolates
+from graphdyn.ops.bdcm import (
+    BDCMData,
+    make_free_entropy,
+    make_leaf_setter,
+    make_mean_m_init,
+    make_sweep,
+)
+
+
+class EntropyResult(NamedTuple):
+    lambdas: np.ndarray    # ladder values actually visited [count]
+    ent: np.ndarray        # φ per λ
+    m_init: np.ndarray     # BP mean initial magnetization per λ
+    ent1: np.ndarray       # tilted entropy φ + λ·m_init per λ
+    sweeps: np.ndarray     # fixed-point sweep counts per λ
+    nonconverged: float    # the reference's `counts`: the λ that failed, or 0
+    chi: np.ndarray        # final messages (resume state)
+
+
+def make_fixed_point(data: BDCMData, config: EntropyConfig):
+    """Jitted ``(chi, lmbd) -> (chi*, sweeps, delta)``: iterate the sweep
+    until ``max|Δchi| < eps`` or ``max_sweeps`` (`ipynb:420-432`)."""
+    sweep = make_sweep(data, damp=config.damp, eps_clamp=config.eps_clamp)
+    eps = config.eps
+    T_max = config.max_sweeps
+
+    @jax.jit
+    def fixed_point(chi, lmbd):
+        def cond(st):
+            _, delta, t = st
+            return (delta > eps) & (t < T_max)
+
+        def body(st):
+            chi, _, t = st
+            new = sweep(chi, lmbd)
+            return new, jnp.abs(new - chi).max(), t + 1
+
+        chi, delta, t = lax.while_loop(
+            cond, body, (chi, jnp.asarray(jnp.inf, chi.dtype), 0)
+        )
+        return chi, t, delta
+
+    return fixed_point
+
+
+def entropy_sweep(
+    graph: Graph,
+    config: EntropyConfig | None = None,
+    *,
+    n_total: int | None = None,
+    seed: int = 0,
+    chi0=None,
+    lambdas: np.ndarray | None = None,
+    verbose: bool = False,
+) -> EntropyResult:
+    """Run the λ ladder on one graph instance.
+
+    ``graph`` may contain isolated nodes; they are removed here and folded in
+    analytically (φ gets ``−λ·n_iso/n``, m_init gets ``+n_iso/n``,
+    `ipynb:283-291,338`). ``n_total`` overrides the density normalization
+    (defaults to ``graph.n`` including isolates).
+    """
+    config = config or EntropyConfig()
+    dyn = config.dynamics
+    n_total = n_total or graph.n
+    sub, n_iso = remove_isolates(graph)
+
+    data = BDCMData(
+        sub,
+        p=dyn.p,
+        c=dyn.c,
+        attr_value=dyn.attr_value,
+        rule=dyn.rule,
+        tie=dyn.tie,
+    )
+    fixed_point = make_fixed_point(data, config)
+    set_leaves = make_leaf_setter(data)
+    phi_fn = make_free_entropy(
+        data, n_total=n_total, n_iso=n_iso, eps_clamp=config.eps_clamp
+    )
+    minit_fn = make_mean_m_init(
+        data, n_total=n_total, n_iso=n_iso, eps_clamp=config.eps_clamp
+    )
+
+    if lambdas is None:
+        a, dl = config.lmbd_max, config.lmbd_step
+        lambdas = np.linspace(0, a, int(a / dl + 1))
+    chi = data.init_messages(seed) if chi0 is None else jnp.asarray(chi0)
+
+    ents, m_inits, ent1s, sweeps, visited = [], [], [], [], []
+    nonconverged = 0.0
+    for lmbd in lambdas:
+        lm = jnp.float32(lmbd)
+        chi = set_leaves(chi, lm)
+        chi, t, delta = fixed_point(chi, lm)
+        t = int(t)
+        failed = float(delta) > config.eps
+        if failed:
+            nonconverged = float(lmbd)
+
+        phi = float(phi_fn(chi, lm))
+        m0 = float(minit_fn(chi))
+        e1 = phi + float(lmbd) * m0
+        visited.append(float(lmbd))
+        ents.append(phi)
+        m_inits.append(m0)
+        ent1s.append(e1)
+        sweeps.append(t)
+        if verbose:
+            print(f"lambda={lmbd:.2f} t={t} m_init={m0:.5f} ent1={e1:.5f}")
+        # early exits (`ipynb:446-447`)
+        if e1 < config.ent_floor or failed:
+            break
+
+    return EntropyResult(
+        lambdas=np.array(visited),
+        ent=np.array(ents),
+        m_init=np.array(m_inits),
+        ent1=np.array(ent1s),
+        sweeps=np.array(sweeps),
+        nonconverged=nonconverged,
+        chi=np.asarray(chi),
+    )
+
+
+class EntropyGridResult(NamedTuple):
+    """The notebook driver's result grids (`ipynb:484-492`)."""
+
+    deg: np.ndarray            # mean-degree grid
+    ent: np.ndarray            # [deg, rep, λ]
+    m_init: np.ndarray
+    ent1: np.ndarray
+    nodes_isolated: np.ndarray  # [deg, rep]
+    mean_degrees: np.ndarray
+    max_degrees: np.ndarray
+    mean_degrees_total: np.ndarray
+
+
+def entropy_grid(
+    n: int,
+    deg_grid: np.ndarray,
+    config: EntropyConfig | None = None,
+    *,
+    seed: int = 0,
+    graph_method: str = "numpy",
+    verbose: bool = False,
+) -> EntropyGridResult:
+    """The notebook's full experiment driver: deg-grid × repetitions × λ
+    ladder on fresh ER instances (`ipynb:496-513`)."""
+    config = config or EntropyConfig()
+    a, dl = config.lmbd_max, config.lmbd_step
+    lambdas = np.linspace(0, a, int(a / dl + 1))
+    L = lambdas.size
+    D, Rr = len(deg_grid), config.num_rep
+
+    ent = np.zeros((D, Rr, L))
+    m_init = np.zeros((D, Rr, L))
+    ent1 = np.zeros((D, Rr, L))
+    nodes_isolated = np.zeros((D, Rr))
+    mean_degrees = np.zeros((D, Rr))
+    max_degrees = np.zeros((D, Rr))
+    mean_degrees_total = np.zeros((D, Rr))
+
+    for di, deg in enumerate(deg_grid):
+        for rep in range(Rr):
+            gseed = seed + 1000 * di + rep
+            g = erdos_renyi_graph(n, deg / (n - 1), seed=gseed, method=graph_method)
+            live = g.deg[g.deg > 0]
+            nodes_isolated[di, rep] = g.n - live.size
+            mean_degrees[di, rep] = live.mean() if live.size else 0.0
+            max_degrees[di, rep] = g.deg.max(initial=0)
+            mean_degrees_total[di, rep] = g.deg.mean()
+            res = entropy_sweep(g, config, seed=gseed, lambdas=lambdas, verbose=verbose)
+            k = res.lambdas.size
+            ent[di, rep, :k] = res.ent
+            m_init[di, rep, :k] = res.m_init
+            ent1[di, rep, :k] = res.ent1
+
+    return EntropyGridResult(
+        deg=np.asarray(deg_grid),
+        ent=ent,
+        m_init=m_init,
+        ent1=ent1,
+        nodes_isolated=nodes_isolated,
+        mean_degrees=mean_degrees,
+        max_degrees=max_degrees,
+        mean_degrees_total=mean_degrees_total,
+    )

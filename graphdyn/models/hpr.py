@@ -1,0 +1,150 @@
+"""History-Passing reinforcement (HPr) — the reinforced-BP solver (L5).
+
+Reproduces the reference's HPr loop (`HPR_pytorch_RRG.py:342-356`): iterate
+the bias-weighted BDCM sweep, compute node marginals, reinforce per-node
+biases toward the marginal winner with probability ``1−(1+t)^{−γ}``
+("cedrics paper, eq. (24)" per the comment at `HPR:135`), read off the trial
+solution ``s = argmax bias``, and stop when ``s`` flows to the all-+1
+attractor under the (p,c) rollout, or after ``TT`` sweeps (sentinel
+``m_final = 2``, `HPR:355`).
+
+TPU-first redesign (SURVEY.md §3.2): the reference crosses the host/device
+boundary every DP combo via string-parsing ``order_gpu`` (`HPR:46-61`) and
+scalar ``A_i_sums`` calls; here the entire iteration — sweep, marginals,
+reinforcement, rollout stop-test — is ONE jitted ``lax.while_loop`` body with
+table-driven factor tensors; zero host round-trips until the loop exits.
+
+Faithful quirk-preservation (capabilities stay, accidents go — SURVEY §7):
+the λ-tilt is ``exp(−λ_eff·x_i(0))`` with λ_eff = ``lmbd_in/n`` = 25
+(`HPR:231,39`); the DP does *not* mask invalid-endpoint source trajectories
+(unlike the entropy sweep) — their chi entries decay under damping instead;
+marginals are ε-clamped at 1e-15 (`HPR:147`). The hard-coded `.to('cuda')`
+(`HPR:347`) and CPU-side ``torch.rand`` mask (`HPR:142`) are bugs, not
+capabilities, and are not reproduced.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from graphdyn.config import HPRConfig
+from graphdyn.graphs import Graph, build_edge_tables
+from graphdyn.ops.bdcm import BDCMData, make_marginals, make_sweep
+from graphdyn.ops.dynamics import batched_rollout_impl, rule_coefficients
+
+
+class HPRResult(NamedTuple):
+    s: np.ndarray            # int8[n] — trial solution at stop
+    mag_reached: np.ndarray  # f32 scalar — m(s) at stop (`HPR:359`)
+    num_steps: int           # sweeps taken (`HPR:360`)
+    m_final: float           # 1.0 success, 2.0 timeout sentinel
+    biases: np.ndarray       # f32[n, 2] — final reinforcement biases
+    chi: np.ndarray          # final messages
+
+
+def hpr_solve(
+    graph: Graph,
+    config: HPRConfig | None = None,
+    *,
+    seed: int = 0,
+    chi0=None,
+) -> HPRResult:
+    """Run one HPr chain on one graph instance."""
+    config = config or HPRConfig()
+    dyn = config.dynamics
+    n = graph.n
+    tables = build_edge_tables(graph)
+    data = BDCMData(
+        graph,
+        tables,
+        p=dyn.p,
+        c=dyn.c,
+        attr_value=dyn.attr_value,
+        rule=dyn.rule,
+        tie=dyn.tie,
+    )
+    sweep = make_sweep(
+        data, damp=config.damp, eps_clamp=0.0, mask_invalid_src=False, with_bias=True
+    )
+    marginals = make_marginals(data, eps=config.eps_clamp)
+    R_coef, C_coef = rule_coefficients(dyn.rule, dyn.tie)
+    rollout_steps = dyn.p + dyn.c - 1
+
+    src = jnp.asarray(tables.src.astype(np.int64))
+    sel_plus = jnp.asarray(data.x0 == 1)
+    nbr = jnp.asarray(graph.nbr)
+    lmbd = jnp.float32(config.lmbd)
+    pie = jnp.float32(config.pie)
+    gamma = jnp.float32(config.gamma)
+    TT = int(config.max_sweeps)
+
+    def m_of_end(s):
+        s_end_sum = (
+            batched_rollout_impl(nbr, s[None], rollout_steps, R_coef, C_coef)
+            .astype(jnp.int32)
+            .sum()
+        )
+        return s_end_sum.astype(jnp.float32) / n
+
+    def bias_to_edge(biases):
+        # bias of the *source* node at its trajectory's initial value
+        # (`positions_biases`, `HPR:120-133`): [2E, K]
+        return jnp.where(sel_plus[None, :], biases[src, 0, None], biases[src, 1, None])
+
+    @jax.jit
+    def run(chi, biases, key):
+        s0 = jnp.where(biases[:, 0] > biases[:, 1], 1, -1).astype(jnp.int8)
+
+        def cond(st):
+            _, _, _, _, t, m_final = st
+            return m_final < 1.0
+
+        def body(st):
+            chi, biases, s, key, t, _ = st
+            chi = sweep(chi, lmbd, bias_to_edge(biases))
+            marg = marginals(chi)
+            # reinforcement (`new_biases_i`, `HPR:137-145`)
+            minus_wins = marg[:, 1] >= marg[:, 0]
+            new_bias = jnp.where(
+                minus_wins[:, None],
+                jnp.array([pie, 1 - pie]),
+                jnp.array([1 - pie, pie]),
+            )
+            key, ku = jax.random.split(key)
+            u = jax.random.uniform(ku, (n,))
+            update = u < 1.0 - (1.0 + t.astype(jnp.float32)) ** (-gamma)
+            biases = jnp.where(update[:, None], new_bias, biases)
+            s = jnp.where(biases[:, 0] > biases[:, 1], 1, -1).astype(jnp.int8)
+            t = t + 1
+            m_final = jnp.where(t > TT, 2.0, m_of_end(s))
+            return chi, biases, s, key, t, m_final
+
+        state = (chi, biases, s0, key, jnp.int32(0), m_of_end(s0))
+        return lax.while_loop(cond, body, state)
+
+    rng = np.random.default_rng(seed)
+    if chi0 is None:
+        # one stream for both draws — keeps chi and biases independent
+        chi0 = data.init_messages(rng)
+    biases0 = rng.random((n, 2))
+    biases0 /= biases0.sum(axis=1, keepdims=True)
+    key = jax.random.PRNGKey(seed)
+
+    chi, biases, s, _, t, m_final = run(
+        jnp.asarray(chi0), jnp.asarray(biases0, jnp.float32), key
+    )
+    s = np.asarray(s)
+    return HPRResult(
+        s=s,
+        mag_reached=np.float32(s.astype(np.float64).mean()),
+        num_steps=int(t),
+        m_final=float(m_final),
+        biases=np.asarray(biases),
+        chi=np.asarray(chi),
+    )

@@ -1,0 +1,211 @@
+"""BDCM tests (SURVEY.md §4): encoding bijectivity, factor tensors vs direct
+scalar evaluation of the reference conditions, and the strongest anchor — BP
+exactness on trees vs brute-force enumeration of all initial configurations."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from graphdyn.attractors import (
+    edge_factor_tensor,
+    leaf_factor_tensor,
+    node_factor_tensor,
+    order_index,
+    rho_lattice,
+    trajectories01,
+)
+from graphdyn.graphs import graph_from_edges, random_regular_graph
+from graphdyn.ops.bdcm import (
+    BDCMData,
+    make_free_entropy,
+    make_leaf_setter,
+    make_marginals,
+    make_mean_m_init,
+    make_sweep,
+)
+
+
+# --- reference-style scalar conditions (direct transcription of semantics,
+# --- used as the oracle for the vectorized tensors) -------------------------
+
+def ref_atr(xi, xj, rho, p, c):
+    tot = rho[p + c - 1] + xj[p + c - 1]
+    if xi[p] == np.sign(tot):
+        return 1
+    if tot == 0 and xi[p] == xi[p + c - 1]:
+        return 1
+    return 0
+
+
+def ref_traj(xi, xj, rho, p, c):
+    for t in range(p + c - 1):
+        tot = rho[t] + xj[t]
+        if xi[t + 1] == np.sign(tot):
+            continue
+        if tot == 0 and xi[t + 1] == xi[t]:
+            continue
+        return 0
+    return 1
+
+
+def test_trajectory_enumeration_order():
+    for T in (1, 2, 3):
+        want = np.array(list(itertools.product([1, 0], repeat=T)))
+        np.testing.assert_array_equal(trajectories01(T), want)
+
+
+def test_order_index_bijective_and_allones_zero():
+    T = 2
+    X = trajectories01(T)
+    seen = set()
+    for i, xi in enumerate(X):
+        for j, xj in enumerate(X):
+            idx = order_index(xi, xj)
+            # matches position in the double enumeration
+            assert idx == i * len(X) + j
+            seen.add(idx)
+    assert seen == set(range(len(X) ** 2))
+    assert order_index(np.ones(T, int), np.ones(T, int)) == 0
+
+
+@pytest.mark.parametrize("d,p,c", [(1, 1, 1), (2, 1, 1), (3, 1, 1), (2, 2, 1), (3, 3, 1), (2, 1, 2)])
+def test_edge_factor_matches_scalar_reference(d, p, c):
+    T = p + c
+    A = edge_factor_tensor(d, p, c, attr_value=1)
+    X = 2 * trajectories01(T) - 1
+    Rho = 2 * rho_lattice(d, T) - d
+    for i, xi in enumerate(X):
+        for j, xj in enumerate(X):
+            for r, rho in enumerate(Rho):
+                want = (
+                    ref_atr(xi, xj, rho, p, c)
+                    * ref_traj(xi, xj, rho, p, c)
+                    * (xi[T - 1] == 1)
+                )
+                assert A[i, j, r] == want, (xi, xj, rho)
+
+
+def test_node_factor_matches_scalar_reference():
+    p = c = 1
+    T = 2
+    for d in (1, 2, 3):
+        Ai = node_factor_tensor(d, p, c, attr_value=1)
+        X = 2 * trajectories01(T) - 1
+        Rho = 2 * rho_lattice(d, T) - d
+        for i, xi in enumerate(X):
+            for r, rho in enumerate(Rho):
+                # node variant: total includes all neighbors, no xj
+                zero = np.zeros(T, dtype=int)
+                want = (
+                    ref_atr(xi, zero, rho, p, c)
+                    * ref_traj(xi, zero, rho, p, c)
+                    * (xi[T - 1] == 1)
+                )
+                assert Ai[i, r] == want
+
+
+def test_leaf_factor_is_zero_rho_edge_factor():
+    A0 = edge_factor_tensor(0, 1, 1)
+    L = leaf_factor_tensor(1, 1)
+    np.testing.assert_array_equal(A0[:, :, 0], L)
+
+
+# --- BP exactness on trees --------------------------------------------------
+
+def brute_force_phi_minit(graph, p, c, lmbd, attr_value=1):
+    """Enumerate all 2^n initial configs; dynamics are deterministic so the
+    trajectory measure reduces to a sum over valid initializations."""
+    from graphdyn.ops.dynamics import run_dynamics
+
+    n = graph.n
+    T = p + c
+    Z = 0.0
+    M0 = 0.0
+    for bits in range(2**n):
+        s0 = np.array([1 if (bits >> k) & 1 else -1 for k in range(n)], np.int8)
+        traj = [s0]
+        s = s0
+        for _ in range(T):
+            s = run_dynamics(graph, s, 1, backend="cpu")
+            traj.append(s)
+        ok = np.all(traj[T] == traj[p]) and np.all(traj[T - 1] == attr_value)
+        if ok:
+            w = np.exp(-lmbd * float(s0.sum()))
+            Z += w
+            M0 += w * float(s0.sum())
+    return np.log(Z) / n, M0 / Z / n
+
+
+def run_fixed_point(data, lmbd, damp=0.3, eps=1e-12, max_iter=4000, seed=0):
+    sweep = make_sweep(data, damp=damp)
+    set_leaves = make_leaf_setter(data)
+    chi = data.init_messages(seed)
+    chi = set_leaves(chi, jnp.float32(lmbd))
+    for _ in range(max_iter):
+        new = sweep(chi, jnp.float32(lmbd))
+        delta = float(jnp.abs(new - chi).max())
+        chi = new
+        if delta < eps:
+            break
+    return chi
+
+
+TREES = {
+    "path4": [(0, 1), (1, 2), (2, 3)],
+    "star4": [(0, 1), (0, 2), (0, 3)],
+    "caterpillar8": [(0, 1), (1, 2), (2, 3), (1, 4), (2, 5), (0, 6), (3, 7)],
+}
+
+
+@pytest.mark.parametrize("name", list(TREES))
+@pytest.mark.parametrize("lmbd", [0.0, 0.4, 1.1])
+def test_bp_exact_on_trees(name, lmbd):
+    edges = np.array(TREES[name])
+    n = int(edges.max()) + 1
+    g = graph_from_edges(n, edges)
+    p = c = 1
+    data = BDCMData(g, p=p, c=c)
+    chi = run_fixed_point(data, lmbd)
+    phi_fn = make_free_entropy(data, n_total=n, n_iso=0)
+    minit_fn = make_mean_m_init(data, n_total=n, n_iso=0)
+    phi = float(phi_fn(chi, jnp.float32(lmbd)))
+    m0 = float(minit_fn(chi))
+    phi_ex, m0_ex = brute_force_phi_minit(g, p, c, lmbd)
+    assert abs(phi - phi_ex) < 5e-5, (phi, phi_ex)
+    assert abs(m0 - m0_ex) < 5e-5, (m0, m0_ex)
+
+
+def test_bp_exact_on_tree_p2():
+    edges = np.array(TREES["caterpillar8"])
+    g = graph_from_edges(8, edges)
+    data = BDCMData(g, p=2, c=1)
+    chi = run_fixed_point(data, 0.3)
+    phi = float(make_free_entropy(data, n_total=8, n_iso=0)(chi, jnp.float32(0.3)))
+    m0 = float(make_mean_m_init(data, n_total=8, n_iso=0)(chi))
+    phi_ex, m0_ex = brute_force_phi_minit(g, 2, 1, 0.3)
+    assert abs(phi - phi_ex) < 5e-5
+    assert abs(m0 - m0_ex) < 5e-5
+
+
+def test_sweep_preserves_normalization():
+    g = random_regular_graph(24, 3, seed=1)
+    data = BDCMData(g, p=1, c=1)
+    sweep = make_sweep(data, damp=0.4)
+    chi = data.init_messages(2)
+    for _ in range(5):
+        chi = sweep(chi, jnp.float32(0.5))
+    sums = np.asarray(chi.sum(axis=(1, 2)))
+    np.testing.assert_allclose(sums, 1.0, atol=1e-5)
+
+
+def test_marginals_normalized_and_shaped():
+    g = random_regular_graph(24, 3, seed=3)
+    data = BDCMData(g, p=1, c=1)
+    chi = data.init_messages(4)
+    marg = np.asarray(make_marginals(data)(chi))
+    assert marg.shape == (24, 2)
+    np.testing.assert_allclose(marg.sum(axis=1), 1.0, atol=1e-6)
+    assert np.all(marg >= 0)

@@ -1,0 +1,79 @@
+"""Entropy λ-sweep tests: golden tolerance vs the notebook's stored triples
+(BASELINE.md), early-exit semantics, grid driver."""
+
+import numpy as np
+import pytest
+
+from graphdyn.config import EntropyConfig
+from graphdyn.graphs import erdos_renyi_graph, graph_from_edges
+from graphdyn.models.entropy import entropy_grid, entropy_sweep
+
+
+@pytest.mark.slow
+def test_golden_triples_tolerance():
+    """Reference ground truth (`ER_BDCM_entropy.ipynb:18-46`, BASELINE.md):
+    deg=1.0, n=1000, p=c=1, damp=0.1, eps=1e-6. The stored run is a single
+    unseeded instance, so we check to within finite-size fluctuation."""
+    golden = {0.0: (0.78598, 0.17207), 0.4: (0.72636, 0.16058), 0.9: (0.67421, 0.12780)}
+    g = erdos_renyi_graph(1000, 1.0 / 999, seed=2)
+    res = entropy_sweep(g, EntropyConfig(), seed=2, lambdas=np.array([0.0, 0.4, 0.9]))
+    assert res.lambdas.size == 3, "all ladder points must converge"
+    for k, lam in enumerate(res.lambdas):
+        m_g, e_g = golden[float(lam)]
+        assert abs(res.m_init[k] - m_g) < 0.03
+        assert abs(res.ent1[k] - e_g) < 0.015
+    # sweep counts in the reference's warm-started regime (~130-250)
+    assert np.all(res.sweeps < 600)
+
+
+def test_entropy_floor_early_exit():
+    edges = np.array([[0, 1], [1, 2], [2, 3], [3, 0], [0, 4]])
+    g = graph_from_edges(5, edges)
+    # floor above any achievable ent1 => break after the first ladder point
+    cfg = EntropyConfig(lmbd_max=3.0, lmbd_step=1.0, ent_floor=10.0)
+    res = entropy_sweep(g, cfg, seed=0)
+    assert res.lambdas.size == 1
+    # floor below everything => full ladder is visited
+    cfg2 = EntropyConfig(lmbd_max=3.0, lmbd_step=1.0, ent_floor=-1e9)
+    res2 = entropy_sweep(g, cfg2, seed=0)
+    assert res2.lambdas.size == 4 or res2.nonconverged > 0
+
+
+def test_isolates_analytic_terms():
+    """Isolated nodes contribute −λ·n_iso/n to φ and +n_iso/n to m_init."""
+    edges = np.array([[0, 1], [1, 2]])
+    g_iso = graph_from_edges(5, edges)      # nodes 3,4 isolated
+    g_core = graph_from_edges(3, edges)
+    lambdas = np.array([0.0, 0.5])
+    r_iso = entropy_sweep(g_iso, EntropyConfig(), seed=1, lambdas=lambdas)
+    r_core = entropy_sweep(g_core, EntropyConfig(), seed=1, lambdas=lambdas)
+    for k, lam in enumerate(lambdas):
+        # φ_iso·5 = φ_core·3 − λ·2 ; m_iso·5 = m_core·3 + 2
+        np.testing.assert_allclose(
+            r_iso.ent[k] * 5, r_core.ent[k] * 3 - lam * 2, atol=1e-4
+        )
+        np.testing.assert_allclose(
+            r_iso.m_init[k] * 5, r_core.m_init[k] * 3 + 2, atol=1e-4
+        )
+
+
+def test_grid_driver_shapes():
+    cfg = EntropyConfig(lmbd_max=0.2, lmbd_step=0.1, num_rep=2)
+    res = entropy_grid(60, np.array([1.0, 1.5]), cfg, seed=3)
+    assert res.ent.shape == (2, 2, 3)
+    assert res.m_init.shape == (2, 2, 3)
+    assert res.nodes_isolated.shape == (2, 2)
+    # deg=1.5 instances have fewer isolates than deg=1.0 on average
+    assert res.mean_degrees_total[1].mean() > res.mean_degrees_total[0].mean()
+
+
+def test_warm_start_resume_state():
+    g = erdos_renyi_graph(80, 1.5 / 79, seed=5)
+    lambdas = np.array([0.0, 0.1, 0.2])
+    full = entropy_sweep(g, EntropyConfig(), seed=5, lambdas=lambdas)
+    # resume: run first two, then continue from chi at the third
+    part = entropy_sweep(g, EntropyConfig(), seed=5, lambdas=lambdas[:2])
+    cont = entropy_sweep(
+        g, EntropyConfig(), seed=5, chi0=part.chi, lambdas=lambdas[2:]
+    )
+    np.testing.assert_allclose(cont.ent1[-1], full.ent1[-1], atol=5e-4)

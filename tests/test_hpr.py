@@ -1,0 +1,52 @@
+"""HPr solver tests: convergence to a consensus-flowing initialization on
+small RRGs, reinforcement semantics, sentinel behavior."""
+
+import numpy as np
+import pytest
+
+from graphdyn.config import DynamicsConfig, HPRConfig
+from graphdyn.graphs import random_regular_graph
+from graphdyn.models.hpr import hpr_solve
+from graphdyn.ops.dynamics import end_state
+
+
+def test_hpr_finds_consensus_flowing_init():
+    g = random_regular_graph(60, 4, seed=1)
+    cfg = HPRConfig(dynamics=DynamicsConfig(p=1, c=1), max_sweeps=3000)
+    res = hpr_solve(g, cfg, seed=0)
+    assert res.m_final == 1.0, f"did not converge in {res.num_steps} sweeps"
+    out = end_state(g, res.s, p=1, c=1, backend="cpu")
+    assert np.all(out == 1)
+    # the point of HPr: a non-trivial (below-consensus) initialization
+    assert res.mag_reached < 1.0
+    assert res.num_steps >= 1
+
+
+def test_hpr_timeout_sentinel():
+    g = random_regular_graph(60, 4, seed=2)
+    cfg = HPRConfig(max_sweeps=2)
+    res = hpr_solve(g, cfg, seed=5)
+    assert res.m_final in (1.0, 2.0)
+    if res.m_final == 2.0:
+        assert res.num_steps == 3  # t incremented past TT
+
+
+def test_hpr_biases_polarized_after_convergence():
+    g = random_regular_graph(40, 4, seed=3)
+    cfg = HPRConfig(max_sweeps=3000)
+    res = hpr_solve(g, cfg, seed=1)
+    if res.m_final == 1.0:
+        # reinforced biases are at (pie, 1-pie) or (1-pie, pie) rows
+        b = res.biases
+        polarized = np.isclose(b.max(axis=1), 1 - cfg.pie, atol=1e-5)
+        assert polarized.mean() > 0.9
+        np.testing.assert_allclose(b.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_hpr_seed_reproducible():
+    g = random_regular_graph(40, 4, seed=4)
+    cfg = HPRConfig(max_sweeps=500)
+    r1 = hpr_solve(g, cfg, seed=7)
+    r2 = hpr_solve(g, cfg, seed=7)
+    assert r1.num_steps == r2.num_steps
+    np.testing.assert_array_equal(r1.s, r2.s)
