@@ -1,0 +1,30 @@
+"""Shared benchmark harness: timing + one-JSON-line reporting."""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def timed(fn, *args, warmup: int = 1, iters: int = 3):
+    """Run ``fn`` ``warmup`` times uncounted, then ``iters`` timed; returns
+    (last_result, seconds_per_iter)."""
+    import jax
+
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return out, (time.perf_counter() - t0) / iters
+
+
+def report(metric: str, value: float, unit: str, vs_baseline: float | None = None, **extra):
+    line = {"metric": metric, "value": value, "unit": unit}
+    if vs_baseline is not None:
+        line["vs_baseline"] = vs_baseline
+    line.update(extra)
+    print(json.dumps(line))

@@ -1,0 +1,62 @@
+#!/usr/bin/env python3
+"""BASELINE config 1: SA simulated annealing, d=3 RRG, N=1e4, 32 replicas.
+
+Measures full SA MCMC steps/sec (each step = one candidate rollout over the
+whole replica batch + Metropolis update) and compares against the numpy
+reference-style chain on the same graph. ``--full`` uses the BASELINE shapes;
+the default is a scaled-down smoke size.
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+from benchmarks.common import report
+from graphdyn.config import DynamicsConfig, SAConfig
+from graphdyn.graphs import random_regular_graph
+from graphdyn.models.sa import simulated_annealing
+
+
+def run(n, R, steps):
+    g = random_regular_graph(n, 3, seed=0)
+    cfg = SAConfig(dynamics=DynamicsConfig(p=3, c=1))
+    rng = np.random.default_rng(0)
+    s0 = (2 * rng.integers(0, 2, size=(R, n)) - 1).astype(np.int8)
+    proposals = rng.integers(0, n, size=(R, steps)).astype(np.int32)
+    uniforms = rng.random(size=(R, steps))
+
+    # device path (timed; includes the single candidate rollout per step)
+    t0 = time.perf_counter()
+    simulated_annealing(
+        g, cfg, s0=s0, proposals=proposals, uniforms=uniforms,
+        max_steps=steps - 1, backend="jax_tpu",
+    )
+    dev = time.perf_counter() - t0
+
+    # numpy oracle on a small prefix, extrapolated
+    o_steps = max(steps // 50, 10)
+    t0 = time.perf_counter()
+    simulated_annealing(
+        g, cfg, s0=s0[:1], proposals=proposals[:1, :o_steps],
+        uniforms=uniforms[:1, :o_steps], max_steps=o_steps - 1, backend="cpu",
+    )
+    cpu = (time.perf_counter() - t0) * (steps / o_steps) * R
+
+    rate = R * steps / dev
+    report(
+        "sa_mcmc_steps_per_sec_d3_rrg_n%d_r%d" % (n, R),
+        rate,
+        "mcmc-steps/s",
+        vs_baseline=cpu / dev,
+    )
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--full", action="store_true")
+    a = ap.parse_args()
+    run(10_000 if a.full else 2000, 32, 2000 if a.full else 400)

@@ -1,0 +1,39 @@
+#!/usr/bin/env python3
+"""BASELINE config 3: ER G(N, 6/N) majority-vote opinion dynamics, N=1e5,
+512 replicas — the bit-packed replica kernel on a ragged degree sequence."""
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from benchmarks.common import report, timed
+from graphdyn.graphs import erdos_renyi_graph
+from graphdyn.ops.packed import packed_rollout
+
+
+def run(n, R, steps):
+    g = erdos_renyi_graph(n, 6.0 / n, seed=0)
+    W = R // 32
+    rng = np.random.default_rng(0)
+    sp = jnp.asarray(rng.integers(0, 2**32, size=(g.n, W), dtype=np.uint32))
+    nbr = jnp.asarray(g.nbr)
+    deg = jnp.asarray(g.deg)
+    f = jax.jit(lambda sp: packed_rollout(nbr, deg, sp, steps))
+    _, dt = timed(f, sp)
+    report(
+        "er_majority_spin_updates_per_sec_n%d_r%d" % (n, R),
+        n * R * steps / dt,
+        "spin-updates/s",
+    )
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--full", action="store_true")
+    a = ap.parse_args()
+    run(100_000 if a.full else 20_000, 512, 20)

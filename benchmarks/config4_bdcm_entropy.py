@@ -1,0 +1,47 @@
+#!/usr/bin/env python3
+"""BASELINE config 4: BDCM entropy sweep, 64 graph instances × 32 λ points.
+
+Measures full λ-ladder wall time (graph build + factor tables + warm-started
+fixed points + observables) per instance.
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+from benchmarks.common import report
+from graphdyn.config import EntropyConfig
+from graphdyn.graphs import erdos_renyi_graph
+from graphdyn.models.entropy import entropy_sweep
+
+
+def run(n, n_graphs, n_lambda):
+    cfg = EntropyConfig(max_sweeps=400)
+    lambdas = np.linspace(0.0, 3.1, n_lambda)
+    t0 = time.perf_counter()
+    done = 0
+    for k in range(n_graphs):
+        g = erdos_renyi_graph(n, 1.5 / (n - 1), seed=k)
+        res = entropy_sweep(g, cfg, seed=k, lambdas=lambdas)
+        done += res.lambdas.size
+    dt = time.perf_counter() - t0
+    report(
+        "bdcm_entropy_lambda_points_per_sec_n%d" % n,
+        done / dt,
+        "lambda-points/s",
+        graphs=n_graphs,
+    )
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--full", action="store_true")
+    a = ap.parse_args()
+    if a.full:
+        run(1000, 64, 32)
+    else:
+        run(300, 4, 8)

@@ -1,0 +1,86 @@
+#!/usr/bin/env python3
+"""BASELINE config 5: d=5 RRG Ising SA, N=1e6, 1024 replicas × 16-point
+temperature ladder, multi-chip psum.
+
+On a multi-chip slice this runs the node+replica-sharded SA step
+(`graphdyn.parallel.sharded.make_sharded_sa_step`) over the full mesh; on the
+single tunneled chip (or a CPU mesh via
+``XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu``)
+it exercises the same sharded program at reduced shapes.
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from benchmarks.common import report, timed
+from graphdyn.graphs import random_regular_graph
+from graphdyn.parallel.mesh import device_pool, make_mesh
+from graphdyn.parallel.sharded import (
+    make_sharded_sa_step,
+    make_sharded_rollout,
+    pad_nodes,
+    place_sharded,
+)
+
+
+def run(n, R, n_temps):
+    n_dev = len(jax.devices())
+    node_shards = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
+    rep_shards = max(n_dev // node_shards, 1)
+    mesh = make_mesh(
+        (rep_shards, node_shards), ("replica", "node"),
+        devices=device_pool(rep_shards * node_shards),
+    )
+    g = random_regular_graph(n, 5, seed=0)
+    nbr_pad, n_pad = pad_nodes(g, node_shards)
+    Rtot = R * n_temps
+    Rtot -= Rtot % max(rep_shards, 1)
+
+    rng = np.random.default_rng(0)
+    s = (2 * rng.integers(0, 2, size=(Rtot, n_pad)) - 1).astype(np.int8)
+    nbr_d = place_sharded(mesh, jnp.asarray(nbr_pad), P("node", None))
+    s_d = place_sharded(mesh, jnp.asarray(s), P("replica", "node"))
+
+    rollout = make_sharded_rollout(mesh, n_real=g.n, steps=1)
+    s_end = rollout(nbr_d, s_d)
+    sum_end = jnp.asarray(
+        np.asarray(s_end)[:, : g.n].astype(np.int64).sum(axis=1), jnp.int32
+    )
+    # temperature ladder: a0/b0 vary per replica block (BASELINE config 5)
+    a0 = np.repeat(np.linspace(0.005, 0.03, n_temps), Rtot // n_temps)[:Rtot]
+    step = make_sharded_sa_step(mesh, rollout_steps=1, n_real=g.n)
+    keys = jax.vmap(jax.random.PRNGKey)(np.arange(Rtot, dtype=np.uint32))
+    args = (
+        nbr_d, s_d,
+        place_sharded(mesh, sum_end, P("replica")),
+        place_sharded(mesh, jnp.asarray(a0 * g.n, jnp.float32), P("replica")),
+        place_sharded(mesh, jnp.full((Rtot,), 0.01 * g.n, jnp.float32), P("replica")),
+        place_sharded(mesh, keys, P("replica")),
+        place_sharded(mesh, jnp.zeros((Rtot,), jnp.int32), P("replica")),
+        jnp.float32(1.0005), jnp.float32(1.0005),
+        jnp.float32(4.5 * g.n), jnp.float32(5.0 * g.n),
+    )
+    _, dt = timed(lambda *a: step(*a), *args)
+    report(
+        "multichip_sa_step_replica_rollouts_per_sec_d5_n%d" % n,
+        Rtot / dt,
+        "replica-steps/s",
+        mesh=f"{rep_shards}x{node_shards}",
+    )
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--full", action="store_true")
+    a = ap.parse_args()
+    if a.full:
+        run(1_000_000, 1024, 16)
+    else:
+        run(50_000, 16, 4)

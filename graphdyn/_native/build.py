@@ -1,15 +1,119 @@
-"""Build/load shim for the C++ graph builder (filled in by milestone M9)."""
+"""Build/load shim for the C++ graph builder (``graphgen.cpp``).
+
+Compiles with g++ on first use (cached as ``_graphgen.so`` next to the
+source, keyed by source mtime) and binds via ctypes. Falls back cleanly —
+``native_available()`` is False — when no toolchain is present, so the pure
+numpy samplers in :mod:`graphdyn.graphs` remain the default everywhere.
+"""
 
 from __future__ import annotations
 
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "graphgen.cpp")
+_SO = os.path.join(_HERE, "_graphgen.so")
+
+_lib = None
+_load_error: str | None = None
+
+
+def _ensure_built():
+    global _lib, _load_error
+    if _lib is not None or _load_error is not None:
+        return
+    try:
+        if (not os.path.exists(_SO)) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            # unique temp name so concurrent first-use builds can't corrupt
+            # each other; os.replace makes the install atomic
+            tmp = f"{_SO}.{os.getpid()}.tmp"
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
+                check=True,
+                capture_output=True,
+            )
+            os.replace(tmp, _SO)
+        lib = ctypes.CDLL(_SO)
+        lib.rrg_edges.restype = ctypes.c_int
+        lib.rrg_edges.argtypes = [
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.er_edges.restype = ctypes.c_int64
+        lib.er_edges.argtypes = [
+            ctypes.c_int64,
+            ctypes.c_double,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+        ]
+        _lib = lib
+    except (subprocess.CalledProcessError, OSError) as e:
+        _load_error = str(e)
+        stderr = getattr(e, "stderr", None)
+        if stderr:
+            _load_error += "\n" + stderr.decode(errors="replace")
+
 
 def native_available() -> bool:
-    return False
+    _ensure_built()
+    return _lib is not None
 
 
-def native_random_regular(n: int, d: int, seed):
-    raise NotImplementedError("native graph builder not built yet; use method='pairing'")
+def _as_seed(seed) -> int:
+    if seed is None:
+        return int.from_bytes(os.urandom(8), "little")
+    if isinstance(seed, np.random.Generator):
+        return int(seed.integers(0, 2**63))
+    return int(seed) & (2**64 - 1)
 
 
-def native_erdos_renyi(n: int, p: float, seed):
-    raise NotImplementedError("native graph builder not built yet; use method='numpy'")
+def native_random_regular(n: int, d: int, seed) -> np.ndarray:
+    """Sample a simple d-regular edge list, shape [n*d/2, 2]."""
+    _ensure_built()
+    if _lib is None:
+        raise RuntimeError(f"native builder unavailable: {_load_error}")
+    E = n * d // 2
+    u = np.empty(E, np.int32)
+    v = np.empty(E, np.int32)
+    rc = _lib.rrg_edges(
+        n,
+        d,
+        _as_seed(seed),
+        u.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        v.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if rc != 0:
+        raise RuntimeError(f"rrg_edges failed (rc={rc})")
+    return np.stack([u, v], axis=1).astype(np.int64)
+
+
+def native_erdos_renyi(n: int, p: float, seed) -> np.ndarray:
+    """Sample G(n,p) edge list, shape [m, 2]."""
+    _ensure_built()
+    if _lib is None:
+        raise RuntimeError(f"native builder unavailable: {_load_error}")
+    mean = n * (n - 1) / 2 * p
+    cap = int(mean + 8 * np.sqrt(mean + 1) + 64)
+    while True:
+        u = np.empty(cap, np.int32)
+        v = np.empty(cap, np.int32)
+        m = _lib.er_edges(
+            n,
+            float(p),
+            _as_seed(seed),
+            u.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            v.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            cap,
+        )
+        if m >= 0:
+            return np.stack([u[:m], v[:m]], axis=1).astype(np.int64)
+        cap *= 2

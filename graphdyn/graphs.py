@@ -337,6 +337,10 @@ def erdos_renyi_graph(
         G = nx.fast_gnp_random_graph(n, p, seed=seed)
         edges = np.array(G.edges, dtype=np.int64).reshape(-1, 2)
         return graph_from_edges(n, edges)
+    if method == "native":
+        from graphdyn._native import native_erdos_renyi
+
+        return graph_from_edges(n, native_erdos_renyi(n, p, seed))
 
     rng = _as_rng(seed)
     M = n * (n - 1) // 2

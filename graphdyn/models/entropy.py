@@ -37,6 +37,14 @@ from graphdyn.ops.bdcm import (
 )
 
 
+def lambda_ladder(config: EntropyConfig) -> np.ndarray:
+    """The configured λ ladder 0..lmbd_max in lmbd_step increments
+    (`ipynb:480-482`); rounded count so e.g. (0.3, 0.1) gives 4 points."""
+    return np.linspace(
+        0.0, config.lmbd_max, int(round(config.lmbd_max / config.lmbd_step)) + 1
+    )
+
+
 class EntropyResult(NamedTuple):
     lambdas: np.ndarray    # ladder values actually visited [count]
     ent: np.ndarray        # φ per λ
@@ -113,8 +121,7 @@ def entropy_sweep(
     )
 
     if lambdas is None:
-        a, dl = config.lmbd_max, config.lmbd_step
-        lambdas = np.linspace(0, a, int(a / dl + 1))
+        lambdas = lambda_ladder(config)
     chi = data.init_messages(seed) if chi0 is None else jnp.asarray(chi0)
 
     ents, m_inits, ent1s, sweeps, visited = [], [], [], [], []
@@ -178,8 +185,7 @@ def entropy_grid(
     """The notebook's full experiment driver: deg-grid × repetitions × λ
     ladder on fresh ER instances (`ipynb:496-513`)."""
     config = config or EntropyConfig()
-    a, dl = config.lmbd_max, config.lmbd_step
-    lambdas = np.linspace(0, a, int(a / dl + 1))
+    lambdas = lambda_ladder(config)
     L = lambdas.size
     D, Rr = len(deg_grid), config.num_rep
 

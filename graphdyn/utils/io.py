@@ -1,0 +1,102 @@
+"""Result persistence and checkpoint/resume.
+
+The reference persists end-of-run result arrays with ``np.savez``
+(`HPR_pytorch_RRG.py:377` live; `SA_RRG.py:92`, `ER_BDCM_entropy.ipynb:515`
+commented) and sketches a time-triggered intermediate save
+(`ipynb:439-445,475-476`). Here both are first-class: npz-compatible result
+files with the reference's key names, plus checkpoints of solver state
+(chi, biases, spins, rng seed, λ index, sweep count) so SA chains and λ
+sweeps resume exactly (SURVEY.md §5.4). Orbax is used when available for
+async checkpointing of jax pytrees; the portable npz path is the default.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any
+
+import numpy as np
+
+
+def save_results_npz(path: str, **arrays) -> None:
+    """Reference-compatible result file (e.g. ``mag_reached=..., conf=...,
+    num_steps=..., graphs=..., time=...`` as in `HPR_pytorch_RRG.py:377`)."""
+    np.savez(path, **{k: np.asarray(v) for k, v in arrays.items()})
+
+
+def load_results_npz(path: str) -> dict[str, np.ndarray]:
+    with np.load(path) as f:
+        return {k: f[k] for k in f.files}
+
+
+class Checkpoint:
+    """Minimal atomic checkpoint of a solver-state dict of arrays + metadata.
+
+    Layout: ``<path>.npz`` (arrays) and ``<path>.json`` (scalars). Writes go
+    through a temp file + rename so a preempted run never sees a torn file.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def save(self, arrays: dict[str, Any], meta: dict[str, Any]) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        tmp = self.path + ".tmp.npz"
+        np.savez(tmp, **{k: np.asarray(v) for k, v in arrays.items()})
+        os.replace(tmp, self.path + ".npz")
+        tmp_j = self.path + ".tmp.json"
+        with open(tmp_j, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp_j, self.path + ".json")
+
+    def load(self) -> tuple[dict[str, np.ndarray], dict[str, Any]] | None:
+        if not (os.path.exists(self.path + ".npz") and os.path.exists(self.path + ".json")):
+            return None
+        with np.load(self.path + ".npz") as f:
+            arrays = {k: f[k] for k in f.files}
+        with open(self.path + ".json") as f:
+            meta = json.load(f)
+        return arrays, meta
+
+
+class PeriodicCheckpointer:
+    """Time-triggered checkpointing (the notebook's ``saving_time`` sketch,
+    `ipynb:439-445`): call ``maybe_save`` inside the solver loop; it writes at
+    most every ``interval_s`` seconds."""
+
+    def __init__(self, path: str, interval_s: float = 30.0, max_saves: int | None = None):
+        self.ckpt = Checkpoint(path)
+        self.interval_s = interval_s
+        self.max_saves = max_saves
+        self._last = time.monotonic()
+        self._count = 0
+
+    def maybe_save(self, arrays: dict[str, Any], meta: dict[str, Any]) -> bool:
+        now = time.monotonic()
+        if now - self._last < self.interval_s:
+            return False
+        if self.max_saves is not None and self._count >= self.max_saves:
+            return False
+        self.ckpt.save(arrays, meta)
+        self._last = now
+        self._count += 1
+        return True
+
+
+def save_pytree_orbax(path: str, pytree) -> bool:
+    """Orbax checkpoint of a jax pytree; returns False if orbax is absent."""
+    try:
+        import orbax.checkpoint as ocp
+    except ImportError:
+        return False
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(os.path.abspath(path), pytree, force=True)
+    return True
+
+
+def load_pytree_orbax(path: str):
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer().restore(os.path.abspath(path))

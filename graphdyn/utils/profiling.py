@@ -1,0 +1,58 @@
+"""Tracing/profiling hooks (SURVEY.md §5.1).
+
+The reference's observability is a wall-clock bracket (`HPR:257,364`) and
+per-λ prints (`ipynb:433`). Here: a timing context that reports the headline
+spin-updates/sec metric, and a thin wrapper over ``jax.profiler`` traces for
+inspecting XLA/TPU execution in TensorBoard/Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class StepTimer:
+    """Accumulates wall time and work counts; reports updates/sec."""
+
+    seconds: float = 0.0
+    updates: int = 0
+    _t0: float = field(default=0.0, repr=False)
+
+    @contextlib.contextmanager
+    def measure(self, n_updates: int):
+        t0 = time.perf_counter()
+        yield
+        self.seconds += time.perf_counter() - t0
+        self.updates += n_updates
+
+    @property
+    def updates_per_sec(self) -> float:
+        return self.updates / self.seconds if self.seconds else 0.0
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str):
+    """``with device_trace('/tmp/trace'):`` → jax.profiler trace of the block
+    (view in TensorBoard's profile tab or Perfetto)."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def wall_clock():
+    """Reference-style bracket (`HPR:257,364`): yields a dict filled with
+    ``seconds`` on exit."""
+    out = {}
+    t0 = time.time()
+    try:
+        yield out
+    finally:
+        out["seconds"] = time.time() - t0

@@ -1,0 +1,51 @@
+"""C++ native graph builder: validity + statistical agreement with numpy."""
+
+import numpy as np
+import pytest
+
+from graphdyn._native import native_available
+from graphdyn.graphs import erdos_renyi_graph, random_regular_graph
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no C++ toolchain in environment"
+)
+
+
+def test_native_rrg_valid():
+    for n, d in [(100, 3), (501, 2), (2000, 5)]:
+        g = random_regular_graph(n, d, seed=9, method="native")
+        assert np.all(g.deg == d)
+        e = g.edges
+        assert np.all(e[:, 0] != e[:, 1])
+        code = np.minimum(e[:, 0], e[:, 1]) * g.n + np.maximum(e[:, 0], e[:, 1])
+        assert np.unique(code).size == code.size
+
+
+def test_native_er_statistics():
+    n, mean_deg = 5000, 4.0
+    g = erdos_renyi_graph(n, mean_deg / (n - 1), seed=3, method="native")
+    assert abs(g.deg.mean() - mean_deg) < 0.3
+    e = g.edges
+    assert np.all(e[:, 0] != e[:, 1])
+    assert np.all((e >= 0) & (e < n))
+    code = np.minimum(e[:, 0], e[:, 1]) * n + np.maximum(e[:, 0], e[:, 1])
+    assert np.unique(code).size == code.size
+
+
+def test_native_er_degenerate():
+    g0 = erdos_renyi_graph(100, 0.0, seed=1, method="native")
+    assert g0.num_edges == 0
+    g1 = erdos_renyi_graph(40, 1.0, seed=1, method="native")
+    assert g1.num_edges == 40 * 39 // 2
+
+
+def test_native_seed_determinism():
+    a = random_regular_graph(200, 3, seed=42, method="native")
+    b = random_regular_graph(200, 3, seed=42, method="native")
+    np.testing.assert_array_equal(a.edges, b.edges)
+
+
+def test_native_vs_numpy_throughput_smoke():
+    # not a perf assert — just exercises the native path at a bigger size
+    g = random_regular_graph(200_000, 3, seed=0, method="native")
+    assert g.num_edges == 300_000
