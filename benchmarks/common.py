@@ -1,9 +1,19 @@
-"""Shared benchmark harness: timing + one-JSON-line reporting."""
+"""Shared benchmark harness: timing + one-JSON-line reporting.
+
+Importing this module makes the repo root importable, so the config scripts
+run from any cwd (``python /path/to/benchmarks/configN_*.py``).
+"""
 
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
 
 def timed(fn, *args, warmup: int = 1, iters: int = 3):

@@ -9,7 +9,7 @@ reference's hot path (`HPR_pytorch_RRG.py:183-218`).
 import argparse
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp

@@ -12,7 +12,7 @@ it exercises the same sharded program at reduced shapes.
 import argparse
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
 
 import numpy as np
 import jax
@@ -53,8 +53,10 @@ def run(n, R, n_temps):
     sum_end = jnp.asarray(
         np.asarray(s_end)[:, : g.n].astype(np.int64).sum(axis=1), jnp.int32
     )
-    # temperature ladder: a0/b0 vary per replica block (BASELINE config 5)
-    a0 = np.repeat(np.linspace(0.005, 0.03, n_temps), Rtot // n_temps)[:Rtot]
+    # temperature ladder: a0/b0 vary per replica block (BASELINE config 5);
+    # tile the ladder across however many replicas survived the shard trim
+    ladder = np.linspace(0.005, 0.03, n_temps)
+    a0 = np.resize(np.repeat(ladder, max(Rtot // n_temps, 1)), Rtot)
     step = make_sharded_sa_step(mesh, rollout_steps=1, n_real=g.n)
     keys = jax.vmap(jax.random.PRNGKey)(np.arange(Rtot, dtype=np.uint32))
     args = (

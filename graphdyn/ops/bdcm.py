@@ -158,6 +158,23 @@ def _neighbor_dp(chi_in, d: int, T: int, K: int):
     return LL.reshape(Ed, K, -1)
 
 
+def class_update(chi_in, A, tilt, chi_old, *, d, T, K, damp, eps_clamp):
+    """XLA per-degree-class message update: neighbor DP, factor contraction,
+    ε-clamp, normalization, damping. The single numerical core shared by the
+    local sweep (:func:`make_sweep`) and the edge-sharded sweep
+    (:func:`graphdyn.parallel.sharded.make_sharded_sweep`), so the
+    sharded-vs-unsharded equivalence is structural, not maintained by hand."""
+    LL = _neighbor_dp(chi_in, d, T, K)                  # [Ed, K, M]
+    chi2 = jnp.einsum("xym,exm->exy", A, LL) * tilt[None, :, None]
+    chi2 = jnp.maximum(chi2, eps_clamp)
+    # safe denominator: an empty attractor set (all factors zero, e.g.
+    # minority dynamics with a c=1 homogeneous endpoint) yields all-zero
+    # messages and φ → −inf downstream instead of NaNs
+    z = chi2.sum(axis=(1, 2), keepdims=True)
+    chi2 = chi2 / jnp.maximum(z, jnp.finfo(chi2.dtype).tiny)
+    return damp * chi2 + (1.0 - damp) * chi_old
+
+
 def make_sweep(
     data: BDCMData,
     *,
@@ -165,12 +182,18 @@ def make_sweep(
     eps_clamp: float = 0.0,
     mask_invalid_src: bool = True,
     with_bias: bool = False,
+    use_pallas: bool | str = "auto",
 ):
     """Build the jitted BDCM sweep ``(chi, lmbd[, bias_edge]) -> chi'``.
 
     ``bias_edge``: [2E, K] multiplicative weight on each message *when
     consumed* (the HPr reinforcement bias ``b_k(x_k(0))`` gathered to edge
     shape, cf. `HPR_pytorch_RRG.py:128-133,188`).
+
+    ``use_pallas``: ``'auto'`` fuses the per-class DP + contraction into the
+    Pallas TPU kernel (:mod:`graphdyn.ops.pallas_bdcm`) on TPU backends when
+    the class shape qualifies; ``True`` forces it (interpret mode off-TPU,
+    for tests); ``False`` keeps the pure-XLA path.
     """
     T, K = data.T, data.K
     valid = jnp.asarray(data.valid)
@@ -185,6 +208,21 @@ def make_sweep(
         for cls in data.edge_classes
     ]
 
+    on_tpu = jax.default_backend() == "tpu"
+    if use_pallas == "auto":
+        pallas_mode = "tpu" if on_tpu else "off"
+    elif use_pallas:
+        pallas_mode = "tpu" if on_tpu else "interpret"
+    else:
+        pallas_mode = "off"
+
+    def _class_pallas_ok(d, idx):
+        if pallas_mode == "off":
+            return False
+        from graphdyn.ops.pallas_bdcm import pallas_supported
+
+        return pallas_supported(d, T, int(idx.shape[0]))
+
     def sweep(chi, lmbd, bias_edge=None):
         tilt = jnp.exp(-lmbd * x0)  # [K]
         for d, idx, in_edges, A in classes:
@@ -193,15 +231,24 @@ def make_sweep(
                 chi_in = chi_in * bias_edge[in_edges][:, :, :, None]
             if mask_invalid_src:
                 chi_in = chi_in * valid[None, None, :, None]
-            LL = _neighbor_dp(chi_in, d, T, K)          # [Ed, K, M]
-            chi2 = jnp.einsum("xym,exm->exy", A, LL) * tilt[None, :, None]
-            chi2 = jnp.maximum(chi2, eps_clamp)
-            # safe denominator: an empty attractor set (all factors zero, e.g.
-            # minority dynamics with a c=1 homogeneous endpoint) yields
-            # all-zero messages and φ → −inf downstream instead of NaNs
-            z = chi2.sum(axis=(1, 2), keepdims=True)
-            norm = chi2 / jnp.maximum(z, jnp.finfo(chi2.dtype).tiny)
-            upd = damp * norm + (1.0 - damp) * chi[idx]
+            if _class_pallas_ok(d, idx):
+                from graphdyn.ops.pallas_bdcm import dp_contract
+
+                upd = dp_contract(
+                    chi_in,
+                    A * tilt[:, None, None],
+                    chi[idx],
+                    d=d,
+                    T=T,
+                    damp=float(damp),
+                    eps_clamp=float(eps_clamp),
+                    interpret=pallas_mode == "interpret",
+                )
+            else:
+                upd = class_update(
+                    chi_in, A, tilt, chi[idx], d=d, T=T, K=K,
+                    damp=damp, eps_clamp=eps_clamp,
+                )
             chi = chi.at[idx].set(upd)
         return chi
 

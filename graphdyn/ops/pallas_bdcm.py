@@ -1,0 +1,187 @@
+"""Pallas TPU kernel: fused BDCM neighbor-DP + factor contraction.
+
+The XLA sweep (:func:`graphdyn.ops.bdcm._neighbor_dp`) materializes the
+ρ-lattice DP state ``LL[E, K, M]`` in HBM once per neighbor-slot ``D`` (d
+round trips of an array ``M/K`` times larger than chi itself), then runs the
+``A``-tensor contraction as a separate einsum. Here the whole per-edge
+pipeline — DP, contraction, ε-clamp, normalization, damping — fuses into one
+VMEM-resident kernel: HBM is touched exactly once for the gathered messages
+in and once for the updated messages out.
+
+Layout: **edges are the lane axis** (last, 128-multiple). All per-edge work
+is elementwise across edges with *identical* control flow, so one vector op
+serves a whole tile; K = 2^T and M = (d+1)^T ride the sublane axis.
+
+The ρ-lattice shift-convolution uses a *flat* mixed-radix shift: trajectory
+``k`` with bits ``b_t`` advances the flat index by
+``off_k = Σ_t b_t·(d+1)^{T−1−t}``. This equals the per-axis rolls of the XLA
+path (`ops/bdcm.py`) because after ``D`` accumulated neighbors every axis
+coordinate is ≤ D < d+1 — no radix carry can occur, so flat-index addition
+never crosses an axis boundary. The shifts are static Python slices, fully
+unrolled at trace time (d·K slice-FMAs of shape [≤M, Eb] per tile).
+
+The λ-tilt ``exp(−λ·x_i(0))`` couples only to the destination trajectory's
+initial value, so it is folded into the A tensor *outside* the kernel
+(``A_tilted[x_i, x_j, m] = A[x_i, x_j, m]·tilt[x_i]``) — λ stays traced and
+one compiled kernel serves the whole λ-ladder.
+
+Reference semantics covered (capability parity, not translation):
+`HPR_pytorch_RRG.py:183-218` (HPr_dp) and `ER_BDCM_entropy.ipynb:133-198`
+(BDCM_ER) — see `SURVEY.md` §2.2/§2.3.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from graphdyn.attractors import trajectories01
+
+LANE = 128
+
+
+def _flat_offsets(d: int, T: int) -> np.ndarray:
+    """off_k for every trajectory k: mixed-radix flat shift on the (d+1)^T
+    lattice."""
+    X01 = trajectories01(T)                       # [K, T]
+    radix = (d + 1) ** np.arange(T - 1, -1, -1)   # [T]
+    return (X01 * radix).sum(axis=1).astype(np.int64)
+
+
+def _dp_contract_kernel(
+    chi_in_ref,   # [d, K, K, Eb]  gathered incoming messages (src-traj major)
+    a_ref,        # [K*K, M, 1]    tilted factor tensor rows (x_i*K + x_j)
+    chi_old_ref,  # [K, K, Eb]     current messages of this tile (for damping)
+    out_ref,      # [K, K, Eb]
+    ll_ref,       # scratch [K, M, Eb]
+    acc_ref,      # scratch [K, M, Eb]
+    *,
+    d: int,
+    K: int,
+    M: int,
+    offsets: tuple,
+    damp: float,
+    eps_clamp: float,
+):
+    # DP base case: δ(ρ = 0) for every destination trajectory x_i
+    ll_ref[:] = jnp.zeros_like(ll_ref)
+    ll_ref[:, 0, :] = jnp.ones_like(ll_ref[:, 0, :])
+
+    # induction over neighbor slots; ping-pong LL <-> acc
+    for D in range(d):
+        src, dst = (ll_ref, acc_ref) if D % 2 == 0 else (acc_ref, ll_ref)
+        dst[:] = jnp.zeros_like(dst)
+        for k in range(K):
+            off = offsets[k]
+            for xi in range(K):
+                w = chi_in_ref[D, k, xi, :]       # [Eb]
+                if off == 0:
+                    dst[xi, :, :] += src[xi, :, :] * w[None, :]
+                else:
+                    dst[xi, off:M, :] += src[xi, 0 : M - off, :] * w[None, :]
+    final = ll_ref if d % 2 == 0 else acc_ref
+
+    # contraction chi2[xi, xj, :] = Σ_m A_tilted[xi, xj, m]·LL[xi, m, :],
+    # then ε-clamp, tile-local normalization, damping — all in VMEM
+    z = jnp.zeros_like(out_ref[0, 0, :])
+    for xi in range(K):
+        for xj in range(K):
+            row = jnp.maximum(
+                jnp.sum(a_ref[xi * K + xj, :, :] * final[xi, :, :], axis=0),
+                eps_clamp,
+            )
+            out_ref[xi, xj, :] = row
+            z = z + row
+    inv = 1.0 / jnp.maximum(z, jnp.finfo(jnp.float32).tiny)
+    for xi in range(K):
+        for xj in range(K):
+            out_ref[xi, xj, :] = (
+                damp * out_ref[xi, xj, :] * inv
+                + (1.0 - damp) * chi_old_ref[xi, xj, :]
+            )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("d", "T", "damp", "eps_clamp", "block_edges", "interpret"),
+)
+def dp_contract(
+    chi_in,      # f32[Ed, d, K, K]  (gathered, bias/mask already applied)
+    a_tilted,    # f32[K, K, M]
+    chi_old,     # f32[Ed, K, K]
+    *,
+    d: int,
+    T: int,
+    damp: float,
+    eps_clamp: float = 0.0,
+    block_edges: int = 512,
+    interpret: bool = False,
+):
+    """Fused DP + contraction + normalize + damp for one edge-degree class.
+
+    Returns f32[Ed, K, K] — the damped updated messages for these edges.
+    """
+    K = 2**T
+    M = (d + 1) ** T
+    Ed = chi_in.shape[0]
+    offsets = tuple(int(o) for o in _flat_offsets(d, T))
+
+    Eb = min(block_edges, max(LANE, ((Ed + LANE - 1) // LANE) * LANE))
+    pad = (-Ed) % Eb
+    n_tiles = (Ed + pad) // Eb
+
+    # edges -> lane axis; pad lanes carry zeros (z=0 -> tiny denominator,
+    # outputs on pad lanes are discarded by the final slice)
+    chi_in_t = jnp.pad(
+        jnp.transpose(chi_in, (1, 2, 3, 0)), ((0, 0),) * 3 + ((0, pad),)
+    )
+    chi_old_t = jnp.pad(
+        jnp.transpose(chi_old, (1, 2, 0)), ((0, 0),) * 2 + ((0, pad),)
+    )
+    a_rows = a_tilted.reshape(K * K, M, 1).astype(jnp.float32)
+
+    kernel = functools.partial(
+        _dp_contract_kernel,
+        d=d,
+        K=K,
+        M=M,
+        offsets=offsets,
+        damp=float(damp),
+        eps_clamp=float(eps_clamp),
+    )
+    out_t = pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec(
+                (d, K, K, Eb), lambda i: (0, 0, 0, i), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((K * K, M, 1), lambda i: (0, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, K, Eb), lambda i: (0, 0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (K, K, Eb), lambda i: (0, 0, i), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((K, K, Ed + pad), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((K, M, Eb), jnp.float32),
+            pltpu.VMEM((K, M, Eb), jnp.float32),
+        ],
+        interpret=interpret,
+    )(chi_in_t.astype(jnp.float32), a_rows, chi_old_t.astype(jnp.float32))
+    return jnp.transpose(out_t[:, :, :Ed], (2, 0, 1))
+
+
+def pallas_supported(d: int, T: int, Ed: int) -> bool:
+    """Heuristic gate: the unrolled kernel body scales as d·K² slice-FMAs —
+    keep it for the regimes the reference targets (T ≤ 4, d ≤ 8) and tiles
+    wide enough to fill the lanes."""
+    K = 2**T
+    M = (d + 1) ** T
+    return T <= 4 and d <= 8 and Ed >= LANE and K * M <= 4096

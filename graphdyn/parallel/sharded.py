@@ -218,3 +218,81 @@ def make_sharded_sa_step(
 
 def place_sharded(mesh: Mesh, x, spec: P):
     return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+# ---------------------------------------------------------------------------
+# Edge-sharded BDCM sweep (giant-graph message passing over the mesh)
+# ---------------------------------------------------------------------------
+
+
+def make_sharded_sweep(
+    data,
+    mesh: Mesh,
+    *,
+    damp: float,
+    eps_clamp: float = 0.0,
+    mask_invalid_src: bool = True,
+    edge_axis: str = "edge",
+):
+    """Edge-parallel BDCM sweep ``(chi, lmbd) -> chi'`` over ``mesh``.
+
+    The reference's BP sweeps are single-device (`HPR_pytorch_RRG.py:348`,
+    `ER_BDCM_entropy.ipynb:424`). For giant single graphs the per-class DP
+    tensors (``[Ed, K, (d+1)^T]`` — the memory hot spot, SURVEY.md §7 "hard
+    parts") dominate; here they shard over the mesh's ``edge_axis`` via GSPMD
+    sharding constraints: the message array stays replicated (it is small —
+    the DP state is what explodes), each device computes the DP + contraction
+    for its slice of every degree class, and XLA inserts the (all_gather /
+    scatter) collectives over ICI. Numerically identical to
+    :func:`graphdyn.ops.bdcm.make_sweep` — covered by the sharded-vs-unsharded
+    equivalence test on the simulated CPU mesh (SURVEY.md §4.4).
+    """
+    import jax.numpy as jnp
+
+    from graphdyn.ops.bdcm import class_update
+
+    T, K = data.T, data.K
+    valid = jnp.asarray(data.valid)
+    x0 = jnp.asarray(data.x0, jnp.float32)
+    n_shards = int(mesh.shape[edge_axis])
+    classes = []
+    for cls in data.edge_classes:
+        Ed = cls.idx.shape[0]
+        pad = (-Ed) % n_shards
+        # pad class members by repeating the first edge; padded lanes compute
+        # a duplicate update that lands on the same index via the scatter —
+        # `.at[idx].set` with duplicate indices writes the same value, so the
+        # result is unchanged
+        idx = np.concatenate([cls.idx, np.repeat(cls.idx[:1], pad)])
+        in_edges = np.concatenate(
+            [cls.in_edges, np.repeat(cls.in_edges[:1], pad, axis=0)]
+        )
+        classes.append(
+            (
+                cls.d,
+                jnp.asarray(idx),
+                jnp.asarray(in_edges),
+                jnp.asarray(cls.A, jnp.float32),
+            )
+        )
+
+    shard = NamedSharding(mesh, P(edge_axis))
+    replicated = NamedSharding(mesh, P())
+
+    @partial(jax.jit, out_shardings=replicated)
+    def sweep(chi, lmbd):
+        tilt = jnp.exp(-lmbd * x0)
+        for d, idx, in_edges, A in classes:
+            chi_in = jax.lax.with_sharding_constraint(
+                chi[in_edges], NamedSharding(mesh, P(edge_axis, None, None, None))
+            )
+            if mask_invalid_src:
+                chi_in = chi_in * valid[None, None, :, None]
+            upd = class_update(
+                chi_in, A, tilt, chi[idx], d=d, T=T, K=K,
+                damp=damp, eps_clamp=eps_clamp,
+            )
+            chi = chi.at[idx].set(upd)
+        return chi
+
+    return sweep

@@ -34,30 +34,35 @@ def load_results_npz(path: str) -> dict[str, np.ndarray]:
 class Checkpoint:
     """Minimal atomic checkpoint of a solver-state dict of arrays + metadata.
 
-    Layout: ``<path>.npz`` (arrays) and ``<path>.json`` (scalars). Writes go
-    through a temp file + rename so a preempted run never sees a torn file.
+    Layout: one ``<path>.npz`` holding the arrays plus the metadata as a
+    JSON-encoded ``__meta__`` entry. The single file goes through a temp
+    file + ``os.replace``, so arrays and metadata can never be torn apart by
+    a preemption — a reader sees either the old checkpoint or the new one.
     """
+
+    _META_KEY = "__meta__"
 
     def __init__(self, path: str):
         self.path = path
 
     def save(self, arrays: dict[str, Any], meta: dict[str, Any]) -> None:
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        if self._META_KEY in arrays:
+            raise ValueError(f"array key {self._META_KEY!r} is reserved")
         tmp = self.path + ".tmp.npz"
-        np.savez(tmp, **{k: np.asarray(v) for k, v in arrays.items()})
+        payload = {k: np.asarray(v) for k, v in arrays.items()}
+        payload[self._META_KEY] = np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8
+        )
+        np.savez(tmp, **payload)
         os.replace(tmp, self.path + ".npz")
-        tmp_j = self.path + ".tmp.json"
-        with open(tmp_j, "w") as f:
-            json.dump(meta, f)
-        os.replace(tmp_j, self.path + ".json")
 
     def load(self) -> tuple[dict[str, np.ndarray], dict[str, Any]] | None:
-        if not (os.path.exists(self.path + ".npz") and os.path.exists(self.path + ".json")):
+        if not os.path.exists(self.path + ".npz"):
             return None
         with np.load(self.path + ".npz") as f:
-            arrays = {k: f[k] for k in f.files}
-        with open(self.path + ".json") as f:
-            meta = json.load(f)
+            arrays = {k: f[k] for k in f.files if k != self._META_KEY}
+            meta = json.loads(f[self._META_KEY].tobytes().decode())
         return arrays, meta
 
 

@@ -1,0 +1,54 @@
+"""Persistence: npz results round-trip, atomic checkpoint, periodic saver."""
+
+import numpy as np
+
+from graphdyn.utils.io import (
+    Checkpoint,
+    PeriodicCheckpointer,
+    load_results_npz,
+    save_results_npz,
+)
+
+
+def test_results_npz_roundtrip(tmp_path):
+    p = str(tmp_path / "res.npz")
+    save_results_npz(
+        p, mag_reached=np.array([0.5]), conf=np.ones((2, 3), np.int8), time=1.25
+    )
+    out = load_results_npz(p)
+    assert set(out) == {"mag_reached", "conf", "time"}
+    np.testing.assert_array_equal(out["conf"], np.ones((2, 3), np.int8))
+
+
+def test_checkpoint_roundtrip_single_file(tmp_path):
+    ck = Checkpoint(str(tmp_path / "state"))
+    assert ck.load() is None
+    arrays = {"chi": np.arange(6.0).reshape(2, 3), "s": np.array([1, -1], np.int8)}
+    meta = {"lmbd_index": 7, "t": 123, "seed": 5}
+    ck.save(arrays, meta)
+    # single-file layout: arrays+meta can never be torn apart by preemption
+    assert (tmp_path / "state.npz").exists()
+    assert not (tmp_path / "state.json").exists()
+    arrs, m = ck.load()
+    assert m == meta
+    np.testing.assert_array_equal(arrs["chi"], arrays["chi"])
+    np.testing.assert_array_equal(arrs["s"], arrays["s"])
+
+
+def test_checkpoint_reserved_key(tmp_path):
+    ck = Checkpoint(str(tmp_path / "state"))
+    try:
+        ck.save({"__meta__": np.zeros(1)}, {})
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("reserved key must be rejected")
+
+
+def test_periodic_checkpointer_throttles(tmp_path):
+    pc = PeriodicCheckpointer(str(tmp_path / "pc"), interval_s=1e9)
+    assert not pc.maybe_save({"x": np.zeros(1)}, {})   # within interval
+    pc._last -= 2e9
+    assert pc.maybe_save({"x": np.zeros(1)}, {"t": 1})
+    arrs, meta = pc.ckpt.load()
+    assert meta == {"t": 1}
