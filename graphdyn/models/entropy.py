@@ -90,6 +90,7 @@ def entropy_sweep(
     chi0=None,
     lambdas: np.ndarray | None = None,
     verbose: bool = False,
+    checkpointer=None,
 ) -> EntropyResult:
     """Run the λ ladder on one graph instance.
 
@@ -97,6 +98,12 @@ def entropy_sweep(
     analytically (φ gets ``−λ·n_iso/n``, m_init gets ``+n_iso/n``,
     `ipynb:283-291,338`). ``n_total`` overrides the density normalization
     (defaults to ``graph.n`` including isolates).
+
+    ``checkpointer``: optional :class:`graphdyn.utils.io.PeriodicCheckpointer`
+    — the notebook's time-triggered intermediate-save sketch
+    (`ipynb:439-445,475-476`) made live: after each λ the warm-start state
+    (chi) and the results so far are offered for saving; resume by passing the
+    restored ``chi`` as ``chi0`` and the remaining ladder as ``lambdas``.
     """
     config = config or EntropyConfig()
     dyn = config.dynamics
@@ -145,6 +152,18 @@ def entropy_sweep(
         sweeps.append(t)
         if verbose:
             print(f"lambda={lmbd:.2f} t={t} m_init={m0:.5f} ent1={e1:.5f}")
+        if checkpointer is not None and checkpointer.due():
+            checkpointer.maybe_save(
+                {
+                    "chi": np.asarray(chi),
+                    "ent": np.array(ents),
+                    "m_init": np.array(m_inits),
+                    "ent1": np.array(ent1s),
+                    "sweeps": np.array(sweeps),
+                    "lambdas": np.array(visited),
+                },
+                {"lmbd": float(lmbd), "seed": seed},
+            )
         # early exits (`ipynb:446-447`)
         if e1 < config.ent_floor or failed:
             break
@@ -171,6 +190,9 @@ class EntropyGridResult(NamedTuple):
     mean_degrees: np.ndarray
     max_degrees: np.ndarray
     mean_degrees_total: np.ndarray
+    counts: np.ndarray          # [deg, rep] — the λ at which BP failed to
+                                # converge, or 0 (the reference's `counts`,
+                                # `ipynb:429-431`)
 
 
 def entropy_grid(
@@ -181,9 +203,11 @@ def entropy_grid(
     seed: int = 0,
     graph_method: str = "numpy",
     verbose: bool = False,
+    save_path: str | None = None,
 ) -> EntropyGridResult:
     """The notebook's full experiment driver: deg-grid × repetitions × λ
-    ladder on fresh ER instances (`ipynb:496-513`)."""
+    ladder on fresh ER instances (`ipynb:496-513`); ``save_path`` persists
+    the result grids npz-style (the commented save at `ipynb:515`)."""
     config = config or EntropyConfig()
     lambdas = lambda_ladder(config)
     L = lambdas.size
@@ -196,6 +220,7 @@ def entropy_grid(
     mean_degrees = np.zeros((D, Rr))
     max_degrees = np.zeros((D, Rr))
     mean_degrees_total = np.zeros((D, Rr))
+    counts = np.zeros((D, Rr))
 
     for di, deg in enumerate(deg_grid):
         for rep in range(Rr):
@@ -211,8 +236,9 @@ def entropy_grid(
             ent[di, rep, :k] = res.ent
             m_init[di, rep, :k] = res.m_init
             ent1[di, rep, :k] = res.ent1
+            counts[di, rep] = res.nonconverged
 
-    return EntropyGridResult(
+    out = EntropyGridResult(
         deg=np.asarray(deg_grid),
         ent=ent,
         m_init=m_init,
@@ -221,4 +247,10 @@ def entropy_grid(
         mean_degrees=mean_degrees,
         max_degrees=max_degrees,
         mean_degrees_total=mean_degrees_total,
+        counts=counts,
     )
+    if save_path:
+        from graphdyn.utils.io import save_results_npz
+
+        save_results_npz(save_path, **out._asdict())
+    return out

@@ -25,6 +25,7 @@ capabilities, and are not reproduced.
 
 from __future__ import annotations
 
+import time
 from typing import NamedTuple
 
 import numpy as np
@@ -46,6 +47,8 @@ class HPRResult(NamedTuple):
     m_final: float           # 1.0 success, 2.0 timeout sentinel
     biases: np.ndarray       # f32[n, 2] — final reinforcement biases
     chi: np.ndarray          # final messages
+    elapsed_s: float         # wall-clock seconds (`HPR:257,364` — persisted
+                             # as `time` in the reference npz, `HPR:377`)
 
 
 def hpr_solve(
@@ -56,6 +59,7 @@ def hpr_solve(
     chi0=None,
 ) -> HPRResult:
     """Run one HPr chain on one graph instance."""
+    t_start = time.perf_counter()
     config = config or HPRConfig()
     dyn = config.dynamics
     n = graph.n
@@ -147,4 +151,60 @@ def hpr_solve(
         m_final=float(m_final),
         biases=np.asarray(biases),
         chi=np.asarray(chi),
+        elapsed_s=time.perf_counter() - t_start,
     )
+
+
+class HPREnsembleResult(NamedTuple):
+    """The reference driver's per-repetition arrays
+    (`HPR_pytorch_RRG.py:251-255,359-362`)."""
+
+    mag_reached: np.ndarray  # f[n_rep]
+    conf: np.ndarray         # int8[n_rep, n]
+    num_steps: np.ndarray    # int[n_rep]
+    graphs: np.ndarray       # int32[n_rep, n, d]
+    time: np.ndarray         # f[n_rep] wall-clock seconds (`HPR:364,370`)
+
+
+def hpr_ensemble(
+    n: int,
+    d: int,
+    config: HPRConfig | None = None,
+    *,
+    n_rep: int = 1,
+    seed: int = 0,
+    graph_method: str = "pairing",
+    save_path: str | None = None,
+) -> HPREnsembleResult:
+    """The reference's experiment driver (`HPR_pytorch_RRG.py:259-377`):
+    ``n_rep`` repetitions, each on a freshly sampled RRG(n, d); pass
+    ``save_path`` to persist the npz with the reference's key names
+    (`HPR:377` — the only live persistence in the reference repo)."""
+    from graphdyn.graphs import random_regular_graph
+    from graphdyn.utils.io import save_results_npz
+
+    config = config or HPRConfig()
+    mag = np.empty(n_rep, np.float64)
+    conf = np.empty((n_rep, n), np.int8)
+    steps = np.empty(n_rep, np.int64)
+    graphs = np.empty((n_rep, n, d), np.int32)
+    times = np.empty(n_rep, np.float64)
+    for k in range(n_rep):
+        g = random_regular_graph(n, d, seed=seed + k, method=graph_method)
+        res = hpr_solve(g, config, seed=seed + k)
+        mag[k] = float(res.mag_reached)
+        conf[k] = res.s
+        steps[k] = res.num_steps
+        graphs[k] = g.nbr
+        times[k] = res.elapsed_s
+    out = HPREnsembleResult(mag, conf, steps, graphs, times)
+    if save_path:
+        save_results_npz(
+            save_path,
+            mag_reached=out.mag_reached,
+            conf=out.conf,
+            num_steps=out.num_steps,
+            graphs=out.graphs,
+            time=out.time,
+        )
+    return out

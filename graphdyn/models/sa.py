@@ -263,6 +263,110 @@ def simulated_annealing(
     )
 
 
+def energy(
+    graph,
+    s,
+    a: float,
+    b: float,
+    p: int,
+    c: int,
+    rule: str = "majority",
+    tie: str = "stay",
+    backend: str = "jax_tpu",
+) -> float:
+    """The SA objective ``E = (a·Σs(0) − b·Σs(end))/n`` (`SA_RRG.py:28-30` —
+    defined there but driven only through its flip-delta; exposed here as a
+    first-class observable). Batched ``s`` returns one energy per replica."""
+    from graphdyn.ops.dynamics import end_state
+
+    s = np.asarray(s)
+    batched = s.ndim == 2
+    s2 = s if batched else s[None]
+    if backend in ("jax", "jax_tpu"):
+        # one dispatch through the shared batched hot kernel
+        import jax.numpy as jnp
+
+        from graphdyn.ops.dynamics import batched_rollout
+
+        nbr = graph.nbr if hasattr(graph, "nbr") else graph
+        s_end = np.asarray(
+            batched_rollout(
+                jnp.asarray(nbr), jnp.asarray(s2, jnp.int8), p + c - 1, rule, tie
+            )
+        )
+    else:
+        # the cpu/torch oracles are single-configuration; roll rows one by one
+        s_end = np.stack(
+            [np.asarray(end_state(graph, row, p, c, rule, tie, backend)) for row in s2]
+        )
+    n = s2.shape[-1]
+    e = (
+        a * s2.astype(np.float64).sum(axis=-1)
+        - b * s_end.astype(np.float64).sum(axis=-1)
+    ) / n
+    return e if batched else float(e[0])
+
+
+class SAEnsembleResult(NamedTuple):
+    """The reference driver's per-repetition arrays (`SA_RRG.py:53-56,86-88`):
+    a FRESH graph is sampled per repetition; ``graphs`` stacks the neighbor
+    tables exactly as the reference records them."""
+
+    mag_reached: np.ndarray  # f[N_stat]
+    num_steps: np.ndarray    # int[N_stat]
+    conf: np.ndarray         # int8[N_stat, n]
+    graphs: np.ndarray       # int32[N_stat, n, d]
+    m_final: np.ndarray      # f[N_stat]
+
+
+def sa_ensemble(
+    n: int,
+    d: int,
+    config: SAConfig | None = None,
+    *,
+    n_stat: int = 5,
+    seed: int = 0,
+    graph_method: str = "pairing",
+    max_steps: int | None = None,
+    save_path: str | None = None,
+    backend: str = "jax_tpu",
+) -> SAEnsembleResult:
+    """The reference's experiment driver (`SA_RRG.py:58-92`): ``n_stat``
+    repetitions, each on a freshly sampled RRG(n, d). Each repetition runs as
+    one replica of the batched solver; pass ``save_path`` to persist the
+    npz with the reference's key names (`SA_RRG.py:92`)."""
+    from graphdyn.graphs import random_regular_graph
+    from graphdyn.utils.io import save_results_npz
+
+    config = config or SAConfig()
+    mag = np.empty(n_stat, np.float64)
+    steps = np.empty(n_stat, np.int64)
+    conf = np.empty((n_stat, n), np.int8)
+    graphs = np.empty((n_stat, n, d), np.int32)
+    m_final = np.empty(n_stat, np.float64)
+    for k in range(n_stat):
+        g = random_regular_graph(n, d, seed=seed + k, method=graph_method)
+        res = simulated_annealing(
+            g, config, n_replicas=1, seed=seed + k,
+            max_steps=max_steps, backend=backend,
+        )
+        mag[k] = res.mag_reached[0]
+        steps[k] = res.num_steps[0]
+        conf[k] = res.s[0]
+        graphs[k] = g.nbr
+        m_final[k] = res.m_final[0]
+    out = SAEnsembleResult(mag, steps, conf, graphs, m_final)
+    if save_path:
+        save_results_npz(
+            save_path,
+            mag_reached=out.mag_reached,
+            num_steps=out.num_steps,
+            conf=out.conf,
+            graphs=out.graphs,
+        )
+    return out
+
+
 def _sa_reference_numpy(
     graph, config, s0, a0, b0, proposals, uniforms, max_steps, np_dt, seed
 ) -> SAResult:

@@ -78,14 +78,19 @@ class PeriodicCheckpointer:
         self._last = time.monotonic()
         self._count = 0
 
-    def maybe_save(self, arrays: dict[str, Any], meta: dict[str, Any]) -> bool:
-        now = time.monotonic()
-        if now - self._last < self.interval_s:
-            return False
+    def due(self) -> bool:
+        """True when the next ``maybe_save`` would actually write — callers
+        with expensive payloads (device-to-host copies) should gate payload
+        construction on this."""
         if self.max_saves is not None and self._count >= self.max_saves:
             return False
+        return time.monotonic() - self._last >= self.interval_s
+
+    def maybe_save(self, arrays: dict[str, Any], meta: dict[str, Any]) -> bool:
+        if not self.due():
+            return False
         self.ckpt.save(arrays, meta)
-        self._last = now
+        self._last = time.monotonic()
         self._count += 1
         return True
 

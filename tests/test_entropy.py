@@ -77,3 +77,28 @@ def test_warm_start_resume_state():
         g, EntropyConfig(), seed=5, chi0=part.chi, lambdas=lambdas[2:]
     )
     np.testing.assert_allclose(cont.ent1[-1], full.ent1[-1], atol=5e-4)
+
+
+def test_entropy_checkpointer_and_counts(tmp_path):
+    """Time-triggered intermediate saves (`ipynb:439-445`) and the
+    nonconvergence `counts` grid (`ipynb:429-431`)."""
+    from graphdyn.utils.io import PeriodicCheckpointer
+
+    g = erdos_renyi_graph(60, 1.5 / 59, seed=9)
+    pc = PeriodicCheckpointer(str(tmp_path / "ck"), interval_s=0.0)
+    res = entropy_sweep(
+        g, EntropyConfig(lmbd_max=0.2, lmbd_step=0.1), seed=9, checkpointer=pc
+    )
+    arrays, meta = pc.ckpt.load()
+    assert arrays["chi"].shape == res.chi.shape
+    assert arrays["ent1"].size >= 1
+    assert "lmbd" in meta
+
+    grid = entropy_grid(
+        50, np.array([1.2]), EntropyConfig(lmbd_max=0.1, lmbd_step=0.1, num_rep=1),
+        seed=2, save_path=str(tmp_path / "grid.npz"),
+    )
+    assert grid.counts.shape == (1, 1)
+    from graphdyn.utils.io import load_results_npz
+    saved = load_results_npz(str(tmp_path / "grid.npz"))
+    assert "counts" in saved and "ent1" in saved

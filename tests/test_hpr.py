@@ -50,3 +50,17 @@ def test_hpr_seed_reproducible():
     r2 = hpr_solve(g, cfg, seed=7)
     assert r1.num_steps == r2.num_steps
     np.testing.assert_array_equal(r1.s, r2.s)
+
+
+def test_hpr_ensemble_driver(tmp_path):
+    """Reference npz keys incl. wall-clock `time` (`HPR_pytorch_RRG.py:377`)."""
+    from graphdyn.models.hpr import hpr_ensemble
+    from graphdyn.utils.io import load_results_npz
+
+    p = str(tmp_path / "hpr.npz")
+    cfg = HPRConfig(max_sweeps=2000)
+    out = hpr_ensemble(40, 4, cfg, n_rep=2, seed=0, save_path=p)
+    assert out.conf.shape == (2, 40)
+    assert np.all(out.time > 0)
+    saved = load_results_npz(p)
+    assert set(saved) == {"mag_reached", "conf", "num_steps", "graphs", "time"}

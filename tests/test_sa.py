@@ -94,3 +94,38 @@ def test_already_converged_takes_zero_steps():
     assert r.num_steps[0] == 0
     assert r.m_final[0] == 1.0
     assert r.mag_reached[0] == 1.0
+
+
+def test_energy_observable():
+    """E = (a·Σs(0) − b·Σs(end))/n (`SA_RRG.py:28-30`) vs a direct rollout."""
+    from graphdyn.models.sa import energy
+    from graphdyn.ops.dynamics import end_state
+
+    g = random_regular_graph(50, 3, seed=2)
+    rng = np.random.default_rng(0)
+    s = (2 * rng.integers(0, 2, size=g.n) - 1).astype(np.int8)
+    a, b, p, c = 3.0, 2.0, 2, 1
+    e = energy(g, s, a, b, p, c, backend="cpu")
+    s_end = end_state(g, s, p, c, backend="cpu")
+    want = (a * s.astype(np.float64).sum() - b * s_end.astype(np.float64).sum()) / g.n
+    assert abs(e - want) < 1e-12
+    # batched form
+    eb = energy(g, np.stack([s, -s]), a, b, p, c, backend="cpu")
+    assert eb.shape == (2,)
+    assert abs(eb[0] - want) < 1e-12
+
+
+def test_sa_ensemble_driver(tmp_path):
+    """Fresh graph per repetition + reference npz keys (`SA_RRG.py:58-92`)."""
+    from graphdyn.models.sa import sa_ensemble
+    from graphdyn.utils.io import load_results_npz
+
+    p = str(tmp_path / "mcmc.npz")
+    cfg = SAConfig(dynamics=DynamicsConfig(p=1, c=1))
+    out = sa_ensemble(30, 3, cfg, n_stat=3, seed=0, max_steps=20_000, save_path=p)
+    assert out.conf.shape == (3, 30)
+    assert out.graphs.shape == (3, 30, 3)
+    # different repetitions sampled different graphs
+    assert not np.array_equal(out.graphs[0], out.graphs[1])
+    saved = load_results_npz(p)
+    assert set(saved) == {"mag_reached", "num_steps", "conf", "graphs"}
