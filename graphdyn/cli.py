@@ -1,0 +1,160 @@
+"""Config-driven experiment runner: ``python -m graphdyn <solver> [flags]``.
+
+The reference's "config system" is hand-edited constant blocks at the top of
+each script (`SA_RRG.py:44-56`, `HPR_pytorch_RRG.py:222-255`,
+`ER_BDCM_entropy.ipynb:455-482` — SURVEY.md §5.6). Here the same parameter
+surface is a CLI over the dataclass configs, running the matching experiment
+driver and persisting reference-key npz results.
+
+Examples::
+
+    python -m graphdyn sa --n 10000 --d 4 --p 3 --c 1 --n-stat 5 --out mcmc.npz
+    python -m graphdyn hpr --n 10000 --d 4 --n-rep 1 --out hpr_d4_p1.npz
+    python -m graphdyn entropy --n 1000 --deg 1.0 1.5 2.0 --num-rep 3 --out er_p1.npz
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import numpy as np
+
+from graphdyn.config import DynamicsConfig, EntropyConfig, HPRConfig, SAConfig
+
+
+def _add_dynamics_flags(ap: argparse.ArgumentParser, p_default: int = 1):
+    ap.add_argument("--p", type=int, default=p_default, help="transient length")
+    ap.add_argument("--c", type=int, default=1, help="cycle length")
+    ap.add_argument("--rule", choices=["majority", "minority"], default="majority")
+    ap.add_argument("--tie", choices=["stay", "change"], default="stay")
+    ap.add_argument("--attr-value", type=int, choices=[1, -1], default=1)
+
+
+def _dynamics(args, p_default=None) -> DynamicsConfig:
+    return DynamicsConfig(
+        p=args.p, c=args.c, rule=args.rule, tie=args.tie, attr_value=args.attr_value
+    )
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="graphdyn")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sa = sub.add_parser("sa", help="SA initialization search (`SA_RRG.py`)")
+    sa.add_argument("--n", type=int, default=10_000)
+    sa.add_argument("--d", type=int, default=4)
+    _add_dynamics_flags(sa, p_default=3)
+    sa.add_argument("--a0-frac", type=float, default=0.015)
+    sa.add_argument("--b0-frac", type=float, default=0.010)
+    sa.add_argument("--par-a", type=float, default=1.0005)
+    sa.add_argument("--par-b", type=float, default=1.0005)
+    sa.add_argument("--a-cap-frac", type=float, default=4.5)
+    sa.add_argument("--b-cap-frac", type=float, default=5.0)
+    sa.add_argument("--n-stat", type=int, default=5)
+    sa.add_argument("--max-steps", type=int, default=None)
+    sa.add_argument("--seed", type=int, default=0)
+    sa.add_argument("--backend", default="jax_tpu")
+    sa.add_argument("--out", default=None, help="npz path (`SA_RRG.py:92` keys)")
+
+    hpr = sub.add_parser("hpr", help="HPr reinforced BP (`HPR_pytorch_RRG.py`)")
+    hpr.add_argument("--n", type=int, default=10_000)
+    hpr.add_argument("--d", type=int, default=4)
+    _add_dynamics_flags(hpr)
+    hpr.add_argument("--damp", type=float, default=0.4)
+    hpr.add_argument("--lmbd", type=float, default=25.0)
+    hpr.add_argument("--pie", type=float, default=0.3)
+    hpr.add_argument("--gamma", type=float, default=0.1)
+    hpr.add_argument("--max-sweeps", type=int, default=10_000)
+    hpr.add_argument("--n-rep", type=int, default=1)
+    hpr.add_argument("--seed", type=int, default=0)
+    hpr.add_argument("--out", default=None, help="npz path (`HPR:377` keys)")
+
+    ent = sub.add_parser("entropy", help="BDCM entropy λ-sweep (notebook)")
+    ent.add_argument("--n", type=int, default=1000)
+    ent.add_argument("--deg", type=float, nargs="+", default=[1.0, 1.5, 2.0])
+    _add_dynamics_flags(ent)
+    ent.add_argument("--lmbd-max", type=float, default=12.0)
+    ent.add_argument("--lmbd-step", type=float, default=0.1)
+    ent.add_argument("--eps", type=float, default=1e-6)
+    ent.add_argument("--damp", type=float, default=0.1)
+    ent.add_argument("--max-sweeps", type=int, default=1300)
+    ent.add_argument("--ent-floor", type=float, default=-0.05)
+    ent.add_argument("--num-rep", type=int, default=3)
+    ent.add_argument("--seed", type=int, default=0)
+    ent.add_argument("--verbose", action="store_true")
+    ent.add_argument("--out", default=None, help="npz path (`ipynb:515` keys)")
+    ent.add_argument(
+        "--checkpoint", default=None,
+        help="path prefix for time-triggered intermediate saves",
+    )
+    ent.add_argument("--checkpoint-interval", type=float, default=30.0)
+
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.cmd == "sa":
+        from graphdyn.models.sa import sa_ensemble
+
+        cfg = SAConfig(
+            dynamics=_dynamics(args),
+            a0_frac=args.a0_frac, b0_frac=args.b0_frac,
+            par_a=args.par_a, par_b=args.par_b,
+            a_cap_frac=args.a_cap_frac, b_cap_frac=args.b_cap_frac,
+        )
+        out = sa_ensemble(
+            args.n, args.d, cfg, n_stat=args.n_stat, seed=args.seed,
+            max_steps=args.max_steps, save_path=args.out, backend=args.backend,
+        )
+        print(json.dumps({
+            "solver": "sa",
+            "mag_reached": out.mag_reached.tolist(),
+            "num_steps": out.num_steps.tolist(),
+            "m_final": out.m_final.tolist(),
+            "out": args.out,
+        }))
+    elif args.cmd == "hpr":
+        from graphdyn.models.hpr import hpr_ensemble
+
+        cfg = HPRConfig(
+            dynamics=_dynamics(args),
+            damp=args.damp, lmbd=args.lmbd, pie=args.pie, gamma=args.gamma,
+            max_sweeps=args.max_sweeps,
+        )
+        out = hpr_ensemble(
+            args.n, args.d, cfg, n_rep=args.n_rep, seed=args.seed,
+            save_path=args.out,
+        )
+        print(json.dumps({
+            "solver": "hpr",
+            "mag_reached": out.mag_reached.tolist(),
+            "num_steps": out.num_steps.tolist(),
+            "time": out.time.tolist(),
+            "out": args.out,
+        }))
+    elif args.cmd == "entropy":
+        from graphdyn.models.entropy import entropy_grid
+
+        cfg = EntropyConfig(
+            dynamics=_dynamics(args),
+            lmbd_max=args.lmbd_max, lmbd_step=args.lmbd_step,
+            eps=args.eps, damp=args.damp, max_sweeps=args.max_sweeps,
+            ent_floor=args.ent_floor, num_rep=args.num_rep,
+        )
+        out = entropy_grid(
+            args.n, np.asarray(args.deg), cfg, seed=args.seed,
+            verbose=args.verbose, save_path=args.out,
+            checkpoint_path=args.checkpoint,
+            checkpoint_interval_s=args.checkpoint_interval,
+        )
+        print(json.dumps({
+            "solver": "entropy",
+            "deg": out.deg.tolist(),
+            "ent1_first_lambda": out.ent1[:, :, 0].tolist(),
+            "counts": out.counts.tolist(),
+            "out": args.out,
+        }))
+    return 0

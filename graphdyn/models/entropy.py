@@ -179,6 +179,22 @@ def entropy_sweep(
     )
 
 
+class _GridCheckpointAdapter:
+    """Injects grid coordinates into the per-sweep checkpoint metadata so a
+    resumed run knows which (deg, rep, λ) cell to continue from."""
+
+    def __init__(self, checkpointer, extra_meta: dict):
+        self._ck = checkpointer
+        self._extra = extra_meta
+        self.ckpt = checkpointer.ckpt
+
+    def due(self) -> bool:
+        return self._ck.due()
+
+    def maybe_save(self, arrays, meta) -> bool:
+        return self._ck.maybe_save(arrays, {**meta, **self._extra})
+
+
 class EntropyGridResult(NamedTuple):
     """The notebook driver's result grids (`ipynb:484-492`)."""
 
@@ -204,11 +220,26 @@ def entropy_grid(
     graph_method: str = "numpy",
     verbose: bool = False,
     save_path: str | None = None,
+    checkpoint_path: str | None = None,
+    checkpoint_interval_s: float = 30.0,
 ) -> EntropyGridResult:
     """The notebook's full experiment driver: deg-grid × repetitions × λ
     ladder on fresh ER instances (`ipynb:496-513`); ``save_path`` persists
-    the result grids npz-style (the commented save at `ipynb:515`)."""
+    the result grids npz-style (the commented save at `ipynb:515`).
+
+    ``checkpoint_path`` enables time-triggered intermediate saves every
+    ``checkpoint_interval_s`` seconds (the notebook's ``saving_time=30``
+    sketch, `ipynb:439-445,475-476`): one shared
+    :class:`~graphdyn.utils.io.PeriodicCheckpointer` across the whole grid,
+    with (deg index, rep, λ) recorded in the checkpoint metadata."""
     config = config or EntropyConfig()
+    checkpointer = None
+    if checkpoint_path is not None:
+        from graphdyn.utils.io import PeriodicCheckpointer
+
+        checkpointer = PeriodicCheckpointer(
+            checkpoint_path, interval_s=checkpoint_interval_s
+        )
     lambdas = lambda_ladder(config)
     L = lambdas.size
     D, Rr = len(deg_grid), config.num_rep
@@ -231,7 +262,13 @@ def entropy_grid(
             mean_degrees[di, rep] = live.mean() if live.size else 0.0
             max_degrees[di, rep] = g.deg.max(initial=0)
             mean_degrees_total[di, rep] = g.deg.mean()
-            res = entropy_sweep(g, config, seed=gseed, lambdas=lambdas, verbose=verbose)
+            ck = None
+            if checkpointer is not None:
+                ck = _GridCheckpointAdapter(checkpointer, {"deg_index": di, "rep": rep})
+            res = entropy_sweep(
+                g, config, seed=gseed, lambdas=lambdas, verbose=verbose,
+                checkpointer=ck,
+            )
             k = res.lambdas.size
             ent[di, rep, :k] = res.ent
             m_init[di, rep, :k] = res.m_init

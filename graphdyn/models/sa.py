@@ -283,17 +283,8 @@ def energy(
     batched = s.ndim == 2
     s2 = s if batched else s[None]
     if backend in ("jax", "jax_tpu"):
-        # one dispatch through the shared batched hot kernel
-        import jax.numpy as jnp
-
-        from graphdyn.ops.dynamics import batched_rollout
-
-        nbr = graph.nbr if hasattr(graph, "nbr") else graph
-        s_end = np.asarray(
-            batched_rollout(
-                jnp.asarray(nbr), jnp.asarray(s2, jnp.int8), p + c - 1, rule, tie
-            )
-        )
+        # end_state dispatches batched input to the shared batched hot kernel
+        s_end = np.asarray(end_state(graph, s2.astype(np.int8), p, c, rule, tie, backend))
     else:
         # the cpu/torch oracles are single-configuration; roll rows one by one
         s_end = np.stack(

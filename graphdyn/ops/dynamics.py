@@ -161,7 +161,10 @@ def run_dynamics(
     if backend == "torch":
         return _run_torch(nbr, init_spins, steps, rule, tie)
     if backend in ("jax", "jax_tpu"):
-        return _run_jax(jnp.asarray(nbr), jnp.asarray(init_spins), steps, rule, tie)
+        s = jnp.asarray(init_spins)
+        if s.ndim == 2:  # replica batch -> the shared batched hot kernel
+            return batched_rollout(jnp.asarray(nbr), s, steps, rule, tie)
+        return _run_jax(jnp.asarray(nbr), s, steps, rule, tie)
     raise ValueError(f"unknown backend {backend!r}")
 
 

@@ -1,0 +1,48 @@
+"""Numerical sanitizers (SURVEY.md §5.2).
+
+The reference has no sanitizers (single-threaded Python; its only guards are
+ε-clamps at `HPR_pytorch_RRG.py:157-158`, `ER_BDCM_entropy.ipynb:209,276`).
+The TPU-native analogues: a ``debug_nans`` context that makes XLA raise at
+the op that produced a NaN/Inf, and a ``checkify`` wrapper that compiles
+float checks *into* the jitted program (works under jit/vmap/scan where
+Python-level assertions cannot run). Determinism over shardings — the psum
+order-independence concern — is covered by the sharded-vs-unsharded and
+mesh-layout-invariance tests in ``tests/test_parallel.py``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def debug_nans(enable: bool = True):
+    """``with debug_nans():`` — any NaN produced inside re-runs the offending
+    op un-jitted and raises with its location (jax's debug_nans mode)."""
+    import jax
+
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", enable)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def checked(fn):
+    """Compile float-error checks into ``fn``: returns a callable with the
+    same signature that raises ``JaxRuntimeError`` on NaN/Inf/div-by-zero
+    produced anywhere inside, including under jit/scan/while_loop."""
+    import functools
+
+    from jax.experimental import checkify
+
+    cfn = checkify.checkify(fn, errors=checkify.float_checks)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        err, out = cfn(*args, **kwargs)
+        checkify.check_error(err)
+        return out
+
+    return wrapper

@@ -1,0 +1,46 @@
+"""CLI experiment runner: flag surface → configs → drivers → npz output."""
+
+import json
+
+import numpy as np
+
+from graphdyn.cli import main
+from graphdyn.utils.io import load_results_npz
+
+
+def test_cli_sa(tmp_path, capsys):
+    out = str(tmp_path / "mcmc.npz")
+    rc = main([
+        "sa", "--n", "40", "--d", "3", "--p", "1", "--c", "1",
+        "--n-stat", "2", "--seed", "0", "--max-steps", "20000", "--out", out,
+    ])
+    assert rc == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["solver"] == "sa" and len(line["m_final"]) == 2
+    assert set(load_results_npz(out)) == {"mag_reached", "num_steps", "conf", "graphs"}
+
+
+def test_cli_hpr(tmp_path, capsys):
+    out = str(tmp_path / "hpr.npz")
+    rc = main([
+        "hpr", "--n", "40", "--d", "4", "--max-sweeps", "1500",
+        "--n-rep", "1", "--out", out,
+    ])
+    assert rc == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["solver"] == "hpr" and len(line["time"]) == 1
+    assert "time" in load_results_npz(out)
+
+
+def test_cli_entropy(tmp_path, capsys):
+    out = str(tmp_path / "er.npz")
+    rc = main([
+        "entropy", "--n", "50", "--deg", "1.2", "--num-rep", "1",
+        "--lmbd-max", "0.1", "--lmbd-step", "0.1", "--out", out,
+    ])
+    assert rc == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["solver"] == "entropy"
+    saved = load_results_npz(out)
+    assert "ent1" in saved and "counts" in saved
+    assert np.asarray(saved["ent1"]).shape[0] == 1

@@ -97,8 +97,12 @@ def test_entropy_checkpointer_and_counts(tmp_path):
     grid = entropy_grid(
         50, np.array([1.2]), EntropyConfig(lmbd_max=0.1, lmbd_step=0.1, num_rep=1),
         seed=2, save_path=str(tmp_path / "grid.npz"),
+        checkpoint_path=str(tmp_path / "grid_ck"), checkpoint_interval_s=0.0,
     )
     assert grid.counts.shape == (1, 1)
-    from graphdyn.utils.io import load_results_npz
+    from graphdyn.utils.io import Checkpoint, load_results_npz
     saved = load_results_npz(str(tmp_path / "grid.npz"))
     assert "counts" in saved and "ent1" in saved
+    # grid checkpoints carry the grid coordinates for resume
+    _, meta = Checkpoint(str(tmp_path / "grid_ck")).load()
+    assert {"deg_index", "rep", "lmbd"} <= set(meta)

@@ -113,6 +113,9 @@ def test_energy_observable():
     eb = energy(g, np.stack([s, -s]), a, b, p, c, backend="cpu")
     assert eb.shape == (2,)
     assert abs(eb[0] - want) < 1e-12
+    # jax batched path == cpu oracle (integer dynamics -> exact)
+    ej = energy(g, np.stack([s, -s]), a, b, p, c, backend="jax")
+    np.testing.assert_allclose(ej, eb, rtol=0, atol=1e-12)
 
 
 def test_sa_ensemble_driver(tmp_path):
