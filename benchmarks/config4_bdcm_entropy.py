@@ -36,6 +36,23 @@ def run(n, n_graphs, n_lambda):
         graphs=n_graphs,
     )
 
+    # vmapped congruent-ensemble path: all graphs × the λ ladder as ONE
+    # device program (no per-graph dispatch/compile)
+    from graphdyn.graphs import random_regular_graph
+    from graphdyn.models.entropy import entropy_ensemble
+
+    graphs = [random_regular_graph(n, 3, seed=k) for k in range(n_graphs)]
+    t0 = time.perf_counter()
+    res = entropy_ensemble(graphs, cfg, seed=0, lambdas=lambdas)
+    dt = time.perf_counter() - t0
+    report(
+        "bdcm_entropy_ensemble_graph_lambda_points_per_sec_n%d" % n,
+        res.lambdas.size * n_graphs / dt,
+        "graph-lambda-points/s",
+        graphs=n_graphs,
+        vmapped=True,
+    )
+
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()

@@ -31,7 +31,7 @@ def _add_dynamics_flags(ap: argparse.ArgumentParser, p_default: int = 1):
     ap.add_argument("--attr-value", type=int, choices=[1, -1], default=1)
 
 
-def _dynamics(args, p_default=None) -> DynamicsConfig:
+def _dynamics(args) -> DynamicsConfig:
     return DynamicsConfig(
         p=args.p, c=args.c, rule=args.rule, tie=args.tie, attr_value=args.attr_value
     )

@@ -179,6 +179,119 @@ def entropy_sweep(
     )
 
 
+class EnsembleEntropyResult(NamedTuple):
+    lambdas: np.ndarray    # ladder values visited [count]
+    ent: np.ndarray        # φ [count, G]
+    m_init: np.ndarray     # [count, G]
+    ent1: np.ndarray       # [count, G]
+    sweeps: np.ndarray     # joint fixed-point sweep counts [count]
+    nonconverged: float    # λ whose joint fixed point failed, or 0 — the
+                           # serial path's sentinel (`ipynb:429-431`); entries
+                           # at that λ are not fixed-point values
+    chi: np.ndarray        # [G, 2E, K, K] resume state
+
+
+def entropy_ensemble(
+    graphs,
+    config: EntropyConfig | None = None,
+    *,
+    seed: int = 0,
+    lambdas: np.ndarray | None = None,
+    ent_floor_mode: str = "all",
+) -> EnsembleEntropyResult:
+    """The λ ladder over a *structurally congruent* graph ensemble (e.g.
+    RRG(n, d) instances) as ONE vmapped device program — the BASELINE
+    config-4 shape (G graphs × λ ladder) without per-graph dispatch or
+    recompilation.
+
+    The fixed point iterates until every instance satisfies
+    ``max|Δchi| < eps`` (converged instances sit at their fixed point, so
+    extra sweeps are no-ops within eps). Early exit on the entropy floor uses
+    ``all`` (default) or ``any`` instance crossing, per ``ent_floor_mode``.
+    Isolated nodes are not supported here — use :func:`entropy_sweep`
+    per-graph for ensembles with isolates.
+    """
+    from graphdyn.ops.bdcm import (
+        EnsembleBDCM,
+        make_ensemble_free_entropy,
+        make_ensemble_leaf_setter,
+        make_ensemble_m_init,
+        make_ensemble_sweep,
+    )
+
+    if ent_floor_mode not in ("all", "any"):
+        raise ValueError(f"ent_floor_mode must be 'all' or 'any', got {ent_floor_mode!r}")
+    config = config or EntropyConfig()
+    dyn = config.dynamics
+    for g in graphs:
+        if (g.deg == 0).any():
+            raise ValueError("entropy_ensemble requires isolate-free graphs")
+    datas = [
+        BDCMData(g, p=dyn.p, c=dyn.c, attr_value=dyn.attr_value,
+                 rule=dyn.rule, tie=dyn.tie)
+        for g in graphs
+    ]
+    ens = EnsembleBDCM(datas)
+    sweep = make_ensemble_sweep(ens, damp=config.damp, eps_clamp=config.eps_clamp)
+    set_leaves = make_ensemble_leaf_setter(ens)
+    phi_fn = make_ensemble_free_entropy(ens, eps_clamp=config.eps_clamp)
+    minit_fn = make_ensemble_m_init(ens, eps_clamp=config.eps_clamp)
+
+    eps, T_max = config.eps, config.max_sweeps
+
+    @jax.jit
+    def fixed_point(chi, lmbd):
+        def cond(st):
+            _, delta, t = st
+            return (delta > eps) & (t < T_max)
+
+        def body(st):
+            chi, _, t = st
+            new = sweep(chi, lmbd)
+            return new, jnp.abs(new - chi).max(), t + 1
+
+        chi, delta, t = lax.while_loop(
+            cond, body, (chi, jnp.asarray(jnp.inf, chi.dtype), 0)
+        )
+        return chi, t, delta
+
+    if lambdas is None:
+        lambdas = lambda_ladder(config)
+    chi = ens.init_messages(seed)
+
+    ents, m_inits, ent1s, sweeps, visited = [], [], [], [], []
+    nonconverged = 0.0
+    for lmbd in lambdas:
+        lm = jnp.float32(lmbd)
+        chi = set_leaves(chi, lm)
+        chi, t, delta = fixed_point(chi, lm)
+        phi = np.asarray(phi_fn(chi, lm))
+        m0 = np.asarray(minit_fn(chi))
+        e1 = phi + float(lmbd) * m0
+        visited.append(float(lmbd))
+        ents.append(phi)
+        m_inits.append(m0)
+        ent1s.append(e1)
+        sweeps.append(int(t))
+        failed = float(delta) > config.eps
+        if failed:
+            nonconverged = float(lmbd)
+        crossed = (e1 < config.ent_floor)
+        stop = crossed.all() if ent_floor_mode == "all" else crossed.any()
+        if stop or failed:
+            break
+
+    return EnsembleEntropyResult(
+        lambdas=np.array(visited),
+        ent=np.array(ents),
+        m_init=np.array(m_inits),
+        ent1=np.array(ent1s),
+        sweeps=np.array(sweeps),
+        nonconverged=nonconverged,
+        chi=np.asarray(chi),
+    )
+
+
 class _GridCheckpointAdapter:
     """Injects grid coordinates into the per-sweep checkpoint metadata so a
     resumed run knows which (deg, rep, λ) cell to continue from."""

@@ -257,6 +257,214 @@ def make_sweep(
     return jax.jit(lambda chi, lmbd: sweep(chi, lmbd))
 
 
+class EnsembleBDCM:
+    """Stacked BDCM data for an ensemble of *structurally congruent* graphs
+    (same n, same degree-class signature — e.g. RRG(n, d) instances, where
+    every directed edge is one class of size 2E).
+
+    The reference runs its graph ensemble as a host ``for`` loop
+    (`HPR_pytorch_RRG.py:259`, `ipynb:496-497`), recompiling nothing because
+    it never compiles; a jitted per-graph loop would recompile or at best
+    re-dispatch per instance. Here the ensemble axis is a *batch* axis:
+    per-class index tables stack to ``[G, Ed, ...]`` and one ``vmap``-ed
+    program sweeps every instance at once — the BASELINE config-4 shape
+    (64 graphs × λ ladder) as a single device program.
+    """
+
+    def __init__(self, datas: list[BDCMData]):
+        if not datas:
+            raise ValueError("empty ensemble")
+        d0 = datas[0]
+        sig = [(c.d, c.idx.shape[0]) for c in d0.edge_classes]
+        nsig = [(c.d, c.idx.shape[0]) for c in d0.node_classes]
+        for dd in datas[1:]:
+            if (
+                dd.p != d0.p
+                or dd.c != d0.c
+                or dd.attr_value != d0.attr_value
+                or dd.rule != d0.rule
+                or dd.tie != d0.tie
+            ):
+                raise ValueError(
+                    "ensemble members must share dynamics parameters "
+                    "(p, c, attr_value, rule, tie) — factor tensors are shared"
+                )
+            if (
+                dd.n != d0.n
+                or dd.T != d0.T
+                or [(c.d, c.idx.shape[0]) for c in dd.edge_classes] != sig
+                or [(c.d, c.idx.shape[0]) for c in dd.node_classes] != nsig
+                or dd.leaf_idx.size != d0.leaf_idx.size
+            ):
+                raise ValueError(
+                    "ensemble graphs must be structurally congruent "
+                    "(same n and degree-class signature)"
+                )
+        self.datas = datas
+        self.G = len(datas)
+        self.T, self.K = d0.T, d0.K
+        self.n = d0.n
+        self.num_edges = d0.num_edges
+        self.num_directed = d0.num_directed
+        self.valid = d0.valid
+        self.x0 = d0.x0
+        # stacked per-class tables: (d, idx[G, Ed], in_edges[G, Ed, d], A)
+        self.edge_classes = [
+            (
+                cls.d,
+                np.stack([dd.edge_classes[k].idx for dd in datas]),
+                np.stack([dd.edge_classes[k].in_edges for dd in datas]),
+                cls.A,
+            )
+            for k, cls in enumerate(d0.edge_classes)
+        ]
+        self.node_classes = [
+            (
+                cls.d,
+                np.stack([dd.node_classes[k].idx for dd in datas]),
+                np.stack([dd.node_classes[k].in_edges for dd in datas]),
+                cls.Ai,
+            )
+            for k, cls in enumerate(d0.node_classes)
+        ]
+        self.edges = np.stack([dd.graph.edges.astype(np.int64) for dd in datas])
+        self.deg = np.stack([dd.graph.deg for dd in datas])
+        self.leaf_idx = np.stack([dd.leaf_idx for dd in datas])   # [G, L]
+        self.leaf01 = d0.leaf01
+
+    def init_messages(self, seed=0) -> jnp.ndarray:
+        """[G, 2E, K, K] random row-normalized chi, one stream per graph."""
+        rng = np.random.default_rng(seed)
+        chi = rng.random((self.G, self.num_directed, self.K, self.K))
+        chi /= chi.sum(axis=(2, 3), keepdims=True)
+        return jnp.asarray(chi, jnp.float32)
+
+
+def make_ensemble_sweep(
+    ens: EnsembleBDCM,
+    *,
+    damp: float,
+    eps_clamp: float = 0.0,
+    mask_invalid_src: bool = True,
+):
+    """Jitted ``(chi[G, 2E, K, K], lmbd) -> chi'``: the BDCM sweep vmapped
+    over the ensemble axis (λ shared across graphs)."""
+    T, K = ens.T, ens.K
+    valid = jnp.asarray(ens.valid)
+    x0 = jnp.asarray(ens.x0, jnp.float32)
+    classes = [
+        (d, jnp.asarray(idx), jnp.asarray(ie), jnp.asarray(A, jnp.float32))
+        for d, idx, ie, A in ens.edge_classes
+    ]
+
+    def sweep_one(chi, lmbd, *tables):
+        tilt = jnp.exp(-lmbd * x0)
+        for (d, _, _, A), (idx, in_edges) in zip(classes, zip(*[iter(tables)] * 2)):
+            chi_in = chi[in_edges]
+            if mask_invalid_src:
+                chi_in = chi_in * valid[None, None, :, None]
+            upd = class_update(
+                chi_in, A, tilt, chi[idx], d=d, T=T, K=K,
+                damp=damp, eps_clamp=eps_clamp,
+            )
+            chi = chi.at[idx].set(upd)
+        return chi
+
+    flat_tables = [t for _, idx, ie, _ in classes for t in (idx, ie)]
+    vsweep = jax.vmap(sweep_one, in_axes=(0, None) + (0,) * len(flat_tables))
+
+    @jax.jit
+    def sweep(chi, lmbd):
+        return vsweep(chi, lmbd, *flat_tables)
+
+    return sweep
+
+
+def make_ensemble_free_entropy(
+    ens: EnsembleBDCM, *, n_total: int | None = None, eps_clamp: float = 0.0
+):
+    """Jitted ``(chi, lmbd) -> φ[G]`` for a congruent isolate-free ensemble."""
+    T, K, n = ens.T, ens.K, ens.n
+    n_total = n_total or n
+    E = ens.num_edges
+    valid = jnp.asarray(ens.valid)
+    validf = jnp.asarray(ens.valid, jnp.float32)
+    mask2 = validf[:, None] * validf[None, :]
+    x0 = jnp.asarray(ens.x0, jnp.float32)
+    nclasses = [
+        (d, jnp.asarray(idx), jnp.asarray(ie), jnp.asarray(Ai, jnp.float32))
+        for d, idx, ie, Ai in ens.node_classes
+    ]
+
+    def phi_one(chi, lmbd, *tables):
+        tilt = jnp.exp(-lmbd * x0)
+        zi = jnp.zeros((n,), chi.dtype)
+        for (d, _, _, Ai), (idx, in_edges) in zip(nclasses, zip(*[iter(tables)] * 2)):
+            chi_in = chi[in_edges] * valid[None, None, :, None]
+            LL = _neighbor_dp(chi_in, d, T, K)
+            z = jnp.einsum("xm,nxm,x->n", Ai, LL, tilt)
+            zi = zi.at[idx].set(z)
+        zi = jnp.maximum(zi, eps_clamp)
+        P = chi[:E] * jnp.swapaxes(chi[E:], 1, 2) * mask2[None]
+        zij = jnp.maximum(P.sum(axis=(1, 2)), eps_clamp)
+        return (jnp.sum(jnp.log(zi)) - jnp.sum(jnp.log(zij))) / n_total
+
+    flat_tables = [t for _, idx, ie, _ in nclasses for t in (idx, ie)]
+    vphi = jax.vmap(phi_one, in_axes=(0, None) + (0,) * len(flat_tables))
+
+    @jax.jit
+    def phi(chi, lmbd):
+        return vphi(chi, lmbd, *flat_tables)
+
+    return phi
+
+
+def make_ensemble_m_init(ens: EnsembleBDCM, *, n_total: int | None = None, eps_clamp: float = 0.0):
+    """Jitted ``chi -> m_init[G]`` for a congruent isolate-free ensemble."""
+    E = ens.num_edges
+    n_total = n_total or ens.n
+    validf = jnp.asarray(ens.valid, jnp.float32)
+    mask2 = validf[:, None] * validf[None, :]
+    x0 = jnp.asarray(ens.x0, jnp.float32)
+    edges = jnp.asarray(ens.edges)
+    deg = jnp.asarray(ens.deg, jnp.float32)
+
+    def m_one(chi, edges_g, deg_g):
+        P = chi[:E] * jnp.swapaxes(chi[E:], 1, 2) * mask2[None]
+        Zij = jnp.maximum(P.sum(axis=(1, 2)), eps_clamp)
+        wu = x0[:, None] / deg_g[edges_g[:, 0]][:, None, None]
+        wv = x0[None, :] / deg_g[edges_g[:, 1]][:, None, None]
+        s = ((wu + wv) * P).sum(axis=(1, 2)) / Zij
+        return s.sum() / n_total
+
+    vm = jax.vmap(m_one, in_axes=(0, 0, 0))
+
+    @jax.jit
+    def m_init(chi):
+        return vm(chi, edges, deg)
+
+    return m_init
+
+
+def make_ensemble_leaf_setter(ens: EnsembleBDCM):
+    """Jitted ``(chi[G,...], lmbd) -> chi``: closed-form leaf messages per
+    graph (no-op when the ensemble has no degree-0 edges)."""
+    has_leaves = ens.leaf_idx.shape[1] > 0
+    leaf01 = jnp.asarray(ens.leaf01, jnp.float32)
+    x0 = jnp.asarray(ens.x0, jnp.float32)
+    leaf_idx = jnp.asarray(ens.leaf_idx)
+
+    @jax.jit
+    def set_leaves(chi, lmbd):
+        if not has_leaves:
+            return chi
+        t = leaf01 * jnp.exp(-lmbd * x0)[:, None]
+        t = t / t.sum()
+        return jax.vmap(lambda c, li: c.at[li].set(t[None]))(chi, leaf_idx)
+
+    return set_leaves
+
+
 def make_leaf_setter(data: BDCMData):
     """Jitted ``(chi, lmbd) -> chi`` writing the closed-form leaf messages
     (d=0 edges): normalized λ-tilted bare factor (`ipynb:403-417`)."""

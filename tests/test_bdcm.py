@@ -209,3 +209,72 @@ def test_marginals_normalized_and_shaped():
     assert marg.shape == (24, 2)
     np.testing.assert_allclose(marg.sum(axis=1), 1.0, atol=1e-6)
     assert np.all(marg >= 0)
+
+
+class TestEnsemble:
+    """Vmapped congruent-ensemble path == serial per-graph path."""
+
+    def _datas(self, G=3, n=60, d=3):
+        from graphdyn.ops.bdcm import BDCMData
+        from graphdyn.graphs import random_regular_graph
+
+        graphs = [random_regular_graph(n, d, seed=k) for k in range(G)]
+        return graphs, [BDCMData(g, p=1, c=1) for g in graphs]
+
+    def test_ensemble_sweep_matches_serial(self):
+        import jax.numpy as jnp
+        from graphdyn.ops.bdcm import EnsembleBDCM, make_ensemble_sweep, make_sweep
+
+        graphs, datas = self._datas()
+        ens = EnsembleBDCM(datas)
+        esweep = make_ensemble_sweep(ens, damp=0.2)
+        chi = np.asarray(ens.init_messages(seed=1))
+        lam = jnp.float32(0.6)
+        out_e = np.asarray(esweep(jnp.asarray(chi), lam))
+        for k, data in enumerate(datas):
+            sw = make_sweep(data, damp=0.2, use_pallas=False)
+            want = np.asarray(sw(jnp.asarray(chi[k]), lam))
+            np.testing.assert_allclose(out_e[k], want, rtol=2e-5, atol=1e-7)
+
+    def test_ensemble_observables_match_serial(self):
+        import jax.numpy as jnp
+        from graphdyn.ops.bdcm import (
+            EnsembleBDCM,
+            make_ensemble_free_entropy,
+            make_ensemble_m_init,
+            make_free_entropy,
+            make_mean_m_init,
+        )
+
+        graphs, datas = self._datas()
+        ens = EnsembleBDCM(datas)
+        chi = ens.init_messages(seed=2)
+        lam = jnp.float32(0.3)
+        phis = np.asarray(make_ensemble_free_entropy(ens)(chi, lam))
+        ms = np.asarray(make_ensemble_m_init(ens)(chi))
+        for k, (g, data) in enumerate(zip(graphs, datas)):
+            phi1 = float(make_free_entropy(data, n_total=g.n, n_iso=0)(chi[k], lam))
+            m1 = float(make_mean_m_init(data, n_total=g.n, n_iso=0)(chi[k]))
+            np.testing.assert_allclose(phis[k], phi1, rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(ms[k], m1, rtol=1e-5, atol=1e-6)
+
+    def test_incongruent_rejected(self):
+        import pytest
+        from graphdyn.ops.bdcm import BDCMData, EnsembleBDCM
+        from graphdyn.graphs import random_regular_graph
+
+        a = BDCMData(random_regular_graph(40, 3, seed=0), p=1, c=1)
+        b = BDCMData(random_regular_graph(40, 4, seed=0), p=1, c=1)
+        with pytest.raises(ValueError, match="congruent"):
+            EnsembleBDCM([a, b])
+
+    def test_mismatched_dynamics_rejected(self):
+        import pytest
+        from graphdyn.ops.bdcm import BDCMData, EnsembleBDCM
+        from graphdyn.graphs import random_regular_graph
+
+        g = random_regular_graph(40, 3, seed=0)
+        a = BDCMData(g, p=1, c=2)
+        b = BDCMData(random_regular_graph(40, 3, seed=1), p=2, c=1)
+        with pytest.raises(ValueError, match="dynamics parameters"):
+            EnsembleBDCM([a, b])
