@@ -42,8 +42,40 @@ def run(n, sweeps):
     )
 
 
+def run_replicas(n, R, sweeps):
+    """Replica-batched iteration throughput (BASELINE config 2's `256
+    replicas` axis): R chains' sweep+marginals as one device program."""
+    g = random_regular_graph(n, 3, seed=0)
+    data = BDCMData(g, p=1, c=1)
+    sweep = make_sweep(data, damp=0.4, mask_invalid_src=False, with_bias=True)
+    marginals = make_marginals(data)
+    vsweep = jax.vmap(sweep, in_axes=(0, None, 0))
+    vmarg = jax.vmap(marginals)
+    chi = jnp.stack([data.init_messages(k) for k in range(R)])
+    bias = jnp.ones((R, data.num_directed, data.K), jnp.float32)
+
+    @jax.jit
+    def body(chi):
+        chi = vsweep(chi, jnp.float32(25.0), bias)
+        return chi, vmarg(chi)
+
+    (_, _), dt = timed(lambda c: body(c), chi, iters=sweeps)
+    report(
+        "hpr_replica_message_updates_per_sec_d3_rrg_n%d_r%d" % (n, R),
+        R * data.num_directed * data.K * data.K / dt,
+        "message-combos/s",
+        sweeps_per_sec=1.0 / dt,
+        replicas=R,
+    )
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--full", action="store_true")
     a = ap.parse_args()
-    run(100_000 if a.full else 10_000, 20)
+    if a.full:
+        run(100_000, 20)
+        run_replicas(100_000, 256, 5)
+    else:
+        run(10_000, 20)
+        run_replicas(10_000, 8, 5)

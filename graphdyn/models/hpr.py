@@ -51,27 +51,30 @@ class HPRResult(NamedTuple):
                              # as `time` in the reference npz, `HPR:377`)
 
 
-def hpr_solve(
-    graph: Graph,
-    config: HPRConfig | None = None,
-    *,
-    seed: int = 0,
-    chi0=None,
-) -> HPRResult:
-    """Run one HPr chain on one graph instance."""
-    t_start = time.perf_counter()
-    config = config or HPRConfig()
+class _HPRSetup(NamedTuple):
+    """Shared per-graph preparation of both HPr solvers — one place carries
+    the reference-faithful quirks (eps_clamp=0, unmasked invalid sources,
+    the bias-to-edge gather; see the module docstring)."""
+
+    data: BDCMData
+    sweep: object
+    marginals: object
+    bias_to_edge: object
+    m_of_end_batch: object   # int8[R, n] -> f32[R]
+    lmbd: jnp.ndarray
+    pie: jnp.ndarray
+    gamma: jnp.ndarray
+    TT: int
+    n: int
+
+
+def _prep(graph: Graph, config: HPRConfig) -> _HPRSetup:
     dyn = config.dynamics
     n = graph.n
     tables = build_edge_tables(graph)
     data = BDCMData(
-        graph,
-        tables,
-        p=dyn.p,
-        c=dyn.c,
-        attr_value=dyn.attr_value,
-        rule=dyn.rule,
-        tie=dyn.tie,
+        graph, tables, p=dyn.p, c=dyn.c, attr_value=dyn.attr_value,
+        rule=dyn.rule, tie=dyn.tie,
     )
     sweep = make_sweep(
         data, damp=config.damp, eps_clamp=0.0, mask_invalid_src=False, with_bias=True
@@ -83,23 +86,47 @@ def hpr_solve(
     src = jnp.asarray(tables.src.astype(np.int64))
     sel_plus = jnp.asarray(data.x0 == 1)
     nbr = jnp.asarray(graph.nbr)
-    lmbd = jnp.float32(config.lmbd)
-    pie = jnp.float32(config.pie)
-    gamma = jnp.float32(config.gamma)
-    TT = int(config.max_sweeps)
-
-    def m_of_end(s):
-        s_end_sum = (
-            batched_rollout_impl(nbr, s[None], rollout_steps, R_coef, C_coef)
-            .astype(jnp.int32)
-            .sum()
-        )
-        return s_end_sum.astype(jnp.float32) / n
 
     def bias_to_edge(biases):
         # bias of the *source* node at its trajectory's initial value
         # (`positions_biases`, `HPR:120-133`): [2E, K]
         return jnp.where(sel_plus[None, :], biases[src, 0, None], biases[src, 1, None])
+
+    def m_of_end_batch(s):
+        s_end = batched_rollout_impl(nbr, s, rollout_steps, R_coef, C_coef)
+        return s_end.astype(jnp.int32).sum(axis=1).astype(jnp.float32) / n
+
+    return _HPRSetup(
+        data=data,
+        sweep=sweep,
+        marginals=marginals,
+        bias_to_edge=bias_to_edge,
+        m_of_end_batch=m_of_end_batch,
+        lmbd=jnp.float32(config.lmbd),
+        pie=jnp.float32(config.pie),
+        gamma=jnp.float32(config.gamma),
+        TT=int(config.max_sweeps),
+        n=n,
+    )
+
+
+def hpr_solve(
+    graph: Graph,
+    config: HPRConfig | None = None,
+    *,
+    seed: int = 0,
+    chi0=None,
+) -> HPRResult:
+    """Run one HPr chain on one graph instance."""
+    t_start = time.perf_counter()
+    config = config or HPRConfig()
+    setup = _prep(graph, config)
+    data, sweep, marginals = setup.data, setup.sweep, setup.marginals
+    bias_to_edge = setup.bias_to_edge
+    lmbd, pie, gamma, TT, n = setup.lmbd, setup.pie, setup.gamma, setup.TT, setup.n
+
+    def m_of_end(s):
+        return setup.m_of_end_batch(s[None])[0]
 
     @jax.jit
     def run(chi, biases, key):
@@ -151,6 +178,121 @@ def hpr_solve(
         m_final=float(m_final),
         biases=np.asarray(biases),
         chi=np.asarray(chi),
+        elapsed_s=time.perf_counter() - t_start,
+    )
+
+
+class HPRBatchResult(NamedTuple):
+    """Per-chain results of the replica-batched solver."""
+
+    s: np.ndarray            # int8[R, n]
+    mag_reached: np.ndarray  # f32[R]
+    num_steps: np.ndarray    # int32[R] — sweeps until that chain stopped
+    m_final: np.ndarray      # f32[R] — 1.0 success, 2.0 timeout sentinel
+    elapsed_s: float
+
+
+def hpr_solve_batch(
+    graph: Graph,
+    config: HPRConfig | None = None,
+    *,
+    n_replicas: int | None = None,
+    seed: int = 0,
+    mesh=None,
+    replica_axis: str = "replica",
+) -> HPRBatchResult:
+    """Run R independent HPr chains on ONE graph as a single batched device
+    program — the BASELINE config-2 replica axis (`N=1e5, 256 replicas`).
+
+    The reference runs one chain per process (`HPR_pytorch_RRG.py:342-356`);
+    here chains batch over a leading replica axis (chi ``[R, 2E, K, K]``,
+    biases ``[R, n, 2]``) inside one ``lax.while_loop``: finished chains
+    freeze (masked updates) while the batch runs to joint completion. Pass a
+    ``mesh`` with a ``replica_axis`` to shard the chains over devices — the
+    per-chain work needs no cross-replica communication; the only collective
+    is the tiny per-sweep ``any(active)`` all-reduce of the loop predicate.
+    """
+    t_start = time.perf_counter()
+    config = config or HPRConfig()
+    R = n_replicas if n_replicas is not None else config.n_replicas
+    setup = _prep(graph, config)
+    data, bias_to_edge = setup.data, setup.bias_to_edge
+    m_of_end_batch = setup.m_of_end_batch
+    lmbd, pie, gamma, TT, n = setup.lmbd, setup.pie, setup.gamma, setup.TT, setup.n
+
+    vsweep = jax.vmap(setup.sweep, in_axes=(0, None, 0))
+    vmarg = jax.vmap(setup.marginals)
+
+    @jax.jit
+    def run(chi, biases, keys):
+        s0 = jnp.where(biases[..., 0] > biases[..., 1], 1, -1).astype(jnp.int8)
+        m0 = m_of_end_batch(s0)
+
+        def cond(st):
+            return jnp.any(st[6])
+
+        def body(st):
+            chi, biases, s, keys, t, m_final, active, steps = st
+            chi_new = vsweep(chi, lmbd, jax.vmap(bias_to_edge)(biases))
+            marg = vmarg(chi_new)
+            minus_wins = marg[..., 1] >= marg[..., 0]
+            new_bias = jnp.where(
+                minus_wins[..., None],
+                jnp.array([pie, 1 - pie]),
+                jnp.array([1 - pie, pie]),
+            )
+            ks = jax.vmap(jax.random.split)(keys)       # [R, 2, key]
+            keys_new, ku = ks[:, 0], ks[:, 1]
+            u = jax.vmap(lambda k: jax.random.uniform(k, (n,)))(ku)
+            update = u < 1.0 - (1.0 + t.astype(jnp.float32)) ** (-gamma)
+            biases_new = jnp.where(update[..., None], new_bias, biases)
+            s_new = jnp.where(
+                biases_new[..., 0] > biases_new[..., 1], 1, -1
+            ).astype(jnp.int8)
+            t_new = t + 1
+            m_new = jnp.where(t_new > TT, 2.0, m_of_end_batch(s_new))
+            # frozen chains keep their final state
+            am = active[:, None, None, None]
+            chi = jnp.where(am, chi_new, chi)
+            biases = jnp.where(active[:, None, None], biases_new, biases)
+            s = jnp.where(active[:, None], s_new, s)
+            keys = jnp.where(active[:, None], keys_new, keys)
+            m_final = jnp.where(active, m_new, m_final)
+            steps = jnp.where(active, t_new, steps)
+            active = active & (m_final < 1.0) & (t_new <= TT)
+            return chi, biases, s, keys, t_new, m_final, active, steps
+
+        state = (
+            chi, biases, s0, keys, jnp.int32(0), m0,
+            m0 < 1.0, jnp.zeros((chi.shape[0],), jnp.int32),
+        )
+        out = lax.while_loop(cond, body, state)
+        return out[2], out[5], out[7]
+
+    rng = np.random.default_rng(seed)
+    chi0 = np.stack([np.asarray(data.init_messages(rng)) for _ in range(R)])
+    biases0 = rng.random((R, n, 2))
+    biases0 /= biases0.sum(axis=2, keepdims=True)
+    # one root key per run: distinct seeds give fully disjoint chain streams
+    keys = jax.random.split(jax.random.PRNGKey(seed), R)
+
+    chi0 = jnp.asarray(chi0)
+    biases0 = jnp.asarray(biases0, jnp.float32)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        shard = NamedSharding(mesh, P(replica_axis))
+        chi0 = jax.device_put(chi0, shard)
+        biases0 = jax.device_put(biases0, shard)
+        keys = jax.device_put(keys, shard)
+
+    s, m_final, steps = run(chi0, biases0, keys)
+    s = np.asarray(s)
+    return HPRBatchResult(
+        s=s,
+        mag_reached=s.astype(np.float64).mean(axis=1).astype(np.float32),
+        num_steps=np.asarray(steps),
+        m_final=np.asarray(m_final),
         elapsed_s=time.perf_counter() - t_start,
     )
 
