@@ -44,7 +44,15 @@ def run(n, sweeps):
 
 def run_replicas(n, R, sweeps):
     """Replica-batched iteration throughput (BASELINE config 2's `256
-    replicas` axis): R chains' sweep+marginals as one device program."""
+    replicas` axis): R chains' sweep+marginals as one device program.
+
+    The vmapped body's DP intermediates scale with R·E; the replica count is
+    capped to what a chip's HBM can hold (~32 at n=1e5 per ~16 GB) times the
+    device count, with the replica axis sharded over the mesh beyond one
+    device — the same layout ``hpr_solve_batch(mesh=...)`` uses.
+    """
+    n_dev = len(jax.devices())
+    R = min(R, 32 * max(n_dev, 1))
     g = random_regular_graph(n, 3, seed=0)
     data = BDCMData(g, p=1, c=1)
     sweep = make_sweep(data, damp=0.4, mask_invalid_src=False, with_bias=True)
@@ -53,6 +61,15 @@ def run_replicas(n, R, sweeps):
     vmarg = jax.vmap(marginals)
     chi = jnp.stack([data.init_messages(k) for k in range(R)])
     bias = jnp.ones((R, data.num_directed, data.K), jnp.float32)
+    if n_dev > 1 and R % n_dev == 0:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from graphdyn.parallel.mesh import make_mesh
+
+        mesh = make_mesh((n_dev,), ("replica",))
+        shard = NamedSharding(mesh, P("replica"))
+        chi = jax.device_put(chi, shard)
+        bias = jax.device_put(bias, shard)
 
     @jax.jit
     def body(chi):

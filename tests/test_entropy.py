@@ -12,16 +12,26 @@ from graphdyn.models.entropy import entropy_grid, entropy_sweep
 @pytest.mark.slow
 def test_golden_triples_tolerance():
     """Reference ground truth (`ER_BDCM_entropy.ipynb:18-46`, BASELINE.md):
-    deg=1.0, n=1000, p=c=1, damp=0.1, eps=1e-6. The stored run is a single
-    unseeded instance, so we check to within finite-size fluctuation."""
-    golden = {0.0: (0.78598, 0.17207), 0.4: (0.72636, 0.16058), 0.9: (0.67421, 0.12780)}
+    all ten stored (λ, m_init, ent1) triples at deg=1.0, n=1000, p=c=1,
+    damp=0.1, eps=1e-6. The stored run is a single unseeded instance, so we
+    check to within finite-size fluctuation, plus the exact monotone shape
+    of the curve (m_init and ent1 strictly decrease along λ)."""
+    golden = {
+        0.0: (0.78598, 0.17207), 0.1: (0.76994, 0.17127), 0.2: (0.75455, 0.16897),
+        0.3: (0.73998, 0.16534), 0.4: (0.72636, 0.16058), 0.5: (0.71376, 0.15492),
+        0.6: (0.70224, 0.14859), 0.7: (0.69182, 0.14183), 0.8: (0.68249, 0.13484),
+        0.9: (0.67421, 0.12780),
+    }
     g = erdos_renyi_graph(1000, 1.0 / 999, seed=2)
-    res = entropy_sweep(g, EntropyConfig(), seed=2, lambdas=np.array([0.0, 0.4, 0.9]))
-    assert res.lambdas.size == 3, "all ladder points must converge"
+    lambdas = np.round(np.arange(0.0, 0.95, 0.1), 2)
+    res = entropy_sweep(g, EntropyConfig(), seed=2, lambdas=lambdas)
+    assert res.lambdas.size == lambdas.size, "all ladder points must converge"
     for k, lam in enumerate(res.lambdas):
-        m_g, e_g = golden[float(lam)]
+        m_g, e_g = golden[float(np.round(lam, 2))]
         assert abs(res.m_init[k] - m_g) < 0.03
         assert abs(res.ent1[k] - e_g) < 0.015
+    assert np.all(np.diff(res.m_init) < 0)
+    assert np.all(np.diff(res.ent1) < 0)
     # sweep counts in the reference's warm-started regime (~130-250)
     assert np.all(res.sweeps < 600)
 
