@@ -52,7 +52,9 @@ def run_replicas(n, R, sweeps):
     device — the same layout ``hpr_solve_batch(mesh=...)`` uses.
     """
     n_dev = len(jax.devices())
-    R = min(R, 32 * max(n_dev, 1))
+    # HBM bound scales with 1/n: ~32 replicas fit per ~16 GB chip at n=1e5
+    per_dev = max(1, int(32 * 1e5 / n))
+    R = min(R, per_dev * max(n_dev, 1))
     g = random_regular_graph(n, 3, seed=0)
     data = BDCMData(g, p=1, c=1)
     sweep = make_sweep(data, damp=0.4, mask_invalid_src=False, with_bias=True)

@@ -13,22 +13,23 @@ import jax.numpy as jnp
 
 from benchmarks.common import report, timed
 from graphdyn.graphs import erdos_renyi_graph
-from graphdyn.ops.packed import packed_rollout
+from graphdyn.ops.packed import packed_consensus_fraction, packed_rollout
 
 
 def run(n, R, steps):
     g = erdos_renyi_graph(n, 6.0 / n, seed=0)
-    W = R // 32
+    W = -(-R // 32)  # ceil: pad replicas live in the top word's high bits
     rng = np.random.default_rng(0)
     sp = jnp.asarray(rng.integers(0, 2**32, size=(g.n, W), dtype=np.uint32))
     nbr = jnp.asarray(g.nbr)
     deg = jnp.asarray(g.deg)
     f = jax.jit(lambda sp: packed_rollout(nbr, deg, sp, steps))
-    _, dt = timed(f, sp)
+    out, dt = timed(f, sp)
     report(
         "er_majority_spin_updates_per_sec_n%d_r%d" % (n, R),
         n * R * steps / dt,
         "spin-updates/s",
+        consensus_fraction=packed_consensus_fraction(out, R),
     )
 
 

@@ -132,6 +132,37 @@ def packed_rollout(nbr, deg, sp, steps: int, rule: str = "majority", tie: str = 
     return lax.fori_loop(0, steps, body, sp) if steps > 0 else sp
 
 
+@partial(jax.jit, static_argnames=("target",))
+def packed_consensus_mask(sp: jnp.ndarray, target: int = 1) -> jnp.ndarray:
+    """Per-replica consensus flags straight from the packed domain.
+
+    Replica r sits at the homogeneous ``target`` state iff its bit column is
+    all-ones (target +1) / all-zeros (target −1) across every node — one
+    AND/OR word-reduction over the node axis, no unpacking. Returns
+    uint32[W] bit-flags (replica r of word w = bit r%32 of entry r//32).
+    """
+    if target == 1:
+        return jax.lax.reduce(
+            sp, np.uint32(0xFFFFFFFF), jax.lax.bitwise_and, dimensions=(0,)
+        )
+    return ~jax.lax.reduce(sp, np.uint32(0), jax.lax.bitwise_or, dimensions=(0,))
+
+
+def packed_consensus_fraction(sp, n_replicas: int, target: int = 1) -> float:
+    """Fraction of replicas at the homogeneous ``target`` consensus
+    (`observe.consensus_fraction` in the packed domain). Pad replicas
+    (unpack reads them as −1) are excluded via ``n_replicas``."""
+    sp = jnp.asarray(sp)
+    if n_replicas > sp.shape[1] * WORD:
+        raise ValueError(
+            f"n_replicas={n_replicas} exceeds packed capacity "
+            f"{sp.shape[1] * WORD} (W={sp.shape[1]} words)"
+        )
+    flags = np.asarray(packed_consensus_mask(sp, target))
+    bits = (flags[:, None] >> np.arange(WORD, dtype=np.uint32)) & np.uint32(1)
+    return float(bits.reshape(-1)[:n_replicas].sum()) / n_replicas
+
+
 def packed_end_state(graph, s, steps, rule="majority", tie="stay"):
     """Convenience wrapper: int8[R, n] in/out through the packed kernel."""
     sp = pack_spins(s)
