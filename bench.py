@@ -78,10 +78,48 @@ def torch_cpu_rate(g, steps=3):
     return g.n * steps / (time.perf_counter() - t0)
 
 
+def _init_watchdog(metric: str, timeout_s: float = 300.0):
+    """Fail loudly if device initialization hangs (e.g. an unreachable TPU
+    tunnel blocks `import jax` indefinitely): after ``timeout_s`` without the
+    armed flag being cleared, print a one-line error JSON and hard-exit so
+    the driver records a diagnosable value instead of a timeout."""
+    import os
+    import threading
+
+    done = threading.Event()
+
+    def watch():
+        if not done.wait(timeout_s):
+            print(
+                json.dumps(
+                    {
+                        "metric": metric,
+                        "value": 0.0,
+                        "unit": "spin-updates/s",
+                        "vs_baseline": 0.0,
+                        "error": "device initialization timed out "
+                                 f"after {timeout_s:.0f}s (TPU unreachable?)",
+                    }
+                ),
+                flush=True,
+            )
+            os._exit(2)
+
+    t = threading.Thread(target=watch, daemon=True)
+    t.start()
+    return done
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="small shapes, fast")
     args = ap.parse_args()
+
+    init_done = _init_watchdog("spin_updates_per_sec_per_chip_d3_rrg")
+    import jax
+
+    jax.devices()
+    init_done.set()
 
     from graphdyn.graphs import random_regular_graph
 

@@ -18,6 +18,7 @@ early-exit logic remain in Python.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
 import numpy as np
@@ -55,30 +56,42 @@ class EntropyResult(NamedTuple):
     chi: np.ndarray        # final messages (resume state)
 
 
+@partial(jax.jit, static_argnames=("spec", "eps", "t_max"))
+def _fixed_point_exec(chi, lmbd, valid, x0, tables, spec, eps: float, t_max: int):
+    """Module-level fixed-point executor: graphs whose sweep shapes coincide
+    (same degree-class signature, e.g. via ``BDCMData(class_bucket=...)``)
+    share ONE compiled while_loop instead of recompiling per instance."""
+    from graphdyn.ops.bdcm import _sweep_core
+
+    def cond(st):
+        _, delta, t = st
+        return (delta > eps) & (t < t_max)
+
+    def body(st):
+        chi, _, t = st
+        new = _sweep_core(chi, lmbd, None, valid, x0, tables, spec)
+        return new, jnp.abs(new - chi).max(), t + 1
+
+    chi, delta, t = lax.while_loop(
+        cond, body, (chi, jnp.asarray(jnp.inf, chi.dtype), 0)
+    )
+    return chi, t, delta
+
+
 def make_fixed_point(data: BDCMData, config: EntropyConfig):
-    """Jitted ``(chi, lmbd) -> (chi*, sweeps, delta)``: iterate the sweep
-    until ``max|Δchi| < eps`` or ``max_sweeps`` (`ipynb:420-432`)."""
-    sweep = make_sweep(data, damp=config.damp, eps_clamp=config.eps_clamp)
-    eps = config.eps
-    T_max = config.max_sweeps
+    """``(chi, lmbd) -> (chi*, sweeps, delta)``: iterate the sweep until
+    ``max|Δchi| < eps`` or ``max_sweeps`` (`ipynb:420-432`), via the shared
+    executor."""
+    from graphdyn.ops.bdcm import _sweep_args
 
-    @jax.jit
-    def fixed_point(chi, lmbd):
-        def cond(st):
-            _, delta, t = st
-            return (delta > eps) & (t < T_max)
-
-        def body(st):
-            chi, _, t = st
-            new = sweep(chi, lmbd)
-            return new, jnp.abs(new - chi).max(), t + 1
-
-        chi, delta, t = lax.while_loop(
-            cond, body, (chi, jnp.asarray(jnp.inf, chi.dtype), 0)
-        )
-        return chi, t, delta
-
-    return fixed_point
+    valid, x0, tables, spec = _sweep_args(
+        data, damp=config.damp, eps_clamp=config.eps_clamp,
+        mask_invalid_src=True, with_bias=False, use_pallas="auto",
+    )
+    return lambda chi, lmbd: _fixed_point_exec(
+        chi, lmbd, valid, x0, tables, spec,
+        float(config.eps), int(config.max_sweeps),
+    )
 
 
 def entropy_sweep(
@@ -91,8 +104,14 @@ def entropy_sweep(
     lambdas: np.ndarray | None = None,
     verbose: bool = False,
     checkpointer=None,
+    class_bucket: int | None = None,
 ) -> EntropyResult:
     """Run the λ ladder on one graph instance.
+
+    ``class_bucket``: round degree-class sizes up to a multiple of this
+    (ghost padding) so different graph instances of the same ensemble land on
+    identical compiled programs — pays a few % padded FLOPs to avoid a full
+    XLA recompile per instance (see ``BDCMData``).
 
     ``graph`` may contain isolated nodes; they are removed here and folded in
     analytically (φ gets ``−λ·n_iso/n``, m_init gets ``+n_iso/n``,
@@ -117,6 +136,7 @@ def entropy_sweep(
         attr_value=dyn.attr_value,
         rule=dyn.rule,
         tie=dyn.tie,
+        class_bucket=class_bucket,
     )
     fixed_point = make_fixed_point(data, config)
     set_leaves = make_leaf_setter(data)
@@ -335,6 +355,7 @@ def entropy_grid(
     save_path: str | None = None,
     checkpoint_path: str | None = None,
     checkpoint_interval_s: float = 30.0,
+    class_bucket: int | None = 64,
 ) -> EntropyGridResult:
     """The notebook's full experiment driver: deg-grid × repetitions × λ
     ladder on fresh ER instances (`ipynb:496-513`); ``save_path`` persists
@@ -380,7 +401,7 @@ def entropy_grid(
                 ck = _GridCheckpointAdapter(checkpointer, {"deg_index": di, "rep": rep})
             res = entropy_sweep(
                 g, config, seed=gseed, lambdas=lambdas, verbose=verbose,
-                checkpointer=ck,
+                checkpointer=ck, class_bucket=class_bucket,
             )
             k = res.lambdas.size
             ent[di, rep, :k] = res.ent

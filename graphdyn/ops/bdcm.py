@@ -63,8 +63,29 @@ class _NodeClass(NamedTuple):
     Ai: np.ndarray         # [K, (d+1)^T]
 
 
+def _pad_class(idx: np.ndarray, in_edges: np.ndarray, bucket: int, ghost_idx: int, ghost_in: int):
+    """Pad a degree class to the next multiple of ``bucket``: padded members
+    scatter to the ghost slot ``ghost_idx`` and gather from the ghost message
+    row ``ghost_in`` (both sliced away by the executors)."""
+    pad = (-idx.shape[0]) % bucket
+    if pad == 0:
+        return idx, in_edges
+    idx = np.concatenate([idx, np.full(pad, ghost_idx, idx.dtype)])
+    in_edges = np.concatenate(
+        [in_edges, np.full((pad, in_edges.shape[1]), ghost_in, in_edges.dtype)]
+    )
+    return idx, in_edges
+
+
 class BDCMData:
-    """Per-graph static data for the BDCM sweep (host-built)."""
+    """Per-graph static data for the BDCM sweep (host-built).
+
+    ``class_bucket``: round every degree-class size up to a multiple of this
+    (padding with ghost edges/nodes). Bucketed instances of the same ensemble
+    usually land on identical shapes, so the module-level jitted executors
+    (:func:`_sweep_exec` etc.) reuse one compiled program across graphs —
+    XLA recompilation, not math, dominates multi-instance ER sweeps.
+    """
 
     def __init__(
         self,
@@ -76,6 +97,7 @@ class BDCMData:
         attr_value: int = 1,
         rule: str = "majority",
         tie: str = "stay",
+        class_bucket: int | None = None,
     ):
         tables = tables or build_edge_tables(graph)
         self.graph = graph
@@ -85,10 +107,13 @@ class BDCMData:
         self.K = 2**self.T
         self.attr_value = attr_value
         self.rule, self.tie = rule, tie
+        self.padded = class_bucket is not None
 
         self.valid = attr_mask(self.T, attr_value)          # bool[K]
         self.x0 = x0_pm(self.T)                             # ±1[K]
         self.leaf01 = leaf_factor_tensor(p, c, attr_value, rule, tie)  # [K,K]
+
+        ghost_edge = tables.num_directed                    # row 2E of chi_ext
 
         eclasses = degree_classes(tables.edge_deg)
         self.leaf_idx = eclasses.get(0, np.empty(0, np.int32))
@@ -96,11 +121,16 @@ class BDCMData:
         for d, idx in sorted(eclasses.items()):
             if d == 0:
                 continue
+            in_edges = tables.in_edges[idx, :d]
+            if self.padded:
+                idx, in_edges = _pad_class(
+                    idx, in_edges, class_bucket, ghost_edge, ghost_edge
+                )
             self.edge_classes.append(
                 _EdgeClass(
                     d=int(d),
                     idx=idx,
-                    in_edges=tables.in_edges[idx, :d],
+                    in_edges=in_edges,
                     A=edge_factor_tensor(d, p, c, attr_value, rule, tie),
                 )
             )
@@ -110,11 +140,16 @@ class BDCMData:
         for d, idx in sorted(nclasses.items()):
             if d == 0:
                 continue
+            in_edges = tables.node_in_edges[idx, :d]
+            if self.padded:
+                idx, in_edges = _pad_class(
+                    idx, in_edges, class_bucket, graph.n, ghost_edge
+                )
             self.node_classes.append(
                 _NodeClass(
                     d=int(d),
                     idx=idx,
-                    in_edges=tables.node_in_edges[idx, :d],
+                    in_edges=in_edges,
                     Ai=node_factor_tensor(d, p, c, attr_value, rule, tie),
                 )
             )
@@ -175,6 +210,120 @@ def class_update(chi_in, A, tilt, chi_old, *, d, T, K, damp, eps_clamp):
     return damp * chi2 + (1.0 - damp) * chi_old
 
 
+class _SweepSpec(NamedTuple):
+    """Hashable static configuration of one sweep program. Everything traced
+    (chi, λ, bias, index tables, factor tensors) is an argument of the
+    module-level executor instead of a closure constant, so graphs whose
+    table *shapes* coincide (same degree-class signature — automatic for RRG
+    ensembles, arranged for ER via ``BDCMData(class_bucket=...)``) share ONE
+    compiled program instead of compiling per instance."""
+
+    T: int
+    K: int
+    damp: float
+    eps_clamp: float
+    mask_invalid_src: bool
+    with_bias: bool
+    padded: bool
+    class_ds: tuple          # per-class neighbor count d
+    pallas: tuple            # per-class: '' (XLA) | 'tpu' | 'interpret'
+
+
+def _sweep_core(chi, lmbd, bias_edge, valid, x0, tables, spec: _SweepSpec):
+    """The sweep body (call inside jit). ``tables``: tuple per class of
+    (idx, in_edges, A)."""
+    T, K = spec.T, spec.K
+    tilt = jnp.exp(-lmbd * x0)  # [K]
+    n_real = chi.shape[0]
+    if spec.padded:
+        # ghost row 2E: gathered by padded class members only (never by real
+        # ones); their garbage updates scatter back to this row and are
+        # sliced off at the end
+        ghost = jnp.full((1,) + chi.shape[1:], 1.0 / (K * K), chi.dtype)
+        chi = jnp.concatenate([chi, ghost], axis=0)
+        if spec.with_bias:
+            bias_edge = jnp.concatenate(
+                [bias_edge, jnp.ones((1, K), bias_edge.dtype)], axis=0
+            )
+    for (d, mode), (idx, in_edges, A) in zip(
+        zip(spec.class_ds, spec.pallas), tables
+    ):
+        chi_in = chi[in_edges]                      # [Ed, d, K, K]
+        if spec.with_bias:
+            chi_in = chi_in * bias_edge[in_edges][:, :, :, None]
+        if spec.mask_invalid_src:
+            chi_in = chi_in * valid[None, None, :, None]
+        if mode:
+            from graphdyn.ops.pallas_bdcm import dp_contract
+
+            upd = dp_contract(
+                chi_in,
+                A * tilt[:, None, None],
+                chi[idx],
+                d=d,
+                T=T,
+                damp=spec.damp,
+                eps_clamp=spec.eps_clamp,
+                interpret=mode == "interpret",
+            )
+        else:
+            upd = class_update(
+                chi_in, A, tilt, chi[idx], d=d, T=T, K=K,
+                damp=spec.damp, eps_clamp=spec.eps_clamp,
+            )
+        chi = chi.at[idx].set(upd)
+    return chi[:n_real]
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def _sweep_exec(chi, lmbd, bias_edge, valid, x0, tables, spec: _SweepSpec):
+    return _sweep_core(chi, lmbd, bias_edge, valid, x0, tables, spec)
+
+
+def _resolve_pallas_modes(data: BDCMData, use_pallas) -> tuple:
+    on_tpu = jax.default_backend() == "tpu"
+    if use_pallas == "auto":
+        pallas_mode = "tpu" if on_tpu else "off"
+    elif use_pallas:
+        pallas_mode = "tpu" if on_tpu else "interpret"
+    else:
+        pallas_mode = "off"
+    modes = []
+    for cls in data.edge_classes:
+        ok = False
+        if pallas_mode != "off":
+            from graphdyn.ops.pallas_bdcm import pallas_supported
+
+            ok = pallas_supported(cls.d, data.T, int(cls.idx.shape[0]))
+        modes.append(pallas_mode if ok else "")
+    return tuple(modes)
+
+
+def _sweep_args(data: BDCMData, *, damp, eps_clamp, mask_invalid_src, with_bias, use_pallas):
+    valid = jnp.asarray(data.valid)
+    x0 = jnp.asarray(data.x0, jnp.float32)
+    tables = tuple(
+        (
+            jnp.asarray(cls.idx),
+            jnp.asarray(cls.in_edges),
+            jnp.asarray(cls.A, jnp.float32),
+        )
+        for cls in data.edge_classes
+    )
+    spec = _SweepSpec(
+        T=data.T,
+        K=data.K,
+        damp=float(damp),
+        eps_clamp=float(eps_clamp),
+        mask_invalid_src=bool(mask_invalid_src),
+        with_bias=bool(with_bias),
+        padded=data.padded,
+        class_ds=tuple(cls.d for cls in data.edge_classes),
+        pallas=_resolve_pallas_modes(data, use_pallas),
+    )
+    return valid, x0, tables, spec
+
+
 def make_sweep(
     data: BDCMData,
     *,
@@ -194,67 +343,21 @@ def make_sweep(
     Pallas TPU kernel (:mod:`graphdyn.ops.pallas_bdcm`) on TPU backends when
     the class shape qualifies; ``True`` forces it (interpret mode off-TPU,
     for tests); ``False`` keeps the pure-XLA path.
+
+    The returned callable dispatches to a module-level jitted executor —
+    graphs with identical class-table shapes share its compile cache (see
+    ``BDCMData(class_bucket=...)`` for arranging that on ER ensembles).
     """
-    T, K = data.T, data.K
-    valid = jnp.asarray(data.valid)
-    x0 = jnp.asarray(data.x0, jnp.float32)
-    classes = [
-        (
-            cls.d,
-            jnp.asarray(cls.idx),
-            jnp.asarray(cls.in_edges),
-            jnp.asarray(cls.A, jnp.float32),
-        )
-        for cls in data.edge_classes
-    ]
-
-    on_tpu = jax.default_backend() == "tpu"
-    if use_pallas == "auto":
-        pallas_mode = "tpu" if on_tpu else "off"
-    elif use_pallas:
-        pallas_mode = "tpu" if on_tpu else "interpret"
-    else:
-        pallas_mode = "off"
-
-    def _class_pallas_ok(d, idx):
-        if pallas_mode == "off":
-            return False
-        from graphdyn.ops.pallas_bdcm import pallas_supported
-
-        return pallas_supported(d, T, int(idx.shape[0]))
-
-    def sweep(chi, lmbd, bias_edge=None):
-        tilt = jnp.exp(-lmbd * x0)  # [K]
-        for d, idx, in_edges, A in classes:
-            chi_in = chi[in_edges]                      # [Ed, d, K, K]
-            if with_bias:
-                chi_in = chi_in * bias_edge[in_edges][:, :, :, None]
-            if mask_invalid_src:
-                chi_in = chi_in * valid[None, None, :, None]
-            if _class_pallas_ok(d, idx):
-                from graphdyn.ops.pallas_bdcm import dp_contract
-
-                upd = dp_contract(
-                    chi_in,
-                    A * tilt[:, None, None],
-                    chi[idx],
-                    d=d,
-                    T=T,
-                    damp=float(damp),
-                    eps_clamp=float(eps_clamp),
-                    interpret=pallas_mode == "interpret",
-                )
-            else:
-                upd = class_update(
-                    chi_in, A, tilt, chi[idx], d=d, T=T, K=K,
-                    damp=damp, eps_clamp=eps_clamp,
-                )
-            chi = chi.at[idx].set(upd)
-        return chi
-
+    valid, x0, tables, spec = _sweep_args(
+        data, damp=damp, eps_clamp=eps_clamp,
+        mask_invalid_src=mask_invalid_src, with_bias=with_bias,
+        use_pallas=use_pallas,
+    )
     if with_bias:
-        return jax.jit(sweep)
-    return jax.jit(lambda chi, lmbd: sweep(chi, lmbd))
+        return lambda chi, lmbd, bias_edge: _sweep_exec(
+            chi, lmbd, bias_edge, valid, x0, tables, spec
+        )
+    return lambda chi, lmbd: _sweep_exec(chi, lmbd, None, valid, x0, tables, spec)
 
 
 class EnsembleBDCM:
@@ -487,16 +590,56 @@ def make_leaf_setter(data: BDCMData):
 def make_edge_partition(data: BDCMData, eps_clamp: float = 0.0):
     """Jitted ``chi -> Z_ij[E]``: per-undirected-edge partition function with
     endpoint-valid trajectories only (`ipynb:146-155`)."""
-    E = data.num_edges
     valid = jnp.asarray(data.valid, jnp.float32)
     mask2 = valid[:, None] * valid[None, :]
+    return lambda chi: _zij_exec(chi, mask2, float(eps_clamp))
 
-    @jax.jit
-    def zij(chi):
-        P = chi[:E] * jnp.swapaxes(chi[E:], 1, 2) * mask2[None]
-        return jnp.maximum(P.sum(axis=(1, 2)), eps_clamp)
 
-    return zij
+class _ZiSpec(NamedTuple):
+    T: int
+    K: int
+    n: int
+    eps_clamp: float
+    padded: bool
+    class_ds: tuple
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def _zi_exec(chi, lmbd, valid, x0, ntables, spec: _ZiSpec):
+    """Module-level Z_i executor (compile-shared across graphs with the same
+    node-class shapes). Padded class members gather from the ghost message
+    row 2E and scatter into a ghost node slot n, both sliced away."""
+    T, K, n = spec.T, spec.K, spec.n
+    tilt = jnp.exp(-lmbd * x0)
+    if spec.padded:
+        ghost = jnp.full((1,) + chi.shape[1:], 1.0 / (K * K), chi.dtype)
+        chi = jnp.concatenate([chi, ghost], axis=0)
+    out = jnp.zeros((n + 1 if spec.padded else n,), chi.dtype)
+    for d, (idx, in_edges, Ai) in zip(spec.class_ds, ntables):
+        chi_in = chi[in_edges] * valid[None, None, :, None]
+        LL = _neighbor_dp(chi_in, d, T, K)          # [Nd, K, M]
+        # einsum over (xi, rho); tilt couples to xi only
+        z = jnp.einsum("xm,nxm,x->n", Ai, LL, tilt)
+        out = out.at[idx].set(z)
+    return jnp.maximum(out[:n], spec.eps_clamp)
+
+
+def _zi_args(data: BDCMData, eps_clamp: float):
+    valid = jnp.asarray(data.valid)
+    x0 = jnp.asarray(data.x0, jnp.float32)
+    ntables = tuple(
+        (
+            jnp.asarray(cls.idx),
+            jnp.asarray(cls.in_edges),
+            jnp.asarray(cls.Ai, jnp.float32),
+        )
+        for cls in data.node_classes
+    )
+    spec = _ZiSpec(
+        T=data.T, K=data.K, n=data.n, eps_clamp=float(eps_clamp),
+        padded=data.padded, class_ds=tuple(cls.d for cls in data.node_classes),
+    )
+    return valid, x0, ntables, spec
 
 
 def make_node_partition(data: BDCMData, eps_clamp: float = 0.0):
@@ -504,73 +647,67 @@ def make_node_partition(data: BDCMData, eps_clamp: float = 0.0):
     all-neighbor DP against ``Ai`` (`ipynb:157-222`). Nodes of degree 0 get
     Z=eps_clamp — the entropy pipeline removes isolates first
     (`ipynb:283-291`)."""
-    T, K, n = data.T, data.K, data.n
-    valid = jnp.asarray(data.valid)
-    x0 = jnp.asarray(data.x0, jnp.float32)
-    classes = [
-        (
-            cls.d,
-            jnp.asarray(cls.idx),
-            jnp.asarray(cls.in_edges),
-            jnp.asarray(cls.Ai, jnp.float32),
-        )
-        for cls in data.node_classes
-    ]
+    valid, x0, ntables, spec = _zi_args(data, eps_clamp)
+    return lambda chi, lmbd: _zi_exec(chi, lmbd, valid, x0, ntables, spec)
 
-    @jax.jit
-    def zi(chi, lmbd):
-        tilt = jnp.exp(-lmbd * x0)
-        out = jnp.zeros((n,), chi.dtype)
-        for d, idx, in_edges, Ai in classes:
-            chi_in = chi[in_edges] * valid[None, None, :, None]
-            LL = _neighbor_dp(chi_in, d, T, K)          # [Nd, K, M]
-            z = jnp.einsum("xm,nxm,x->n", Ai, LL, tilt)
-        # NOTE: einsum over (xi, rho); tilt couples to xi only
-            out = out.at[idx].set(z)
-        return jnp.maximum(out, eps_clamp)
 
-    return zi
+@partial(jax.jit, static_argnames=("eps_clamp",))
+def _zij_exec(chi, mask2, eps_clamp: float):
+    E = chi.shape[0] // 2
+    P = chi[:E] * jnp.swapaxes(chi[E:], 1, 2) * mask2[None]
+    return jnp.maximum(P.sum(axis=(1, 2)), eps_clamp)
+
+
+@partial(jax.jit, static_argnames=("spec", "eps_clamp"))
+def _phi_exec(chi, lmbd, valid, x0, ntables, mask2, n_iso, n_total, spec, eps_clamp):
+    zi = _zi_exec(chi, lmbd, valid, x0, ntables, spec)
+    zij = _zij_exec(chi, mask2, eps_clamp)
+    return (
+        jnp.sum(jnp.log(zi)) - jnp.sum(jnp.log(zij)) - lmbd * n_iso
+    ) / n_total
 
 
 def make_free_entropy(data: BDCMData, *, n_total: int, n_iso: int, eps_clamp: float = 0.0):
     """Jitted ``(chi, lmbd) -> φ``: Bethe free entropy density
     ``(Σ ln Z_i − Σ ln Z_ij − λ·n_iso)/n_total`` (`ipynb:318-322`), with the
-    analytic isolated-node term."""
-    zi = make_node_partition(data, eps_clamp)
-    zij = make_edge_partition(data, eps_clamp)
+    analytic isolated-node term. The isolate counts are traced scalars, so
+    the compiled program is shared across graphs of the same shape."""
+    valid, x0, ntables, spec = _zi_args(data, eps_clamp)
+    validf = jnp.asarray(data.valid, jnp.float32)
+    mask2 = validf[:, None] * validf[None, :]
+    n_iso_t = jnp.float32(n_iso)
+    n_total_t = jnp.float32(n_total)
+    return lambda chi, lmbd: _phi_exec(
+        chi, lmbd, valid, x0, ntables, mask2, n_iso_t, n_total_t,
+        spec, float(eps_clamp),
+    )
 
-    @jax.jit
-    def phi(chi, lmbd):
-        return (
-            jnp.sum(jnp.log(zi(chi, lmbd)))
-            - jnp.sum(jnp.log(zij(chi)))
-            - lmbd * n_iso
-        ) / n_total
 
-    return phi
+@partial(jax.jit, static_argnames=("eps_clamp",))
+def _minit_exec(chi, mask2, x0, edges, deg, n_iso, n_total, eps_clamp: float):
+    E = chi.shape[0] // 2
+    P = chi[:E] * jnp.swapaxes(chi[E:], 1, 2) * mask2[None]
+    Zij = jnp.maximum(P.sum(axis=(1, 2)), eps_clamp)
+    wu = x0[:, None] / deg[edges[:, 0]][:, None, None]
+    wv = x0[None, :] / deg[edges[:, 1]][:, None, None]
+    s = ((wu + wv) * P).sum(axis=(1, 2)) / Zij
+    return (s.sum() + n_iso) / n_total
 
 
 def make_mean_m_init(data: BDCMData, *, n_total: int, n_iso: int, eps_clamp: float = 0.0):
     """Jitted ``chi -> m_init``: BP mean initial magnetization
     (`ipynb:325-338`); each isolated node contributes +1 (it must sit at the
     attractor value)."""
-    E = data.num_edges
-    valid = jnp.asarray(data.valid, jnp.float32)
-    mask2 = valid[:, None] * valid[None, :]
+    validf = jnp.asarray(data.valid, jnp.float32)
+    mask2 = validf[:, None] * validf[None, :]
     x0 = jnp.asarray(data.x0, jnp.float32)
     edges = jnp.asarray(data.graph.edges.astype(np.int64))
     deg = jnp.asarray(data.graph.deg, jnp.float32)
-
-    @jax.jit
-    def m_init(chi):
-        P = chi[:E] * jnp.swapaxes(chi[E:], 1, 2) * mask2[None]
-        Zij = jnp.maximum(P.sum(axis=(1, 2)), eps_clamp)
-        wu = x0[:, None] / deg[edges[:, 0]][:, None, None]
-        wv = x0[None, :] / deg[edges[:, 1]][:, None, None]
-        s = ((wu + wv) * P).sum(axis=(1, 2)) / Zij
-        return (s.sum() + n_iso) / n_total
-
-    return m_init
+    n_iso_t = jnp.float32(n_iso)
+    n_total_t = jnp.float32(n_total)
+    return lambda chi: _minit_exec(
+        chi, mask2, x0, edges, deg, n_iso_t, n_total_t, float(eps_clamp)
+    )
 
 
 def make_marginals(data: BDCMData, eps: float = 1e-15):

@@ -278,3 +278,80 @@ class TestEnsemble:
         b = BDCMData(random_regular_graph(40, 3, seed=1), p=2, c=1)
         with pytest.raises(ValueError, match="dynamics parameters"):
             EnsembleBDCM([a, b])
+
+
+class TestBucketedClasses:
+    """class_bucket ghost padding: identical math, shared compiled programs."""
+
+    def test_bucketed_sweep_matches_unbucketed(self):
+        import jax.numpy as jnp
+        from graphdyn.graphs import erdos_renyi_graph
+        from graphdyn.ops.bdcm import BDCMData, make_sweep
+
+        g = erdos_renyi_graph(300, 3.0 / 299, seed=7)
+        a = BDCMData(g, p=1, c=1)
+        b = BDCMData(g, p=1, c=1, class_bucket=64)
+        sa_ = make_sweep(a, damp=0.2, use_pallas=False)
+        sb = make_sweep(b, damp=0.2, use_pallas=False)
+        chi = a.init_messages(seed=0)
+        lam = jnp.float32(0.5)
+        ca, cb = chi, chi
+        for _ in range(3):
+            ca = sa_(ca, lam)
+            cb = sb(cb, lam)
+        np.testing.assert_allclose(np.asarray(cb), np.asarray(ca), rtol=1e-6, atol=1e-8)
+
+    def test_bucketed_partitions_match(self):
+        import jax.numpy as jnp
+        from graphdyn.graphs import erdos_renyi_graph, remove_isolates
+        from graphdyn.ops.bdcm import (
+            BDCMData, make_free_entropy, make_mean_m_init,
+        )
+
+        g, _ = remove_isolates(erdos_renyi_graph(200, 2.0 / 199, seed=3))
+        a = BDCMData(g, p=1, c=1)
+        b = BDCMData(g, p=1, c=1, class_bucket=32)
+        chi = a.init_messages(seed=2)
+        lam = jnp.float32(0.3)
+        pa = float(make_free_entropy(a, n_total=g.n, n_iso=0)(chi, lam))
+        pb = float(make_free_entropy(b, n_total=g.n, n_iso=0)(chi, lam))
+        np.testing.assert_allclose(pb, pa, rtol=1e-6)
+        ma = float(make_mean_m_init(a, n_total=g.n, n_iso=0)(chi))
+        mb = float(make_mean_m_init(b, n_total=g.n, n_iso=0)(chi))
+        np.testing.assert_allclose(mb, ma, rtol=1e-6)
+
+    def test_entropy_sweep_bucketed_matches(self):
+        from graphdyn.config import EntropyConfig
+        from graphdyn.graphs import erdos_renyi_graph
+        from graphdyn.models.entropy import entropy_sweep
+
+        g = erdos_renyi_graph(150, 1.8 / 149, seed=4)
+        lambdas = np.array([0.0, 0.2])
+        r0 = entropy_sweep(g, EntropyConfig(), seed=1, lambdas=lambdas)
+        r1 = entropy_sweep(
+            g, EntropyConfig(), seed=1, lambdas=lambdas, class_bucket=64
+        )
+        np.testing.assert_allclose(r1.ent1, r0.ent1, atol=1e-5)
+        np.testing.assert_allclose(r1.m_init, r0.m_init, atol=1e-5)
+
+    def test_compile_cache_shared_across_instances(self):
+        """Two same-signature graphs (RRG seeds) must reuse one compiled
+        fixed-point program — the whole point of the shared executors."""
+        from graphdyn.config import EntropyConfig
+        from graphdyn.graphs import random_regular_graph
+        from graphdyn.models.entropy import _fixed_point_exec, make_fixed_point
+        from graphdyn.ops.bdcm import BDCMData
+
+        import jax.numpy as jnp
+
+        cfg = EntropyConfig()
+        before = _fixed_point_exec._cache_size()
+        sizes = []
+        for seed in (11, 12):
+            g = random_regular_graph(60, 3, seed=seed)
+            data = BDCMData(g, p=1, c=1)
+            fp = make_fixed_point(data, cfg)
+            fp(data.init_messages(seed), jnp.float32(0.1))
+            sizes.append(_fixed_point_exec._cache_size())
+        assert sizes[0] <= before + 1
+        assert sizes[1] == sizes[0], "second instance must hit the jit cache"
