@@ -30,6 +30,7 @@ masked out of the DP (HPr relies on those chi entries decaying under damping);
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
 import numpy as np
