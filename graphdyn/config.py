@@ -83,6 +83,9 @@ class EntropyConfig:
     ent_floor: float = -0.05    # early-exit threshold (`ipynb:446`)
     num_rep: int = 3
     seed: int = 0
+    dtype: str = "float32"      # 'float64' matches the reference's precision
+                                # (numpy default / `HPR_pytorch_RRG.py:11`);
+                                # requires jax_enable_x64
 
 
 def asdict(cfg) -> dict:

@@ -137,6 +137,7 @@ def entropy_sweep(
         rule=dyn.rule,
         tie=dyn.tie,
         class_bucket=class_bucket,
+        dtype=config.dtype,
     )
     fixed_point = make_fixed_point(data, config)
     set_leaves = make_leaf_setter(data)
@@ -149,12 +150,12 @@ def entropy_sweep(
 
     if lambdas is None:
         lambdas = lambda_ladder(config)
-    chi = data.init_messages(seed) if chi0 is None else jnp.asarray(chi0)
+    chi = data.init_messages(seed) if chi0 is None else jnp.asarray(chi0, data.dtype)
 
     ents, m_inits, ent1s, sweeps, visited = [], [], [], [], []
     nonconverged = 0.0
     for lmbd in lambdas:
-        lm = jnp.float32(lmbd)
+        lm = jnp.asarray(lmbd, data.dtype)
         chi = set_leaves(chi, lm)
         chi, t, delta = fixed_point(chi, lm)
         t = int(t)
@@ -248,7 +249,7 @@ def entropy_ensemble(
             raise ValueError("entropy_ensemble requires isolate-free graphs")
     datas = [
         BDCMData(g, p=dyn.p, c=dyn.c, attr_value=dyn.attr_value,
-                 rule=dyn.rule, tie=dyn.tie)
+                 rule=dyn.rule, tie=dyn.tie, dtype=config.dtype)
         for g in graphs
     ]
     ens = EnsembleBDCM(datas)
@@ -282,7 +283,7 @@ def entropy_ensemble(
     ents, m_inits, ent1s, sweeps, visited = [], [], [], [], []
     nonconverged = 0.0
     for lmbd in lambdas:
-        lm = jnp.float32(lmbd)
+        lm = jnp.asarray(lmbd, ens.dtype)
         chi = set_leaves(chi, lm)
         chi, t, delta = fixed_point(chi, lm)
         phi = np.asarray(phi_fn(chi, lm))

@@ -71,6 +71,56 @@ def _batched_end_sum(nbr, s, steps: int, R_coef: int, C_coef: int):
     return s_end.astype(jnp.int32).sum(axis=1)
 
 
+def draw_sa_proposal(key, t, proposals, uniforms, *, injected, stream_len, n, dt):
+    """Per-replica proposal ``(i, u)`` — injected-stream mode reads the
+    caller's streams at the per-replica step index; PRNG mode derives from
+    ``fold_in(key, t)``. One implementation shared by the unsharded and
+    sharded solvers, so their bit-parity is structural at the draw layer."""
+    if injected:
+        tt = jnp.minimum(t, stream_len - 1).astype(jnp.int32)
+        i = jnp.take_along_axis(proposals, tt[:, None], axis=1)[:, 0]
+        u = jnp.take_along_axis(uniforms, tt[:, None], axis=1)[:, 0].astype(dt)
+    else:
+        step_keys = jax.vmap(jax.random.fold_in)(key, t.astype(jnp.uint32))
+        ki, ku = jnp.split(jax.vmap(jax.random.split)(step_keys), 2, axis=1)
+        i = jax.vmap(lambda k: jax.random.randint(k[0], (), 0, n))(ki)
+        u = jax.vmap(lambda k: jax.random.uniform(k[0], (), dt))(ku)
+    return i, u
+
+
+def metropolis_anneal_update(
+    active, a, b, t, m_final, sum_end, sum_end_flip, s_i, u,
+    *, par_a, par_b, a_cap, b_cap, max_steps, n,
+):
+    """The per-replica Metropolis accept + anneal + sentinel arithmetic
+    (`SA_RRG.py:32-37,74-85`), on vectors of any sharding. Shared by
+    :func:`simulated_annealing` and the mesh solver — a change here changes
+    both, keeping their advertised bit-parity structural.
+
+    Returns ``(do, sum_end_new, a_new, b_new, t_new, m_final_new,
+    active_new)`` where ``do`` masks replicas whose flip was accepted this
+    step (the caller applies it to its spin layout)."""
+    dt = a.dtype
+    # ΔH = (−2a·s_i(0) + b·(Σs_end − Σs_end_flip))/n  (`SA_RRG.py:32-37`)
+    delta_H = (
+        -2.0 * a * s_i.astype(dt) + b * (sum_end - sum_end_flip).astype(dt)
+    ) / n
+    accept = u < jnp.exp(-delta_H)
+    do = active & accept
+    sum_end_new = jnp.where(do, sum_end_flip, sum_end)
+    # anneal (cap checked before multiply, `SA_RRG.py:80-81`)
+    a_new = jnp.where(a < a_cap, a * par_a, a)
+    b_new = jnp.where(b < b_cap, b * par_b, b)
+    a_new = jnp.where(active, a_new, a)
+    b_new = jnp.where(active, b_new, b)
+    t_new = jnp.where(active, t + 1, t)
+    timeout = t_new > max_steps
+    m_new = jnp.where(timeout, jnp.asarray(2.0, dt), sum_end_new.astype(dt) / n)
+    m_final_new = jnp.where(active, m_new, m_final)
+    active_new = active & (m_final_new < 1.0) & ~timeout
+    return do, sum_end_new, a_new, b_new, t_new, m_final_new, active_new
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -106,7 +156,7 @@ def _sa_run(
         sum_end=sum_end0,
         a=a0,
         b=b0,
-        t=jnp.zeros((R,), jnp.int32),
+        t=jnp.zeros((R,), jnp.int64 if jax.config.jax_enable_x64 else jnp.int32),
         m_final=m0,
         active=m0 < 1.0,
         key=key0,
@@ -116,53 +166,95 @@ def _sa_run(
         return jnp.any(st.active)
 
     def body(st: _SAState):
-        if injected:
-            tt = jnp.minimum(st.t, stream_len - 1).astype(jnp.int32)
-            i = jnp.take_along_axis(proposals, tt[:, None], axis=1)[:, 0]
-            u = jnp.take_along_axis(uniforms, tt[:, None], axis=1)[:, 0].astype(dt)
-            key = st.key
-        else:
-            step_keys = jax.vmap(jax.random.fold_in)(st.key, st.t.astype(jnp.uint32))
-            ki, ku = jnp.split(jax.vmap(jax.random.split)(step_keys), 2, axis=1)
-            i = jax.vmap(lambda k: jax.random.randint(k[0], (), 0, n))(ki)
-            u = jax.vmap(lambda k: jax.random.uniform(k[0], (), dt))(ku)
-            key = st.key
-
+        i, u = draw_sa_proposal(
+            st.key, st.t, proposals, uniforms,
+            injected=injected, stream_len=stream_len, n=n, dt=dt,
+        )
         ridx = jnp.arange(R)
         s_i = st.s[ridx, i].astype(jnp.int32)
         s_flip = st.s.at[ridx, i].set((-s_i).astype(jnp.int8))
         sum_end_flip = _batched_end_sum(nbr, s_flip, rollout_steps, R_coef, C_coef)
 
-        # ΔH = (−2a·s_i(0) + b·(Σs_end − Σs_end_flip))/n  (`SA_RRG.py:32-37`)
-        delta_H = (
-            -2.0 * st.a * s_i.astype(dt)
-            + st.b * (st.sum_end - sum_end_flip).astype(dt)
-        ) / n
-        accept = u < jnp.exp(-delta_H)
-
-        do = st.active & accept
-        s_new = jnp.where(do[:, None], s_flip, st.s)
-        sum_end_new = jnp.where(do, sum_end_flip, st.sum_end)
-
-        # anneal (cap checked before multiply, `SA_RRG.py:80-81`)
-        a_new = jnp.where(st.a < a_cap, st.a * par_a, st.a)
-        b_new = jnp.where(st.b < b_cap, st.b * par_b, st.b)
-        a_new = jnp.where(st.active, a_new, st.a)
-        b_new = jnp.where(st.active, b_new, st.b)
-
-        t_new = jnp.where(st.active, st.t + 1, st.t)
-        timeout = t_new > max_steps
-        m_new = jnp.where(
-            timeout, jnp.asarray(2.0, dt), sum_end_new.astype(dt) / n
+        do, sum_end_new, a_new, b_new, t_new, m_final, active = (
+            metropolis_anneal_update(
+                st.active, st.a, st.b, st.t, st.m_final,
+                st.sum_end, sum_end_flip, s_i, u,
+                par_a=par_a, par_b=par_b, a_cap=a_cap, b_cap=b_cap,
+                max_steps=max_steps, n=n,
+            )
         )
-        m_final = jnp.where(st.active, m_new, st.m_final)
-        active = st.active & (m_final < 1.0) & ~timeout
-
-        return _SAState(s_new, sum_end_new, a_new, b_new, t_new, m_final, active, key)
+        s_new = jnp.where(do[:, None], s_flip, st.s)
+        return _SAState(
+            s_new, sum_end_new, a_new, b_new, t_new, m_final, active, st.key
+        )
 
     out = lax.while_loop(cond, body, state)
     mag = out.s.astype(dt).sum(axis=1) / n
     return out.s, mag, out.t, out.m_final
+
+
+def prepare_sa_inputs(
+    graph,
+    config: SAConfig,
+    *,
+    n_replicas=None,
+    seed=None,
+    s0=None,
+    a0=None,
+    b0=None,
+    proposals=None,
+    uniforms=None,
+    max_steps=None,
+):
+    """Shared host-side preparation of SA solver inputs — defaults, replica
+    broadcast of the (a0, b0) temperature ladder, the step-budget sentinel
+    threshold (int64 under x64, clamped to int32 otherwise — `SA_RRG.py:84`),
+    and injected-stream normalization. One implementation serves the
+    unsharded solver (:func:`simulated_annealing`) and the mesh solver
+    (:func:`graphdyn.parallel.sa_sharded.sa_sharded`) so their parity cannot
+    drift at the prep layer.
+
+    Returns ``(R, seed, s0, a0, b0, proposals, uniforms, max_steps,
+    stream_len, injected)``.
+    """
+    n = graph.n
+    if seed is None:
+        seed = config.seed
+    if n_replicas is None:
+        n_replicas = config.n_replicas if s0 is None else np.shape(s0)[0]
+    R = n_replicas
+
+    rng = np.random.default_rng(seed)
+    if s0 is None:
+        s0 = (2 * rng.integers(0, 2, size=(R, n)) - 1).astype(np.int8)
+    s0 = np.asarray(s0, dtype=np.int8).reshape(R, n)
+
+    a0 = np.broadcast_to(
+        np.asarray(config.a0_frac * n if a0 is None else a0, dtype=np.float64), (R,)
+    )
+    b0 = np.broadcast_to(
+        np.asarray(config.b0_frac * n if b0 is None else b0, dtype=np.float64), (R,)
+    )
+    if max_steps is None:
+        max_steps = config.max_steps if config.max_steps is not None else 2 * n**3
+    # under x64 the device counter is int64 and the reference's 2n³ sentinel
+    # (`SA_RRG.py:84`) is held exactly; with x64 off the counter canonicalizes
+    # to int32, so clamp the threshold (2·10¹² is unreachable wall-clock)
+    if not jax.config.jax_enable_x64:
+        max_steps = min(int(max_steps), 2**31 - 2)
+    max_steps = int(max_steps)
+
+    injected = proposals is not None
+    if injected:
+        proposals = np.asarray(proposals, dtype=np.int32).reshape(R, -1)
+        uniforms = np.asarray(uniforms, dtype=np.float64).reshape(R, -1)
+        stream_len = proposals.shape[1]
+        max_steps = min(max_steps, stream_len)
+    else:
+        stream_len = 1
+        proposals = np.zeros((R, 1), np.int32)
+        uniforms = np.zeros((R, 1), np.float64)
+    return R, seed, s0, a0, b0, proposals, uniforms, max_steps, stream_len, injected
 
 
 def simulated_annealing(
@@ -193,39 +285,12 @@ def simulated_annealing(
     R_coef, C_coef = rule_coefficients(dyn.rule, dyn.tie)
     rollout = dyn.p + dyn.c - 1
 
-    if seed is None:
-        seed = config.seed
-    if n_replicas is None:
-        n_replicas = config.n_replicas if s0 is None else np.shape(s0)[0]
-    R = n_replicas
-
-    rng = np.random.default_rng(seed)
-    if s0 is None:
-        s0 = (2 * rng.integers(0, 2, size=(R, n)) - 1).astype(np.int8)
-    s0 = np.asarray(s0, dtype=np.int8).reshape(R, n)
-
-    a0 = np.broadcast_to(
-        np.asarray(config.a0_frac * n if a0 is None else a0, dtype=np.float64), (R,)
+    prep = prepare_sa_inputs(
+        graph, config, n_replicas=n_replicas, seed=seed, s0=s0, a0=a0, b0=b0,
+        proposals=proposals, uniforms=uniforms, max_steps=max_steps,
     )
-    b0 = np.broadcast_to(
-        np.asarray(config.b0_frac * n if b0 is None else b0, dtype=np.float64), (R,)
-    )
-    if max_steps is None:
-        max_steps = config.max_steps if config.max_steps is not None else 2 * n**3
-    # step counters are int32 on device when x64 is off; 2n³ at n=10⁴ (2·10¹²)
-    # is unreachable wall-clock anyway, so clamp the sentinel threshold
-    max_steps = min(int(max_steps), 2**31 - 2)
-
-    injected = proposals is not None
-    if injected:
-        proposals = np.asarray(proposals, dtype=np.int32).reshape(R, -1)
-        uniforms = np.asarray(uniforms, dtype=np.float64).reshape(R, -1)
-        stream_len = proposals.shape[1]
-        max_steps = min(max_steps, stream_len)
-    else:
-        stream_len = 1
-        proposals = np.zeros((R, 1), np.int32)
-        uniforms = np.zeros((R, 1), np.float64)
+    (R, seed, s0, a0, b0, proposals, uniforms,
+     max_steps, stream_len, injected) = prep
 
     if backend == "cpu":
         np_scalar = np.float32 if dtype == jnp.float32 else np.float64

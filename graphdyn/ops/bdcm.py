@@ -99,7 +99,18 @@ class BDCMData:
         rule: str = "majority",
         tie: str = "stay",
         class_bucket: int | None = None,
+        dtype=jnp.float32,
     ):
+        # the reference's entropy/HPr paths run float64
+        # (`HPR_pytorch_RRG.py:11`, numpy default in the notebook); dtype
+        # threads through messages, factor casts, and observables. float64
+        # requires jax_enable_x64 (and disables the f32 Pallas kernel).
+        self.dtype = jnp.dtype(dtype)
+        if self.dtype == jnp.float64 and not jax.config.jax_enable_x64:
+            raise ValueError(
+                "BDCMData(dtype=float64) requires jax.config.update"
+                "('jax_enable_x64', True) before tracing"
+            )
         tables = tables or build_edge_tables(graph)
         self.graph = graph
         self.tables = tables
@@ -165,7 +176,7 @@ class BDCMData:
         rng = np.random.default_rng(seed)
         chi = rng.random((self.num_directed, self.K, self.K))
         chi /= chi.sum(axis=(1, 2), keepdims=True)
-        return jnp.asarray(chi, jnp.float32)
+        return jnp.asarray(chi, self.dtype)
 
 
 def _neighbor_dp(chi_in, d: int, T: int, K: int):
@@ -282,6 +293,17 @@ def _sweep_exec(chi, lmbd, bias_edge, valid, x0, tables, spec: _SweepSpec):
 
 
 def _resolve_pallas_modes(data: BDCMData, use_pallas) -> tuple:
+    if data.dtype == jnp.float64:
+        # the fused kernel is f32-only; f64 runs always take the XLA path.
+        # Refuse an explicit force rather than silently comparing XLA to
+        # itself in a parity test.
+        if use_pallas is True:
+            raise ValueError(
+                "use_pallas=True is incompatible with BDCMData(dtype=float64) "
+                "— the Pallas kernel is f32-only; use dtype=float32 or "
+                "use_pallas='auto'/False"
+            )
+        return tuple("" for _ in data.edge_classes)
     on_tpu = jax.default_backend() == "tpu"
     if use_pallas == "auto":
         pallas_mode = "tpu" if on_tpu else "off"
@@ -302,12 +324,12 @@ def _resolve_pallas_modes(data: BDCMData, use_pallas) -> tuple:
 
 def _sweep_args(data: BDCMData, *, damp, eps_clamp, mask_invalid_src, with_bias, use_pallas):
     valid = jnp.asarray(data.valid)
-    x0 = jnp.asarray(data.x0, jnp.float32)
+    x0 = jnp.asarray(data.x0, data.dtype)
     tables = tuple(
         (
             jnp.asarray(cls.idx),
             jnp.asarray(cls.in_edges),
-            jnp.asarray(cls.A, jnp.float32),
+            jnp.asarray(cls.A, data.dtype),
         )
         for cls in data.edge_classes
     )
@@ -435,13 +457,14 @@ class EnsembleBDCM:
         self.deg = np.stack([dd.graph.deg for dd in datas])
         self.leaf_idx = np.stack([dd.leaf_idx for dd in datas])   # [G, L]
         self.leaf01 = d0.leaf01
+        self.dtype = d0.dtype
 
     def init_messages(self, seed=0) -> jnp.ndarray:
         """[G, 2E, K, K] random row-normalized chi, one stream per graph."""
         rng = np.random.default_rng(seed)
         chi = rng.random((self.G, self.num_directed, self.K, self.K))
         chi /= chi.sum(axis=(2, 3), keepdims=True)
-        return jnp.asarray(chi, jnp.float32)
+        return jnp.asarray(chi, self.dtype)
 
 
 def make_ensemble_sweep(
@@ -455,9 +478,9 @@ def make_ensemble_sweep(
     over the ensemble axis (λ shared across graphs)."""
     T, K = ens.T, ens.K
     valid = jnp.asarray(ens.valid)
-    x0 = jnp.asarray(ens.x0, jnp.float32)
+    x0 = jnp.asarray(ens.x0, ens.dtype)
     classes = [
-        (d, jnp.asarray(idx), jnp.asarray(ie), jnp.asarray(A, jnp.float32))
+        (d, jnp.asarray(idx), jnp.asarray(ie), jnp.asarray(A, ens.dtype))
         for d, idx, ie, A in ens.edge_classes
     ]
 
@@ -492,11 +515,11 @@ def make_ensemble_free_entropy(
     n_total = n_total or n
     E = ens.num_edges
     valid = jnp.asarray(ens.valid)
-    validf = jnp.asarray(ens.valid, jnp.float32)
+    validf = jnp.asarray(ens.valid, ens.dtype)
     mask2 = validf[:, None] * validf[None, :]
-    x0 = jnp.asarray(ens.x0, jnp.float32)
+    x0 = jnp.asarray(ens.x0, ens.dtype)
     nclasses = [
-        (d, jnp.asarray(idx), jnp.asarray(ie), jnp.asarray(Ai, jnp.float32))
+        (d, jnp.asarray(idx), jnp.asarray(ie), jnp.asarray(Ai, ens.dtype))
         for d, idx, ie, Ai in ens.node_classes
     ]
 
@@ -527,11 +550,11 @@ def make_ensemble_m_init(ens: EnsembleBDCM, *, n_total: int | None = None, eps_c
     """Jitted ``chi -> m_init[G]`` for a congruent isolate-free ensemble."""
     E = ens.num_edges
     n_total = n_total or ens.n
-    validf = jnp.asarray(ens.valid, jnp.float32)
+    validf = jnp.asarray(ens.valid, ens.dtype)
     mask2 = validf[:, None] * validf[None, :]
-    x0 = jnp.asarray(ens.x0, jnp.float32)
+    x0 = jnp.asarray(ens.x0, ens.dtype)
     edges = jnp.asarray(ens.edges)
-    deg = jnp.asarray(ens.deg, jnp.float32)
+    deg = jnp.asarray(ens.deg, ens.dtype)
 
     def m_one(chi, edges_g, deg_g):
         P = chi[:E] * jnp.swapaxes(chi[E:], 1, 2) * mask2[None]
@@ -554,8 +577,8 @@ def make_ensemble_leaf_setter(ens: EnsembleBDCM):
     """Jitted ``(chi[G,...], lmbd) -> chi``: closed-form leaf messages per
     graph (no-op when the ensemble has no degree-0 edges)."""
     has_leaves = ens.leaf_idx.shape[1] > 0
-    leaf01 = jnp.asarray(ens.leaf01, jnp.float32)
-    x0 = jnp.asarray(ens.x0, jnp.float32)
+    leaf01 = jnp.asarray(ens.leaf01, ens.dtype)
+    x0 = jnp.asarray(ens.x0, ens.dtype)
     leaf_idx = jnp.asarray(ens.leaf_idx)
 
     @jax.jit
@@ -572,8 +595,8 @@ def make_ensemble_leaf_setter(ens: EnsembleBDCM):
 def make_leaf_setter(data: BDCMData):
     """Jitted ``(chi, lmbd) -> chi`` writing the closed-form leaf messages
     (d=0 edges): normalized λ-tilted bare factor (`ipynb:403-417`)."""
-    leaf01 = jnp.asarray(data.leaf01, jnp.float32)
-    x0 = jnp.asarray(data.x0, jnp.float32)
+    leaf01 = jnp.asarray(data.leaf01, data.dtype)
+    x0 = jnp.asarray(data.x0, data.dtype)
     leaf_idx = jnp.asarray(data.leaf_idx)
     has_leaves = data.leaf_idx.size > 0
 
@@ -591,7 +614,7 @@ def make_leaf_setter(data: BDCMData):
 def make_edge_partition(data: BDCMData, eps_clamp: float = 0.0):
     """Jitted ``chi -> Z_ij[E]``: per-undirected-edge partition function with
     endpoint-valid trajectories only (`ipynb:146-155`)."""
-    valid = jnp.asarray(data.valid, jnp.float32)
+    valid = jnp.asarray(data.valid, data.dtype)
     mask2 = valid[:, None] * valid[None, :]
     return lambda chi: _zij_exec(chi, mask2, float(eps_clamp))
 
@@ -627,12 +650,12 @@ def _zi_exec(chi, lmbd, valid, x0, ntables, spec: _ZiSpec):
 
 def _zi_args(data: BDCMData, eps_clamp: float):
     valid = jnp.asarray(data.valid)
-    x0 = jnp.asarray(data.x0, jnp.float32)
+    x0 = jnp.asarray(data.x0, data.dtype)
     ntables = tuple(
         (
             jnp.asarray(cls.idx),
             jnp.asarray(cls.in_edges),
-            jnp.asarray(cls.Ai, jnp.float32),
+            jnp.asarray(cls.Ai, data.dtype),
         )
         for cls in data.node_classes
     )
@@ -674,10 +697,10 @@ def make_free_entropy(data: BDCMData, *, n_total: int, n_iso: int, eps_clamp: fl
     analytic isolated-node term. The isolate counts are traced scalars, so
     the compiled program is shared across graphs of the same shape."""
     valid, x0, ntables, spec = _zi_args(data, eps_clamp)
-    validf = jnp.asarray(data.valid, jnp.float32)
+    validf = jnp.asarray(data.valid, data.dtype)
     mask2 = validf[:, None] * validf[None, :]
-    n_iso_t = jnp.float32(n_iso)
-    n_total_t = jnp.float32(n_total)
+    n_iso_t = jnp.asarray(n_iso, data.dtype)
+    n_total_t = jnp.asarray(n_total, data.dtype)
     return lambda chi, lmbd: _phi_exec(
         chi, lmbd, valid, x0, ntables, mask2, n_iso_t, n_total_t,
         spec, float(eps_clamp),
@@ -699,13 +722,13 @@ def make_mean_m_init(data: BDCMData, *, n_total: int, n_iso: int, eps_clamp: flo
     """Jitted ``chi -> m_init``: BP mean initial magnetization
     (`ipynb:325-338`); each isolated node contributes +1 (it must sit at the
     attractor value)."""
-    validf = jnp.asarray(data.valid, jnp.float32)
+    validf = jnp.asarray(data.valid, data.dtype)
     mask2 = validf[:, None] * validf[None, :]
-    x0 = jnp.asarray(data.x0, jnp.float32)
+    x0 = jnp.asarray(data.x0, data.dtype)
     edges = jnp.asarray(data.graph.edges.astype(np.int64))
-    deg = jnp.asarray(data.graph.deg, jnp.float32)
-    n_iso_t = jnp.float32(n_iso)
-    n_total_t = jnp.float32(n_total)
+    deg = jnp.asarray(data.graph.deg, data.dtype)
+    n_iso_t = jnp.asarray(n_iso, data.dtype)
+    n_total_t = jnp.asarray(n_total, data.dtype)
     return lambda chi: _minit_exec(
         chi, mask2, x0, edges, deg, n_iso_t, n_total_t, float(eps_clamp)
     )
@@ -719,7 +742,7 @@ def make_marginals(data: BDCMData, eps: float = 1e-15):
     over the node's outgoing edges. No endpoint-validity mask (faithful to the
     reference)."""
     E = data.num_edges
-    sel_plus = jnp.asarray(data.x0 == 1, jnp.float32)
+    sel_plus = jnp.asarray(data.x0 == 1, data.dtype)
     rev = jnp.asarray(data.tables.rev(np.arange(2 * E)))
     out_edges = jnp.asarray(data.tables.node_out_edges.astype(np.int64))
 

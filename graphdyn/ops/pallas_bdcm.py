@@ -45,6 +45,24 @@ from graphdyn.attractors import trajectories01
 
 LANE = 128
 
+# Per-core VMEM is ~16 MiB on v4/v5e-class chips; leave headroom for the
+# compiler. Pipelined in/out blocks are double-buffered (×2); the two DP
+# scratch buffers are not.
+VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def vmem_block_edges(d: int, T: int, budget: int = VMEM_BUDGET) -> int:
+    """Largest lane-multiple edge-tile width whose VMEM working set fits
+    ``budget``: 2×(chi_in + chi_old + out) pipelined blocks, the broadcast A
+    rows, and the two [K, M, Eb] DP scratch buffers. Returns 0 when even a
+    single lane-width tile does not fit."""
+    K = 2**T
+    M = (d + 1) ** T
+    fixed = 8 * K * K * M                        # a_rows, double-buffered
+    per_edge = 8 * (K * K * (d + 2) + K * M)     # blocks ×2 + scratch ×2
+    eb = (budget - fixed) // per_edge
+    return int(max(0, eb // LANE) * LANE)
+
 
 def _flat_offsets(d: int, T: int) -> np.ndarray:
     """off_k for every trajectory k: mixed-radix flat shift on the (d+1)^T
@@ -120,19 +138,34 @@ def dp_contract(
     T: int,
     damp: float,
     eps_clamp: float = 0.0,
-    block_edges: int = 512,
+    block_edges: int | None = None,
     interpret: bool = False,
 ):
     """Fused DP + contraction + normalize + damp for one edge-degree class.
 
-    Returns f32[Ed, K, K] — the damped updated messages for these edges.
+    ``block_edges=None`` picks the widest lane-multiple tile that fits the
+    VMEM budget (:func:`vmem_block_edges`); an explicit value is still
+    clamped to that budget. Returns f32[Ed, K, K] — the damped updated
+    messages for these edges.
     """
     K = 2**T
     M = (d + 1) ** T
     Ed = chi_in.shape[0]
     offsets = tuple(int(o) for o in _flat_offsets(d, T))
 
-    Eb = min(block_edges, max(LANE, ((Ed + LANE - 1) // LANE) * LANE))
+    budget_eb = vmem_block_edges(d, T)
+    if budget_eb == 0 and not interpret:
+        raise ValueError(
+            f"dp_contract(d={d}, T={T}): no lane-multiple edge tile fits the "
+            f"{VMEM_BUDGET >> 20} MiB VMEM budget (K·M = {K * M}); use the "
+            "XLA path (pallas_supported() gates this automatically)"
+        )
+    vmem_eb = max(LANE, budget_eb)               # interpret mode has no VMEM
+    Eb = min(
+        block_edges if block_edges is not None else vmem_eb,
+        vmem_eb,
+        max(LANE, ((Ed + LANE - 1) // LANE) * LANE),
+    )
     pad = (-Ed) % Eb
     n_tiles = (Ed + pad) // Eb
 
@@ -179,9 +212,10 @@ def dp_contract(
 
 
 def pallas_supported(d: int, T: int, Ed: int) -> bool:
-    """Heuristic gate: the unrolled kernel body scales as d·K² slice-FMAs —
-    keep it for the regimes the reference targets (T ≤ 4, d ≤ 8) and tiles
-    wide enough to fill the lanes."""
-    K = 2**T
-    M = (d + 1) ** T
-    return T <= 4 and d <= 8 and Ed >= LANE and K * M <= 4096
+    """Gate for the fused kernel. Bounds validated on a real v5e chip
+    (see PALLAS_TPU.md): the unrolled body scales as d·K² slice-FMAs, so we
+    keep the reference regime (T ≤ 4, d ≤ 8), require at least one full lane
+    tile of edges, and require a lane-multiple tile to fit the VMEM budget
+    (:func:`vmem_block_edges` — replaces the earlier K·M heuristic that
+    admitted >2×16 MiB scratch at its own upper end)."""
+    return T <= 4 and d <= 8 and Ed >= LANE and vmem_block_edges(d, T) >= LANE

@@ -2,3 +2,4 @@
 reductions, node-sharded dynamics for giant graphs."""
 
 from graphdyn.parallel.mesh import make_mesh, device_pool, replicate, shard_batch  # noqa: F401
+from graphdyn.parallel.sa_sharded import make_sharded_sa_solver, sa_sharded  # noqa: F401

@@ -42,8 +42,11 @@ def pad_nodes(graph, n_shards: int):
     if n_pad:
         ghost_rows = np.full((n_pad, dmax), n, dtype=np.int32)
         nbr = np.concatenate([nbr, ghost_rows], axis=0)
-    # ghost index stays n (the zero slot of the extended spin vector); real
-    # rows already use n as the pad, which remains correct after padding
+        # remap the ghost slot: `Graph.nbr` pads ragged rows with index n,
+        # but the zero slot of the gathered spin vector now sits at index
+        # n + n_pad (appended after the pad columns) — without the remap,
+        # ghost gathers would read pad-column spins instead of 0
+        nbr = np.where(nbr == n, n + n_pad, nbr)
     return nbr, n + n_pad
 
 
@@ -141,15 +144,18 @@ def make_sharded_sa_step(
 
     def step(nbr_local, s_local, sum_end, a, b, key, t,
              par_a, par_b, a_cap, b_cap):
+        from graphdyn.models.sa import draw_sa_proposal, metropolis_anneal_update
+
         Rl, n_block = s_local.shape
         node_idx = lax.axis_index(node_axis)
         mask = _real_mask(node_axis, n_block, n_real)
 
-        # one proposal per replica (global node index), same on every node shard
-        step_keys = jax.vmap(jax.random.fold_in)(key, t.astype(jnp.uint32))
-        pk = jax.vmap(jax.random.split)(step_keys)
-        i = jax.vmap(lambda k: jax.random.randint(k[0], (), 0, n_real))(pk)
-        u = jax.vmap(lambda k: jax.random.uniform(k[1], ()))(pk)
+        # one proposal per replica (global node index), same on every node
+        # shard — the shared draw used by both full solvers
+        i, u = draw_sa_proposal(
+            key, t, None, None, injected=False, stream_len=1,
+            n=n_real, dt=a.dtype,
+        )
 
         # flip spin i on the owning shard
         local_i = i - node_idx * n_block
@@ -172,14 +178,16 @@ def make_sharded_sa_step(
         # `>= n_real` consensus test below
         sum_end_flip = lax.psum(_masked_block_sum(s_end_flip, mask), node_axis)
 
-        delta_H = (-2.0 * a * s_i.astype(a.dtype)
-                   + b * (sum_end - sum_end_flip).astype(a.dtype)) / n_real
-        accept = u < jnp.exp(-delta_H)
-
-        s_new = jnp.where(accept[:, None], s_flip, s_local)
-        sum_end_new = jnp.where(accept, sum_end_flip, sum_end)
-        a_new = jnp.where(a < a_cap, a * par_a, a)
-        b_new = jnp.where(b < b_cap, b * par_b, b)
+        # every replica is live in the single-step primitive: no freeze mask,
+        # no timeout (the full solver `sa_sharded` owns those semantics)
+        always = jnp.ones(a.shape, bool)
+        do, sum_end_new, a_new, b_new, _, _, _ = metropolis_anneal_update(
+            always, a, b, t, jnp.zeros(a.shape, a.dtype),
+            sum_end, sum_end_flip, s_i, u,
+            par_a=par_a, par_b=par_b, a_cap=a_cap, b_cap=b_cap,
+            max_steps=2**31 - 2, n=n_real,
+        )
+        s_new = jnp.where(do[:, None], s_flip, s_local)
 
         # ensemble observable over the whole mesh (ICI collective)
         local_consensus = jnp.mean(
@@ -253,7 +261,7 @@ def make_sharded_sweep(
 
     T, K = data.T, data.K
     valid = jnp.asarray(data.valid)
-    x0 = jnp.asarray(data.x0, jnp.float32)
+    x0 = jnp.asarray(data.x0, data.dtype)
     n_shards = int(mesh.shape[edge_axis])
     classes = []
     for cls in data.edge_classes:
@@ -272,7 +280,7 @@ def make_sharded_sweep(
                 cls.d,
                 jnp.asarray(idx),
                 jnp.asarray(in_edges),
-                jnp.asarray(cls.A, jnp.float32),
+                jnp.asarray(cls.A, data.dtype),
             )
         )
 

@@ -62,7 +62,12 @@ class Checkpoint:
             return None
         with np.load(self.path + ".npz") as f:
             arrays = {k: f[k] for k in f.files if k != self._META_KEY}
-            meta = json.loads(f[self._META_KEY].tobytes().decode())
+            if self._META_KEY in f.files:
+                meta = json.loads(f[self._META_KEY].tobytes().decode())
+            else:
+                # foreign/legacy npz (e.g. a reference-style results file):
+                # still loadable, just with empty metadata
+                meta = {}
         return arrays, meta
 
 

@@ -132,3 +132,48 @@ def test_entropy_ensemble_matches_serial():
         # serial reference needs the same chi0 stream as the stacked init
         one = entropy_sweep(g, cfg, seed=0, chi0=res.chi[k], lambdas=lambdas[-1:])
         np.testing.assert_allclose(one.ent1[-1], res.ent1[-1, k], atol=5e-4)
+
+
+@pytest.mark.slow
+def test_golden_triples_tight_f64():
+    """Tight golden anchor in float64 (the reference's precision — numpy
+    default in `ER_BDCM_entropy.ipynb`).
+
+    The reference's stored run used an *unseeded* `nx.fast_gnp_random_graph`
+    (`ipynb:280`), so the exact instance is unrecoverable; seed 9425 is the
+    networkx sampler seed whose instance matches the stored run's printed
+    stats exactly (`ipynb:16`: 370 isolated nodes, avg_degree_total 0.97 ⇒
+    E=485) and lands within ≤5e-3 of all ten stored (λ, m_init, ent1)
+    triples — instance-to-instance spread among stat-matched graphs is
+    ~1e-2, so this is regression-grade for the framework while staying
+    honest about the irreproducible instance."""
+    import jax
+
+    golden = [
+        (0.0, 0.7859766580538275, 0.1720699495590459),
+        (0.1, 0.7699358367558866, 0.17127259171924963),
+        (0.2, 0.7545492129205356, 0.16897079877838897),
+        (0.3, 0.7399806499309954, 0.16533606458353123),
+        (0.4, 0.7263552613663471, 0.1605754636000715),
+        (0.5, 0.7137593656167142, 0.15491615729839237),
+        (0.6, 0.7022428278329915, 0.14859118078564132),
+        (0.7, 0.6918229572378949, 0.14182740343380668),
+        (0.8, 0.6824890587925729, 0.13484592378355741),
+        (0.9, 0.6742072244439773, 0.12780494062947345),
+    ]
+    g = erdos_renyi_graph(1000, 1.0 / 999, seed=9425, method="networkx")
+    assert int((g.deg == 0).sum()) == 370 and g.edges.shape[0] == 485
+    jax.config.update("jax_enable_x64", True)
+    try:
+        cfg = EntropyConfig(lmbd_max=0.9, lmbd_step=0.1, dtype="float64")
+        res = entropy_sweep(g, cfg, seed=0)
+    finally:
+        jax.config.update("jax_enable_x64", False)
+    assert res.lambdas.size == 10, "all ten ladder points must converge"
+    assert res.chi.dtype == np.float64
+    for k, (lam, m_g, e_g) in enumerate(golden):
+        assert abs(res.m_init[k] - m_g) <= 5e-3, (lam, res.m_init[k], m_g)
+        assert abs(res.ent1[k] - e_g) <= 5e-3, (lam, res.ent1[k], e_g)
+    # warm-started sweep counts in the stored run's regime (`ipynb:18-46`:
+    # 130-160 for λ≥0.1; measured here 127-163)
+    assert np.all(res.sweeps <= 200) and np.all(res.sweeps >= 100)

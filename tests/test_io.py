@@ -52,3 +52,13 @@ def test_periodic_checkpointer_throttles(tmp_path):
     assert pc.maybe_save({"x": np.zeros(1)}, {"t": 1})
     arrs, meta = pc.ckpt.load()
     assert meta == {"t": 1}
+
+
+def test_checkpoint_load_metaless_npz(tmp_path):
+    """A foreign npz without the __meta__ entry (e.g. a reference-style
+    results file) loads with empty metadata instead of KeyError."""
+    path = tmp_path / "foreign"
+    np.savez(str(path) + ".npz", s=np.arange(4), m=np.float64(0.5))
+    arrays, meta = Checkpoint(str(path)).load()
+    assert meta == {}
+    np.testing.assert_array_equal(arrays["s"], np.arange(4))

@@ -132,3 +132,24 @@ def test_sa_ensemble_driver(tmp_path):
     assert not np.array_equal(out.graphs[0], out.graphs[1])
     saved = load_results_npz(p)
     assert set(saved) == {"mag_reached", "num_steps", "conf", "graphs"}
+
+
+def test_int64_step_budget_under_x64():
+    """With x64 enabled a >2³¹ step budget (the 2n³ sentinel regime,
+    `SA_RRG.py:84`) passes through UNCLAMPED into the device comparison —
+    PRNG mode, so no injected-stream clamp shortens it — and the chains still
+    converge with int64 counters."""
+    import jax
+
+    from graphdyn.config import DynamicsConfig
+
+    cfg = SAConfig(dynamics=DynamicsConfig(p=2, c=1))
+    g = random_regular_graph(40, 3, seed=2)
+    jax.config.update("jax_enable_x64", True)
+    try:
+        res = simulated_annealing(g, cfg, n_replicas=4, seed=3, max_steps=2**40)
+    finally:
+        jax.config.update("jax_enable_x64", False)
+    assert res.num_steps.dtype == np.int64
+    assert np.all(res.m_final == 1.0)           # converged, not timed out
+    assert np.all(res.num_steps < 2**31)        # finite steps under big budget
