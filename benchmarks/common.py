@@ -15,20 +15,45 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
+# Environment plugins can pin jax_platforms at interpreter startup, which
+# plain `JAX_PLATFORMS=cpu` in the environment cannot override; this knob
+# forces the platform from inside the process before first jax use (how the
+# test conftest does it), so the config-runner's CPU smoke mode is hermetic.
+_force = os.environ.get("GRAPHDYN_FORCE_PLATFORM")
+if _force:
+    import jax
+
+    jax.config.update("jax_platforms", _force)
+
+
+def _sync(out):
+    """Wait for ``out`` for real: ``block_until_ready`` plus a one-element
+    device-to-host read. On the tunneled TPU platform, ``block_until_ready``
+    has been observed returning early after any >64 MB execution (timings
+    collapse to dispatch overhead — see PALLAS_TPU.md); a D2H read cannot
+    complete before the producing execution has, and the device executes
+    in-order, so this fences every dispatched iteration."""
+    import jax
+    import numpy as np
+
+    jax.block_until_ready(out)
+    for leaf in jax.tree_util.tree_leaves(out):
+        if hasattr(leaf, "ravel") and getattr(leaf, "size", 0) > 0:
+            np.asarray(leaf.ravel()[0])
+            break
+
 
 def timed(fn, *args, warmup: int = 1, iters: int = 3):
     """Run ``fn`` ``warmup`` times uncounted, then ``iters`` timed; returns
     (last_result, seconds_per_iter)."""
-    import jax
-
     out = None
     for _ in range(warmup):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _sync(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _sync(out)
     return out, (time.perf_counter() - t0) / iters
 
 
@@ -38,3 +63,29 @@ def report(metric: str, value: float, unit: str, vs_baseline: float | None = Non
         line["vs_baseline"] = vs_baseline
     line.update(extra)
     print(json.dumps(line))
+
+
+def is_oom(e: Exception) -> bool:
+    """True for device out-of-memory errors (XLA RESOURCE_EXHAUSTED)."""
+    s = str(e)
+    return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s or "OOM" in s
+
+
+def halve_on_oom(attempt, R: int, floor: int = 1, multiple: int = 1):
+    """Call ``attempt(R)``, halving R on device OOM until it fits.
+
+    ``floor`` is the smallest admissible R; ``multiple`` keeps every tried R
+    divisible (e.g. by the replica-shard count, so sharding constraints stay
+    satisfiable). Returns ``(result, achieved_R)``; re-raises non-OOM errors.
+    """
+    def snap(r):
+        return max(floor, r - r % multiple if multiple > 1 else r)
+
+    R = snap(R)
+    while True:
+        try:
+            return attempt(R), R
+        except Exception as e:  # noqa: BLE001 — halve only on device OOM
+            if not is_oom(e) or R <= floor:
+                raise
+            R = snap(R // 2)

@@ -46,45 +46,55 @@ def run_replicas(n, R, sweeps):
     """Replica-batched iteration throughput (BASELINE config 2's `256
     replicas` axis): R chains' sweep+marginals as one device program.
 
-    The vmapped body's DP intermediates scale with R·E; the replica count is
-    capped to what a chip's HBM can hold (~32 at n=1e5 per ~16 GB) times the
-    device count, with the replica axis sharded over the mesh beyond one
-    device — the same layout ``hpr_solve_batch(mesh=...)`` uses.
+    Replicas batch as a DISJOINT-UNION graph (R structural copies side by
+    side, `graphdyn.graphs.replicate_disjoint`): the edge axis stays the one
+    big lane dimension, so memory scales linearly in R — a ``vmap`` over a
+    leading replica axis instead makes XLA pad the replica dim to 128 lanes
+    (R-independent 2.3 GB temps at n=1e5, measured OOM). On a multi-device
+    slice the union's edge/node-blocked state shards over a 1-D mesh (chains
+    are disjoint, so shard-crossing gathers are rare). Capacity is still
+    *measured*: halve R on device OOM until the program fits.
     """
+    from benchmarks.common import halve_on_oom
+
     n_dev = len(jax.devices())
-    # HBM bound scales with 1/n: ~32 replicas fit per ~16 GB chip at n=1e5
-    per_dev = max(1, int(32 * 1e5 / n))
-    R = min(R, per_dev * max(n_dev, 1))
     g = random_regular_graph(n, 3, seed=0)
-    data = BDCMData(g, p=1, c=1)
-    sweep = make_sweep(data, damp=0.4, mask_invalid_src=False, with_bias=True)
-    marginals = make_marginals(data)
-    vsweep = jax.vmap(sweep, in_axes=(0, None, 0))
-    vmarg = jax.vmap(marginals)
-    chi = jnp.stack([data.init_messages(k) for k in range(R)])
-    bias = jnp.ones((R, data.num_directed, data.K), jnp.float32)
-    if n_dev > 1 and R % n_dev == 0:
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from graphdyn.parallel.mesh import make_mesh
+    def attempt(R):
+        from graphdyn.graphs import replicate_disjoint
 
-        mesh = make_mesh((n_dev,), ("replica",))
-        shard = NamedSharding(mesh, P("replica"))
-        chi = jax.device_put(chi, shard)
-        bias = jax.device_put(bias, shard)
+        gu = replicate_disjoint(g, R)
+        data = BDCMData(gu, p=1, c=1)
+        sweep = make_sweep(data, damp=0.4, mask_invalid_src=False, with_bias=True)
+        marginals = make_marginals(data)
+        chi = data.init_messages(0)
+        bias = jnp.ones((data.num_directed, data.K), jnp.float32)
+        if n_dev > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
 
-    @jax.jit
-    def body(chi):
-        chi = vsweep(chi, jnp.float32(25.0), bias)
-        return chi, vmarg(chi)
+            from graphdyn.parallel.mesh import make_mesh
 
-    (_, _), dt = timed(lambda c: body(c), chi, iters=sweeps)
+            mesh = make_mesh((n_dev,), ("replica",))
+            chi = jax.device_put(chi, NamedSharding(mesh, P("replica")))
+            bias = jax.device_put(bias, NamedSharding(mesh, P("replica")))
+
+        @jax.jit
+        def body(chi):
+            chi = sweep(chi, jnp.float32(25.0), bias)
+            return chi, marginals(chi)
+
+        (_, _), dt = timed(lambda c: body(c), chi, iters=sweeps)
+        return data, dt
+
+    requested = R
+    (data, dt), R = halve_on_oom(attempt, R, floor=1, multiple=max(n_dev, 1))
     report(
         "hpr_replica_message_updates_per_sec_d3_rrg_n%d_r%d" % (n, R),
-        R * data.num_directed * data.K * data.K / dt,
+        data.num_directed * data.K * data.K / dt,
         "message-combos/s",
         sweeps_per_sec=1.0 / dt,
         replicas=R,
+        replicas_requested=requested,
     )
 
 

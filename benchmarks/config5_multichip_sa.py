@@ -2,15 +2,28 @@
 """BASELINE config 5: d=5 RRG Ising SA, N=1e6, 1024 replicas × 16-point
 temperature ladder, multi-chip psum.
 
-On a multi-chip slice this runs the node+replica-sharded SA step
-(`graphdyn.parallel.sharded.make_sharded_sa_step`) over the full mesh; on the
-single tunneled chip (or a CPU mesh via
-``XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu``)
-it exercises the same sharded program at reduced shapes.
+Two measurements over the replica×node mesh:
+
+1. ``run_step`` — throughput of one full sharded SA step (proposal,
+   candidate rollout with the tiled int8 all_gather, Metropolis, anneal,
+   pmean'd consensus), the raw config-5 hot path.
+2. ``run_solver`` — the END-TO-END sharded solver
+   (:func:`graphdyn.parallel.sa_sharded.sa_sharded`): the consensus-stop
+   ``lax.while_loop`` with per-replica freezing, annealing caps, and the
+   timeout sentinel (`SA_RRG.py:72-85` semantics), reporting
+   steps-to-consensus per replica and sustained step rate under a bounded
+   ``max_steps``.
+
+On a multi-chip slice this spans the full mesh; on the single tunneled chip
+(or a CPU mesh via ``XLA_FLAGS=--xla_force_host_platform_device_count=8
+JAX_PLATFORMS=cpu``) the same sharded program runs at reduced shapes, with
+device OOM probed by halving the replica count (capacity is measured, not
+guessed).
 """
 
 import argparse
 import sys
+import time
 
 sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
 
@@ -20,6 +33,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from benchmarks.common import report, timed
+from graphdyn.config import DynamicsConfig, SAConfig
 from graphdyn.graphs import random_regular_graph
 from graphdyn.parallel.mesh import device_pool, make_mesh
 from graphdyn.parallel.sharded import (
@@ -28,9 +42,10 @@ from graphdyn.parallel.sharded import (
     pad_nodes,
     place_sharded,
 )
+from graphdyn.parallel.sa_sharded import sa_sharded
 
 
-def run(n, R, n_temps):
+def _mesh():
     n_dev = len(jax.devices())
     node_shards = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
     rep_shards = max(n_dev // node_shards, 1)
@@ -38,43 +53,97 @@ def run(n, R, n_temps):
         (rep_shards, node_shards), ("replica", "node"),
         devices=device_pool(rep_shards * node_shards),
     )
+    return mesh, rep_shards, node_shards
+
+
+def run_step(n, R, n_temps):
+    mesh, rep_shards, node_shards = _mesh()
     g = random_regular_graph(n, 5, seed=0)
     nbr_pad, n_pad = pad_nodes(g, node_shards)
     Rtot = R * n_temps
     Rtot -= Rtot % max(rep_shards, 1)
 
-    rng = np.random.default_rng(0)
-    s = (2 * rng.integers(0, 2, size=(Rtot, n_pad)) - 1).astype(np.int8)
-    nbr_d = place_sharded(mesh, jnp.asarray(nbr_pad), P("node", None))
-    s_d = place_sharded(mesh, jnp.asarray(s), P("replica", "node"))
+    def attempt(Rtot):
+        rng = np.random.default_rng(0)
+        s = (2 * rng.integers(0, 2, size=(Rtot, n_pad)) - 1).astype(np.int8)
+        nbr_d = place_sharded(mesh, jnp.asarray(nbr_pad), P("node", None))
+        s_d = place_sharded(mesh, jnp.asarray(s), P("replica", "node"))
 
-    rollout = make_sharded_rollout(mesh, n_real=g.n, steps=1)
-    s_end = rollout(nbr_d, s_d)
-    sum_end = jnp.asarray(
-        np.asarray(s_end)[:, : g.n].astype(np.int64).sum(axis=1), jnp.int32
+        rollout = make_sharded_rollout(mesh, n_real=g.n, steps=1)
+        s_end = rollout(nbr_d, s_d)
+        sum_end = jnp.asarray(
+            np.asarray(s_end)[:, : g.n].astype(np.int64).sum(axis=1), jnp.int32
+        )
+        # temperature ladder: a0 varies per replica block (BASELINE config 5)
+        ladder = np.linspace(0.005, 0.03, n_temps)
+        a0 = np.resize(np.repeat(ladder, max(Rtot // n_temps, 1)), Rtot)
+        step = make_sharded_sa_step(mesh, rollout_steps=1, n_real=g.n)
+        keys = jax.vmap(jax.random.PRNGKey)(np.arange(Rtot, dtype=np.uint32))
+        args = (
+            nbr_d, s_d,
+            place_sharded(mesh, sum_end, P("replica")),
+            place_sharded(mesh, jnp.asarray(a0 * g.n, jnp.float32), P("replica")),
+            place_sharded(mesh, jnp.full((Rtot,), 0.01 * g.n, jnp.float32), P("replica")),
+            place_sharded(mesh, keys, P("replica")),
+            place_sharded(mesh, jnp.zeros((Rtot,), jnp.int32), P("replica")),
+            jnp.float32(1.0005), jnp.float32(1.0005),
+            jnp.float32(4.5 * g.n), jnp.float32(5.0 * g.n),
+        )
+        return timed(lambda *a: step(*a), *args)
+
+    requested = Rtot
+    from benchmarks.common import halve_on_oom
+
+    (_, dt), Rtot = halve_on_oom(
+        attempt, Rtot, floor=rep_shards, multiple=rep_shards
     )
-    # temperature ladder: a0/b0 vary per replica block (BASELINE config 5);
-    # tile the ladder across however many replicas survived the shard trim
-    ladder = np.linspace(0.005, 0.03, n_temps)
-    a0 = np.resize(np.repeat(ladder, max(Rtot // n_temps, 1)), Rtot)
-    step = make_sharded_sa_step(mesh, rollout_steps=1, n_real=g.n)
-    keys = jax.vmap(jax.random.PRNGKey)(np.arange(Rtot, dtype=np.uint32))
-    args = (
-        nbr_d, s_d,
-        place_sharded(mesh, sum_end, P("replica")),
-        place_sharded(mesh, jnp.asarray(a0 * g.n, jnp.float32), P("replica")),
-        place_sharded(mesh, jnp.full((Rtot,), 0.01 * g.n, jnp.float32), P("replica")),
-        place_sharded(mesh, keys, P("replica")),
-        place_sharded(mesh, jnp.zeros((Rtot,), jnp.int32), P("replica")),
-        jnp.float32(1.0005), jnp.float32(1.0005),
-        jnp.float32(4.5 * g.n), jnp.float32(5.0 * g.n),
-    )
-    _, dt = timed(lambda *a: step(*a), *args)
     report(
         "multichip_sa_step_replica_rollouts_per_sec_d5_n%d" % n,
         Rtot / dt,
         "replica-steps/s",
         mesh=f"{rep_shards}x{node_shards}",
+        replicas=Rtot,
+        replicas_requested=requested,
+    )
+
+
+def run_solver(n, R, n_temps, max_steps):
+    """End-to-end sharded solve: the consensus-stop loop with sentinels."""
+    mesh, rep_shards, node_shards = _mesh()
+    g = random_regular_graph(n, 5, seed=0)
+    Rtot = max(R * n_temps, rep_shards)
+    cfg = SAConfig(dynamics=DynamicsConfig(p=1, c=1))
+
+    def attempt(Rt):
+        ladder = np.resize(
+            np.repeat(np.linspace(0.010, 0.020, n_temps), max(Rt // n_temps, 1)),
+            Rt,
+        )
+        t0 = time.perf_counter()
+        res = sa_sharded(
+            g, cfg, mesh=mesh, n_replicas=Rt, seed=0,
+            a0=ladder * g.n, max_steps=max_steps,
+        )
+        return res, time.perf_counter() - t0
+
+    from benchmarks.common import halve_on_oom
+
+    (res, dt), Rtot = halve_on_oom(
+        attempt, Rtot, floor=rep_shards, multiple=rep_shards
+    )
+    converged = res.m_final == 1.0
+    steps_total = int(res.num_steps.sum())
+    report(
+        "multichip_sa_solver_steps_per_sec_d5_n%d" % n,
+        steps_total / dt,
+        "mcmc-steps/s",
+        mesh=f"{rep_shards}x{node_shards}",
+        replicas=Rtot,
+        consensus_frac=float(converged.mean()),
+        median_steps_to_consensus=(
+            float(np.median(res.num_steps[converged])) if converged.any() else None
+        ),
+        max_steps=max_steps,
     )
 
 
@@ -83,6 +152,8 @@ if __name__ == "__main__":
     ap.add_argument("--full", action="store_true")
     a = ap.parse_args()
     if a.full:
-        run(1_000_000, 1024, 16)
+        run_step(1_000_000, 1024, 16)
+        run_solver(20_000, 4, 4, max_steps=300_000)
     else:
-        run(50_000, 16, 4)
+        run_step(50_000, 16, 4)
+        run_solver(1_000, 2, 2, max_steps=150_000)

@@ -56,6 +56,21 @@ def build_parser() -> argparse.ArgumentParser:
     sa.add_argument("--seed", type=int, default=0)
     sa.add_argument("--backend", default="jax_tpu")
     sa.add_argument("--out", default=None, help="npz path (`SA_RRG.py:92` keys)")
+    sa.add_argument(
+        "--sharded", action="store_true",
+        help="run the multi-chip solver (replica x node mesh over all "
+             "visible devices) instead of the per-repetition driver",
+    )
+    sa.add_argument(
+        "--n-replicas", type=int, default=32,
+        help="replica count for --sharded; with --ladder the per-replica a0 "
+             "spans [a0-frac, ladder-max-frac] linearly across replicas",
+    )
+    sa.add_argument(
+        "--ladder-max-frac", type=float, default=None,
+        help="enable a temperature ladder on the replica axis: per-replica "
+             "a0 = linspace(a0-frac, this, n-replicas) * n",
+    )
 
     hpr = sub.add_parser("hpr", help="HPr reinforced BP (`HPR_pytorch_RRG.py`)")
     hpr.add_argument("--n", type=int, default=10_000)
@@ -89,6 +104,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="path prefix for time-triggered intermediate saves",
     )
     ent.add_argument("--checkpoint-interval", type=float, default=30.0)
+    ent.add_argument(
+        "--dtype", choices=["float32", "float64"], default="float32",
+        help="float64 matches the reference's precision (enables x64)",
+    )
 
     return ap
 
@@ -97,14 +116,53 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
     if args.cmd == "sa":
-        from graphdyn.models.sa import sa_ensemble
-
         cfg = SAConfig(
             dynamics=_dynamics(args),
             a0_frac=args.a0_frac, b0_frac=args.b0_frac,
             par_a=args.par_a, par_b=args.par_b,
             a_cap_frac=args.a_cap_frac, b_cap_frac=args.b_cap_frac,
         )
+        if args.sharded:
+            import jax
+
+            from graphdyn.graphs import random_regular_graph
+            from graphdyn.parallel.mesh import make_mesh
+            from graphdyn.parallel.sa_sharded import sa_sharded
+            from graphdyn.utils.io import save_results_npz
+
+            n_dev = len(jax.devices())
+            node_shards = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
+            mesh = make_mesh(
+                (max(n_dev // node_shards, 1), node_shards), ("replica", "node")
+            )
+            g = random_regular_graph(args.n, args.d, seed=args.seed)
+            a0 = None
+            if args.ladder_max_frac is not None:
+                import numpy as _np
+
+                a0 = _np.linspace(
+                    args.a0_frac, args.ladder_max_frac, args.n_replicas
+                ) * args.n
+            res = sa_sharded(
+                g, cfg, mesh=mesh, n_replicas=args.n_replicas, a0=a0,
+                seed=args.seed, max_steps=args.max_steps,
+            )
+            if args.out:
+                save_results_npz(
+                    args.out, mag_reached=res.mag_reached,
+                    num_steps=res.num_steps, conf=res.s, m_final=res.m_final,
+                )
+            print(json.dumps({
+                "solver": "sa_sharded",
+                "mesh": dict(mesh.shape),
+                "mag_reached": res.mag_reached.tolist(),
+                "num_steps": res.num_steps.tolist(),
+                "m_final": res.m_final.tolist(),
+                "out": args.out,
+            }))
+            return 0
+        from graphdyn.models.sa import sa_ensemble
+
         out = sa_ensemble(
             args.n, args.d, cfg, n_stat=args.n_stat, seed=args.seed,
             max_steps=args.max_steps, save_path=args.out, backend=args.backend,
@@ -138,11 +196,16 @@ def main(argv=None) -> int:
     elif args.cmd == "entropy":
         from graphdyn.models.entropy import entropy_grid
 
+        if args.dtype == "float64":
+            import jax
+
+            jax.config.update("jax_enable_x64", True)
         cfg = EntropyConfig(
             dynamics=_dynamics(args),
             lmbd_max=args.lmbd_max, lmbd_step=args.lmbd_step,
             eps=args.eps, damp=args.damp, max_sweeps=args.max_sweeps,
             ent_floor=args.ent_floor, num_rep=args.num_rep,
+            dtype=args.dtype,
         )
         out = entropy_grid(
             args.n, np.asarray(args.deg), cfg, seed=args.seed,

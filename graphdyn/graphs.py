@@ -361,3 +361,69 @@ def erdos_renyi_graph(
         codes = rng.permutation(codes)[:m]
     i, j = _decode_triu(np.sort(codes), n)
     return graph_from_edges(n, np.stack([i, j], axis=1))
+
+
+def bfs_order(graph: Graph) -> np.ndarray:
+    """Breadth-first node ordering (frontier-vectorized; spans all
+    components). Returns ``order`` with ``order[k]`` = old id of the node
+    assigned new id ``k``.
+
+    Purpose: HBM gather locality. The packed/int8 dynamics kernels gather a
+    row of spin words per neighbor; under a random labeling those rows are
+    uniform over the array, while BFS labeling keeps a node's neighbors
+    within a few frontier widths — the same rows land near each other in
+    HBM, which prefetch and DMA batching reward (roofline notes in
+    ARCHITECTURE.md). Dynamics are label-equivariant, so results only
+    permute (tested).
+    """
+    n = graph.n
+    nbr = graph.nbr
+    visited = np.zeros(n + 1, bool)
+    visited[n] = True                      # ghost slot
+    order = np.empty(n, np.int64)
+    pos = 0
+    scan = 0                               # pointer to next unvisited seed
+    while pos < n:
+        while scan < n and visited[scan]:
+            scan += 1
+        frontier = np.array([scan], np.int64)
+        visited[scan] = True
+        while frontier.size:
+            order[pos : pos + frontier.size] = frontier
+            pos += frontier.size
+            nxt = np.unique(nbr[frontier].reshape(-1))
+            nxt = nxt[~visited[nxt]]
+            visited[nxt] = True
+            frontier = nxt
+    return order
+
+
+def permute_nodes(graph: Graph, order: np.ndarray) -> tuple[Graph, np.ndarray]:
+    """Relabel nodes so old node ``order[k]`` becomes new node ``k``.
+
+    Returns ``(relabeled_graph, inv)`` with ``inv[old] = new``; a spin vector
+    follows via ``s_new[..., inv] = s_old`` i.e. ``s_new = s_old[..., order]``.
+    """
+    inv = np.empty_like(order)
+    inv[order] = np.arange(order.size)
+    new_edges = inv[graph.edges.astype(np.int64)]
+    return graph_from_edges(graph.n, new_edges, dmax=graph.dmax), inv
+
+
+def replicate_disjoint(graph: Graph, R: int) -> Graph:
+    """Disjoint union of ``R`` copies of ``graph`` (copy r occupies nodes
+    ``[r*n, (r+1)*n)``).
+
+    TPU-first replica batching for message passing: a ``vmap`` over a
+    replica axis of chi ``[R, 2E, K, K]`` makes XLA pick the replica axis as
+    the 128-lane dim, so every ``R < 128`` pads to 128 (8× HBM blowup at
+    R=16, measured — the padded buffer size is R-independent). The disjoint
+    union instead keeps ONE big edge axis ``[R·2E]`` as the lane dim — the
+    layout the unbatched sweep already uses — so memory scales linearly in
+    R. Per-replica observables are reshapes ``[R·n] -> [R, n]``.
+    """
+    n = graph.n
+    E = graph.num_edges
+    offs = (np.arange(R, dtype=np.int64) * n)[:, None, None]     # [R, 1, 1]
+    edges = (graph.edges.astype(np.int64)[None] + offs).reshape(R * E, 2)
+    return graph_from_edges(R * n, edges, dmax=graph.dmax)

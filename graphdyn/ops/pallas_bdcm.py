@@ -45,23 +45,27 @@ from graphdyn.attractors import trajectories01
 
 LANE = 128
 
-# Per-core VMEM is ~16 MiB on v4/v5e-class chips; leave headroom for the
-# compiler. Pipelined in/out blocks are double-buffered (×2); the two DP
-# scratch buffers are not.
-VMEM_BUDGET = 12 * 1024 * 1024
+# Per-core VMEM is ~16 MiB on v4/v5e-class chips. The byte model below
+# underestimates the compiler's scoped-vmem demand by up to ~33% (measured:
+# a modeled 12.5 MiB kernel was charged 16.55 MiB by the v5e AOT compiler),
+# so the budget leaves that margin. Pipelined in/out blocks are
+# double-buffered (×2); the two DP scratch buffers are not.
+VMEM_BUDGET = 10 * 1024 * 1024
+MAX_BLOCK_EDGES = 8192  # wider tiles add nothing once the VPU is saturated
 
 
 def vmem_block_edges(d: int, T: int, budget: int = VMEM_BUDGET) -> int:
     """Largest lane-multiple edge-tile width whose VMEM working set fits
     ``budget``: 2×(chi_in + chi_old + out) pipelined blocks, the broadcast A
-    rows, and the two [K, M, Eb] DP scratch buffers. Returns 0 when even a
-    single lane-width tile does not fit."""
+    rows, and the two [K, M, Eb] DP scratch buffers — capped at
+    ``MAX_BLOCK_EDGES``. Returns 0 when even a single lane-width tile does
+    not fit."""
     K = 2**T
     M = (d + 1) ** T
     fixed = 8 * K * K * M                        # a_rows, double-buffered
     per_edge = 8 * (K * K * (d + 2) + K * M)     # blocks ×2 + scratch ×2
     eb = (budget - fixed) // per_edge
-    return int(max(0, eb // LANE) * LANE)
+    return int(min(MAX_BLOCK_EDGES, max(0, eb // LANE) * LANE))
 
 
 def _flat_offsets(d: int, T: int) -> np.ndarray:

@@ -1,0 +1,115 @@
+"""Run all five BASELINE benchmark configs and aggregate into one JSON doc.
+
+Each config runs as its own subprocess (fresh JAX runtime — no HBM carryover
+between configs; one config crashing cannot take down the rest). The JSON
+lines every config prints via ``benchmarks.common.report`` are collected
+into a single artifact.
+
+Usage:
+  python scripts/run_baseline_configs.py --out BENCH_CONFIGS_r03.json [--full]
+  # CPU smoke:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python scripts/run_baseline_configs.py --out smoke.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+CONFIGS = [
+    "config1_sa_rrg",
+    "config2_hpr",
+    "config3_er_majority",
+    "config4_bdcm_entropy",
+    "config5_multichip_sa",
+]
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_config(name: str, full: bool, timeout_s: float, platform: str | None) -> dict:
+    cmd = [sys.executable, os.path.join(ROOT, "benchmarks", f"{name}.py")]
+    if full:
+        cmd.append("--full")
+    env = dict(os.environ)
+    if platform:
+        # benchmarks.common applies this before first jax use — survives
+        # environment plugins that pin jax_platforms at interpreter startup
+        env["GRAPHDYN_FORCE_PLATFORM"] = platform
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s, cwd=ROOT,
+            env=env,
+        )
+        rc, out, err = proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        rc, out, err = -1, (e.stdout or ""), f"TIMEOUT after {timeout_s}s"
+    metrics = []
+    for line in out.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                metrics.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    entry = {
+        "config": name,
+        "rc": rc,
+        "elapsed_s": round(time.time() - t0, 1),
+        "metrics": metrics,
+    }
+    if rc != 0 or not metrics:
+        entry["stderr_tail"] = "\n".join(err.splitlines()[-15:])
+    return entry
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="BENCH_CONFIGS.json")
+    ap.add_argument("--full", action="store_true")
+    ap.add_argument("--timeout", type=float, default=3600.0, help="per-config seconds")
+    ap.add_argument("--only", nargs="*", help="subset of config names")
+    ap.add_argument(
+        "--platform", choices=["cpu", "tpu"], default=None,
+        help="force the JAX platform in each config subprocess",
+    )
+    args = ap.parse_args()
+
+    sys.path.insert(0, ROOT)
+    if args.platform:
+        os.environ["GRAPHDYN_FORCE_PLATFORM"] = args.platform
+    import benchmarks.common  # noqa: F401 — applies the platform force
+    import jax
+
+    doc = {
+        "backend": jax.default_backend(),
+        "devices": [str(d) for d in jax.devices()],
+        "mode": "full" if args.full else "smoke",
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "configs": [],
+    }
+    names = args.only or CONFIGS
+    for name in names:
+        print(f"=== {name} ({doc['mode']}) ===", flush=True)
+        entry = run_config(name, args.full, args.timeout, args.platform)
+        doc["configs"].append(entry)
+        for m in entry["metrics"]:
+            print("  ", json.dumps(m), flush=True)
+        if entry["rc"] != 0:
+            print("  rc=%s\n%s" % (entry["rc"], entry.get("stderr_tail", "")), flush=True)
+    ok = all(c["rc"] == 0 and c["metrics"] for c in doc["configs"])
+    doc["ok"] = ok
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"WROTE {args.out} ok={ok}")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

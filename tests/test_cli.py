@@ -44,3 +44,38 @@ def test_cli_entropy(tmp_path, capsys):
     saved = load_results_npz(out)
     assert "ent1" in saved and "counts" in saved
     assert np.asarray(saved["ent1"]).shape[0] == 1
+
+
+def test_cli_sa_sharded(tmp_path, capsys):
+    out = str(tmp_path / "sa_sharded.npz")
+    rc = main([
+        "sa", "--sharded", "--n", "80", "--d", "3", "--n-replicas", "4",
+        "--max-steps", "3000", "--out", out,
+    ])
+    assert rc == 0
+    import json
+
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["solver"] == "sa_sharded"
+    assert len(line["m_final"]) == 4
+    import numpy as np
+
+    with np.load(out) as f:
+        assert f["conf"].shape == (4, 80)
+
+
+def test_cli_entropy_dtype_f64(tmp_path, capsys):
+    import jax
+
+    try:
+        rc = main([
+            "entropy", "--n", "120", "--deg", "1.0", "--num-rep", "1",
+            "--lmbd-max", "0.2", "--lmbd-step", "0.1", "--dtype", "float64",
+        ])
+    finally:
+        jax.config.update("jax_enable_x64", False)
+    assert rc == 0
+    import json
+
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["solver"] == "entropy"
