@@ -43,8 +43,26 @@ def run(n, n_graphs, n_lambda):
         graphs=n_pg,
     )
 
-    # vmapped congruent-ensemble path: all graphs × the λ ladder as ONE
-    # device program (no per-graph dispatch/compile)
+    # union-ensemble path: the TRUE config-4 workload — the heterogeneous ER
+    # ensemble (different degree signatures, isolates) × the λ ladder as ONE
+    # device program via the disjoint union (single big edge axis)
+    from graphdyn.models.entropy import entropy_ensemble_union
+
+    er_graphs = [
+        erdos_renyi_graph(n, 1.5 / (n - 1), seed=k) for k in range(n_graphs)
+    ]
+    t0 = time.perf_counter()
+    res = entropy_ensemble_union(er_graphs, cfg, seed=0, lambdas=lambdas)
+    dt = time.perf_counter() - t0
+    report(
+        "bdcm_entropy_union_ensemble_graph_lambda_points_per_sec_n%d" % n,
+        res.lambdas.size * n_graphs / dt,
+        "graph-lambda-points/s",
+        graphs=n_graphs,
+        union=True,
+    )
+
+    # vmapped congruent-ensemble path (RRG members share one signature)
     from graphdyn.graphs import random_regular_graph
     from graphdyn.models.entropy import entropy_ensemble
 

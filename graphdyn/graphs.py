@@ -427,3 +427,33 @@ def replicate_disjoint(graph: Graph, R: int) -> Graph:
     offs = (np.arange(R, dtype=np.int64) * n)[:, None, None]     # [R, 1, 1]
     edges = (graph.edges.astype(np.int64)[None] + offs).reshape(R * E, 2)
     return graph_from_edges(R * n, edges, dmax=graph.dmax)
+
+
+def disjoint_union(graphs) -> tuple[Graph, np.ndarray, np.ndarray]:
+    """Disjoint union of arbitrary graphs (graph k's nodes shifted by the
+    cumulative node count).
+
+    Returns ``(union, node_gid, edge_gid)`` where ``node_gid[i]`` /
+    ``edge_gid[e]`` give the member-graph index of union node i / undirected
+    union edge e (edges keep per-graph order, concatenated). The same
+    layout rationale as :func:`replicate_disjoint` — one big edge/node axis
+    instead of a padded batch axis — but for *heterogeneous* members: the
+    union's degree classes are simply the merged classes of all members, so
+    message passing over e.g. a whole ER ensemble with different degree
+    signatures compiles as ONE program.
+    """
+    G = len(graphs)
+    if G == 0:
+        raise ValueError("empty union")
+    ns = [g.n for g in graphs]
+    offs = np.cumsum([0] + ns)
+    edges = [
+        g.edges.astype(np.int64) + offs[k] for k, g in enumerate(graphs)
+        if g.num_edges
+    ]
+    edges = (
+        np.concatenate(edges) if edges else np.empty((0, 2), np.int64)
+    )
+    node_gid = np.repeat(np.arange(G), ns)
+    edge_gid = np.repeat(np.arange(G), [g.num_edges for g in graphs])
+    return graph_from_edges(int(offs[-1]), edges), node_gid, edge_gid

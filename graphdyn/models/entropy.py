@@ -313,6 +313,158 @@ def entropy_ensemble(
     )
 
 
+class UnionEnsembleEntropyResult(NamedTuple):
+    """Per-member λ-ladder results of :func:`entropy_ensemble_union`.
+
+    Unlike :class:`EnsembleEntropyResult`, members may differ in edge count,
+    so ``chi`` is the UNION resume state ``[2E_union, K, K]`` (pass it back
+    as ``chi0`` to resume); ``edge_gid[e]`` maps undirected union edge ``e``
+    to its member index for any per-member slicing."""
+
+    lambdas: np.ndarray    # ladder values visited [count]
+    ent: np.ndarray        # φ [count, G]
+    m_init: np.ndarray     # [count, G]
+    ent1: np.ndarray       # [count, G]
+    sweeps: np.ndarray     # joint fixed-point sweep counts [count]
+    nonconverged: float    # λ whose joint fixed point failed, or 0
+    chi: np.ndarray        # [2E_union, K, K] union resume state
+    edge_gid: np.ndarray   # int[E_union] — member index per undirected edge
+
+
+def entropy_ensemble_union(
+    graphs,
+    config: EntropyConfig | None = None,
+    *,
+    seed: int = 0,
+    chi0=None,
+    lambdas: np.ndarray | None = None,
+    ent_floor_mode: str = "all",
+) -> UnionEnsembleEntropyResult:
+    """The λ ladder over an ARBITRARY graph ensemble as one device program,
+    via the disjoint union (:func:`graphdyn.graphs.disjoint_union`).
+
+    Unlike :func:`entropy_ensemble` (vmapped, congruent members only — and a
+    batch axis XLA pads to 128 lanes on TPU), the union concatenates members
+    into one big graph: heterogeneous degree signatures merge into one set
+    of degree classes, isolated nodes are allowed (handled per member with
+    the analytic ``−λ·n_iso/n`` / ``+n_iso/n`` terms, `ipynb:283-291,338`),
+    and the edge axis stays the single TPU lane dimension. Per-member φ and
+    m_init come from segment sums of the per-node/per-edge partition
+    functions. This is the BASELINE config-4 shape (64 ER instances × the
+    λ ladder) done natively. ``chi0`` resumes from a previous result's union
+    ``chi``.
+    """
+    import jax.ops
+
+    from graphdyn.graphs import disjoint_union
+    from graphdyn.ops.bdcm import (
+        make_edge_partition,
+        make_m_init_edge_terms,
+        make_node_partition,
+    )
+
+    if ent_floor_mode not in ("all", "any"):
+        raise ValueError(f"ent_floor_mode must be 'all' or 'any', got {ent_floor_mode!r}")
+    config = config or EntropyConfig()
+    dyn = config.dynamics
+    G = len(graphs)
+    subs, n_isos, n_totals = [], [], []
+    for g in graphs:
+        sub, n_iso = remove_isolates(g)
+        subs.append(sub)
+        n_isos.append(n_iso)
+        n_totals.append(g.n)
+    gu, node_gid, edge_gid = disjoint_union(subs)
+
+    if lambdas is None:
+        lambdas = lambda_ladder(config)
+    if gu.num_edges == 0:
+        # every member is edgeless (all isolates): the analytic closed form
+        # IS the whole answer — φ_g = −λ·n_iso/n, m_init = 1 per member
+        n_iso_a = np.asarray(n_isos, float)
+        n_tot_a = np.asarray(n_totals, float)
+        lam = np.asarray(lambdas, float)
+        ent = -lam[:, None] * n_iso_a[None, :] / n_tot_a[None, :]
+        m0 = np.broadcast_to(n_iso_a / n_tot_a, (lam.size, G)).copy()
+        K = 2 ** (dyn.p + dyn.c)
+        return UnionEnsembleEntropyResult(
+            lambdas=lam,
+            ent=ent,
+            m_init=m0,
+            ent1=ent + lam[:, None] * m0,
+            sweeps=np.zeros(lam.size, int),
+            nonconverged=0.0,
+            chi=np.zeros((0, K, K)),
+            edge_gid=edge_gid,
+        )
+
+    data = BDCMData(
+        gu, p=dyn.p, c=dyn.c, attr_value=dyn.attr_value,
+        rule=dyn.rule, tie=dyn.tie, dtype=config.dtype,
+    )
+    fixed_point = make_fixed_point(data, config)
+    set_leaves = make_leaf_setter(data)
+    zi_fn = make_node_partition(data, eps_clamp=config.eps_clamp)
+    zij_fn = make_edge_partition(data, eps_clamp=config.eps_clamp)
+    mterm_fn = make_m_init_edge_terms(data, eps_clamp=config.eps_clamp)
+
+    node_gid = jnp.asarray(node_gid)
+    edge_gid = jnp.asarray(edge_gid)
+    n_iso_v = jnp.asarray(n_isos, data.dtype)
+    n_tot_v = jnp.asarray(n_totals, data.dtype)
+
+    @jax.jit
+    def observables(chi, lmbd):
+        zi = zi_fn(chi, lmbd)                                    # [n_union]
+        zij = zij_fn(chi)                                        # [E_union]
+        phi = (
+            jax.ops.segment_sum(jnp.log(zi), node_gid, num_segments=G)
+            - jax.ops.segment_sum(jnp.log(zij), edge_gid, num_segments=G)
+            - lmbd * n_iso_v
+        ) / n_tot_v
+        m0 = (
+            jax.ops.segment_sum(mterm_fn(chi), edge_gid, num_segments=G)
+            + n_iso_v
+        ) / n_tot_v
+        return phi, m0
+
+    chi = data.init_messages(seed) if chi0 is None else jnp.asarray(chi0, data.dtype)
+
+    ents, m_inits, ent1s, sweeps, visited = [], [], [], [], []
+    nonconverged = 0.0
+    for lmbd in lambdas:
+        lm = jnp.asarray(lmbd, data.dtype)
+        chi = set_leaves(chi, lm)
+        chi, t, delta = fixed_point(chi, lm)
+        phi, m0 = observables(chi, lm)
+        phi = np.asarray(phi)
+        m0 = np.asarray(m0)
+        e1 = phi + float(lmbd) * m0
+        visited.append(float(lmbd))
+        ents.append(phi)
+        m_inits.append(m0)
+        ent1s.append(e1)
+        sweeps.append(int(t))
+        failed = float(delta) > config.eps
+        if failed:
+            nonconverged = float(lmbd)
+        crossed = e1 < config.ent_floor
+        stop = crossed.all() if ent_floor_mode == "all" else crossed.any()
+        if stop or failed:
+            break
+
+    return UnionEnsembleEntropyResult(
+        lambdas=np.array(visited),
+        ent=np.array(ents),
+        m_init=np.array(m_inits),
+        ent1=np.array(ent1s),
+        sweeps=np.array(sweeps),
+        nonconverged=nonconverged,
+        chi=np.asarray(chi),
+        edge_gid=edge_gid,
+    )
+
+
 class _GridCheckpointAdapter:
     """Injects grid coordinates into the per-sweep checkpoint metadata so a
     resumed run knows which (deg, rep, λ) cell to continue from."""

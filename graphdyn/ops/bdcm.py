@@ -708,30 +708,40 @@ def make_free_entropy(data: BDCMData, *, n_total: int, n_iso: int, eps_clamp: fl
 
 
 @partial(jax.jit, static_argnames=("eps_clamp",))
-def _minit_exec(chi, mask2, x0, edges, deg, n_iso, n_total, eps_clamp: float):
+def _minit_edge_terms_exec(chi, mask2, x0, edges, deg, eps_clamp: float):
     E = chi.shape[0] // 2
     P = chi[:E] * jnp.swapaxes(chi[E:], 1, 2) * mask2[None]
     Zij = jnp.maximum(P.sum(axis=(1, 2)), eps_clamp)
     wu = x0[:, None] / deg[edges[:, 0]][:, None, None]
     wv = x0[None, :] / deg[edges[:, 1]][:, None, None]
-    s = ((wu + wv) * P).sum(axis=(1, 2)) / Zij
-    return (s.sum() + n_iso) / n_total
+    return ((wu + wv) * P).sum(axis=(1, 2)) / Zij
 
 
-def make_mean_m_init(data: BDCMData, *, n_total: int, n_iso: int, eps_clamp: float = 0.0):
-    """Jitted ``chi -> m_init``: BP mean initial magnetization
-    (`ipynb:325-338`); each isolated node contributes +1 (it must sit at the
-    attractor value)."""
+def make_m_init_edge_terms(data: BDCMData, eps_clamp: float = 0.0):
+    """Jitted ``chi -> s[E]``: each undirected edge's contribution to the BP
+    mean initial magnetization (the summand of `ipynb:325-338`, before the
+    edge sum). Lets callers aggregate per graph-ensemble member via segment
+    sums (the union-ensemble entropy path)."""
     validf = jnp.asarray(data.valid, data.dtype)
     mask2 = validf[:, None] * validf[None, :]
     x0 = jnp.asarray(data.x0, data.dtype)
     edges = jnp.asarray(data.graph.edges.astype(np.int64))
     deg = jnp.asarray(data.graph.deg, data.dtype)
+    return lambda chi: _minit_edge_terms_exec(
+        chi, mask2, x0, edges, deg, float(eps_clamp)
+    )
+
+
+def make_mean_m_init(data: BDCMData, *, n_total: int, n_iso: int, eps_clamp: float = 0.0):
+    """Jitted ``chi -> m_init``: BP mean initial magnetization
+    (`ipynb:325-338`); each isolated node contributes +1 (it must sit at the
+    attractor value). Shares the per-edge summand with
+    :func:`make_m_init_edge_terms` (one implementation of the magnetization
+    term)."""
+    terms = make_m_init_edge_terms(data, eps_clamp)
     n_iso_t = jnp.asarray(n_iso, data.dtype)
     n_total_t = jnp.asarray(n_total, data.dtype)
-    return lambda chi: _minit_exec(
-        chi, mask2, x0, edges, deg, n_iso_t, n_total_t, float(eps_clamp)
-    )
+    return lambda chi: (terms(chi).sum() + n_iso_t) / n_total_t
 
 
 def make_marginals(data: BDCMData, eps: float = 1e-15):

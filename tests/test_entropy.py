@@ -177,3 +177,67 @@ def test_golden_triples_tight_f64():
     # warm-started sweep counts in the stored run's regime (`ipynb:18-46`:
     # 130-160 for λ≥0.1; measured here 127-163)
     assert np.all(res.sweeps <= 200) and np.all(res.sweeps >= 100)
+
+
+def test_union_ensemble_matches_per_graph():
+    """entropy_ensemble_union on heterogeneous ER members (different degree
+    signatures, isolates included) reproduces the per-graph entropy_sweep
+    results member by member."""
+    from graphdyn.models.entropy import entropy_ensemble_union
+
+    cfg = EntropyConfig()
+    lambdas = np.round(np.arange(0.0, 0.35, 0.1), 2)
+    graphs = [erdos_renyi_graph(200, 1.2 / 199, seed=s) for s in (1, 2, 3)]
+    assert any((g.deg == 0).any() for g in graphs)      # isolates present
+    res = entropy_ensemble_union(graphs, cfg, seed=0, lambdas=lambdas)
+    assert res.lambdas.size == lambdas.size
+    for k, g in enumerate(graphs):
+        ref = entropy_sweep(g, cfg, seed=10 + k, lambdas=lambdas)
+        np.testing.assert_allclose(res.ent[:, k], ref.ent, atol=2e-3)
+        np.testing.assert_allclose(res.m_init[:, k], ref.m_init, atol=2e-3)
+        np.testing.assert_allclose(res.ent1[:, k], ref.ent1, atol=2e-3)
+
+
+def test_union_ensemble_all_isolate_member():
+    """A member that is entirely isolated nodes gets the closed-form
+    analytic entropy: φ = −λ·n_iso/n, m_init = 1."""
+    from graphdyn.graphs import graph_from_edges
+    from graphdyn.models.entropy import entropy_ensemble_union
+
+    iso = graph_from_edges(5, np.empty((0, 2), dtype=np.int64))
+    er = erdos_renyi_graph(60, 1.5 / 59, seed=4)
+    cfg = EntropyConfig()
+    lambdas = np.array([0.0, 0.5])
+    res = entropy_ensemble_union([er, iso], cfg, seed=0, lambdas=lambdas)
+    np.testing.assert_allclose(res.m_init[:, 1], 1.0, atol=1e-6)
+    np.testing.assert_allclose(res.ent[:, 1], -lambdas * 1.0, atol=1e-6)
+
+
+def test_union_ensemble_all_edgeless_closed_form():
+    """A union whose every member is edgeless takes the analytic closed
+    form — no BP machinery, full ladder, exact values."""
+    from graphdyn.graphs import graph_from_edges
+    from graphdyn.models.entropy import entropy_ensemble_union
+
+    iso = graph_from_edges(5, np.empty((0, 2), dtype=np.int64))
+    lambdas = np.array([0.0, 0.5, 1.0])
+    res = entropy_ensemble_union([iso, iso], EntropyConfig(), lambdas=lambdas)
+    assert res.lambdas.size == 3
+    np.testing.assert_allclose(res.m_init, 1.0)
+    np.testing.assert_allclose(res.ent, -lambdas[:, None] * np.ones((1, 2)))
+    np.testing.assert_allclose(res.ent1, 0.0, atol=1e-12)
+
+
+def test_union_ensemble_resume_chi0():
+    """Passing a previous union result's chi back as chi0 warm-starts: the
+    resumed first λ converges in far fewer sweeps than a cold start."""
+    from graphdyn.models.entropy import entropy_ensemble_union
+
+    cfg = EntropyConfig()
+    graphs = [erdos_renyi_graph(150, 1.2 / 149, seed=s) for s in (5, 6)]
+    r1 = entropy_ensemble_union(graphs, cfg, seed=0, lambdas=np.array([0.0, 0.1]))
+    r2 = entropy_ensemble_union(
+        graphs, cfg, chi0=r1.chi, lambdas=np.array([0.1])
+    )
+    assert r2.sweeps[0] < r1.sweeps[0] / 2
+    np.testing.assert_allclose(r2.ent[0], r1.ent[1], atol=5e-4)

@@ -1,0 +1,39 @@
+"""Profiling/tracing hooks (SURVEY.md §5.1)."""
+
+import numpy as np
+
+from graphdyn.utils.profiling import StepTimer, device_trace, wall_clock
+
+
+def test_step_timer_accumulates_and_rates():
+    t = StepTimer()
+    with t.measure(100):
+        pass
+    with t.measure(50):
+        pass
+    assert t.updates == 150
+    assert t.seconds > 0
+    assert t.updates_per_sec > 0
+    assert StepTimer().updates_per_sec == 0.0    # no division by zero
+
+
+def test_wall_clock_bracket():
+    with wall_clock() as w:
+        _ = np.arange(10).sum()
+    assert w["seconds"] >= 0.0
+
+
+def test_device_trace_writes_profile(tmp_path):
+    import jax.numpy as jnp
+
+    logdir = str(tmp_path / "trace")
+    with device_trace(logdir):
+        jnp.arange(16).sum().block_until_ready()
+    import os
+
+    found = any(
+        f.endswith((".pb", ".json.gz", ".trace.json.gz", ".xplane.pb"))
+        for _, _, files in os.walk(logdir)
+        for f in files
+    )
+    assert found, "no profiler artifact written"
