@@ -94,6 +94,65 @@ def make_fixed_point(data: BDCMData, config: EntropyConfig):
     )
 
 
+def _run_ladder(
+    lambdas,
+    chi,
+    dtype,
+    *,
+    set_leaves,
+    fixed_point,
+    observe,
+    eps: float,
+    stop_fn,
+    checkpointer=None,
+    checkpoint_meta: dict | None = None,
+    checkpoint_extra_arrays: dict | None = None,
+    verbose: bool = False,
+):
+    """The shared λ-ladder loop (`ipynb:394-451` semantics) used by every
+    entropy solver: leaf write → warm-started fixed point → observables →
+    Legendre transform → checkpoint → early exits. ``observe(chi, lm)``
+    returns (φ, m_init) as scalars or per-member arrays; ``stop_fn(e1)``
+    decides the entropy-floor exit. Returns
+    ``(visited, ents, m_inits, ent1s, sweeps, nonconverged, chi)``."""
+    ents, m_inits, ent1s, sweeps, visited = [], [], [], [], []
+    nonconverged = 0.0
+    for lmbd in lambdas:
+        lm = jnp.asarray(lmbd, dtype)
+        chi = set_leaves(chi, lm)
+        chi, t, delta = fixed_point(chi, lm)
+        t = int(t)
+        phi, m0 = observe(chi, lm)
+        phi, m0 = np.asarray(phi), np.asarray(m0)
+        e1 = phi + float(lmbd) * m0
+        visited.append(float(lmbd))
+        ents.append(phi)
+        m_inits.append(m0)
+        ent1s.append(e1)
+        sweeps.append(t)
+        failed = float(delta) > eps
+        if failed:
+            nonconverged = float(lmbd)
+        if verbose:
+            print(f"lambda={lmbd:.2f} t={t} m_init={m0:.5f} ent1={e1:.5f}")
+        if checkpointer is not None and checkpointer.due():
+            checkpointer.maybe_save(
+                {
+                    "chi": np.asarray(chi),
+                    "ent": np.array(ents),
+                    "m_init": np.array(m_inits),
+                    "ent1": np.array(ent1s),
+                    "sweeps": np.array(sweeps),
+                    "lambdas": np.array(visited),
+                    **(checkpoint_extra_arrays or {}),
+                },
+                {"lmbd": float(lmbd), **(checkpoint_meta or {})},
+            )
+        if stop_fn(e1) or failed:
+            break
+    return visited, ents, m_inits, ent1s, sweeps, nonconverged, chi
+
+
 def entropy_sweep(
     graph: Graph,
     config: EntropyConfig | None = None,
@@ -152,43 +211,18 @@ def entropy_sweep(
         lambdas = lambda_ladder(config)
     chi = data.init_messages(seed) if chi0 is None else jnp.asarray(chi0, data.dtype)
 
-    ents, m_inits, ent1s, sweeps, visited = [], [], [], [], []
-    nonconverged = 0.0
-    for lmbd in lambdas:
-        lm = jnp.asarray(lmbd, data.dtype)
-        chi = set_leaves(chi, lm)
-        chi, t, delta = fixed_point(chi, lm)
-        t = int(t)
-        failed = float(delta) > config.eps
-        if failed:
-            nonconverged = float(lmbd)
-
-        phi = float(phi_fn(chi, lm))
-        m0 = float(minit_fn(chi))
-        e1 = phi + float(lmbd) * m0
-        visited.append(float(lmbd))
-        ents.append(phi)
-        m_inits.append(m0)
-        ent1s.append(e1)
-        sweeps.append(t)
-        if verbose:
-            print(f"lambda={lmbd:.2f} t={t} m_init={m0:.5f} ent1={e1:.5f}")
-        if checkpointer is not None and checkpointer.due():
-            checkpointer.maybe_save(
-                {
-                    "chi": np.asarray(chi),
-                    "ent": np.array(ents),
-                    "m_init": np.array(m_inits),
-                    "ent1": np.array(ent1s),
-                    "sweeps": np.array(sweeps),
-                    "lambdas": np.array(visited),
-                },
-                {"lmbd": float(lmbd), "seed": seed},
-            )
+    visited, ents, m_inits, ent1s, sweeps, nonconverged, chi = _run_ladder(
+        lambdas, chi, data.dtype,
+        set_leaves=set_leaves,
+        fixed_point=fixed_point,
+        observe=lambda c, lm: (phi_fn(c, lm), minit_fn(c)),
+        eps=config.eps,
         # early exits (`ipynb:446-447`)
-        if e1 < config.ent_floor or failed:
-            break
-
+        stop_fn=lambda e1: bool(e1 < config.ent_floor),
+        checkpointer=checkpointer,
+        checkpoint_meta={"seed": seed},
+        verbose=verbose,
+    )
     return EntropyResult(
         lambdas=np.array(visited),
         ent=np.array(ents),
@@ -280,28 +314,18 @@ def entropy_ensemble(
         lambdas = lambda_ladder(config)
     chi = ens.init_messages(seed)
 
-    ents, m_inits, ent1s, sweeps, visited = [], [], [], [], []
-    nonconverged = 0.0
-    for lmbd in lambdas:
-        lm = jnp.asarray(lmbd, ens.dtype)
-        chi = set_leaves(chi, lm)
-        chi, t, delta = fixed_point(chi, lm)
-        phi = np.asarray(phi_fn(chi, lm))
-        m0 = np.asarray(minit_fn(chi))
-        e1 = phi + float(lmbd) * m0
-        visited.append(float(lmbd))
-        ents.append(phi)
-        m_inits.append(m0)
-        ent1s.append(e1)
-        sweeps.append(int(t))
-        failed = float(delta) > config.eps
-        if failed:
-            nonconverged = float(lmbd)
-        crossed = (e1 < config.ent_floor)
-        stop = crossed.all() if ent_floor_mode == "all" else crossed.any()
-        if stop or failed:
-            break
+    def stop_fn(e1):
+        crossed = e1 < config.ent_floor
+        return bool(crossed.all() if ent_floor_mode == "all" else crossed.any())
 
+    visited, ents, m_inits, ent1s, sweeps, nonconverged, chi = _run_ladder(
+        lambdas, chi, ens.dtype,
+        set_leaves=set_leaves,
+        fixed_point=fixed_point,
+        observe=lambda c, lm: (phi_fn(c, lm), minit_fn(c)),
+        eps=config.eps,
+        stop_fn=stop_fn,
+    )
     return EnsembleEntropyResult(
         lambdas=np.array(visited),
         ent=np.array(ents),
@@ -311,6 +335,31 @@ def entropy_ensemble(
         nonconverged=nonconverged,
         chi=np.asarray(chi),
     )
+
+
+@partial(jax.jit, static_argnames=("G",))
+def _union_observables_exec(zi, zij, mterms, lmbd, node_gid, edge_gid,
+                            n_iso_v, n_tot_v, G: int):
+    """Per-member (φ, m_init) from union-graph partition functions by
+    segment reduction. Module-level jit: calls with identical shapes (the
+    chi0-resume and checkpointer-restore flows) share one compile."""
+    import jax.ops
+
+    phi = (
+        jax.ops.segment_sum(jnp.log(zi), node_gid, num_segments=G)
+        - jax.ops.segment_sum(jnp.log(zij), edge_gid, num_segments=G)
+        - lmbd * n_iso_v
+    ) / n_tot_v
+    # per-member empty-attractor guard: φ_g = −inf, not NaN (see
+    # ops.bdcm._phi_exec). Edgeless members have no nodes either (their
+    # isolates were removed), so segment_min's identity (+inf) keeps them
+    # on the analytic branch.
+    zi_min = jax.ops.segment_min(zi, node_gid, num_segments=G)
+    phi = jnp.where(zi_min <= 0.0, -jnp.inf, phi)
+    m0 = (
+        jax.ops.segment_sum(mterms, edge_gid, num_segments=G) + n_iso_v
+    ) / n_tot_v
+    return phi, m0
 
 
 class UnionEnsembleEntropyResult(NamedTuple):
@@ -339,6 +388,7 @@ def entropy_ensemble_union(
     chi0=None,
     lambdas: np.ndarray | None = None,
     ent_floor_mode: str = "all",
+    checkpointer=None,
 ) -> UnionEnsembleEntropyResult:
     """The λ ladder over an ARBITRARY graph ensemble as one device program,
     via the disjoint union (:func:`graphdyn.graphs.disjoint_union`).
@@ -352,10 +402,11 @@ def entropy_ensemble_union(
     m_init come from segment sums of the per-node/per-edge partition
     functions. This is the BASELINE config-4 shape (64 ER instances × the
     λ ladder) done natively. ``chi0`` resumes from a previous result's union
-    ``chi``.
+    ``chi``; ``checkpointer`` (a
+    :class:`graphdyn.utils.io.PeriodicCheckpointer`) saves the warm-start
+    state + results-so-far after a λ point at most every ``interval_s`` —
+    resume with the restored ``chi`` as ``chi0`` and the remaining ladder.
     """
-    import jax.ops
-
     from graphdyn.graphs import disjoint_union
     from graphdyn.ops.bdcm import (
         make_edge_partition,
@@ -408,51 +459,37 @@ def entropy_ensemble_union(
     zij_fn = make_edge_partition(data, eps_clamp=config.eps_clamp)
     mterm_fn = make_m_init_edge_terms(data, eps_clamp=config.eps_clamp)
 
+    edge_gid_np = edge_gid
     node_gid = jnp.asarray(node_gid)
     edge_gid = jnp.asarray(edge_gid)
     n_iso_v = jnp.asarray(n_isos, data.dtype)
     n_tot_v = jnp.asarray(n_totals, data.dtype)
 
-    @jax.jit
     def observables(chi, lmbd):
-        zi = zi_fn(chi, lmbd)                                    # [n_union]
-        zij = zij_fn(chi)                                        # [E_union]
-        phi = (
-            jax.ops.segment_sum(jnp.log(zi), node_gid, num_segments=G)
-            - jax.ops.segment_sum(jnp.log(zij), edge_gid, num_segments=G)
-            - lmbd * n_iso_v
-        ) / n_tot_v
-        m0 = (
-            jax.ops.segment_sum(mterm_fn(chi), edge_gid, num_segments=G)
-            + n_iso_v
-        ) / n_tot_v
-        return phi, m0
+        # composed of module-level jitted executors (zi/zij/m-terms and the
+        # segment reduce below) — repeat calls on same shapes share compiles
+        return _union_observables_exec(
+            zi_fn(chi, lmbd), zij_fn(chi), mterm_fn(chi),
+            lmbd, node_gid, edge_gid, n_iso_v, n_tot_v, G,
+        )
 
     chi = data.init_messages(seed) if chi0 is None else jnp.asarray(chi0, data.dtype)
 
-    ents, m_inits, ent1s, sweeps, visited = [], [], [], [], []
-    nonconverged = 0.0
-    for lmbd in lambdas:
-        lm = jnp.asarray(lmbd, data.dtype)
-        chi = set_leaves(chi, lm)
-        chi, t, delta = fixed_point(chi, lm)
-        phi, m0 = observables(chi, lm)
-        phi = np.asarray(phi)
-        m0 = np.asarray(m0)
-        e1 = phi + float(lmbd) * m0
-        visited.append(float(lmbd))
-        ents.append(phi)
-        m_inits.append(m0)
-        ent1s.append(e1)
-        sweeps.append(int(t))
-        failed = float(delta) > config.eps
-        if failed:
-            nonconverged = float(lmbd)
+    def stop_fn(e1):
         crossed = e1 < config.ent_floor
-        stop = crossed.all() if ent_floor_mode == "all" else crossed.any()
-        if stop or failed:
-            break
+        return bool(crossed.all() if ent_floor_mode == "all" else crossed.any())
 
+    visited, ents, m_inits, ent1s, sweeps, nonconverged, chi = _run_ladder(
+        lambdas, chi, data.dtype,
+        set_leaves=set_leaves,
+        fixed_point=fixed_point,
+        observe=observables,
+        eps=config.eps,
+        stop_fn=stop_fn,
+        checkpointer=checkpointer,
+        checkpoint_meta={"seed": seed},
+        checkpoint_extra_arrays={"edge_gid": edge_gid_np},
+    )
     return UnionEnsembleEntropyResult(
         lambdas=np.array(visited),
         ent=np.array(ents),
@@ -461,7 +498,7 @@ def entropy_ensemble_union(
         sweeps=np.array(sweeps),
         nonconverged=nonconverged,
         chi=np.asarray(chi),
-        edge_gid=edge_gid,
+        edge_gid=edge_gid_np,
     )
 
 

@@ -214,9 +214,12 @@ def hpr_solve_batch(
     every R < 128 pads to 128 (measured: R-independent 2.3 GB input copies
     at n=1e5, OOM). Chains stay independent (no edges between copies);
     finished chains freeze via per-replica masks gathered to the node/edge
-    axes, inside one ``lax.while_loop``. Pass a ``mesh`` to shard the
-    edge/node-blocked state over devices; the only cross-replica collective
-    is the tiny per-sweep ``any(active)`` reduce of the loop predicate.
+    axes, inside one ``lax.while_loop``. Pass a ``mesh`` to split the
+    edge/node-blocked state over devices; note the directed-edge layout
+    ([all forward | all reverse]) puts a replica's two blocks on different
+    shards, so GSPMD inserts gathers for reverse-edge reads — the sharding
+    trades some ICI traffic for HBM capacity rather than being
+    communication-free.
     """
     t_start = time.perf_counter()
     config = config or HPRConfig()

@@ -534,7 +534,9 @@ def make_ensemble_free_entropy(
         zi = jnp.maximum(zi, eps_clamp)
         P = chi[:E] * jnp.swapaxes(chi[E:], 1, 2) * mask2[None]
         zij = jnp.maximum(P.sum(axis=(1, 2)), eps_clamp)
-        return (jnp.sum(jnp.log(zi)) - jnp.sum(jnp.log(zij))) / n_total
+        phi = (jnp.sum(jnp.log(zi)) - jnp.sum(jnp.log(zij))) / n_total
+        # empty attractor set: φ=−inf, not (−inf)−(−inf)=NaN (see _phi_exec)
+        return jnp.where(jnp.any(zi <= 0.0), -jnp.inf, phi)
 
     flat_tables = [t for _, idx, ie, _ in nclasses for t in (idx, ie)]
     vphi = jax.vmap(phi_one, in_axes=(0, None) + (0,) * len(flat_tables))
@@ -686,9 +688,14 @@ def _zij_exec(chi, mask2, eps_clamp: float):
 def _phi_exec(chi, lmbd, valid, x0, ntables, mask2, n_iso, n_total, spec, eps_clamp):
     zi = _zi_exec(chi, lmbd, valid, x0, ntables, spec)
     zij = _zij_exec(chi, mask2, eps_clamp)
-    return (
+    phi = (
         jnp.sum(jnp.log(zi)) - jnp.sum(jnp.log(zij)) - lmbd * n_iso
     ) / n_total
+    # empty attractor set (some Z_i = 0, e.g. minority dynamics with a c=1
+    # homogeneous endpoint): no valid configuration exists — report φ=−inf
+    # rather than the NaN that (−inf) − (−inf) would produce when Z_ij
+    # vanishes too
+    return jnp.where(jnp.any(zi <= 0.0), -jnp.inf, phi)
 
 
 def make_free_entropy(data: BDCMData, *, n_total: int, n_iso: int, eps_clamp: float = 0.0):
@@ -714,7 +721,14 @@ def _minit_edge_terms_exec(chi, mask2, x0, edges, deg, eps_clamp: float):
     Zij = jnp.maximum(P.sum(axis=(1, 2)), eps_clamp)
     wu = x0[:, None] / deg[edges[:, 0]][:, None, None]
     wv = x0[None, :] / deg[edges[:, 1]][:, None, None]
-    return ((wu + wv) * P).sum(axis=(1, 2)) / Zij
+    s = ((wu + wv) * P).sum(axis=(1, 2))
+    # Z_ij = 0 (empty attractor set): the edge carries no admissible
+    # configurations — report 0, not 0/0 = NaN. φ is −inf there
+    # (see _phi_exec), so ent1 = −inf + λ·m stays well-defined and the
+    # entropy-floor early exit still fires.
+    return jnp.where(
+        Zij > 0.0, s / jnp.maximum(Zij, jnp.finfo(chi.dtype).tiny), 0.0
+    )
 
 
 def make_m_init_edge_terms(data: BDCMData, eps_clamp: float = 0.0):

@@ -241,3 +241,24 @@ def test_union_ensemble_resume_chi0():
     )
     assert r2.sweeps[0] < r1.sweeps[0] / 2
     np.testing.assert_allclose(r2.ent[0], r1.ent[1], atol=5e-4)
+
+
+def test_union_ensemble_checkpointing(tmp_path):
+    """The union ensemble saves resumable state through a
+    PeriodicCheckpointer; restoring chi as chi0 continues the ladder."""
+    from graphdyn.models.entropy import entropy_ensemble_union
+    from graphdyn.utils.io import PeriodicCheckpointer
+
+    cfg = EntropyConfig()
+    graphs = [erdos_renyi_graph(100, 1.2 / 99, seed=s) for s in (7, 8)]
+    ck = PeriodicCheckpointer(str(tmp_path / "union"), interval_s=0.0)
+    res = entropy_ensemble_union(
+        graphs, cfg, seed=0, lambdas=np.array([0.0, 0.1]), checkpointer=ck
+    )
+    arrays, meta = ck.ckpt.load()
+    assert meta["lmbd"] == 0.1
+    np.testing.assert_array_equal(arrays["chi"], res.chi)
+    r2 = entropy_ensemble_union(
+        graphs, cfg, chi0=arrays["chi"], lambdas=np.array([0.2])
+    )
+    assert r2.lambdas.size == 1 and np.isfinite(r2.ent1).all()
