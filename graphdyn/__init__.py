@@ -28,6 +28,10 @@ from graphdyn.graphs import (  # noqa: F401
     erdos_renyi_graph,
     graph_from_edges,
     build_edge_tables,
+    bfs_order,
+    permute_nodes,
+    replicate_disjoint,
+    disjoint_union,
 )
 from graphdyn.ops.dynamics import (  # noqa: F401
     Rule,

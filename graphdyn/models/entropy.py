@@ -94,6 +94,21 @@ def make_fixed_point(data: BDCMData, config: EntropyConfig):
     )
 
 
+def _ensemble_stop_fn(config: EntropyConfig, ent_floor_mode: str):
+    """Shared ent-floor exit for per-member e1 vectors: 'all' members (or
+    'any') must cross the floor. Validates the mode."""
+    if ent_floor_mode not in ("all", "any"):
+        raise ValueError(
+            f"ent_floor_mode must be 'all' or 'any', got {ent_floor_mode!r}"
+        )
+
+    def stop_fn(e1):
+        crossed = e1 < config.ent_floor
+        return bool(crossed.all() if ent_floor_mode == "all" else crossed.any())
+
+    return stop_fn
+
+
 def _run_ladder(
     lambdas,
     chi,
@@ -274,8 +289,6 @@ def entropy_ensemble(
         make_ensemble_sweep,
     )
 
-    if ent_floor_mode not in ("all", "any"):
-        raise ValueError(f"ent_floor_mode must be 'all' or 'any', got {ent_floor_mode!r}")
     config = config or EntropyConfig()
     dyn = config.dynamics
     for g in graphs:
@@ -314,17 +327,13 @@ def entropy_ensemble(
         lambdas = lambda_ladder(config)
     chi = ens.init_messages(seed)
 
-    def stop_fn(e1):
-        crossed = e1 < config.ent_floor
-        return bool(crossed.all() if ent_floor_mode == "all" else crossed.any())
-
     visited, ents, m_inits, ent1s, sweeps, nonconverged, chi = _run_ladder(
         lambdas, chi, ens.dtype,
         set_leaves=set_leaves,
         fixed_point=fixed_point,
         observe=lambda c, lm: (phi_fn(c, lm), minit_fn(c)),
         eps=config.eps,
-        stop_fn=stop_fn,
+        stop_fn=_ensemble_stop_fn(config, ent_floor_mode),
     )
     return EnsembleEntropyResult(
         lambdas=np.array(visited),
@@ -337,9 +346,9 @@ def entropy_ensemble(
     )
 
 
-@partial(jax.jit, static_argnames=("G",))
+@partial(jax.jit, static_argnames=("G", "eps_clamp"))
 def _union_observables_exec(zi, zij, mterms, lmbd, node_gid, edge_gid,
-                            n_iso_v, n_tot_v, G: int):
+                            n_iso_v, n_tot_v, G: int, eps_clamp: float = 0.0):
     """Per-member (φ, m_init) from union-graph partition functions by
     segment reduction. Module-level jit: calls with identical shapes (the
     chi0-resume and checkpointer-restore flows) share one compile."""
@@ -351,11 +360,11 @@ def _union_observables_exec(zi, zij, mterms, lmbd, node_gid, edge_gid,
         - lmbd * n_iso_v
     ) / n_tot_v
     # per-member empty-attractor guard: φ_g = −inf, not NaN (see
-    # ops.bdcm._phi_exec). Edgeless members have no nodes either (their
-    # isolates were removed), so segment_min's identity (+inf) keeps them
-    # on the analytic branch.
+    # ops.bdcm._phi_exec; a vanished Z sits AT the clamp floor). Edgeless
+    # members have no nodes either (their isolates were removed), so
+    # segment_min's identity (+inf) keeps them on the analytic branch.
     zi_min = jax.ops.segment_min(zi, node_gid, num_segments=G)
-    phi = jnp.where(zi_min <= 0.0, -jnp.inf, phi)
+    phi = jnp.where(zi_min <= eps_clamp, -jnp.inf, phi)
     m0 = (
         jax.ops.segment_sum(mterms, edge_gid, num_segments=G) + n_iso_v
     ) / n_tot_v
@@ -414,8 +423,6 @@ def entropy_ensemble_union(
         make_node_partition,
     )
 
-    if ent_floor_mode not in ("all", "any"):
-        raise ValueError(f"ent_floor_mode must be 'all' or 'any', got {ent_floor_mode!r}")
     config = config or EntropyConfig()
     dyn = config.dynamics
     G = len(graphs)
@@ -471,13 +478,10 @@ def entropy_ensemble_union(
         return _union_observables_exec(
             zi_fn(chi, lmbd), zij_fn(chi), mterm_fn(chi),
             lmbd, node_gid, edge_gid, n_iso_v, n_tot_v, G,
+            eps_clamp=float(config.eps_clamp),
         )
 
     chi = data.init_messages(seed) if chi0 is None else jnp.asarray(chi0, data.dtype)
-
-    def stop_fn(e1):
-        crossed = e1 < config.ent_floor
-        return bool(crossed.all() if ent_floor_mode == "all" else crossed.any())
 
     visited, ents, m_inits, ent1s, sweeps, nonconverged, chi = _run_ladder(
         lambdas, chi, data.dtype,
@@ -485,7 +489,7 @@ def entropy_ensemble_union(
         fixed_point=fixed_point,
         observe=observables,
         eps=config.eps,
-        stop_fn=stop_fn,
+        stop_fn=_ensemble_stop_fn(config, ent_floor_mode),
         checkpointer=checkpointer,
         checkpoint_meta={"seed": seed},
         checkpoint_extra_arrays={"edge_gid": edge_gid_np},

@@ -535,8 +535,9 @@ def make_ensemble_free_entropy(
         P = chi[:E] * jnp.swapaxes(chi[E:], 1, 2) * mask2[None]
         zij = jnp.maximum(P.sum(axis=(1, 2)), eps_clamp)
         phi = (jnp.sum(jnp.log(zi)) - jnp.sum(jnp.log(zij))) / n_total
-        # empty attractor set: φ=−inf, not (−inf)−(−inf)=NaN (see _phi_exec)
-        return jnp.where(jnp.any(zi <= 0.0), -jnp.inf, phi)
+        # empty attractor set: φ=−inf, not (−inf)−(−inf)=NaN; vanished Z
+        # sits AT the clamp floor (see _phi_exec)
+        return jnp.where(jnp.any(zi <= eps_clamp), -jnp.inf, phi)
 
     flat_tables = [t for _, idx, ie, _ in nclasses for t in (idx, ie)]
     vphi = jax.vmap(phi_one, in_axes=(0, None) + (0,) * len(flat_tables))
@@ -694,8 +695,9 @@ def _phi_exec(chi, lmbd, valid, x0, ntables, mask2, n_iso, n_total, spec, eps_cl
     # empty attractor set (some Z_i = 0, e.g. minority dynamics with a c=1
     # homogeneous endpoint): no valid configuration exists — report φ=−inf
     # rather than the NaN that (−inf) − (−inf) would produce when Z_ij
-    # vanishes too
-    return jnp.where(jnp.any(zi <= 0.0), -jnp.inf, phi)
+    # vanishes too. _zi_exec clamps zi at spec.eps_clamp, so a vanished Z
+    # sits AT the floor — compare against it, not against 0
+    return jnp.where(jnp.any(zi <= spec.eps_clamp), -jnp.inf, phi)
 
 
 def make_free_entropy(data: BDCMData, *, n_total: int, n_iso: int, eps_clamp: float = 0.0):
@@ -725,9 +727,10 @@ def _minit_edge_terms_exec(chi, mask2, x0, edges, deg, eps_clamp: float):
     # Z_ij = 0 (empty attractor set): the edge carries no admissible
     # configurations — report 0, not 0/0 = NaN. φ is −inf there
     # (see _phi_exec), so ent1 = −inf + λ·m stays well-defined and the
-    # entropy-floor early exit still fires.
+    # entropy-floor early exit still fires. A vanished Z sits AT the clamp
+    # floor when eps_clamp > 0, so compare against the floor.
     return jnp.where(
-        Zij > 0.0, s / jnp.maximum(Zij, jnp.finfo(chi.dtype).tiny), 0.0
+        Zij > eps_clamp, s / jnp.maximum(Zij, jnp.finfo(chi.dtype).tiny), 0.0
     )
 
 

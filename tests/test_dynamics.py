@@ -141,3 +141,24 @@ def test_solvers_run_under_nondefault_rules():
     assert out2.ent1[0] == -np.inf
     # ent1 = -inf < ent_floor => the ladder early-exits after one point
     assert out2.lambdas.size == 1
+
+
+def test_empty_attractor_guard_with_eps_clamp():
+    """The -inf guard must hold with a nonzero eps_clamp too: the clamp
+    floors vanished Z's AT eps_clamp, which previously slipped past a
+    `<= 0` comparison and produced finite garbage entropy."""
+    import numpy as np
+
+    from graphdyn.config import DynamicsConfig, EntropyConfig
+    from graphdyn.graphs import erdos_renyi_graph
+    from graphdyn.models.entropy import entropy_sweep
+
+    er = erdos_renyi_graph(80, 1.2 / 79, seed=2)
+    cfg = EntropyConfig(
+        dynamics=DynamicsConfig(p=1, c=1, rule="minority", attr_value=-1),
+        lmbd_max=0.3, lmbd_step=0.1, max_sweeps=50, eps_clamp=1e-12,
+    )
+    out = entropy_sweep(er, cfg, seed=0)
+    assert out.ent[0] == -np.inf
+    assert np.isfinite(out.m_init[0])
+    assert out.lambdas.size == 1                # early exit still fires

@@ -115,3 +115,31 @@ def test_sharded_sweep_matches_unsharded(kind):
     np.testing.assert_allclose(
         np.asarray(c_sh), np.asarray(c_ref), rtol=2e-5, atol=1e-7
     )
+
+
+def test_sharded_sweep_f64_matches_unsharded():
+    """The edge-sharded sweep honors BDCMData(dtype=float64): constants cast
+    to f64 and shard/unshard agreement holds at f64 tolerance."""
+    import jax
+
+    from graphdyn.ops.bdcm import BDCMData, make_sweep
+
+    prev_x64 = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", True)
+    try:
+        g = erdos_renyi_graph(200, 2.5 / 199, seed=3)
+        data = BDCMData(g, p=1, c=1, dtype=jnp.float64)
+        emesh = make_mesh((8,), ("edge",), devices=device_pool(8))
+        sw_ref = make_sweep(data, damp=0.2, use_pallas=False)
+        sw_sh = make_sharded_sweep(data, emesh, damp=0.2)
+        chi = data.init_messages(seed=4)
+        assert chi.dtype == jnp.float64
+        lam = jnp.float64(0.7)
+        c_ref = sw_ref(chi, lam)
+        c_sh = sw_sh(chi, lam)
+        assert c_sh.dtype == jnp.float64
+        np.testing.assert_allclose(
+            np.asarray(c_sh), np.asarray(c_ref), rtol=1e-12, atol=1e-14
+        )
+    finally:
+        jax.config.update("jax_enable_x64", prev_x64)
