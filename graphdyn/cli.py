@@ -17,10 +17,21 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 
 import numpy as np
 
 from graphdyn.config import DynamicsConfig, EntropyConfig, HPRConfig, SAConfig
+
+_force = os.environ.get("GRAPHDYN_FORCE_PLATFORM")
+if _force:
+    # Environment plugins can pin jax_platforms at interpreter startup, which
+    # plain JAX_PLATFORMS in the environment cannot override; this knob forces
+    # the platform before first jax use (same contract as benchmarks.common) —
+    # e.g. GRAPHDYN_FORCE_PLATFORM=cpu runs the CLI with the TPU unreachable.
+    import jax
+
+    jax.config.update("jax_platforms", _force)
 
 
 def _add_dynamics_flags(ap: argparse.ArgumentParser, p_default: int = 1):
@@ -57,6 +68,11 @@ def build_parser() -> argparse.ArgumentParser:
     sa.add_argument("--backend", default="jax_tpu")
     sa.add_argument("--out", default=None, help="npz path (`SA_RRG.py:92` keys)")
     sa.add_argument(
+        "--checkpoint", default=None,
+        help="path prefix for preemption-safe exact resume (driver + chain)",
+    )
+    sa.add_argument("--checkpoint-interval", type=float, default=30.0)
+    sa.add_argument(
         "--sharded", action="store_true",
         help="run the multi-chip solver (replica x node mesh over all "
              "visible devices) instead of the per-repetition driver",
@@ -84,6 +100,11 @@ def build_parser() -> argparse.ArgumentParser:
     hpr.add_argument("--n-rep", type=int, default=1)
     hpr.add_argument("--seed", type=int, default=0)
     hpr.add_argument("--out", default=None, help="npz path (`HPR:377` keys)")
+    hpr.add_argument(
+        "--checkpoint", default=None,
+        help="path prefix for preemption-safe exact resume (driver + chain)",
+    )
+    hpr.add_argument("--checkpoint-interval", type=float, default=30.0)
 
     ent = sub.add_parser("entropy", help="BDCM entropy λ-sweep (notebook)")
     ent.add_argument("--n", type=int, default=1000)
@@ -123,6 +144,11 @@ def main(argv=None) -> int:
             a_cap_frac=args.a_cap_frac, b_cap_frac=args.b_cap_frac,
         )
         if args.sharded:
+            if args.checkpoint:
+                raise SystemExit(
+                    "--checkpoint is not supported with --sharded (the mesh "
+                    "solver has no chunked resume yet); drop one of the flags"
+                )
             import jax
 
             from graphdyn.graphs import random_regular_graph
@@ -166,6 +192,8 @@ def main(argv=None) -> int:
         out = sa_ensemble(
             args.n, args.d, cfg, n_stat=args.n_stat, seed=args.seed,
             max_steps=args.max_steps, save_path=args.out, backend=args.backend,
+            checkpoint_path=args.checkpoint,
+            checkpoint_interval_s=args.checkpoint_interval,
         )
         print(json.dumps({
             "solver": "sa",
@@ -185,6 +213,8 @@ def main(argv=None) -> int:
         out = hpr_ensemble(
             args.n, args.d, cfg, n_rep=args.n_rep, seed=args.seed,
             save_path=args.out,
+            checkpoint_path=args.checkpoint,
+            checkpoint_interval_s=args.checkpoint_interval,
         )
         print(json.dumps({
             "solver": "hpr",

@@ -290,6 +290,7 @@ def entropy_ensemble(
     )
 
     config = config or EntropyConfig()
+    stop_fn = _ensemble_stop_fn(config, ent_floor_mode)   # fail-fast validation
     dyn = config.dynamics
     for g in graphs:
         if (g.deg == 0).any():
@@ -333,7 +334,7 @@ def entropy_ensemble(
         fixed_point=fixed_point,
         observe=lambda c, lm: (phi_fn(c, lm), minit_fn(c)),
         eps=config.eps,
-        stop_fn=_ensemble_stop_fn(config, ent_floor_mode),
+        stop_fn=stop_fn,
     )
     return EnsembleEntropyResult(
         lambdas=np.array(visited),
@@ -424,6 +425,7 @@ def entropy_ensemble_union(
     )
 
     config = config or EntropyConfig()
+    stop_fn = _ensemble_stop_fn(config, ent_floor_mode)   # fail-fast validation
     dyn = config.dynamics
     G = len(graphs)
     subs, n_isos, n_totals = [], [], []
@@ -489,7 +491,7 @@ def entropy_ensemble_union(
         fixed_point=fixed_point,
         observe=observables,
         eps=config.eps,
-        stop_fn=_ensemble_stop_fn(config, ent_floor_mode),
+        stop_fn=stop_fn,
         checkpointer=checkpointer,
         checkpoint_meta={"seed": seed},
         checkpoint_extra_arrays={"edge_gid": edge_gid_np},

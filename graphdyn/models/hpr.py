@@ -117,8 +117,19 @@ def hpr_solve(
     *,
     seed: int = 0,
     chi0=None,
+    checkpoint_path: str | None = None,
+    checkpoint_interval_s: float = 30.0,
+    chunk_sweeps: int = 200,
 ) -> HPRResult:
-    """Run one HPr chain on one graph instance."""
+    """Run one HPr chain on one graph instance.
+
+    ``checkpoint_path`` enables exact chain resume (SURVEY.md §5.4): the
+    device loop runs in ``chunk_sweeps``-bounded chunks (the bound is a
+    traced absolute sweep index, so every chunk reuses one compiled program)
+    and the full chain state (chi, biases, s, PRNG key, t) is snapshotted
+    atomically at most every ``checkpoint_interval_s`` seconds; a rerun
+    pointing at the checkpoint continues bit-for-bit. Removed on completion.
+    """
     t_start = time.perf_counter()
     config = config or HPRConfig()
     setup = _prep(graph, config)
@@ -130,12 +141,10 @@ def hpr_solve(
         return setup.m_of_end_batch(s[None])[0]
 
     @jax.jit
-    def run(chi, biases, key):
-        s0 = jnp.where(biases[:, 0] > biases[:, 1], 1, -1).astype(jnp.int8)
-
+    def run_chunk(chi, biases, s, key, t, m_final, t_end):
         def cond(st):
             _, _, _, _, t, m_final = st
-            return m_final < 1.0
+            return (m_final < 1.0) & (t < t_end)
 
         def body(st):
             chi, biases, s, key, t, _ = st
@@ -157,20 +166,70 @@ def hpr_solve(
             m_final = jnp.where(t > TT, 2.0, m_of_end(s))
             return chi, biases, s, key, t, m_final
 
-        state = (chi, biases, s0, key, jnp.int32(0), m_of_end(s0))
-        return lax.while_loop(cond, body, state)
+        return lax.while_loop(cond, body, (chi, biases, s, key, t, m_final))
 
-    rng = np.random.default_rng(seed)
-    if chi0 is None:
-        # one stream for both draws — keeps chi and biases independent
-        chi0 = data.init_messages(rng)
-    biases0 = rng.random((n, 2))
-    biases0 /= biases0.sum(axis=1, keepdims=True)
-    key = jax.random.PRNGKey(seed)
+    ckpt = None
+    state = None
+    if checkpoint_path is not None:
+        from graphdyn.utils.io import Checkpoint, PeriodicCheckpointer
 
-    chi, biases, s, _, t, m_final = run(
-        jnp.asarray(chi0), jnp.asarray(biases0, jnp.float32), key
-    )
+        loaded = Checkpoint(checkpoint_path).load()
+        if loaded is not None:
+            arrays, meta = loaded
+            if (
+                meta.get("kind") != "hpr_chain"
+                or meta.get("seed") != int(seed)
+                or arrays["s"].shape != (n,)
+                or arrays["chi"].shape != (data.num_directed, data.K, data.K)
+            ):
+                raise ValueError(
+                    f"checkpoint at {checkpoint_path!r} is not a matching "
+                    f"hpr_chain snapshot (meta {meta}) for this graph/seed; "
+                    f"refusing to resume"
+                )
+            state = (
+                jnp.asarray(arrays["chi"]),
+                jnp.asarray(arrays["biases"]),
+                jnp.asarray(arrays["s"]),
+                jnp.asarray(arrays["key"]),
+                jnp.asarray(arrays["t"]),
+                jnp.asarray(arrays["m_final"]),
+            )
+        ckpt = PeriodicCheckpointer(checkpoint_path, interval_s=checkpoint_interval_s)
+
+    if state is None:
+        rng = np.random.default_rng(seed)
+        if chi0 is None:
+            # one stream for both draws — keeps chi and biases independent
+            chi0 = data.init_messages(rng)
+        biases0 = rng.random((n, 2))
+        biases0 /= biases0.sum(axis=1, keepdims=True)
+        biases0 = jnp.asarray(biases0, jnp.float32)
+        s0 = jnp.where(biases0[:, 0] > biases0[:, 1], 1, -1).astype(jnp.int8)
+        state = (
+            jnp.asarray(chi0), biases0, s0, jax.random.PRNGKey(seed),
+            jnp.int32(0), m_of_end(s0),
+        )
+
+    if ckpt is None:
+        state = run_chunk(*state, jnp.int32(TT + 2))
+    else:
+        while bool(state[5] < 1.0):
+            t_end = jnp.minimum(state[4] + jnp.int32(chunk_sweeps), TT + 2)
+            state = run_chunk(*state, t_end)
+            if ckpt.due():
+                chi_c, biases_c, s_c, key_c, t_c, m_c = state
+                ckpt.maybe_save(
+                    {
+                        "chi": np.asarray(chi_c), "biases": np.asarray(biases_c),
+                        "s": np.asarray(s_c), "key": np.asarray(key_c),
+                        "t": np.asarray(t_c), "m_final": np.asarray(m_c),
+                    },
+                    {"kind": "hpr_chain", "seed": int(seed)},
+                )
+        ckpt.remove()
+
+    chi, biases, s, _, t, m_final = state
     s = np.asarray(s)
     return HPRResult(
         s=s,
@@ -348,13 +407,20 @@ def hpr_ensemble(
     seed: int = 0,
     graph_method: str = "pairing",
     save_path: str | None = None,
+    checkpoint_path: str | None = None,
+    checkpoint_interval_s: float = 30.0,
 ) -> HPREnsembleResult:
     """The reference's experiment driver (`HPR_pytorch_RRG.py:259-377`):
     ``n_rep`` repetitions, each on a freshly sampled RRG(n, d); pass
     ``save_path`` to persist the npz with the reference's key names
-    (`HPR:377` — the only live persistence in the reference repo)."""
+    (`HPR:377` — the only live persistence in the reference repo).
+
+    ``checkpoint_path`` makes the driver preemption-safe, exactly as in
+    :func:`graphdyn.models.sa.sa_ensemble`: completed repetitions snapshot
+    with the next repetition index, the in-flight chain checkpoints at
+    ``<path>_chain`` (exact resume), graphs re-derive from ``seed + k``."""
     from graphdyn.graphs import random_regular_graph
-    from graphdyn.utils.io import save_results_npz
+    from graphdyn.utils.io import Checkpoint, load_resume_prefix, save_results_npz
 
     config = config or HPRConfig()
     mag = np.empty(n_rep, np.float64)
@@ -362,14 +428,43 @@ def hpr_ensemble(
     steps = np.empty(n_rep, np.int64)
     graphs = np.empty((n_rep, n, d), np.int32)
     times = np.empty(n_rep, np.float64)
-    for k in range(n_rep):
+
+    start_k = 0
+    ck = Checkpoint(checkpoint_path) if checkpoint_path else None
+    run_id = {"seed": seed, "n_rep": n_rep, "n": n, "d": d}
+    if ck is not None:
+        resumed = load_resume_prefix(ck, run_id)
+        if resumed is not None:
+            arrays, start_k = resumed
+            mag[:start_k] = arrays["mag_reached"][:start_k]
+            conf[:start_k] = arrays["conf"][:start_k]
+            steps[:start_k] = arrays["num_steps"][:start_k]
+            times[:start_k] = arrays["time"][:start_k]
+
+    for k in range(start_k, n_rep):
         g = random_regular_graph(n, d, seed=seed + k, method=graph_method)
-        res = hpr_solve(g, config, seed=seed + k)
+        res = hpr_solve(
+            g, config, seed=seed + k,
+            checkpoint_path=(checkpoint_path + "_chain") if checkpoint_path else None,
+            checkpoint_interval_s=checkpoint_interval_s,
+        )
         mag[k] = float(res.mag_reached)
         conf[k] = res.s
         steps[k] = res.num_steps
         graphs[k] = g.nbr
         times[k] = res.elapsed_s
+        if ck is not None:
+            ck.save(
+                {"mag_reached": mag, "conf": conf, "num_steps": steps,
+                 "time": times},
+                {**run_id, "next_rep": k + 1},
+            )
+    for k in range(start_k):
+        graphs[k] = random_regular_graph(
+            n, d, seed=seed + k, method=graph_method
+        ).nbr
+    if ck is not None:
+        ck.remove()
     out = HPREnsembleResult(mag, conf, steps, graphs, times)
     if save_path:
         save_results_npz(

@@ -60,6 +60,8 @@ class _SAState(NamedTuple):
     m_final: jnp.ndarray   # f[R]
     active: jnp.ndarray    # bool[R]
     key: jnp.ndarray       # PRNG key per replica [R]
+    chunk_t: jnp.ndarray   # int32[] — steps taken in the current chunk (see
+    #                        `simulated_annealing(checkpoint_path=...)`)
 
 
 def _batched_end_sum(nbr, s, steps: int, R_coef: int, C_coef: int):
@@ -121,18 +123,35 @@ def metropolis_anneal_update(
     return do, sum_end_new, a_new, b_new, t_new, m_final_new, active_new
 
 
+@partial(jax.jit, static_argnames=("rollout_steps", "R_coef", "C_coef"))
+def _sa_init(nbr, s0, key0, a0, b0, *, rollout_steps: int, R_coef: int, C_coef: int):
+    R, n = s0.shape
+    dt = a0.dtype
+    sum_end0 = _batched_end_sum(nbr, s0, rollout_steps, R_coef, C_coef)
+    m0 = sum_end0.astype(dt) / n
+    return _SAState(
+        s=s0,
+        sum_end=sum_end0,
+        a=a0,
+        b=b0,
+        t=jnp.zeros((R,), jnp.int64 if jax.config.jax_enable_x64 else jnp.int32),
+        m_final=m0,
+        active=m0 < 1.0,
+        key=key0,
+        chunk_t=jnp.zeros((), jnp.int32),
+    )
+
+
 @partial(
     jax.jit,
     static_argnames=(
-        "rollout_steps", "R_coef", "C_coef", "max_steps", "injected", "stream_len"
+        "rollout_steps", "R_coef", "C_coef", "max_steps", "injected",
+        "stream_len", "chunk_steps",
     ),
 )
-def _sa_run(
+def _sa_loop(
     nbr,
-    s0,
-    key0,
-    a0,
-    b0,
+    state: _SAState,
     par_a,
     par_b,
     a_cap,
@@ -146,24 +165,20 @@ def _sa_run(
     max_steps: int,
     injected: bool,
     stream_len: int,
+    chunk_steps: int | None = None,
 ):
-    R, n = s0.shape
-    dt = a0.dtype
-    sum_end0 = _batched_end_sum(nbr, s0, rollout_steps, R_coef, C_coef)
-    m0 = sum_end0.astype(dt) / n
-    state = _SAState(
-        s=s0,
-        sum_end=sum_end0,
-        a=a0,
-        b=b0,
-        t=jnp.zeros((R,), jnp.int64 if jax.config.jax_enable_x64 else jnp.int32),
-        m_final=m0,
-        active=m0 < 1.0,
-        key=key0,
-    )
+    """Run the SA while-loop from ``state`` until every replica stops — or,
+    with ``chunk_steps``, for at most that many more steps (the state is then
+    a host-visible exact-resume point: re-entering with it continues the
+    chain bit-for-bit, since the loop body is step-index-driven)."""
+    R, n = state.s.shape
+    dt = state.a.dtype
 
     def cond(st: _SAState):
-        return jnp.any(st.active)
+        go = jnp.any(st.active)
+        if chunk_steps is not None:
+            go = go & (st.chunk_t < chunk_steps)
+        return go
 
     def body(st: _SAState):
         i, u = draw_sa_proposal(
@@ -185,12 +200,11 @@ def _sa_run(
         )
         s_new = jnp.where(do[:, None], s_flip, st.s)
         return _SAState(
-            s_new, sum_end_new, a_new, b_new, t_new, m_final, active, st.key
+            s_new, sum_end_new, a_new, b_new, t_new, m_final, active, st.key,
+            st.chunk_t + 1,
         )
 
-    out = lax.while_loop(cond, body, state)
-    mag = out.s.astype(dt).sum(axis=1) / n
-    return out.s, mag, out.t, out.m_final
+    return lax.while_loop(cond, body, state)
 
 
 def prepare_sa_inputs(
@@ -271,6 +285,9 @@ def simulated_annealing(
     max_steps: int | None = None,
     dtype=jnp.float32,
     backend: str = "jax_tpu",
+    checkpoint_path: str | None = None,
+    checkpoint_interval_s: float = 30.0,
+    chunk_steps: int = 100_000,
 ) -> SAResult:
     """Run batched SA chains.
 
@@ -278,6 +295,16 @@ def simulated_annealing(
     axis of BASELINE.json config 5. ``proposals``/``uniforms`` (``[R, L]``)
     switch to injected-stream mode for parity testing. ``backend='cpu'`` runs
     the numpy oracle.
+
+    ``checkpoint_path`` enables **exact chain resume** (SURVEY.md §5.4: the
+    reference's only persistence is end-of-run `np.savez`, `SA_RRG.py:92`;
+    preemption recovery is a new capability): the device loop runs in
+    ``chunk_steps``-bounded chunks, the full chain state (spins, cached
+    end-sums, annealing weights, step counters, PRNG keys) is snapshotted
+    atomically at most every ``checkpoint_interval_s`` seconds, and a rerun
+    pointing at an existing checkpoint continues bit-for-bit — the loop body
+    is step-index-driven, so splitting it across while-loops cannot change
+    the chain. The file is deleted on successful completion.
     """
     config = config or SAConfig()
     n = graph.n
@@ -293,6 +320,11 @@ def simulated_annealing(
      max_steps, stream_len, injected) = prep
 
     if backend == "cpu":
+        if checkpoint_path is not None:
+            raise ValueError(
+                "checkpoint_path requires the jax backend (the numpy oracle "
+                "has no chunked resume); drop --checkpoint or use backend='jax'"
+            )
         np_scalar = np.float32 if dtype == jnp.float32 else np.float64
         return _sa_reference_numpy(
             graph, config, s0, a0, b0, proposals if injected else None,
@@ -300,31 +332,90 @@ def simulated_annealing(
         )
 
     np_dt = np.float32 if dtype == jnp.float32 else np.float64
+    nbr = jnp.asarray(graph.nbr)
     keys = jax.vmap(jax.random.PRNGKey)(np.arange(R, dtype=np.uint32) + np.uint32(seed))
-    s, mag, t, m_final = _sa_run(
-        jnp.asarray(graph.nbr),
-        jnp.asarray(s0),
-        keys,
-        jnp.asarray(a0.astype(np_dt)),
-        jnp.asarray(b0.astype(np_dt)),
+
+    ckpt = None
+    state = None
+    if checkpoint_path is not None:
+        from graphdyn.utils.io import Checkpoint, PeriodicCheckpointer
+
+        loaded = Checkpoint(checkpoint_path).load()
+        if loaded is not None:
+            arrays, meta = loaded
+            if (
+                meta.get("kind") != "sa_chain"
+                or meta.get("seed") != int(seed)
+                or meta.get("R") != int(R)
+                or arrays["s"].shape != (R, n)
+            ):
+                raise ValueError(
+                    f"checkpoint at {checkpoint_path!r} is not a matching "
+                    f"sa_chain snapshot (meta {meta}, s {arrays['s'].shape} "
+                    f"vs expected seed={seed} R={R} n={n}); refusing to resume"
+                )
+            state = _SAState(
+                s=jnp.asarray(arrays["s"]),
+                sum_end=jnp.asarray(arrays["sum_end"]),
+                a=jnp.asarray(arrays["a"].astype(np_dt)),
+                b=jnp.asarray(arrays["b"].astype(np_dt)),
+                t=jnp.asarray(arrays["t"]),
+                m_final=jnp.asarray(arrays["m_final"].astype(np_dt)),
+                active=jnp.asarray(arrays["active"]),
+                key=jnp.asarray(arrays["key"]),
+                chunk_t=jnp.zeros((), jnp.int32),
+            )
+        ckpt = PeriodicCheckpointer(checkpoint_path, interval_s=checkpoint_interval_s)
+
+    if state is None:
+        state = _sa_init(
+            nbr, jnp.asarray(s0), keys,
+            jnp.asarray(a0.astype(np_dt)), jnp.asarray(b0.astype(np_dt)),
+            rollout_steps=rollout, R_coef=R_coef, C_coef=C_coef,
+        )
+
+    loop_kwargs = dict(
+        rollout_steps=rollout, R_coef=R_coef, C_coef=C_coef,
+        max_steps=int(max_steps), injected=injected, stream_len=stream_len,
+    )
+    loop_args = (
         jnp.asarray(np_dt(config.par_a)),
         jnp.asarray(np_dt(config.par_b)),
         jnp.asarray(np_dt(config.a_cap_frac * n)),
         jnp.asarray(np_dt(config.b_cap_frac * n)),
         jnp.asarray(proposals),
         jnp.asarray(uniforms.astype(np_dt)),
-        rollout_steps=rollout,
-        R_coef=R_coef,
-        C_coef=C_coef,
-        max_steps=int(max_steps),
-        injected=injected,
-        stream_len=stream_len,
     )
+    if ckpt is None:
+        state = _sa_loop(nbr, state, *loop_args, **loop_kwargs)
+    else:
+        while bool(jnp.any(state.active)):
+            state = _sa_loop(
+                nbr, state._replace(chunk_t=jnp.zeros((), jnp.int32)),
+                *loop_args, chunk_steps=int(chunk_steps), **loop_kwargs,
+            )
+            if ckpt.due():
+                ckpt.maybe_save(
+                    {
+                        "s": np.asarray(state.s),
+                        "sum_end": np.asarray(state.sum_end),
+                        "a": np.asarray(state.a),
+                        "b": np.asarray(state.b),
+                        "t": np.asarray(state.t),
+                        "m_final": np.asarray(state.m_final),
+                        "active": np.asarray(state.active),
+                        "key": np.asarray(state.key),
+                    },
+                    {"kind": "sa_chain", "seed": int(seed), "R": int(R)},
+                )
+        ckpt.remove()
+
+    mag = np.asarray(state.s).astype(np.float64).sum(axis=1) / n
     return SAResult(
-        s=np.asarray(s),
-        mag_reached=np.asarray(mag),
-        num_steps=np.asarray(t),
-        m_final=np.asarray(m_final),
+        s=np.asarray(state.s),
+        mag_reached=mag.astype(np_dt),
+        num_steps=np.asarray(state.t),
+        m_final=np.asarray(state.m_final),
     )
 
 
@@ -386,13 +477,21 @@ def sa_ensemble(
     max_steps: int | None = None,
     save_path: str | None = None,
     backend: str = "jax_tpu",
+    checkpoint_path: str | None = None,
+    checkpoint_interval_s: float = 30.0,
 ) -> SAEnsembleResult:
     """The reference's experiment driver (`SA_RRG.py:58-92`): ``n_stat``
     repetitions, each on a freshly sampled RRG(n, d). Each repetition runs as
     one replica of the batched solver; pass ``save_path`` to persist the
-    npz with the reference's key names (`SA_RRG.py:92`)."""
+    npz with the reference's key names (`SA_RRG.py:92`).
+
+    ``checkpoint_path`` makes the whole driver preemption-safe: completed
+    repetitions are snapshotted (with the next repetition index), and the
+    in-flight chain checkpoints its own state at ``<path>_chain`` (exact
+    resume — see :func:`simulated_annealing`). Graphs re-derive from
+    ``seed + k``, so a resumed run records identical graphs."""
     from graphdyn.graphs import random_regular_graph
-    from graphdyn.utils.io import save_results_npz
+    from graphdyn.utils.io import Checkpoint, load_resume_prefix, save_results_npz
 
     config = config or SAConfig()
     mag = np.empty(n_stat, np.float64)
@@ -400,17 +499,52 @@ def sa_ensemble(
     conf = np.empty((n_stat, n), np.int8)
     graphs = np.empty((n_stat, n, d), np.int32)
     m_final = np.empty(n_stat, np.float64)
-    for k in range(n_stat):
+
+    start_k = 0
+    ck = Checkpoint(checkpoint_path) if checkpoint_path else None
+    run_id = {"seed": seed, "n_stat": n_stat, "n": n, "d": d,
+              "max_steps": max_steps}
+    if ck is not None:
+        resumed = load_resume_prefix(ck, run_id)
+        if resumed is not None:
+            arrays, start_k = resumed
+            mag[:start_k] = arrays["mag_reached"][:start_k]
+            steps[:start_k] = arrays["num_steps"][:start_k]
+            conf[:start_k] = arrays["conf"][:start_k]
+            m_final[:start_k] = arrays["m_final"][:start_k]
+
+    for k in range(start_k, n_stat):
         g = random_regular_graph(n, d, seed=seed + k, method=graph_method)
+        chain_ckpt = (
+            checkpoint_path + "_chain"
+            if checkpoint_path and backend != "cpu" else None
+        )   # driver-level resume still works for the numpy-oracle backend
         res = simulated_annealing(
             g, config, n_replicas=1, seed=seed + k,
             max_steps=max_steps, backend=backend,
+            checkpoint_path=chain_ckpt,
+            checkpoint_interval_s=checkpoint_interval_s,
         )
         mag[k] = res.mag_reached[0]
         steps[k] = res.num_steps[0]
         conf[k] = res.s[0]
         graphs[k] = g.nbr
         m_final[k] = res.m_final[0]
+        if ck is not None:
+            ck.save(
+                {
+                    "mag_reached": mag, "num_steps": steps,
+                    "conf": conf, "m_final": m_final,
+                },
+                {**run_id, "next_rep": k + 1},
+            )
+    # graphs for reps completed before a resume re-derive from seed + k
+    for k in range(start_k):
+        graphs[k] = random_regular_graph(
+            n, d, seed=seed + k, method=graph_method
+        ).nbr
+    if ck is not None:
+        ck.remove()
     out = SAEnsembleResult(mag, steps, conf, graphs, m_final)
     if save_path:
         save_results_npz(

@@ -564,7 +564,13 @@ def make_ensemble_m_init(ens: EnsembleBDCM, *, n_total: int | None = None, eps_c
         Zij = jnp.maximum(P.sum(axis=(1, 2)), eps_clamp)
         wu = x0[:, None] / deg_g[edges_g[:, 0]][:, None, None]
         wv = x0[None, :] / deg_g[edges_g[:, 1]][:, None, None]
-        s = ((wu + wv) * P).sum(axis=(1, 2)) / Zij
+        s = ((wu + wv) * P).sum(axis=(1, 2))
+        # Z_ij = 0 (empty attractor set): 0, not 0/0 = NaN — same guard as
+        # _minit_edge_terms_exec, so ent1 degrades to −inf and the
+        # entropy-floor exit still fires on ensemble members
+        s = jnp.where(
+            Zij > eps_clamp, s / jnp.maximum(Zij, jnp.finfo(chi.dtype).tiny), 0.0
+        )
         return s.sum() / n_total
 
     vm = jax.vmap(m_one, in_axes=(0, 0, 0))

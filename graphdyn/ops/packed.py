@@ -91,13 +91,27 @@ def _compare_planes(planes, thr_bits):
     return gt, eq
 
 
-@partial(jax.jit, static_argnames=("rule", "tie", "steps"))
-def packed_rollout(nbr, deg, sp, steps: int, rule: str = "majority", tie: str = "stay"):
+@partial(jax.jit, static_argnames=("rule", "tie", "steps", "gather"))
+def packed_rollout(nbr, deg, sp, steps: int, rule: str = "majority",
+                   tie: str = "stay", gather: str = "per_slot"):
     """Roll packed spins ``sp: uint32[n, W]`` for ``steps`` synchronous
     updates. ``nbr: int32[n, dmax]`` ghost-padded with n; ``deg: int32[n]``.
+
+    ``gather`` selects the HBM access pattern (bit-identical results):
+
+    - ``"per_slot"`` (default): one ``[n, W]`` gather per neighbor slot,
+      consumed immediately by the carry-save accumulation — XLA fuses each
+      gather into the CSA elementwise ops, so no ``[n, dmax, W]`` gather
+      buffer ever exists in HBM. Per-step traffic approaches the streaming
+      minimum ``n·W·4·(d reads + 1 write)`` bytes.
+    - ``"fused"``: one big gather materializing ``[n, dmax, W]`` before the
+      CSA (the round-2 formulation; kept for A/B measurement —
+      ARCHITECTURE.md roofline notes).
     """
     rule = Rule(rule)
     tie = TieBreak(tie)
+    if gather not in ("per_slot", "fused"):
+        raise ValueError(f"gather must be 'per_slot' or 'fused', got {gather!r}")
     n, dmax = nbr.shape
     n_planes = max(int(np.ceil(np.log2(dmax + 1))), 1)
     flat_nbr = nbr.reshape(-1)
@@ -112,8 +126,17 @@ def packed_rollout(nbr, deg, sp, steps: int, rule: str = "majority", tie: str = 
 
     def body(_, sp):
         sp_ext = jnp.concatenate([sp, jnp.zeros((1, sp.shape[1]), sp.dtype)], axis=0)
-        g = jnp.take(sp_ext, flat_nbr, axis=0).reshape(n, dmax, sp.shape[1])
-        planes = _csa_planes(g, dmax, n_planes)
+        if gather == "per_slot":
+            planes = [jnp.zeros_like(sp) for _ in range(n_planes)]
+            for j in range(dmax):
+                carry = jnp.take(sp_ext, nbr[:, j], axis=0)
+                for k in range(n_planes):
+                    new_carry = planes[k] & carry
+                    planes[k] = planes[k] ^ carry
+                    carry = new_carry
+        else:
+            g = jnp.take(sp_ext, flat_nbr, axis=0).reshape(n, dmax, sp.shape[1])
+            planes = _csa_planes(g, dmax, n_planes)
         gt, eq = _compare_planes(planes, thr_bits)
         win = gt                                     # 2cnt > deg
         tie_mask = eq & even_mask                    # 2cnt == deg

@@ -57,6 +57,13 @@ class Checkpoint:
         np.savez(tmp, **payload)
         os.replace(tmp, self.path + ".npz")
 
+    def remove(self) -> None:
+        """Delete the checkpoint file if present (end-of-run cleanup)."""
+        try:
+            os.remove(self.path + ".npz")
+        except FileNotFoundError:
+            pass
+
     def load(self) -> tuple[dict[str, np.ndarray], dict[str, Any]] | None:
         if not os.path.exists(self.path + ".npz"):
             return None
@@ -69,6 +76,27 @@ class Checkpoint:
                 # still loadable, just with empty metadata
                 meta = {}
         return arrays, meta
+
+
+def load_resume_prefix(ck: Checkpoint, expect: dict[str, Any]):
+    """Load an ensemble-driver resume snapshot and validate its identity.
+
+    The shared half of the driver resume protocol (used by ``sa_ensemble``
+    and ``hpr_ensemble``): returns ``(arrays, next_rep)``, or ``None`` when
+    no checkpoint exists; raises ``ValueError`` when any ``expect`` key
+    disagrees with the stored metadata — a checkpoint from a different run
+    must be refused, never silently mixed in."""
+    loaded = ck.load()
+    if loaded is None:
+        return None
+    arrays, meta = loaded
+    bad = {k: (meta.get(k), v) for k, v in expect.items() if meta.get(k) != v}
+    if bad:
+        raise ValueError(
+            f"checkpoint at {ck.path!r} is from a different run "
+            f"(stored vs expected: {bad}); refusing to resume"
+        )
+    return arrays, int(meta["next_rep"])
 
 
 class PeriodicCheckpointer:
@@ -98,6 +126,9 @@ class PeriodicCheckpointer:
         self._last = time.monotonic()
         self._count += 1
         return True
+
+    def remove(self) -> None:
+        self.ckpt.remove()
 
 
 def save_pytree_orbax(path: str, pytree) -> bool:

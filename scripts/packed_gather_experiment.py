@@ -1,0 +1,102 @@
+"""Measure packed-kernel gather formulations on the real chip.
+
+Question (ARCHITECTURE.md roofline): the round-2 packed kernel's single big
+gather produces a ``[n, dmax, W]`` intermediate. If XLA materializes it in
+HBM, per-step traffic is ~5 GB instead of the 2 GB streaming minimum at
+n=1e6, W=128, d=3. Variants measured here, all through the library kernel
+(`graphdyn.ops.packed.packed_rollout`, whose two gather schedules are
+bit-identity-tested in tests/test_packed.py):
+
+  A. fused        — one gather materializing [n, dmax, W] before the CSA
+                    (gather="fused", the round-2 formulation).
+  B. per_slot     — dmax separate [n, W] gathers, each fused into the CSA
+                    accumulation (gather="per_slot", the default).
+  C. per_slot + column-sorted neighbor slots — same kernel, nbr sorted
+                    ascending within each row (the CSA sum is
+                    slot-order-invariant, so results are unchanged).
+
+All variants run on the BFS-reordered graph (the round-3 locality win).
+Usage: python scripts/packed_gather_experiment.py [--n 1000000] [--w 128]
+Prints one JSON line per variant.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import benchmarks.common  # noqa: F401 — applies GRAPHDYN_FORCE_PLATFORM
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def _sync(x):
+    from benchmarks.common import _sync as fence
+
+    fence(x)
+
+
+def time_rollout(nbr, deg, sp, steps, gather, iters=3):
+    from graphdyn.ops.packed import packed_rollout
+
+    out = packed_rollout(nbr, deg, sp, steps, gather=gather)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = packed_rollout(nbr, deg, out, steps, gather=gather)
+    _sync(out)
+    dt = time.perf_counter() - t0
+    n, W = sp.shape
+    return n * W * 32 * steps * iters / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1_000_000)
+    ap.add_argument("--w", type=int, default=128)
+    ap.add_argument("--d", type=int, default=3)
+    ap.add_argument("--steps", type=int, default=10)
+    args = ap.parse_args()
+
+    from graphdyn.graphs import bfs_order, permute_nodes, random_regular_graph
+
+    g = random_regular_graph(args.n, args.d, seed=1)
+    g, _ = permute_nodes(g, bfs_order(g))
+    nbr = jnp.asarray(g.nbr)
+    deg = jnp.asarray(g.deg)
+    nbr_sorted = jnp.asarray(np.sort(g.nbr, axis=1))
+    sp = jnp.asarray(
+        np.random.default_rng(0).integers(
+            0, 2**32, size=(args.n, args.w), dtype=np.uint32
+        )
+    )
+
+    for name, gather, tbl in [
+        ("A_fused_gather", "fused", nbr),
+        ("B_per_slot", "per_slot", nbr),
+        ("C_per_slot_sorted", "per_slot", nbr_sorted),
+    ]:
+        rate = time_rollout(tbl, deg, sp, args.steps, gather)
+        print(
+            json.dumps(
+                {
+                    "variant": name,
+                    "spin_updates_per_sec": rate,
+                    "n": args.n,
+                    "W": args.w,
+                    "d": args.d,
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,24 @@ def test_packed_high_degree(rng):
         np.testing.assert_array_equal(got[r], want)
 
 
+@pytest.mark.parametrize("rule", ["majority", "minority"])
+@pytest.mark.parametrize("tie", ["stay", "change"])
+def test_gather_variants_bit_identical(rule, tie, rng):
+    """The two HBM gather formulations (fused [n,dmax,W] buffer vs per-slot
+    fused-into-CSA) are alternative schedules of the same bitwise program."""
+    import jax.numpy as jnp
+
+    from graphdyn.ops.packed import packed_rollout
+
+    g = erdos_renyi_graph(250, 4.0 / 249, seed=11)
+    sp = rng.integers(0, 2**32, size=(g.n, 3), dtype=np.uint32)
+    a = packed_rollout(jnp.asarray(g.nbr), jnp.asarray(g.deg), jnp.asarray(sp),
+                       7, rule, tie, gather="fused")
+    b = packed_rollout(jnp.asarray(g.nbr), jnp.asarray(g.deg), jnp.asarray(sp),
+                       7, rule, tie, gather="per_slot")
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_packed_consensus_fraction_matches_unpacked():
     from graphdyn.graphs import erdos_renyi_graph
     from graphdyn.observe import consensus_fraction

@@ -134,6 +134,69 @@ def test_sa_ensemble_driver(tmp_path):
     assert set(saved) == {"mag_reached", "num_steps", "conf", "graphs"}
 
 
+def test_checkpoint_resume_bit_exact(tmp_path):
+    """Chunked + checkpointed runs equal the uninterrupted run bit-for-bit,
+    and a run restarted from a mid-flight checkpoint continues the same chain
+    (SURVEY.md §5.4 exact SA-chain resume)."""
+    import os
+
+    cfg = SAConfig(dynamics=DynamicsConfig(p=1, c=1))
+    g, s0, proposals, uniforms = _small_setup(n=50, R=3, L=4000, seed=9)
+    kw = dict(s0=s0, proposals=proposals, uniforms=uniforms, backend="jax")
+    base = simulated_annealing(g, cfg, **kw)
+
+    # (a) chunking alone (checkpoint file written every chunk) changes nothing
+    p1 = str(tmp_path / "sa_ck1")
+    chunked = simulated_annealing(
+        g, cfg, checkpoint_path=p1, checkpoint_interval_s=0.0, chunk_steps=37, **kw
+    )
+    np.testing.assert_array_equal(base.s, chunked.s)
+    np.testing.assert_array_equal(base.num_steps, chunked.num_steps)
+    np.testing.assert_array_equal(base.m_final, chunked.m_final)
+    assert not os.path.exists(p1 + ".npz")      # removed on completion
+
+    # (b) resume from a mid-flight snapshot: run a few bounded chunks, keep
+    # the checkpoint, then restart from it and finish
+    from graphdyn.models.sa import _sa_init, _sa_loop  # chunk primitives
+    from graphdyn.utils.io import Checkpoint
+
+    p2 = str(tmp_path / "sa_ck2")
+    import jax.numpy as jnp
+    import jax
+
+    keys = jax.vmap(jax.random.PRNGKey)(np.arange(3, dtype=np.uint32))
+    st = _sa_init(
+        jnp.asarray(g.nbr), jnp.asarray(s0), keys,
+        jnp.asarray(np.full(3, cfg.a0_frac * g.n, np.float32)),
+        jnp.asarray(np.full(3, cfg.b0_frac * g.n, np.float32)),
+        rollout_steps=1, R_coef=1, C_coef=1,
+    )
+    st = _sa_loop(
+        jnp.asarray(g.nbr), st,
+        jnp.float32(cfg.par_a), jnp.float32(cfg.par_b),
+        jnp.float32(cfg.a_cap_frac * g.n), jnp.float32(cfg.b_cap_frac * g.n),
+        jnp.asarray(proposals), jnp.asarray(uniforms.astype(np.float32)),
+        rollout_steps=1, R_coef=1, C_coef=1, max_steps=4000,
+        injected=True, stream_len=4000, chunk_steps=50,
+    )
+    assert bool(jnp.any(st.active))             # genuinely mid-flight
+    Checkpoint(p2).save(
+        {
+            "s": np.asarray(st.s), "sum_end": np.asarray(st.sum_end),
+            "a": np.asarray(st.a), "b": np.asarray(st.b),
+            "t": np.asarray(st.t), "m_final": np.asarray(st.m_final),
+            "active": np.asarray(st.active), "key": np.asarray(st.key),
+        },
+        {"kind": "sa_chain", "seed": cfg.seed, "R": 3},
+    )
+    resumed = simulated_annealing(
+        g, cfg, checkpoint_path=p2, chunk_steps=64, **kw
+    )
+    np.testing.assert_array_equal(base.s, resumed.s)
+    np.testing.assert_array_equal(base.num_steps, resumed.num_steps)
+    np.testing.assert_array_equal(base.m_final, resumed.m_final)
+
+
 def test_int64_step_budget_under_x64():
     """With x64 enabled a >2³¹ step budget (the 2n³ sentinel regime,
     `SA_RRG.py:84`) passes through UNCLAMPED into the device comparison —
@@ -153,3 +216,52 @@ def test_int64_step_budget_under_x64():
     assert res.num_steps.dtype == np.int64
     assert np.all(res.m_final == 1.0)           # converged, not timed out
     assert np.all(res.num_steps < 2**31)        # finite steps under big budget
+
+
+def test_sa_ensemble_driver_resume(tmp_path):
+    """A driver interrupted between repetitions resumes with completed reps
+    intact and produces the same results and graphs as an uninterrupted run."""
+    import os
+
+    from graphdyn.models.sa import sa_ensemble
+    from graphdyn.utils.io import Checkpoint
+
+    cfg = SAConfig(dynamics=DynamicsConfig(p=1, c=1))
+    kw = dict(n_stat=3, seed=4, max_steps=30_000, backend="jax")
+    base = sa_ensemble(30, 3, cfg, **kw)
+
+    p = str(tmp_path / "sa_grid")
+    saved_save = Checkpoint.save
+    calls = {"n": 0}
+
+    class _Abort(Exception):
+        pass
+
+    def counting_save(self, arrays, meta):
+        saved_save(self, arrays, meta)
+        calls["n"] += 1
+        if meta.get("next_rep") == 2:           # die after rep 2 of 3 lands
+            raise _Abort
+
+    try:
+        Checkpoint.save = counting_save
+        try:
+            sa_ensemble(30, 3, cfg, checkpoint_path=p, **kw)
+        except _Abort:
+            pass
+    finally:
+        Checkpoint.save = saved_save
+    assert os.path.exists(p + ".npz")
+
+    resumed = sa_ensemble(30, 3, cfg, checkpoint_path=p, **kw)
+    np.testing.assert_array_equal(base.conf, resumed.conf)
+    np.testing.assert_array_equal(base.num_steps, resumed.num_steps)
+    np.testing.assert_array_equal(base.graphs, resumed.graphs)
+    assert not os.path.exists(p + ".npz")
+
+    # a mismatched-run checkpoint is refused, not silently misapplied
+    Checkpoint(p).save({"mag_reached": base.mag_reached}, {"seed": 99,
+                                                          "n_stat": 3,
+                                                          "next_rep": 1})
+    with pytest.raises(ValueError, match="different"):
+        sa_ensemble(30, 3, cfg, checkpoint_path=p, **kw)
