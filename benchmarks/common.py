@@ -15,15 +15,12 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
-# Environment plugins can pin jax_platforms at interpreter startup, which
-# plain `JAX_PLATFORMS=cpu` in the environment cannot override; this knob
-# forces the platform from inside the process before first jax use (how the
-# test conftest does it), so the config-runner's CPU smoke mode is hermetic.
-_force = os.environ.get("GRAPHDYN_FORCE_PLATFORM")
-if _force:
-    import jax
+# GRAPHDYN_FORCE_PLATFORM: forces the jax platform before first use (plugins
+# can pin jax_platforms at startup, where JAX_PLATFORMS alone cannot win) —
+# one shared implementation with the CLI, see graphdyn.utils.platform
+from graphdyn.utils.platform import apply_force_platform
 
-    jax.config.update("jax_platforms", _force)
+apply_force_platform()
 
 
 def _sync(out):

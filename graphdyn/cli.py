@@ -17,21 +17,14 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 
 import numpy as np
 
 from graphdyn.config import DynamicsConfig, EntropyConfig, HPRConfig, SAConfig
 
-_force = os.environ.get("GRAPHDYN_FORCE_PLATFORM")
-if _force:
-    # Environment plugins can pin jax_platforms at interpreter startup, which
-    # plain JAX_PLATFORMS in the environment cannot override; this knob forces
-    # the platform before first jax use (same contract as benchmarks.common) —
-    # e.g. GRAPHDYN_FORCE_PLATFORM=cpu runs the CLI with the TPU unreachable.
-    import jax
+from graphdyn.utils.platform import apply_force_platform
 
-    jax.config.update("jax_platforms", _force)
+apply_force_platform()
 
 
 def _add_dynamics_flags(ap: argparse.ArgumentParser, p_default: int = 1):
@@ -144,11 +137,6 @@ def main(argv=None) -> int:
             a_cap_frac=args.a_cap_frac, b_cap_frac=args.b_cap_frac,
         )
         if args.sharded:
-            if args.checkpoint:
-                raise SystemExit(
-                    "--checkpoint is not supported with --sharded (the mesh "
-                    "solver has no chunked resume yet); drop one of the flags"
-                )
             import jax
 
             from graphdyn.graphs import random_regular_graph
@@ -172,6 +160,8 @@ def main(argv=None) -> int:
             res = sa_sharded(
                 g, cfg, mesh=mesh, n_replicas=args.n_replicas, a0=a0,
                 seed=args.seed, max_steps=args.max_steps,
+                checkpoint_path=args.checkpoint,
+                checkpoint_interval_s=args.checkpoint_interval,
             )
             if args.out:
                 save_results_npz(

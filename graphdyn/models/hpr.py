@@ -171,21 +171,25 @@ def hpr_solve(
     ckpt = None
     state = None
     if checkpoint_path is not None:
-        from graphdyn.utils.io import Checkpoint, PeriodicCheckpointer
+        from graphdyn.utils.io import (
+            Checkpoint, PeriodicCheckpointer, run_fingerprint,
+        )
 
+        fp = run_fingerprint(graph.edges, config)
         loaded = Checkpoint(checkpoint_path).load()
         if loaded is not None:
             arrays, meta = loaded
             if (
                 meta.get("kind") != "hpr_chain"
                 or meta.get("seed") != int(seed)
+                or meta.get("fp") != fp
                 or arrays["s"].shape != (n,)
                 or arrays["chi"].shape != (data.num_directed, data.K, data.K)
             ):
                 raise ValueError(
                     f"checkpoint at {checkpoint_path!r} is not a matching "
-                    f"hpr_chain snapshot (meta {meta}) for this graph/seed; "
-                    f"refusing to resume"
+                    f"hpr_chain snapshot for this graph/config/seed "
+                    f"(meta {meta}); refusing to resume"
                 )
             state = (
                 jnp.asarray(arrays["chi"]),
@@ -225,7 +229,7 @@ def hpr_solve(
                         "s": np.asarray(s_c), "key": np.asarray(key_c),
                         "t": np.asarray(t_c), "m_final": np.asarray(m_c),
                     },
-                    {"kind": "hpr_chain", "seed": int(seed)},
+                    {"kind": "hpr_chain", "seed": int(seed), "fp": fp},
                 )
         ckpt.remove()
 
@@ -431,7 +435,8 @@ def hpr_ensemble(
 
     start_k = 0
     ck = Checkpoint(checkpoint_path) if checkpoint_path else None
-    run_id = {"seed": seed, "n_rep": n_rep, "n": n, "d": d}
+    run_id = {"seed": seed, "n_rep": n_rep, "n": n, "d": d,
+              "graph_method": graph_method, "config": repr(config)}
     if ck is not None:
         resumed = load_resume_prefix(ck, run_id)
         if resumed is not None:

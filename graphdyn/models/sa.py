@@ -338,8 +338,15 @@ def simulated_annealing(
     ckpt = None
     state = None
     if checkpoint_path is not None:
-        from graphdyn.utils.io import Checkpoint, PeriodicCheckpointer
+        from graphdyn.utils.io import (
+            Checkpoint, PeriodicCheckpointer, run_fingerprint,
+        )
 
+        # full run identity: same graph, config, budget, dtype, x64 mode
+        fp = run_fingerprint(
+            graph.edges, config, int(max_steps), bool(injected),
+            np_dt, bool(jax.config.jax_enable_x64),
+        )
         loaded = Checkpoint(checkpoint_path).load()
         if loaded is not None:
             arrays, meta = loaded
@@ -347,12 +354,13 @@ def simulated_annealing(
                 meta.get("kind") != "sa_chain"
                 or meta.get("seed") != int(seed)
                 or meta.get("R") != int(R)
+                or meta.get("fp") != fp
                 or arrays["s"].shape != (R, n)
             ):
                 raise ValueError(
                     f"checkpoint at {checkpoint_path!r} is not a matching "
-                    f"sa_chain snapshot (meta {meta}, s {arrays['s'].shape} "
-                    f"vs expected seed={seed} R={R} n={n}); refusing to resume"
+                    f"sa_chain snapshot for this graph/config/seed "
+                    f"(meta {meta}); refusing to resume"
                 )
             state = _SAState(
                 s=jnp.asarray(arrays["s"]),
@@ -406,7 +414,8 @@ def simulated_annealing(
                         "active": np.asarray(state.active),
                         "key": np.asarray(state.key),
                     },
-                    {"kind": "sa_chain", "seed": int(seed), "R": int(R)},
+                    {"kind": "sa_chain", "seed": int(seed), "R": int(R),
+                     "fp": fp},
                 )
         ckpt.remove()
 
@@ -503,7 +512,8 @@ def sa_ensemble(
     start_k = 0
     ck = Checkpoint(checkpoint_path) if checkpoint_path else None
     run_id = {"seed": seed, "n_stat": n_stat, "n": n, "d": d,
-              "max_steps": max_steps}
+              "max_steps": max_steps, "graph_method": graph_method,
+              "config": repr(config), "backend": backend}
     if ck is not None:
         resumed = load_resume_prefix(ck, run_id)
         if resumed is not None:

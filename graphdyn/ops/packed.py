@@ -64,6 +64,17 @@ def unpack_spins(p: np.ndarray, R: int) -> np.ndarray:
     return (2 * bits.astype(np.int8) - 1).T
 
 
+def _csa_add_one(planes, carry):
+    """Ripple one 1-bit addend (a packed word) into the bit-plane counter —
+    the shared inner step of both gather schedules. Mutates ``planes``. The
+    final carry out of the top plane is discarded: ``n_planes =
+    ceil(log2(dmax+1))`` makes overflow impossible."""
+    for k in range(len(planes)):
+        new_carry = planes[k] & carry
+        planes[k] = planes[k] ^ carry
+        carry = new_carry
+
+
 def _csa_planes(gathered, d: int, n_planes: int):
     """Carry-save accumulate ``d`` one-bit addends (packed words) into
     ``n_planes`` bit-planes of a per-replica counter. ``gathered``:
@@ -71,11 +82,7 @@ def _csa_planes(gathered, d: int, n_planes: int):
     output is needed."""
     planes = [jnp.zeros_like(gathered[:, 0, :]) for _ in range(n_planes)]
     for j in range(d):
-        carry = gathered[:, j, :]
-        for k in range(n_planes):
-            new_carry = planes[k] & carry
-            planes[k] = planes[k] ^ carry
-            carry = new_carry
+        _csa_add_one(planes, gathered[:, j, :])
     return planes
 
 
@@ -129,11 +136,7 @@ def packed_rollout(nbr, deg, sp, steps: int, rule: str = "majority",
         if gather == "per_slot":
             planes = [jnp.zeros_like(sp) for _ in range(n_planes)]
             for j in range(dmax):
-                carry = jnp.take(sp_ext, nbr[:, j], axis=0)
-                for k in range(n_planes):
-                    new_carry = planes[k] & carry
-                    planes[k] = planes[k] ^ carry
-                    carry = new_carry
+                _csa_add_one(planes, jnp.take(sp_ext, nbr[:, j], axis=0))
         else:
             g = jnp.take(sp_ext, flat_nbr, axis=0).reshape(n, dmax, sp.shape[1])
             planes = _csa_planes(g, dmax, n_planes)

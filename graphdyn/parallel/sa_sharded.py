@@ -57,6 +57,7 @@ class _State(NamedTuple):
     active: jnp.ndarray    # bool[Rl]
     key: jnp.ndarray       # per-replica PRNG key
     live: jnp.ndarray      # int32 scalar — mesh-wide count of active shards
+    chunk_t: jnp.ndarray   # int32 scalar — steps taken in the current chunk
 
 
 @functools.lru_cache(maxsize=64)
@@ -70,33 +71,31 @@ def make_sharded_sa_solver(
     tie: str = "stay",
     injected: bool = False,
     stream_len: int = 1,
-    n_real_replicas: int | None = None,
     replica_axis: str = "replica",
     node_axis: str = "node",
+    chunk_steps: int | None = None,
 ):
-    """Build the jitted sharded solver
-    ``f(nbr, s0, key, a0, b0, par_a, par_b, a_cap, b_cap, proposals,
-    uniforms) -> (s, mag, num_steps, m_final)`` with ``s0`` sharded
-    ``P(replica, node)`` and per-replica vectors ``P(replica)``.
+    """Build the jitted sharded solver pair ``(init_fn, chunk_fn)``.
 
-    ``n_real_replicas``: replicas with global index ≥ this are shard padding
-    and start inactive — they must not keep the mesh loop alive (an all-+1
+    ``init_fn(nbr, s0) -> sum_end0`` computes the rolled-out end sum of the
+    starting configuration (the cached quantity the 1-rollout-per-step
+    redesign carries). ``chunk_fn(nbr, s, key, a, b, t, m_final, active,
+    sum_end, par_a, par_b, a_cap, b_cap, proposals, uniforms) -> (s, mag,
+    key, a, b, t, m_final, active, sum_end)`` advances every chain until all
+    stop — or, with ``chunk_steps``, for at most that many more steps, which
+    makes the returned state an exact-resume point (the loop body is
+    step-index-driven, so splitting it across calls cannot change the
+    chain). ``s0``/``s`` are sharded ``P(replica, node)``, per-replica
+    vectors ``P(replica)``.
+
+    The caller builds the initial ``active`` mask — shard-padding replicas
+    must start inactive so they cannot keep the mesh loop alive (an all-+1
     pad row is at consensus under majority dynamics, but not under e.g.
     ``rule='minority'``)."""
     R_coef, C_coef = rule_coefficients(rule, tie)
 
-    def solve(nbr_local, s0_local, key0, a0, b0,
-              par_a, par_b, a_cap, b_cap, proposals, uniforms):
-        Rl, n_block = s0_local.shape
-        dt = a0.dtype
-        node_idx = lax.axis_index(node_axis)
+    def _rollout_tools(nbr_local, n_block):
         mask = _real_mask(node_axis, n_block, n_real)
-        rep_gidx = lax.axis_index(replica_axis) * Rl + jnp.arange(Rl)
-        real_replica = (
-            rep_gidx < n_real_replicas
-            if n_real_replicas is not None
-            else jnp.ones((Rl,), bool)
-        )
 
         def rollout(s_loc):
             def rbody(_, s):
@@ -108,16 +107,27 @@ def make_sharded_sa_solver(
         def end_sum(s_loc):
             return lax.psum(_masked_block_sum(rollout(s_loc), mask), node_axis)
 
-        sum_end0 = end_sum(s0_local)
-        m0 = sum_end0.astype(dt) / n_real
-        active0 = (m0 < 1.0) & real_replica
-        live0 = lax.psum(jnp.any(active0).astype(jnp.int32), replica_axis)
+        return mask, end_sum
+
+    def init(nbr_local, s0_local):
+        _, end_sum = _rollout_tools(nbr_local, s0_local.shape[1])
+        return end_sum(s0_local)
+
+    def chunk(nbr_local, s_local, key, a, b, t, m_final_in, active_in,
+              sum_end_in, par_a, par_b, a_cap, b_cap, proposals, uniforms):
+        Rl, n_block = s_local.shape
+        dt = a.dtype
+        node_idx = lax.axis_index(node_axis)
+        mask, end_sum = _rollout_tools(nbr_local, n_block)
 
         def cond(st: _State):
-            return st.live > 0
+            go = st.live > 0
+            if chunk_steps is not None:
+                go = go & (st.chunk_t < chunk_steps)
+            return go
 
         def body(st: _State):
-            # identical draw to the unsharded `_sa_run` (shared helper):
+            # identical draw to the unsharded `_sa_loop` (shared helper):
             # replicated keys make every node shard draw the same (i, u)
             i, u = draw_sa_proposal(
                 st.key, st.t, proposals, uniforms,
@@ -148,42 +158,45 @@ def make_sharded_sa_solver(
             live = lax.psum(jnp.any(active).astype(jnp.int32), replica_axis)
             return _State(
                 s_new, sum_end_new, a_new, b_new, t_new, m_final, active,
-                st.key, live,
+                st.key, live, st.chunk_t + 1,
             )
 
+        live0 = lax.psum(jnp.any(active_in).astype(jnp.int32), replica_axis)
         state0 = _State(
-            s0_local, sum_end0, a0, b0,
-            jnp.zeros(
-                a0.shape, jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
-            ),
-            m0, active0, key0, live0,
+            s_local, sum_end_in, a, b, t, m_final_in, active_in, key,
+            live0, jnp.zeros((), jnp.int32),
         )
         out = lax.while_loop(cond, body, state0)
         mag = lax.psum(_masked_block_sum(out.s, mask), node_axis).astype(dt) / n_real
-        return out.s, mag, out.t, out.m_final
+        return (out.s, mag, out.key, out.a, out.b, out.t, out.m_final,
+                out.active, out.sum_end)
 
-    f = shard_map(
-        solve,
+    rep = P(replica_axis)
+    init_fn = jax.jit(shard_map(
+        init,
+        mesh=mesh,
+        in_specs=(P(node_axis, None), P(replica_axis, node_axis)),
+        out_specs=rep,
+        check_rep=False,
+    ))
+    chunk_fn = jax.jit(shard_map(
+        chunk,
         mesh=mesh,
         in_specs=(
             P(node_axis, None),            # nbr
-            P(replica_axis, node_axis),    # s0
-            P(replica_axis),               # key
-            P(replica_axis),               # a0
-            P(replica_axis),               # b0
+            P(replica_axis, node_axis),    # s
+            rep, rep, rep, rep, rep, rep, rep,  # key a b t m_final active sum_end
             P(), P(), P(), P(),            # par_a, par_b, a_cap, b_cap
             P(replica_axis, None),         # proposals
             P(replica_axis, None),         # uniforms
         ),
         out_specs=(
             P(replica_axis, node_axis),
-            P(replica_axis),
-            P(replica_axis),
-            P(replica_axis),
+            rep, rep, rep, rep, rep, rep, rep, rep,
         ),
         check_rep=False,
-    )
-    return jax.jit(f)
+    ))
+    return init_fn, chunk_fn
 
 
 def sa_sharded(
@@ -202,16 +215,21 @@ def sa_sharded(
     dtype=jnp.float32,
     replica_axis: str = "replica",
     node_axis: str = "node",
+    checkpoint_path: str | None = None,
+    checkpoint_interval_s: float = 30.0,
+    chunk_steps: int = 100_000,
 ) -> SAResult:
     """Run batched SA chains to completion over a device mesh.
 
     The multi-chip counterpart of
     :func:`graphdyn.models.sa.simulated_annealing` (same API axes:
     per-replica ``a0``/``b0`` carry the temperature ladder, injected
-    ``proposals``/``uniforms`` enable bitwise parity testing). Replicas pad
-    up to the replica-axis size with already-converged all-+1 dummies; the
-    node axis pads via :func:`pad_nodes`. Results are sliced back to the
-    caller's shapes.
+    ``proposals``/``uniforms`` enable bitwise parity testing; the same
+    ``checkpoint_path`` exact-resume contract — state is saved UNPADDED, so
+    a run may resume on a different mesh shape, bit-exactly when the
+    collective reduction order matches). Replicas pad up to the replica-axis
+    size with already-converged all-+1 dummies; the node axis pads via
+    :func:`pad_nodes`. Results are sliced back to the caller's shapes.
     """
     config = config or SAConfig()
     n = graph.n
@@ -225,30 +243,99 @@ def sa_sharded(
 
     rep_shards = int(mesh.shape[replica_axis])
     node_shards = int(mesh.shape[node_axis])
+    np_dt = np.float32 if dtype == jnp.float32 else np.float64
+    t_dt = np.int64 if jax.config.jax_enable_x64 else np.int32
+
+    ckpt = None
+    restored = None
+    fp = None
+    if checkpoint_path is not None:
+        from graphdyn.utils.io import (
+            Checkpoint, PeriodicCheckpointer, run_fingerprint,
+        )
+
+        # run identity deliberately excludes the mesh shape: state is saved
+        # unpadded/global, so resuming on a different mesh is supported
+        fp = run_fingerprint(
+            graph.edges, config, int(max_steps), bool(injected),
+            np_dt, bool(jax.config.jax_enable_x64),
+        )
+        loaded = Checkpoint(checkpoint_path).load()
+        if loaded is not None:
+            arrays, meta = loaded
+            if (
+                meta.get("kind") != "sa_sharded_chain"
+                or meta.get("seed") != int(seed)
+                or meta.get("R") != int(R)
+                or meta.get("fp") != fp
+                or arrays["s"].shape != (R, n)
+            ):
+                raise ValueError(
+                    f"checkpoint at {checkpoint_path!r} is not a matching "
+                    f"sa_sharded_chain snapshot for this graph/config/seed "
+                    f"(meta {meta}); refusing to resume"
+                )
+            restored = arrays
+        ckpt = PeriodicCheckpointer(checkpoint_path, interval_s=checkpoint_interval_s)
 
     # replica padding: all-+1 rows are at consensus (m0 == 1) and freeze on
-    # entry — they do no work and are sliced off below
+    # entry (active=False below) — they do no work and are sliced off at exit
     R_pad = (-R) % rep_shards
-    if R_pad:
-        s0 = np.concatenate([s0, np.ones((R_pad, n), np.int8)])
-        a0 = np.concatenate([a0, np.ones(R_pad)])
-        b0 = np.concatenate([b0, np.ones(R_pad)])
-        proposals = np.concatenate([proposals, np.zeros((R_pad, stream_len), np.int32)])
-        uniforms = np.concatenate([uniforms, np.zeros((R_pad, stream_len))])
     Rtot = R + R_pad
 
+    def pad_rep(x, fill):
+        x = np.asarray(x)
+        if not R_pad:
+            return x
+        pad = np.full((R_pad,) + x.shape[1:], fill, x.dtype)
+        return np.concatenate([x, pad])
+
+    proposals = pad_rep(proposals, 0)
+    uniforms = pad_rep(uniforms, 0.0)
+
     nbr_pad, n_pad = pad_nodes(graph, node_shards)
-    # padded node columns: frozen +1 spins, excluded from all masked sums
-    s0_pad = np.concatenate(
-        [s0, np.ones((Rtot, n_pad - n), np.int8)], axis=1
-    )
 
-    np_dt = np.float32 if dtype == jnp.float32 else np.float64
-    keys = jax.vmap(jax.random.PRNGKey)(
-        np.arange(Rtot, dtype=np.uint32) + np.uint32(seed)
-    )
+    if restored is None:
+        s_h = np.asarray(s0, np.int8)
+        a_h = a0.astype(np_dt)
+        b_h = b0.astype(np_dt)
+        t_h = np.zeros(R, t_dt)
+        key_h = np.asarray(jax.vmap(jax.random.PRNGKey)(
+            np.arange(R, dtype=np.uint32) + np.uint32(seed)
+        ))
+        sum_end_h = None           # computed by init_fn below
+        m_final_h = None
+        active_h = None
+    else:
+        s_h = restored["s"].astype(np.int8)
+        a_h = restored["a"].astype(np_dt)
+        b_h = restored["b"].astype(np_dt)
+        t_h = restored["t"].astype(t_dt)
+        key_h = restored["key"]
+        sum_end_h = restored["sum_end"].astype(np.int32)
+        m_final_h = restored["m_final"].astype(np_dt)
+        active_h = restored["active"].astype(bool)
 
-    solver = make_sharded_sa_solver(
+    def place_state():
+        """Pad the host state to mesh shapes and place it."""
+        s_pad = np.concatenate(          # frozen +1 pad rows and node columns
+            [np.concatenate([s_h, np.ones((R_pad, n), np.int8)])
+             if R_pad else s_h,
+             np.ones((Rtot, n_pad - n), np.int8)], axis=1,
+        )
+        key_pad = np.concatenate(
+            [key_h, np.asarray(jax.vmap(jax.random.PRNGKey)(
+                np.zeros(R_pad, np.uint32)))]
+        ) if R_pad else key_h
+        return (
+            place_sharded(mesh, jnp.asarray(s_pad), P(replica_axis, node_axis)),
+            place_sharded(mesh, jnp.asarray(key_pad), P(replica_axis)),
+            place_sharded(mesh, jnp.asarray(pad_rep(a_h, 1.0)), P(replica_axis)),
+            place_sharded(mesh, jnp.asarray(pad_rep(b_h, 1.0)), P(replica_axis)),
+            place_sharded(mesh, jnp.asarray(pad_rep(t_h, 0)), P(replica_axis)),
+        )
+
+    init_fn, chunk_fn = make_sharded_sa_solver(
         mesh,
         n_real=n,
         rollout_steps=dyn.p + dyn.c - 1,
@@ -257,16 +344,28 @@ def sa_sharded(
         tie=dyn.tie,
         injected=injected,
         stream_len=stream_len,
-        n_real_replicas=R,
         replica_axis=replica_axis,
         node_axis=node_axis,
+        chunk_steps=int(chunk_steps) if ckpt is not None else None,
     )
-    s, mag, t, m_final = solver(
-        place_sharded(mesh, jnp.asarray(nbr_pad), P(node_axis, None)),
-        place_sharded(mesh, jnp.asarray(s0_pad), P(replica_axis, node_axis)),
-        place_sharded(mesh, keys, P(replica_axis)),
-        place_sharded(mesh, jnp.asarray(a0.astype(np_dt)), P(replica_axis)),
-        place_sharded(mesh, jnp.asarray(b0.astype(np_dt)), P(replica_axis)),
+    nbr_dev = place_sharded(mesh, jnp.asarray(nbr_pad), P(node_axis, None))
+    s_dev, key_dev, a_dev, b_dev, t_dev = place_state()
+
+    if sum_end_h is None:
+        sum_end_h = np.asarray(init_fn(nbr_dev, s_dev))[:R]
+        m_final_h = (sum_end_h.astype(np_dt) / np_dt(n)).astype(np_dt)
+        active_h = m_final_h < 1.0
+
+    def place_rep(x, fill):
+        return place_sharded(mesh, jnp.asarray(pad_rep(x, fill)), P(replica_axis))
+
+    state = (
+        s_dev, key_dev, a_dev, b_dev, t_dev,
+        place_rep(m_final_h, 1.0),                 # pad rows: at consensus
+        place_rep(active_h, False),                # pad rows: frozen
+        place_rep(sum_end_h, n),
+    )
+    consts = (
         jnp.asarray(np_dt(config.par_a)),
         jnp.asarray(np_dt(config.par_b)),
         jnp.asarray(np_dt(config.a_cap_frac * n)),
@@ -274,9 +373,35 @@ def sa_sharded(
         place_sharded(mesh, jnp.asarray(proposals), P(replica_axis, None)),
         place_sharded(mesh, jnp.asarray(uniforms.astype(np_dt)), P(replica_axis, None)),
     )
+
+    while True:
+        s_dev, mag, key_dev, a_dev, b_dev, t_dev, m_final_dev, active_dev, \
+            sum_end_dev = chunk_fn(nbr_dev, *state, *consts)
+        state = (s_dev, key_dev, a_dev, b_dev, t_dev, m_final_dev,
+                 active_dev, sum_end_dev)
+        if not bool(np.asarray(active_dev)[:R].any()):
+            break
+        if ckpt is not None and ckpt.due():
+            ckpt.maybe_save(
+                {
+                    "s": np.asarray(s_dev)[:R, :n],
+                    "key": np.asarray(key_dev)[:R],
+                    "a": np.asarray(a_dev)[:R],
+                    "b": np.asarray(b_dev)[:R],
+                    "t": np.asarray(t_dev)[:R],
+                    "m_final": np.asarray(m_final_dev)[:R],
+                    "active": np.asarray(active_dev)[:R],
+                    "sum_end": np.asarray(sum_end_dev)[:R],
+                },
+                {"kind": "sa_sharded_chain", "seed": int(seed), "R": int(R),
+                 "fp": fp},
+            )
+    if ckpt is not None:
+        ckpt.remove()
+
     return SAResult(
-        s=np.asarray(s)[:R, :n],
+        s=np.asarray(s_dev)[:R, :n],
         mag_reached=np.asarray(mag)[:R],
-        num_steps=np.asarray(t)[:R],
-        m_final=np.asarray(m_final)[:R],
+        num_steps=np.asarray(t_dev)[:R],
+        m_final=np.asarray(m_final_dev)[:R],
     )

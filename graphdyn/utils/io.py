@@ -12,12 +12,30 @@ async checkpointing of jax pytrees; the portable npz path is the default.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import time
 from typing import Any
 
 import numpy as np
+
+
+def run_fingerprint(*parts) -> str:
+    """Stable hex digest identifying a solver run's full identity — graph
+    arrays hash by bytes+shape, everything else by ``repr`` (config
+    dataclasses have stable field reprs). Stored in chain-checkpoint
+    metadata so a resume under a different graph, config, dtype, or budget
+    is refused instead of silently producing a chimera chain."""
+    h = hashlib.sha1()
+    for p in parts:
+        if isinstance(p, np.ndarray):
+            h.update(str(p.shape).encode())
+            h.update(np.ascontiguousarray(p).tobytes())
+        else:
+            h.update(repr(p).encode())
+        h.update(b"|")
+    return h.hexdigest()
 
 
 def save_results_npz(path: str, **arrays) -> None:

@@ -1,0 +1,26 @@
+"""Platform forcing shared by every entry point (CLI, bench, benchmarks).
+
+Environment plugins can pin ``jax_platforms`` at interpreter startup, which a
+plain ``JAX_PLATFORMS`` environment variable cannot override; the
+``GRAPHDYN_FORCE_PLATFORM`` knob forces the platform from inside the process
+before first jax use — e.g. ``GRAPHDYN_FORCE_PLATFORM=cpu`` runs any entry
+point with the TPU unreachable. One implementation here so the contract
+cannot drift between entry points.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_force_platform(env_var: str = "GRAPHDYN_FORCE_PLATFORM") -> str | None:
+    """Apply the force-platform knob if set; returns the forced platform.
+
+    Must run before the first operation that initializes a jax backend
+    (importing jax alone is fine)."""
+    force = os.environ.get(env_var)
+    if force:
+        import jax
+
+        jax.config.update("jax_platforms", force)
+    return force or None

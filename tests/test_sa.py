@@ -155,46 +155,59 @@ def test_checkpoint_resume_bit_exact(tmp_path):
     np.testing.assert_array_equal(base.m_final, chunked.m_final)
     assert not os.path.exists(p1 + ".npz")      # removed on completion
 
-    # (b) resume from a mid-flight snapshot: run a few bounded chunks, keep
-    # the checkpoint, then restart from it and finish
-    from graphdyn.models.sa import _sa_init, _sa_loop  # chunk primitives
+    # (b) resume from a mid-flight snapshot: abort right after the first
+    # checkpoint write, keep the file, restart from it and finish
     from graphdyn.utils.io import Checkpoint
 
     p2 = str(tmp_path / "sa_ck2")
-    import jax.numpy as jnp
-    import jax
+    saved_save = Checkpoint.save
+    calls = {"n": 0}
 
-    keys = jax.vmap(jax.random.PRNGKey)(np.arange(3, dtype=np.uint32))
-    st = _sa_init(
-        jnp.asarray(g.nbr), jnp.asarray(s0), keys,
-        jnp.asarray(np.full(3, cfg.a0_frac * g.n, np.float32)),
-        jnp.asarray(np.full(3, cfg.b0_frac * g.n, np.float32)),
-        rollout_steps=1, R_coef=1, C_coef=1,
-    )
-    st = _sa_loop(
-        jnp.asarray(g.nbr), st,
-        jnp.float32(cfg.par_a), jnp.float32(cfg.par_b),
-        jnp.float32(cfg.a_cap_frac * g.n), jnp.float32(cfg.b_cap_frac * g.n),
-        jnp.asarray(proposals), jnp.asarray(uniforms.astype(np.float32)),
-        rollout_steps=1, R_coef=1, C_coef=1, max_steps=4000,
-        injected=True, stream_len=4000, chunk_steps=50,
-    )
-    assert bool(jnp.any(st.active))             # genuinely mid-flight
-    Checkpoint(p2).save(
-        {
-            "s": np.asarray(st.s), "sum_end": np.asarray(st.sum_end),
-            "a": np.asarray(st.a), "b": np.asarray(st.b),
-            "t": np.asarray(st.t), "m_final": np.asarray(st.m_final),
-            "active": np.asarray(st.active), "key": np.asarray(st.key),
-        },
-        {"kind": "sa_chain", "seed": cfg.seed, "R": 3},
-    )
+    class _Abort(Exception):
+        pass
+
+    def counting_save(self, arrays, meta):
+        saved_save(self, arrays, meta)
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise _Abort
+
+    try:
+        Checkpoint.save = counting_save
+        try:
+            simulated_annealing(
+                g, cfg, checkpoint_path=p2,
+                checkpoint_interval_s=0.0, chunk_steps=50, **kw
+            )
+        except _Abort:
+            pass
+    finally:
+        Checkpoint.save = saved_save
+    assert os.path.exists(p2 + ".npz")          # a mid-flight snapshot exists
     resumed = simulated_annealing(
         g, cfg, checkpoint_path=p2, chunk_steps=64, **kw
     )
     np.testing.assert_array_equal(base.s, resumed.s)
     np.testing.assert_array_equal(base.num_steps, resumed.num_steps)
     np.testing.assert_array_equal(base.m_final, resumed.m_final)
+
+    # (c) a checkpoint from a DIFFERENT graph/config is refused even when
+    # seed/R/shape all match (full-identity fingerprint)
+    g2 = random_regular_graph(50, 3, seed=77)   # same n, different edges
+    try:
+        Checkpoint.save = counting_save
+        calls["n"] = 0
+        try:
+            simulated_annealing(
+                g, cfg, checkpoint_path=p2,
+                checkpoint_interval_s=0.0, chunk_steps=50, **kw
+            )
+        except _Abort:
+            pass
+    finally:
+        Checkpoint.save = saved_save
+    with pytest.raises(ValueError, match="refusing to resume"):
+        simulated_annealing(g2, cfg, checkpoint_path=p2, **kw)
 
 
 def test_int64_step_budget_under_x64():
