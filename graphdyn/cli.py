@@ -122,6 +122,10 @@ def build_parser() -> argparse.ArgumentParser:
         "--dtype", choices=["float32", "float64"], default="float32",
         help="float64 matches the reference's precision (enables x64)",
     )
+    ent.add_argument(
+        "--plot", default=None, metavar="PNG",
+        help="render the s(m_init) curve family (one per degree) to this file",
+    )
 
     return ap
 
@@ -216,6 +220,15 @@ def main(argv=None) -> int:
     elif args.cmd == "entropy":
         from graphdyn.models.entropy import entropy_grid
 
+        if args.plot:
+            # fail fast BEFORE the (possibly hours-long) sweep if the plot
+            # cannot be written at the end
+            import importlib.util
+
+            if importlib.util.find_spec("matplotlib") is None:
+                raise SystemExit(
+                    "--plot requires matplotlib, which is not installed"
+                )
         if args.dtype == "float64":
             import jax
 
@@ -233,11 +246,16 @@ def main(argv=None) -> int:
             checkpoint_path=args.checkpoint,
             checkpoint_interval_s=args.checkpoint_interval,
         )
+        if args.plot:
+            from graphdyn.plotting import plot_entropy_grid
+
+            plot_entropy_grid(out, save_path=args.plot)
         print(json.dumps({
             "solver": "entropy",
             "deg": out.deg.tolist(),
             "ent1_first_lambda": out.ent1[:, :, 0].tolist(),
             "counts": out.counts.tolist(),
             "out": args.out,
+            "plot": args.plot,
         }))
     return 0

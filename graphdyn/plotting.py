@@ -1,0 +1,88 @@
+"""BDCM entropy plots — the notebook's end artifact as a library call.
+
+The reference notebook exists to compute "BDCM entropy plots"
+(`code/README.md:1`); it stores result grids and the author plots the tilted
+entropy ``s(m_init) = φ + λ·m_init`` against the BP mean initial
+magnetization, one curve per mean degree. These helpers render exactly that
+from the solver results, headless (Agg backend) so they work on TPU hosts
+with no display. matplotlib is imported lazily — the rest of the framework
+has no hard dependency on it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _mpl():
+    import matplotlib
+
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+def plot_entropy_curve(result, *, ax=None, label=None, save_path=None):
+    """Plot one tilted-entropy curve s(m_init) from an
+    :class:`~graphdyn.models.entropy.EntropyResult` (or any object with
+    ``m_init``/``ent1`` arrays over the visited λ ladder).
+
+    Points where the entropy degraded to −inf (empty attractor set) are
+    dropped. Returns the matplotlib Axes."""
+    plt = _mpl()
+    if ax is None:
+        _, ax = plt.subplots(figsize=(5, 3.6), dpi=120)
+    m = np.asarray(result.m_init, float).reshape(-1)
+    s = np.asarray(result.ent1, float).reshape(-1)
+    keep = np.isfinite(m) & np.isfinite(s)
+    ax.plot(m[keep], s[keep], marker="o", ms=3, lw=1.2, label=label)
+    ax.set_xlabel(r"$m_{\mathrm{init}}$")
+    ax.set_ylabel(r"$s(m_{\mathrm{init}}) = \phi + \lambda\, m_{\mathrm{init}}$")
+    ax.axhline(0.0, color="0.7", lw=0.8, zorder=0)
+    if label:
+        ax.legend(frameon=False, fontsize=8)
+    if save_path:
+        ax.figure.tight_layout()
+        ax.figure.savefig(save_path)
+    return ax
+
+
+def plot_entropy_grid(grid, *, rep: int | str = "mean", save_path=None):
+    """Plot the deg-grid family of s(m_init) curves from an
+    :class:`~graphdyn.models.entropy.EntropyGridResult` — the notebook
+    driver's deg × rep × λ grids (`ipynb:484-492`), one curve per mean
+    degree.
+
+    ``rep``: a repetition index, or ``"mean"`` to average the grids over
+    repetitions (zero entries from early-exited λ points are masked out).
+    Returns the matplotlib Axes."""
+    plt = _mpl()
+    _, ax = plt.subplots(figsize=(5.5, 4), dpi=120)
+    deg = np.asarray(grid.deg, float)
+    for di in range(deg.size):
+        m = np.asarray(grid.m_init[di], float)     # [rep, λ]
+        s = np.asarray(grid.ent1[di], float)
+        if rep == "mean":
+            # untouched entries stay 0; −inf/NaN (degraded reps) must not
+            # poison the mean of the finite reps at the same λ
+            visited = ((m != 0) | (s != 0)) & np.isfinite(m) & np.isfinite(s)
+            with np.errstate(invalid="ignore"):
+                cnt = np.maximum(visited.sum(axis=0), 1)
+                m_v = np.where(visited, m, 0.0).sum(axis=0) / cnt
+                s_v = np.where(visited, s, 0.0).sum(axis=0) / cnt
+            keep = visited.any(axis=0)
+            m_v, s_v = m_v[keep], s_v[keep]
+        else:
+            m_v, s_v = m[int(rep)], s[int(rep)]
+        finite = np.isfinite(m_v) & np.isfinite(s_v)
+        ax.plot(m_v[finite], s_v[finite], marker="o", ms=3, lw=1.2,
+                label=f"deg={deg[di]:g}")
+    ax.set_xlabel(r"$m_{\mathrm{init}}$")
+    ax.set_ylabel(r"$s(m_{\mathrm{init}})$")
+    ax.axhline(0.0, color="0.7", lw=0.8, zorder=0)
+    ax.legend(frameon=False, fontsize=8)
+    if save_path:
+        ax.figure.tight_layout()
+        ax.figure.savefig(save_path)
+    return ax

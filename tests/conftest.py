@@ -23,5 +23,46 @@ def rng():
     return np.random.default_rng(1234)
 
 
+class CheckpointAbort(Exception):
+    """Raised by the abort_after_save fixture to simulate a preemption."""
+
+
+@pytest.fixture
+def abort_after_save():
+    """Monkeypatch ``Checkpoint.save`` to raise :class:`CheckpointAbort`
+    AFTER the n-th successful write — simulating a preemption that leaves a
+    valid mid-flight snapshot on disk. Usage::
+
+        with abort_after_save(n=1):
+            with pytest.raises(CheckpointAbort):
+                solver(..., checkpoint_path=p, checkpoint_interval_s=0.0)
+
+    The original ``save`` is restored on context exit."""
+    import contextlib
+
+    from graphdyn.utils.io import Checkpoint
+
+    @contextlib.contextmanager
+    def patcher(n: int = 1, when=None):
+        """Abort after the n-th write, or after the first write whose
+        ``meta`` satisfies ``when(meta)`` (e.g. a driver's next_rep)."""
+        saved_save = Checkpoint.save
+        calls = {"n": 0}
+
+        def counting_save(self, arrays, meta):
+            saved_save(self, arrays, meta)
+            calls["n"] += 1
+            if (when(meta) if when is not None else calls["n"] == n):
+                raise CheckpointAbort
+
+        Checkpoint.save = counting_save
+        try:
+            yield
+        finally:
+            Checkpoint.save = saved_save
+
+    return patcher
+
+
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running correctness anchors")

@@ -100,7 +100,7 @@ def test_hpr_batch_sharded_replicas():
     assert np.all((res.m_final == 1.0) | (res.m_final == 2.0))
 
 
-def test_hpr_checkpoint_resume_bit_exact(tmp_path):
+def test_hpr_checkpoint_resume_bit_exact(tmp_path, abort_after_save):
     """Chunked+checkpointed HPr equals the uninterrupted chain bit-for-bit,
     and resuming from a kept mid-flight checkpoint finishes identically
     (SURVEY.md §5.4 resume state: chi, biases, s, rng key, t)."""
@@ -124,30 +124,13 @@ def test_hpr_checkpoint_resume_bit_exact(tmp_path):
 
     # mid-flight restart: force an abort after the first chunk by keeping the
     # checkpoint file, then resume from it
-    from graphdyn.utils.io import Checkpoint
-
-    class _Abort(Exception):
-        pass
+    from conftest import CheckpointAbort
 
     p2 = str(tmp_path / "hpr_ck2")
-    saved_save = Checkpoint.save
-    calls = {"n": 0}
-
-    def counting_save(self, arrays, meta):  # abort right after first save
-        saved_save(self, arrays, meta)
-        calls["n"] += 1
-        if calls["n"] == 1:
-            raise _Abort
-
-    try:
-        Checkpoint.save = counting_save
-        try:
+    with abort_after_save(n=1):
+        with pytest.raises(CheckpointAbort):
             hpr_solve(g, cfg, seed=0, checkpoint_path=p2,
                       checkpoint_interval_s=0.0, chunk_sweeps=5)
-        except _Abort:
-            pass
-    finally:
-        Checkpoint.save = saved_save
     assert os.path.exists(p2 + ".npz")          # a mid-flight snapshot exists
 
     resumed = hpr_solve(g, cfg, seed=0, checkpoint_path=p2,
@@ -158,37 +141,22 @@ def test_hpr_checkpoint_resume_bit_exact(tmp_path):
     np.testing.assert_array_equal(resumed.chi, base.chi)
 
 
-def test_hpr_ensemble_driver_resume(tmp_path):
+def test_hpr_ensemble_driver_resume(tmp_path, abort_after_save):
     """Driver-level resume (completed reps kept, graphs re-derived) mirrors
     sa_ensemble's; abort lands between repetitions."""
     import os
 
+    from conftest import CheckpointAbort
     from graphdyn.models.hpr import hpr_ensemble
-    from graphdyn.utils.io import Checkpoint
 
     cfg = HPRConfig(dynamics=DynamicsConfig(p=1, c=1), max_sweeps=3000)
     kw = dict(n_rep=2, seed=1)
     base = hpr_ensemble(50, 4, cfg, **kw)
 
     p = str(tmp_path / "hpr_grid")
-    saved_save = Checkpoint.save
-
-    class _Abort(Exception):
-        pass
-
-    def aborting_save(self, arrays, meta):
-        saved_save(self, arrays, meta)
-        if meta.get("next_rep") == 1:
-            raise _Abort
-
-    try:
-        Checkpoint.save = aborting_save
-        try:
+    with abort_after_save(when=lambda meta: meta.get("next_rep") == 1):
+        with pytest.raises(CheckpointAbort):
             hpr_ensemble(50, 4, cfg, checkpoint_path=p, **kw)
-        except _Abort:
-            pass
-    finally:
-        Checkpoint.save = saved_save
     assert os.path.exists(p + ".npz")
 
     resumed = hpr_ensemble(50, 4, cfg, checkpoint_path=p, **kw)

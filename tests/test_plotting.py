@@ -1,0 +1,65 @@
+"""Entropy plot rendering: the notebook's end artifact ("BDCM entropy plots",
+`code/README.md:1`) renders headlessly from solver results."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("matplotlib")
+
+
+def _fake_grid():
+    from graphdyn.models.entropy import EntropyGridResult
+
+    L = 5
+    lam = np.linspace(0, 0.4, L)
+    m = np.stack([np.linspace(0.8, 0.6, L), np.linspace(0.82, 0.62, L)])
+    ent = np.stack([0.17 - 0.1 * lam, 0.16 - 0.1 * lam])
+    ent1 = ent + lam * m
+    z = np.zeros((1, 2))
+    return EntropyGridResult(
+        deg=np.array([1.0]),
+        ent=ent[None], m_init=m[None], ent1=ent1[None],
+        nodes_isolated=z, mean_degrees=z, max_degrees=z,
+        mean_degrees_total=z, counts=z,
+    )
+
+
+def test_plot_entropy_grid_writes_png(tmp_path):
+    from graphdyn.plotting import plot_entropy_grid
+
+    p = str(tmp_path / "curves.png")
+    ax = plot_entropy_grid(_fake_grid(), save_path=p)
+    assert ax is not None
+    assert (tmp_path / "curves.png").stat().st_size > 0
+
+
+def test_plot_entropy_curve_drops_nonfinite(tmp_path):
+    from graphdyn.models.entropy import EntropyResult
+    from graphdyn.plotting import plot_entropy_curve
+
+    res = EntropyResult(
+        lambdas=np.array([0.0, 0.1, 0.2]),
+        ent=np.array([0.1, 0.05, -np.inf]),
+        m_init=np.array([0.8, 0.7, 0.6]),
+        ent1=np.array([0.1, 0.12, -np.inf]),   # last point: empty attractor
+        sweeps=np.array([10, 12, 5]),
+        nonconverged=0.0,
+        chi=np.zeros((2, 2, 2)),
+    )
+    p = str(tmp_path / "curve.png")
+    ax = plot_entropy_curve(res, label="deg=1", save_path=p)
+    (line,) = [l for l in ax.lines if l.get_label() == "deg=1"]
+    assert line.get_xdata().size == 2            # -inf point dropped
+    assert (tmp_path / "curve.png").stat().st_size > 0
+
+
+def test_cli_entropy_plot_flag(tmp_path):
+    from graphdyn.cli import main
+
+    p = str(tmp_path / "grid.png")
+    rc = main([
+        "entropy", "--n", "60", "--deg", "1.0", "--num-rep", "1",
+        "--lmbd-max", "0.2", "--plot", p,
+    ])
+    assert rc == 0
+    assert (tmp_path / "grid.png").stat().st_size > 0

@@ -134,7 +134,7 @@ def test_sa_ensemble_driver(tmp_path):
     assert set(saved) == {"mag_reached", "num_steps", "conf", "graphs"}
 
 
-def test_checkpoint_resume_bit_exact(tmp_path):
+def test_checkpoint_resume_bit_exact(tmp_path, abort_after_save):
     """Chunked + checkpointed runs equal the uninterrupted run bit-for-bit,
     and a run restarted from a mid-flight checkpoint continues the same chain
     (SURVEY.md §5.4 exact SA-chain resume)."""
@@ -157,32 +157,15 @@ def test_checkpoint_resume_bit_exact(tmp_path):
 
     # (b) resume from a mid-flight snapshot: abort right after the first
     # checkpoint write, keep the file, restart from it and finish
-    from graphdyn.utils.io import Checkpoint
+    from conftest import CheckpointAbort
 
     p2 = str(tmp_path / "sa_ck2")
-    saved_save = Checkpoint.save
-    calls = {"n": 0}
-
-    class _Abort(Exception):
-        pass
-
-    def counting_save(self, arrays, meta):
-        saved_save(self, arrays, meta)
-        calls["n"] += 1
-        if calls["n"] == 1:
-            raise _Abort
-
-    try:
-        Checkpoint.save = counting_save
-        try:
+    with abort_after_save(n=1):
+        with pytest.raises(CheckpointAbort):
             simulated_annealing(
                 g, cfg, checkpoint_path=p2,
                 checkpoint_interval_s=0.0, chunk_steps=50, **kw
             )
-        except _Abort:
-            pass
-    finally:
-        Checkpoint.save = saved_save
     assert os.path.exists(p2 + ".npz")          # a mid-flight snapshot exists
     resumed = simulated_annealing(
         g, cfg, checkpoint_path=p2, chunk_steps=64, **kw
@@ -194,18 +177,12 @@ def test_checkpoint_resume_bit_exact(tmp_path):
     # (c) a checkpoint from a DIFFERENT graph/config is refused even when
     # seed/R/shape all match (full-identity fingerprint)
     g2 = random_regular_graph(50, 3, seed=77)   # same n, different edges
-    try:
-        Checkpoint.save = counting_save
-        calls["n"] = 0
-        try:
+    with abort_after_save(n=1):
+        with pytest.raises(CheckpointAbort):
             simulated_annealing(
                 g, cfg, checkpoint_path=p2,
                 checkpoint_interval_s=0.0, chunk_steps=50, **kw
             )
-        except _Abort:
-            pass
-    finally:
-        Checkpoint.save = saved_save
     with pytest.raises(ValueError, match="refusing to resume"):
         simulated_annealing(g2, cfg, checkpoint_path=p2, **kw)
 
@@ -231,11 +208,12 @@ def test_int64_step_budget_under_x64():
     assert np.all(res.num_steps < 2**31)        # finite steps under big budget
 
 
-def test_sa_ensemble_driver_resume(tmp_path):
+def test_sa_ensemble_driver_resume(tmp_path, abort_after_save):
     """A driver interrupted between repetitions resumes with completed reps
     intact and produces the same results and graphs as an uninterrupted run."""
     import os
 
+    from conftest import CheckpointAbort
     from graphdyn.models.sa import sa_ensemble
     from graphdyn.utils.io import Checkpoint
 
@@ -244,26 +222,9 @@ def test_sa_ensemble_driver_resume(tmp_path):
     base = sa_ensemble(30, 3, cfg, **kw)
 
     p = str(tmp_path / "sa_grid")
-    saved_save = Checkpoint.save
-    calls = {"n": 0}
-
-    class _Abort(Exception):
-        pass
-
-    def counting_save(self, arrays, meta):
-        saved_save(self, arrays, meta)
-        calls["n"] += 1
-        if meta.get("next_rep") == 2:           # die after rep 2 of 3 lands
-            raise _Abort
-
-    try:
-        Checkpoint.save = counting_save
-        try:
+    with abort_after_save(when=lambda meta: meta.get("next_rep") == 2):
+        with pytest.raises(CheckpointAbort):    # die after rep 2 of 3 lands
             sa_ensemble(30, 3, cfg, checkpoint_path=p, **kw)
-        except _Abort:
-            pass
-    finally:
-        Checkpoint.save = saved_save
     assert os.path.exists(p + ".npz")
 
     resumed = sa_ensemble(30, 3, cfg, checkpoint_path=p, **kw)

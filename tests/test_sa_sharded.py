@@ -119,7 +119,7 @@ def test_ragged_degree_graph_bit_parity():
     np.testing.assert_array_equal(got.m_final, ref.m_final)
 
 
-def test_sharded_checkpoint_resume_bit_exact(tmp_path):
+def test_sharded_checkpoint_resume_bit_exact(tmp_path, abort_after_save):
     """Chunked+checkpointed mesh runs equal the uninterrupted mesh run (and
     therefore the unsharded solver) bit-for-bit; a mid-flight snapshot kept
     by an aborted run resumes to the identical result — including on a
@@ -144,28 +144,13 @@ def test_sharded_checkpoint_resume_bit_exact(tmp_path):
     assert not os.path.exists(p1 + ".npz")
 
     # abort after the first snapshot, then resume — on another mesh shape
+    from conftest import CheckpointAbort
+
     p2 = str(tmp_path / "shck2")
-    saved_save = Checkpoint.save
-    calls = {"n": 0}
-
-    class _Abort(Exception):
-        pass
-
-    def counting_save(self, arrays, meta):
-        saved_save(self, arrays, meta)
-        calls["n"] += 1
-        if calls["n"] == 1:
-            raise _Abort
-
-    try:
-        Checkpoint.save = counting_save
-        try:
+    with abort_after_save(n=1):
+        with pytest.raises(CheckpointAbort):
             sa_sharded(g, cfg, mesh=_mesh(4, 2), checkpoint_path=p2,
                        checkpoint_interval_s=0.0, chunk_steps=37, **kw)
-        except _Abort:
-            pass
-    finally:
-        Checkpoint.save = saved_save
     assert os.path.exists(p2 + ".npz")
 
     resumed = sa_sharded(g, cfg, mesh=_mesh(2, 4), checkpoint_path=p2,
