@@ -71,35 +71,65 @@ def step_spins(
     return (R * jnp.sign(t)).astype(s.dtype)
 
 
-def batched_rollout_impl(nbr, s, steps: int, R_coef: int, C_coef: int):
+def batched_rollout_impl(nbr, s, steps: int, R_coef: int, C_coef: int,
+                         gather: str = "fused"):
     """Roll a batch ``s: int8[R, n]`` for ``steps`` synchronous updates.
 
-    The framework's single hot kernel: one fused gather→sum→sign per step
-    (int8 spins, int32 sums). Shared by the SA solver and the benchmark so
-    BASELINE numbers measure the shipped code path. Call inside jit; for a
-    standalone jitted version use :func:`batched_rollout`.
+    The framework's single hot kernel, shared by the SA solver and the
+    benchmark so BASELINE numbers measure the shipped code path. Call inside
+    jit; for a standalone jitted version use :func:`batched_rollout`.
+
+    ``gather`` selects the HBM schedule (identical results — integer sums
+    are order-exact):
+
+    - ``"fused"`` (default): one gather producing ``[R, n, dmax]``, widened
+      int32, then row-summed. Wins on CPU (cache-backed; measured ~1.3× vs
+      per_slot at the smoke shape) and is the historical schedule.
+    - ``"per_slot"``: one **int8** ``[R, n]`` gather per neighbor slot
+      accumulated straight into the int32 sum — no ``[R, n, dmax]`` buffer,
+      and the gathered bytes stay 1/4 the size (the packed kernel's
+      ``per_slot`` reasoning, ARCHITECTURE.md roofline). Candidate TPU
+      default, pending on-chip A/B (scripts/tpu_bench_session.sh).
     """
-    n = s.shape[-1]
-    flat_nbr = nbr.reshape(-1)
     dmax = nbr.shape[-1]
 
+    if gather == "per_slot":
+        def neighbor_sums(sb):
+            sb_ext = jnp.concatenate(
+                [sb, jnp.zeros((sb.shape[0], 1), sb.dtype)], axis=1
+            )
+            sums = jnp.zeros(sb.shape, jnp.int32)
+            for j in range(dmax):
+                sums = sums + jnp.take(sb_ext, nbr[:, j], axis=1).astype(jnp.int32)
+            return sums
+    elif gather == "fused":
+        n = s.shape[-1]
+        flat_nbr = nbr.reshape(-1)
+
+        def neighbor_sums(sb):
+            s_ext = jnp.concatenate(
+                [sb.astype(jnp.int32), jnp.zeros((sb.shape[0], 1), jnp.int32)],
+                axis=1,
+            )
+            g = jnp.take(s_ext, flat_nbr, axis=1).reshape(sb.shape[0], n, dmax)
+            return g.sum(axis=2)
+    else:
+        raise ValueError(f"gather must be 'fused' or 'per_slot', got {gather!r}")
+
     def body(_, sb):
-        s_ext = jnp.concatenate(
-            [sb.astype(jnp.int32), jnp.zeros((sb.shape[0], 1), jnp.int32)], axis=1
-        )
-        g = jnp.take(s_ext, flat_nbr, axis=1).reshape(sb.shape[0], n, dmax)
-        sums = g.sum(axis=2)
-        return (R_coef * jnp.sign(2 * sums + C_coef * sb.astype(jnp.int32))).astype(
-            jnp.int8
-        )
+        sums = neighbor_sums(sb)
+        return (
+            R_coef * jnp.sign(2 * sums + C_coef * sb.astype(jnp.int32))
+        ).astype(jnp.int8)
 
     return lax.fori_loop(0, steps, body, s) if steps > 0 else s
 
 
-@partial(jax.jit, static_argnames=("steps", "rule", "tie"))
-def batched_rollout(nbr, s, steps: int, rule: str = "majority", tie: str = "stay"):
+@partial(jax.jit, static_argnames=("steps", "rule", "tie", "gather"))
+def batched_rollout(nbr, s, steps: int, rule: str = "majority",
+                    tie: str = "stay", gather: str = "fused"):
     R_coef, C_coef = rule_coefficients(rule, tie)
-    return batched_rollout_impl(nbr, s, steps, R_coef, C_coef)
+    return batched_rollout_impl(nbr, s, steps, R_coef, C_coef, gather)
 
 
 @partial(jax.jit, static_argnames=("steps", "rule", "tie"))

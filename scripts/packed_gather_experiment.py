@@ -43,18 +43,27 @@ def _sync(x):
     fence(x)
 
 
-def time_rollout(nbr, deg, sp, steps, gather, iters=3):
-    from graphdyn.ops.packed import packed_rollout
-
-    out = packed_rollout(nbr, deg, sp, steps, gather=gather)
+def time_chained(step, state0, updates_per_call, iters=3):
+    """Shared timing harness: warmup call, then ``iters`` chained calls
+    (each consumes the previous output) fenced by a device-to-host read.
+    Returns updates/sec."""
+    out = step(state0)
     _sync(out)
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = packed_rollout(nbr, deg, out, steps, gather=gather)
+        out = step(out)
     _sync(out)
-    dt = time.perf_counter() - t0
+    return updates_per_call * iters / (time.perf_counter() - t0)
+
+
+def time_rollout(nbr, deg, sp, steps, gather, iters=3):
+    from graphdyn.ops.packed import packed_rollout
+
     n, W = sp.shape
-    return n * W * 32 * steps * iters / dt
+    return time_chained(
+        lambda x: packed_rollout(nbr, deg, x, steps, gather=gather),
+        sp, n * W * 32 * steps, iters,
+    )
 
 
 def main():
@@ -93,6 +102,27 @@ def main():
                     "W": args.w,
                     "d": args.d,
                 }
+            ),
+            flush=True,
+        )
+
+    # int8 kernel A/B (the SA solver's hot rollout — ops.dynamics)
+    from graphdyn.ops.dynamics import batched_rollout
+
+    R8 = 64
+    s8 = jnp.asarray(
+        (2 * np.random.default_rng(1).integers(0, 2, size=(R8, args.n)) - 1)
+        .astype(np.int8)
+    )
+    for name, gather in [("int8_A_fused", "fused"), ("int8_B_per_slot", "per_slot")]:
+        rate = time_chained(
+            lambda x, g=gather: batched_rollout(nbr, x, args.steps, gather=g),
+            s8, args.n * R8 * args.steps,
+        )
+        print(
+            json.dumps(
+                {"variant": name, "spin_updates_per_sec": rate,
+                 "n": args.n, "R": R8, "d": args.d}
             ),
             flush=True,
         )

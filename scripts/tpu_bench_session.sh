@@ -1,0 +1,33 @@
+#!/bin/bash
+# One-shot TPU benchmark session: run everything that needs the real chip and
+# collect artifacts. Fire this as soon as the tunnel is confirmed up (the
+# relay wedges unpredictably — front-load chip work):
+#
+#   bash scripts/tpu_bench_session.sh [outdir]
+#
+# Produces in <outdir> (default /tmp/tpu_session):
+#   bench_headline.json      — bench.py (packed kernel, natural vs BFS order)
+#   gather_experiment.jsonl  — fused vs per-slot vs slot-sorted A/B/C
+#   configs_tpu.json         — all five BASELINE configs, full scale
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/tpu_session}"
+mkdir -p "$OUT"
+
+echo "[tpu-session] headline bench ..." >&2
+timeout 1800 python bench.py > "$OUT/bench_headline.json" 2> "$OUT/bench_headline.err"
+echo "[tpu-session] bench rc=$? $(tail -c 300 "$OUT/bench_headline.json")" >&2
+
+echo "[tpu-session] gather experiment ..." >&2
+timeout 1800 python scripts/packed_gather_experiment.py \
+    > "$OUT/gather_experiment.jsonl" 2> "$OUT/gather_experiment.err"
+echo "[tpu-session] gather rc=$?" >&2
+
+echo "[tpu-session] five BASELINE configs (full) ..." >&2
+# per-config budget x5 must fit inside the outer budget, or the aggregator
+# dies before writing --out and every completed config's result is lost
+timeout 9000 python scripts/run_baseline_configs.py \
+    --out "$OUT/configs_tpu.json" --full --timeout 1500 >&2
+echo "[tpu-session] configs rc=$?" >&2
+
+echo "[tpu-session] done; artifacts in $OUT" >&2

@@ -162,3 +162,20 @@ def test_empty_attractor_guard_with_eps_clamp():
     assert out.ent[0] == -np.inf
     assert np.isfinite(out.m_init[0])
     assert out.lambdas.size == 1                # early exit still fires
+
+
+def test_int8_gather_schedules_bit_identical(rng):
+    """fused vs per_slot int8 rollout schedules are alternative HBM orders of
+    the same integer program — results must match exactly."""
+    from graphdyn.graphs import erdos_renyi_graph
+    from graphdyn.ops.dynamics import batched_rollout
+    import jax.numpy as jnp
+
+    g = erdos_renyi_graph(200, 5.0 / 199, seed=3)
+    s = rng.choice(np.array([-1, 1], dtype=np.int8), size=(7, g.n))
+    for rule in ("majority", "minority"):
+        a = batched_rollout(jnp.asarray(g.nbr), jnp.asarray(s), 6, rule,
+                            "stay", gather="fused")
+        b = batched_rollout(jnp.asarray(g.nbr), jnp.asarray(s), 6, rule,
+                            "stay", gather="per_slot")
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
