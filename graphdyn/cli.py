@@ -115,7 +115,7 @@ def build_parser() -> argparse.ArgumentParser:
     ent.add_argument("--out", default=None, help="npz path (`ipynb:515` keys)")
     ent.add_argument(
         "--checkpoint", default=None,
-        help="path prefix for time-triggered intermediate saves",
+        help="path prefix for time-triggered saves + exact λ-granular resume",
     )
     ent.add_argument("--checkpoint-interval", type=float, default=30.0)
     ent.add_argument(

@@ -161,7 +161,8 @@ def _run_ladder(
                     "lambdas": np.array(visited),
                     **(checkpoint_extra_arrays or {}),
                 },
-                {"lmbd": float(lmbd), **(checkpoint_meta or {})},
+                {"lmbd": float(lmbd), "failed": bool(failed),
+                 **(checkpoint_meta or {})},
             )
         if stop_fn(e1) or failed:
             break
@@ -509,19 +510,25 @@ def entropy_ensemble_union(
 
 
 class _GridCheckpointAdapter:
-    """Injects grid coordinates into the per-sweep checkpoint metadata so a
-    resumed run knows which (deg, rep, λ) cell to continue from."""
+    """Injects grid coordinates into the per-sweep checkpoint metadata (so a
+    resumed run knows which (deg, rep, λ) cell to continue from) and the
+    grid result arrays into the payload (so completed cells survive the
+    restart). ``extra_arrays`` holds live references — the driver mutates
+    the grids in place, so each save captures their current state."""
 
-    def __init__(self, checkpointer, extra_meta: dict):
+    def __init__(self, checkpointer, extra_meta: dict, extra_arrays: dict):
         self._ck = checkpointer
         self._extra = extra_meta
+        self._extra_arrays = extra_arrays
         self.ckpt = checkpointer.ckpt
 
     def due(self) -> bool:
         return self._ck.due()
 
     def maybe_save(self, arrays, meta) -> bool:
-        return self._ck.maybe_save(arrays, {**meta, **self._extra})
+        return self._ck.maybe_save(
+            {**arrays, **self._extra_arrays}, {**meta, **self._extra}
+        )
 
 
 class EntropyGridResult(NamedTuple):
@@ -559,17 +566,16 @@ def entropy_grid(
 
     ``checkpoint_path`` enables time-triggered intermediate saves every
     ``checkpoint_interval_s`` seconds (the notebook's ``saving_time=30``
-    sketch, `ipynb:439-445,475-476`): one shared
-    :class:`~graphdyn.utils.io.PeriodicCheckpointer` across the whole grid,
-    with (deg index, rep, λ) recorded in the checkpoint metadata."""
+    sketch, `ipynb:439-445,475-476`) — **and exact resume**: a rerun
+    pointing at an existing checkpoint restores every completed grid cell,
+    re-enters the interrupted cell at the first unvisited λ with the saved
+    warm-start chi (λ-granular — exactly the state the uninterrupted run
+    would carry, so the continuation is bit-exact), and refuses a
+    checkpoint whose run identity (n, grid, config, seed, sampler)
+    mismatches. Fitting, given that the reference notebook's own stored run
+    ends in a KeyboardInterrupt (`ipynb:47-49`). The file is removed on
+    completion."""
     config = config or EntropyConfig()
-    checkpointer = None
-    if checkpoint_path is not None:
-        from graphdyn.utils.io import PeriodicCheckpointer
-
-        checkpointer = PeriodicCheckpointer(
-            checkpoint_path, interval_s=checkpoint_interval_s
-        )
     lambdas = lambda_ladder(config)
     L = lambdas.size
     D, Rr = len(deg_grid), config.num_rep
@@ -582,9 +588,68 @@ def entropy_grid(
     max_degrees = np.zeros((D, Rr))
     mean_degrees_total = np.zeros((D, Rr))
     counts = np.zeros((D, Rr))
+    grids = {
+        "grid_ent": ent, "grid_m_init": m_init, "grid_ent1": ent1,
+        "grid_counts": counts, "grid_nodes_isolated": nodes_isolated,
+        "grid_mean_degrees": mean_degrees, "grid_max_degrees": max_degrees,
+        "grid_mean_degrees_total": mean_degrees_total,
+    }
+
+    checkpointer = None
+    start_di = start_rep = 0
+    resume_cell = None
+    if checkpoint_path is not None:
+        from graphdyn.utils.io import (
+            Checkpoint, PeriodicCheckpointer, run_fingerprint,
+        )
+
+        grid_id = run_fingerprint(
+            n, np.asarray(deg_grid, float), config, seed, graph_method,
+            class_bucket,
+        )
+        loaded = Checkpoint(checkpoint_path).load()
+        if loaded is not None:
+            arrays, meta = loaded
+            if meta.get("grid_id") != grid_id:
+                raise ValueError(
+                    f"checkpoint at {checkpoint_path!r} is from a different "
+                    f"entropy grid run (meta {meta}); refusing to resume"
+                )
+            start_di, start_rep = int(meta["deg_index"]), int(meta["rep"])
+            for key, arr in grids.items():
+                if key in arrays:
+                    arr[:] = arrays[key]
+            # the interrupted cell: λ points [k_off, k_off+seg) of the
+            # ladder live in the sweep-local arrays; earlier segments of a
+            # twice-interrupted cell are already in the grid rows
+            k_off = int(meta.get("lmbd_offset", 0))
+            seg = int(arrays["lambdas"].size)
+            sl = slice(k_off, k_off + seg)
+            ent[start_di, start_rep, sl] = arrays["ent"]
+            m_init[start_di, start_rep, sl] = arrays["m_init"]
+            ent1[start_di, start_rep, sl] = arrays["ent1"]
+            resume_cell = {
+                "chi": arrays["chi"],
+                "visited": k_off + seg,
+                "last_lmbd": float(arrays["lambdas"][-1]),
+                "last_e1": float(arrays["ent1"][-1]),
+                # the recorded flag, not a sweeps>=max inference — a fixed
+                # point that converges on exactly the last allowed sweep is
+                # NOT a failure (legacy snapshots without the flag fall back
+                # to the inference)
+                "failed": bool(meta.get(
+                    "failed",
+                    int(arrays["sweeps"][-1]) >= config.max_sweeps,
+                )),
+            }
+        checkpointer = PeriodicCheckpointer(
+            checkpoint_path, interval_s=checkpoint_interval_s
+        )
 
     for di, deg in enumerate(deg_grid):
         for rep in range(Rr):
+            if (di, rep) < (start_di, start_rep):
+                continue                        # completed cell, restored
             gseed = seed + 1000 * di + rep
             g = erdos_renyi_graph(n, deg / (n - 1), seed=gseed, method=graph_method)
             live = g.deg[g.deg > 0]
@@ -592,17 +657,36 @@ def entropy_grid(
             mean_degrees[di, rep] = live.mean() if live.size else 0.0
             max_degrees[di, rep] = g.deg.max(initial=0)
             mean_degrees_total[di, rep] = g.deg.mean()
+
+            cell_resume = resume_cell if (di, rep) == (start_di, start_rep) else None
+            k0 = 0
+            chi0 = None
+            if cell_resume is not None:
+                k0 = cell_resume["visited"]
+                chi0 = cell_resume["chi"]
+                failed = cell_resume["failed"]
+                if failed:
+                    counts[di, rep] = cell_resume["last_lmbd"]
+                if failed or cell_resume["last_e1"] < config.ent_floor or k0 >= L:
+                    continue                    # cell had already stopped
+
             ck = None
             if checkpointer is not None:
-                ck = _GridCheckpointAdapter(checkpointer, {"deg_index": di, "rep": rep})
+                ck = _GridCheckpointAdapter(
+                    checkpointer,
+                    {"deg_index": di, "rep": rep, "lmbd_offset": k0,
+                     "grid_id": grid_id},
+                    grids,
+                )
             res = entropy_sweep(
-                g, config, seed=gseed, lambdas=lambdas, verbose=verbose,
-                checkpointer=ck, class_bucket=class_bucket,
+                g, config, seed=gseed, lambdas=lambdas[k0:], chi0=chi0,
+                verbose=verbose, checkpointer=ck, class_bucket=class_bucket,
             )
             k = res.lambdas.size
-            ent[di, rep, :k] = res.ent
-            m_init[di, rep, :k] = res.m_init
-            ent1[di, rep, :k] = res.ent1
+            sl = slice(k0, k0 + k)
+            ent[di, rep, sl] = res.ent
+            m_init[di, rep, sl] = res.m_init
+            ent1[di, rep, sl] = res.ent1
             counts[di, rep] = res.nonconverged
 
     out = EntropyGridResult(
@@ -620,4 +704,8 @@ def entropy_grid(
         from graphdyn.utils.io import save_results_npz
 
         save_results_npz(save_path, **out._asdict())
+    # remove the checkpoint only after the results are durably persisted —
+    # a failed final save must leave the checkpoint for another resume
+    if checkpointer is not None:
+        checkpointer.remove()
     return out

@@ -62,7 +62,7 @@ def time_rollout(nbr, deg, sp, steps, gather, iters=3):
     n, W = sp.shape
     return time_chained(
         lambda x: packed_rollout(nbr, deg, x, steps, gather=gather),
-        sp, n * W * 32 * steps, iters,
+        sp, n * W * 32 * steps, iters=iters,
     )
 
 

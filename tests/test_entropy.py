@@ -110,12 +110,64 @@ def test_entropy_checkpointer_and_counts(tmp_path):
         checkpoint_path=str(tmp_path / "grid_ck"), checkpoint_interval_s=0.0,
     )
     assert grid.counts.shape == (1, 1)
-    from graphdyn.utils.io import Checkpoint, load_results_npz
+    import os
+
+    from graphdyn.utils.io import load_results_npz
     saved = load_results_npz(str(tmp_path / "grid.npz"))
     assert "counts" in saved and "ent1" in saved
-    # grid checkpoints carry the grid coordinates for resume
-    _, meta = Checkpoint(str(tmp_path / "grid_ck")).load()
-    assert {"deg_index", "rep", "lmbd"} <= set(meta)
+    # the grid checkpoint is cleanup-removed once the run completes
+    assert not os.path.exists(str(tmp_path / "grid_ck") + ".npz")
+
+
+def test_entropy_grid_resume_bit_exact(tmp_path, abort_after_save):
+    """A grid interrupted mid-cell (the reference notebook's own fate,
+    `ipynb:47-49`) resumes at the first unvisited λ with the saved
+    warm-start chi and finishes with grids identical to the uninterrupted
+    run; a mismatched-run checkpoint is refused."""
+    import os
+
+    from conftest import CheckpointAbort
+    from graphdyn.models.entropy import entropy_grid
+    from graphdyn.utils.io import Checkpoint
+
+    cfg = EntropyConfig(lmbd_max=0.3, lmbd_step=0.1, num_rep=2)
+    kw = dict(seed=3, checkpoint_interval_s=0.0)
+    base = entropy_grid(50, np.array([1.2, 1.6]), cfg, seed=3)
+
+    p = str(tmp_path / "grid_ck")
+    # abort on the 3rd λ-level save: lands mid-cell with restored prefix
+    with abort_after_save(n=3):
+        with pytest.raises(CheckpointAbort):
+            entropy_grid(50, np.array([1.2, 1.6]), cfg, checkpoint_path=p, **kw)
+    assert os.path.exists(p + ".npz")
+    _, meta = Checkpoint(p).load()
+    assert {"deg_index", "rep", "lmbd", "lmbd_offset", "grid_id"} <= set(meta)
+
+    resumed = entropy_grid(50, np.array([1.2, 1.6]), cfg, checkpoint_path=p, **kw)
+    np.testing.assert_array_equal(base.ent, resumed.ent)
+    np.testing.assert_array_equal(base.m_init, resumed.m_init)
+    np.testing.assert_array_equal(base.ent1, resumed.ent1)
+    np.testing.assert_array_equal(base.counts, resumed.counts)
+    np.testing.assert_array_equal(base.nodes_isolated, resumed.nodes_isolated)
+    assert not os.path.exists(p + ".npz")
+
+    # a second interruption inside the SAME continued cell also resumes
+    with abort_after_save(n=1):
+        with pytest.raises(CheckpointAbort):
+            entropy_grid(50, np.array([1.2, 1.6]), cfg, checkpoint_path=p, **kw)
+    with abort_after_save(n=1):
+        with pytest.raises(CheckpointAbort):
+            entropy_grid(50, np.array([1.2, 1.6]), cfg, checkpoint_path=p, **kw)
+    twice = entropy_grid(50, np.array([1.2, 1.6]), cfg, checkpoint_path=p, **kw)
+    np.testing.assert_array_equal(base.ent1, twice.ent1)
+
+    # different grid/seed: refused
+    with abort_after_save(n=1):
+        with pytest.raises(CheckpointAbort):
+            entropy_grid(50, np.array([1.2, 1.6]), cfg, checkpoint_path=p, **kw)
+    with pytest.raises(ValueError, match="refusing to resume"):
+        entropy_grid(50, np.array([1.2, 1.6]), cfg, checkpoint_path=p, seed=99,
+                     checkpoint_interval_s=0.0)
 
 
 def test_entropy_ensemble_matches_serial():
