@@ -171,35 +171,24 @@ def hpr_solve(
     ckpt = None
     state = None
     if checkpoint_path is not None:
-        from graphdyn.utils.io import (
-            Checkpoint, PeriodicCheckpointer, run_fingerprint,
-        )
+        from graphdyn.utils.io import ChainCheckpointer, run_fingerprint
 
-        fp = run_fingerprint(graph.edges, config)
-        loaded = Checkpoint(checkpoint_path).load()
-        if loaded is not None:
-            arrays, meta = loaded
-            if (
-                meta.get("kind") != "hpr_chain"
-                or meta.get("seed") != int(seed)
-                or meta.get("fp") != fp
-                or arrays["s"].shape != (n,)
-                or arrays["chi"].shape != (data.num_directed, data.K, data.K)
-            ):
-                raise ValueError(
-                    f"checkpoint at {checkpoint_path!r} is not a matching "
-                    f"hpr_chain snapshot for this graph/config/seed "
-                    f"(meta {meta}); refusing to resume"
-                )
-            state = (
-                jnp.asarray(arrays["chi"]),
-                jnp.asarray(arrays["biases"]),
-                jnp.asarray(arrays["s"]),
-                jnp.asarray(arrays["key"]),
-                jnp.asarray(arrays["t"]),
-                jnp.asarray(arrays["m_final"]),
+        if chunk_sweeps < 1:
+            raise ValueError(f"chunk_sweeps must be >= 1, got {chunk_sweeps}")
+        ckpt = ChainCheckpointer(
+            checkpoint_path, kind="hpr_chain", seed=seed,
+            fp=run_fingerprint(graph.edges, config),
+            interval_s=checkpoint_interval_s,
+        )
+        arrays = ckpt.load_state(
+            check=lambda a: a["s"].shape == (n,)
+            and a["chi"].shape == (data.num_directed, data.K, data.K)
+        )
+        if arrays is not None:
+            state = tuple(
+                jnp.asarray(arrays[k])
+                for k in ("chi", "biases", "s", "key", "t", "m_final")
             )
-        ckpt = PeriodicCheckpointer(checkpoint_path, interval_s=checkpoint_interval_s)
 
     if state is None:
         rng = np.random.default_rng(seed)
@@ -222,15 +211,8 @@ def hpr_solve(
             t_end = jnp.minimum(state[4] + jnp.int32(chunk_sweeps), TT + 2)
             state = run_chunk(*state, t_end)
             if ckpt.due():
-                chi_c, biases_c, s_c, key_c, t_c, m_c = state
-                ckpt.maybe_save(
-                    {
-                        "chi": np.asarray(chi_c), "biases": np.asarray(biases_c),
-                        "s": np.asarray(s_c), "key": np.asarray(key_c),
-                        "t": np.asarray(t_c), "m_final": np.asarray(m_c),
-                    },
-                    {"kind": "hpr_chain", "seed": int(seed), "fp": fp},
-                )
+                names = ("chi", "biases", "s", "key", "t", "m_final")
+                ckpt.maybe_save({k: np.asarray(v) for k, v in zip(names, state)})
         ckpt.remove()
 
     chi, biases, s, _, t, m_final = state
@@ -264,6 +246,9 @@ def hpr_solve_batch(
     seed: int = 0,
     mesh=None,
     replica_axis: str = "replica",
+    checkpoint_path: str | None = None,
+    checkpoint_interval_s: float = 30.0,
+    chunk_sweeps: int = 200,
 ) -> HPRBatchResult:
     """Run R independent HPr chains on ONE graph as a single batched device
     program — the BASELINE config-2 replica axis (`N=1e5, 256 replicas`).
@@ -283,6 +268,12 @@ def hpr_solve_batch(
     shards, so GSPMD inserts gathers for reverse-edge reads — the sharding
     trades some ICI traffic for HBM capacity rather than being
     communication-free.
+
+    ``checkpoint_path``: exact-resume checkpointing with the same contract
+    as :func:`hpr_solve` (chunked loop, full state snapshot, fingerprint-
+    validated resume, removed on completion). chi dominates the snapshot
+    size (``R·2E·K²`` floats), so pick ``checkpoint_interval_s``
+    accordingly at config-2 scale.
     """
     t_start = time.perf_counter()
     config = config or HPRConfig()
@@ -318,12 +309,9 @@ def hpr_solve_batch(
         )
 
     @jax.jit
-    def run(chi, biases, keys):
-        s0 = jnp.where(biases[:, 0] > biases[:, 1], 1, -1).astype(jnp.int8)
-        m0 = m_per_replica(s0)
-
+    def run_chunk(chi, biases, s, keys, t, m_final, active, steps, t_end):
         def cond(st):
-            return jnp.any(st[6])
+            return jnp.any(st[6]) & (st[4] < t_end)
 
         def body(st):
             chi, biases, s, keys, t, m_final, active, steps = st
@@ -357,30 +345,70 @@ def hpr_solve_batch(
             active = active & (m_final < 1.0) & (t_new <= TT)
             return chi, biases, s, keys, t_new, m_final, active, steps
 
+        return lax.while_loop(
+            cond, body, (chi, biases, s, keys, t, m_final, active, steps)
+        )
+
+    ckpt = None
+    state = None
+    if checkpoint_path is not None:
+        from graphdyn.utils.io import ChainCheckpointer, run_fingerprint
+
+        if chunk_sweeps < 1:
+            raise ValueError(f"chunk_sweeps must be >= 1, got {chunk_sweeps}")
+        ckpt = ChainCheckpointer(
+            checkpoint_path, kind="hpr_batch_chain", seed=seed,
+            fp=run_fingerprint(graph.edges, config, R),
+            interval_s=checkpoint_interval_s,
+        )
+        arrays = ckpt.load_state(check=lambda a: a["s"].shape == (R * n,))
+        if arrays is not None:
+            state = tuple(
+                jnp.asarray(arrays[k])
+                for k in ("chi", "biases", "s", "keys", "t", "m_final",
+                          "active", "steps")
+            )
+
+    if state is None:
+        rng = np.random.default_rng(seed)
+        chi0 = jnp.asarray(data.init_messages(rng))
+        biases0 = rng.random((R * n, 2))
+        biases0 /= biases0.sum(axis=1, keepdims=True)
+        biases0 = jnp.asarray(biases0, jnp.float32)
+        # one root key per chain: distinct seeds give fully disjoint streams
+        keys = jax.random.split(jax.random.PRNGKey(seed), R)
+        s0 = jnp.where(biases0[:, 0] > biases0[:, 1], 1, -1).astype(jnp.int8)
+        m0 = m_per_replica(s0)
         state = (
-            chi, biases, s0, keys, jnp.int32(0), m0,
+            chi0, biases0, s0, keys, jnp.int32(0), m0,
             m0 < 1.0, jnp.zeros((R,), jnp.int32),
         )
-        out = lax.while_loop(cond, body, state)
-        return out[2], out[5], out[7]
-
-    rng = np.random.default_rng(seed)
-    chi0 = jnp.asarray(data.init_messages(rng))
-    biases0 = rng.random((R * n, 2))
-    biases0 /= biases0.sum(axis=1, keepdims=True)
-    biases0 = jnp.asarray(biases0, jnp.float32)
-    # one root key per chain: distinct seeds give fully disjoint streams
-    keys = jax.random.split(jax.random.PRNGKey(seed), R)
 
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         shard = NamedSharding(mesh, P(replica_axis))
-        chi0 = jax.device_put(chi0, shard)
-        biases0 = jax.device_put(biases0, shard)
-        keys = jax.device_put(keys, shard)
+        state = (
+            jax.device_put(state[0], shard),       # chi [R·2E, K, K]
+            jax.device_put(state[1], shard),       # biases [R·n, 2]
+            jax.device_put(state[2], shard),       # s [R·n]
+            jax.device_put(state[3], shard),       # keys [R]
+            *state[4:],
+        )
 
-    s_u, m_final, steps = run(chi0, biases0, keys)
+    if ckpt is None:
+        state = run_chunk(*state, jnp.int32(TT + 2))
+    else:
+        while bool(jnp.any(state[6])):
+            t_end = jnp.minimum(state[4] + jnp.int32(chunk_sweeps), TT + 2)
+            state = run_chunk(*state, t_end)
+            if bool(jnp.any(state[6])) and ckpt.due():
+                names = ("chi", "biases", "s", "keys", "t", "m_final",
+                         "active", "steps")
+                ckpt.maybe_save({k: np.asarray(v) for k, v in zip(names, state)})
+        ckpt.remove()
+
+    _, _, s_u, _, _, m_final, _, steps = state
     s = np.asarray(s_u).reshape(R, n)
     return HPRBatchResult(
         s=s,

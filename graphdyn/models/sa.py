@@ -338,30 +338,22 @@ def simulated_annealing(
     ckpt = None
     state = None
     if checkpoint_path is not None:
-        from graphdyn.utils.io import (
-            Checkpoint, PeriodicCheckpointer, run_fingerprint,
-        )
+        from graphdyn.utils.io import ChainCheckpointer, run_fingerprint
 
-        # full run identity: same graph, config, budget, dtype, x64 mode
-        fp = run_fingerprint(
-            graph.edges, config, int(max_steps), bool(injected),
-            np_dt, bool(jax.config.jax_enable_x64),
+        if chunk_steps < 1:
+            raise ValueError(f"chunk_steps must be >= 1, got {chunk_steps}")
+        ckpt = ChainCheckpointer(
+            checkpoint_path, kind="sa_chain", seed=seed,
+            # full run identity: same graph, config, budget, dtype, x64 mode
+            fp=run_fingerprint(
+                graph.edges, config, int(max_steps), bool(injected),
+                np_dt, bool(jax.config.jax_enable_x64),
+            ),
+            interval_s=checkpoint_interval_s,
+            extra_meta={"R": int(R)},
         )
-        loaded = Checkpoint(checkpoint_path).load()
-        if loaded is not None:
-            arrays, meta = loaded
-            if (
-                meta.get("kind") != "sa_chain"
-                or meta.get("seed") != int(seed)
-                or meta.get("R") != int(R)
-                or meta.get("fp") != fp
-                or arrays["s"].shape != (R, n)
-            ):
-                raise ValueError(
-                    f"checkpoint at {checkpoint_path!r} is not a matching "
-                    f"sa_chain snapshot for this graph/config/seed "
-                    f"(meta {meta}); refusing to resume"
-                )
+        arrays = ckpt.load_state(check=lambda a: a["s"].shape == (R, n))
+        if arrays is not None:
             state = _SAState(
                 s=jnp.asarray(arrays["s"]),
                 sum_end=jnp.asarray(arrays["sum_end"]),
@@ -373,7 +365,6 @@ def simulated_annealing(
                 key=jnp.asarray(arrays["key"]),
                 chunk_t=jnp.zeros((), jnp.int32),
             )
-        ckpt = PeriodicCheckpointer(checkpoint_path, interval_s=checkpoint_interval_s)
 
     if state is None:
         state = _sa_init(
@@ -413,9 +404,7 @@ def simulated_annealing(
                         "m_final": np.asarray(state.m_final),
                         "active": np.asarray(state.active),
                         "key": np.asarray(state.key),
-                    },
-                    {"kind": "sa_chain", "seed": int(seed), "R": int(R),
-                     "fp": fp},
+                    }
                 )
         ckpt.remove()
 

@@ -248,35 +248,23 @@ def sa_sharded(
 
     ckpt = None
     restored = None
-    fp = None
     if checkpoint_path is not None:
-        from graphdyn.utils.io import (
-            Checkpoint, PeriodicCheckpointer, run_fingerprint,
-        )
+        from graphdyn.utils.io import ChainCheckpointer, run_fingerprint
 
-        # run identity deliberately excludes the mesh shape: state is saved
-        # unpadded/global, so resuming on a different mesh is supported
-        fp = run_fingerprint(
-            graph.edges, config, int(max_steps), bool(injected),
-            np_dt, bool(jax.config.jax_enable_x64),
+        if chunk_steps < 1:
+            raise ValueError(f"chunk_steps must be >= 1, got {chunk_steps}")
+        ckpt = ChainCheckpointer(
+            checkpoint_path, kind="sa_sharded_chain", seed=seed,
+            # run identity deliberately excludes the mesh shape: state is
+            # saved unpadded/global, so resuming on a different mesh works
+            fp=run_fingerprint(
+                graph.edges, config, int(max_steps), bool(injected),
+                np_dt, bool(jax.config.jax_enable_x64),
+            ),
+            interval_s=checkpoint_interval_s,
+            extra_meta={"R": int(R)},
         )
-        loaded = Checkpoint(checkpoint_path).load()
-        if loaded is not None:
-            arrays, meta = loaded
-            if (
-                meta.get("kind") != "sa_sharded_chain"
-                or meta.get("seed") != int(seed)
-                or meta.get("R") != int(R)
-                or meta.get("fp") != fp
-                or arrays["s"].shape != (R, n)
-            ):
-                raise ValueError(
-                    f"checkpoint at {checkpoint_path!r} is not a matching "
-                    f"sa_sharded_chain snapshot for this graph/config/seed "
-                    f"(meta {meta}); refusing to resume"
-                )
-            restored = arrays
-        ckpt = PeriodicCheckpointer(checkpoint_path, interval_s=checkpoint_interval_s)
+        restored = ckpt.load_state(check=lambda a: a["s"].shape == (R, n))
 
     # replica padding: all-+1 rows are at consensus (m0 == 1) and freeze on
     # entry (active=False below) — they do no work and are sliced off at exit
@@ -392,9 +380,7 @@ def sa_sharded(
                     "m_final": np.asarray(m_final_dev)[:R],
                     "active": np.asarray(active_dev)[:R],
                     "sum_end": np.asarray(sum_end_dev)[:R],
-                },
-                {"kind": "sa_sharded_chain", "seed": int(seed), "R": int(R),
-                 "fp": fp},
+                }
             )
     if ckpt is not None:
         ckpt.remove()

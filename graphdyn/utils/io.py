@@ -117,6 +117,55 @@ def load_resume_prefix(ck: Checkpoint, expect: dict[str, Any]):
     return arrays, int(meta["next_rep"])
 
 
+class ChainCheckpointer:
+    """The chain-level exact-resume protocol shared by the solvers
+    (``simulated_annealing``, ``sa_sharded``, ``hpr_solve``,
+    ``hpr_solve_batch``): a fingerprint-validated load that refuses foreign
+    snapshots, a due-gated periodic save stamping identical metadata, and
+    remove-on-completion. One implementation so the protocol cannot drift
+    between solvers.
+
+    ``extra_meta``: additional identity fields (e.g. replica count) checked
+    for equality on load and stamped on save alongside kind/seed/fp.
+    """
+
+    def __init__(self, path: str, *, kind: str, seed: int, fp: str,
+                 interval_s: float, extra_meta: dict | None = None):
+        self.path = path
+        self._meta = {"kind": kind, "seed": int(seed), "fp": fp,
+                      **(extra_meta or {})}
+        self.ckpt = Checkpoint(path)
+        self._pc = PeriodicCheckpointer(path, interval_s=interval_s)
+
+    def load_state(self, check=None) -> dict | None:
+        """Load and validate; returns the arrays dict, or None when no
+        checkpoint exists. ``check(arrays) -> bool`` adds shape/content
+        validation. Raises ValueError on any identity mismatch."""
+        loaded = self.ckpt.load()
+        if loaded is None:
+            return None
+        arrays, meta = loaded
+        ok = all(meta.get(k) == v for k, v in self._meta.items())
+        if ok and check is not None:
+            ok = bool(check(arrays))
+        if not ok:
+            raise ValueError(
+                f"checkpoint at {self.path!r} is not a matching "
+                f"{self._meta['kind']} snapshot for this graph/config/seed "
+                f"(meta {meta}); refusing to resume"
+            )
+        return arrays
+
+    def due(self) -> bool:
+        return self._pc.due()
+
+    def maybe_save(self, arrays: dict) -> bool:
+        return self._pc.maybe_save(arrays, self._meta)
+
+    def remove(self) -> None:
+        self._pc.remove()
+
+
 class PeriodicCheckpointer:
     """Time-triggered checkpointing (the notebook's ``saving_time`` sketch,
     `ipynb:439-445`): call ``maybe_save`` inside the solver loop; it writes at

@@ -164,3 +164,46 @@ def test_hpr_ensemble_driver_resume(tmp_path, abort_after_save):
     np.testing.assert_array_equal(base.num_steps, resumed.num_steps)
     np.testing.assert_array_equal(base.graphs, resumed.graphs)
     assert not os.path.exists(p + ".npz")
+
+
+def test_hpr_batch_checkpoint_resume_bit_exact(tmp_path, abort_after_save):
+    """Chunked+checkpointed batch solver equals the uninterrupted run
+    bit-for-bit; a kept mid-flight snapshot resumes identically; foreign
+    checkpoints are refused."""
+    import os
+
+    from conftest import CheckpointAbort
+    from graphdyn.models.hpr import hpr_solve_batch
+
+    g = random_regular_graph(40, 4, seed=5)
+    cfg = HPRConfig(max_sweeps=3000)
+    base = hpr_solve_batch(g, cfg, n_replicas=4, seed=2)
+
+    p1 = str(tmp_path / "hb_ck")
+    chunked = hpr_solve_batch(
+        g, cfg, n_replicas=4, seed=2, checkpoint_path=p1,
+        checkpoint_interval_s=0.0, chunk_sweeps=9,
+    )
+    np.testing.assert_array_equal(base.s, chunked.s)
+    np.testing.assert_array_equal(base.num_steps, chunked.num_steps)
+    np.testing.assert_array_equal(base.m_final, chunked.m_final)
+    assert not os.path.exists(p1 + ".npz")
+
+    p2 = str(tmp_path / "hb_ck2")
+    with abort_after_save(n=1):
+        with pytest.raises(CheckpointAbort):
+            hpr_solve_batch(g, cfg, n_replicas=4, seed=2, checkpoint_path=p2,
+                            checkpoint_interval_s=0.0, chunk_sweeps=7)
+    assert os.path.exists(p2 + ".npz")
+    resumed = hpr_solve_batch(g, cfg, n_replicas=4, seed=2,
+                              checkpoint_path=p2, chunk_sweeps=50)
+    np.testing.assert_array_equal(base.s, resumed.s)
+    np.testing.assert_array_equal(base.num_steps, resumed.num_steps)
+
+    # wrong replica count: refused (R is part of the fingerprint)
+    with abort_after_save(n=1):
+        with pytest.raises(CheckpointAbort):
+            hpr_solve_batch(g, cfg, n_replicas=4, seed=2, checkpoint_path=p2,
+                            checkpoint_interval_s=0.0, chunk_sweeps=7)
+    with pytest.raises(ValueError, match="refusing to resume"):
+        hpr_solve_batch(g, cfg, n_replicas=5, seed=2, checkpoint_path=p2)
