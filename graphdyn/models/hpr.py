@@ -40,6 +40,13 @@ from graphdyn.ops.bdcm import BDCMData, make_marginals, make_sweep
 from graphdyn.ops.dynamics import batched_rollout_impl, rule_coefficients
 
 
+# chain-checkpoint state fields, in state-tuple order (one constant per
+# solver: the restore and the save payload cannot drift apart)
+_HPR_CHAIN_FIELDS = ("chi", "biases", "s", "key", "t", "m_final")
+_HPR_BATCH_FIELDS = ("chi", "biases", "s", "keys", "t", "m_final", "active",
+                     "steps")
+
+
 class HPRResult(NamedTuple):
     s: np.ndarray            # int8[n] — trial solution at stop
     mag_reached: np.ndarray  # f32 scalar — m(s) at stop (`HPR:359`)
@@ -185,10 +192,7 @@ def hpr_solve(
             and a["chi"].shape == (data.num_directed, data.K, data.K)
         )
         if arrays is not None:
-            state = tuple(
-                jnp.asarray(arrays[k])
-                for k in ("chi", "biases", "s", "key", "t", "m_final")
-            )
+            state = tuple(jnp.asarray(arrays[k]) for k in _HPR_CHAIN_FIELDS)
 
     if state is None:
         rng = np.random.default_rng(seed)
@@ -207,13 +211,16 @@ def hpr_solve(
     if ckpt is None:
         state = run_chunk(*state, jnp.int32(TT + 2))
     else:
-        while bool(state[5] < 1.0):
-            t_end = jnp.minimum(state[4] + jnp.int32(chunk_sweeps), TT + 2)
-            state = run_chunk(*state, t_end)
-            if ckpt.due():
-                names = ("chi", "biases", "s", "key", "t", "m_final")
-                ckpt.maybe_save({k: np.asarray(v) for k, v in zip(names, state)})
-        ckpt.remove()
+        state = ckpt.drive(
+            state,
+            advance=lambda st: run_chunk(
+                *st, jnp.minimum(st[4] + jnp.int32(chunk_sweeps), TT + 2)
+            ),
+            active=lambda st: bool(st[5] < 1.0),
+            payload=lambda st: {
+                k: np.asarray(v) for k, v in zip(_HPR_CHAIN_FIELDS, st)
+            },
+        )
 
     chi, biases, s, _, t, m_final = state
     s = np.asarray(s)
@@ -363,11 +370,7 @@ def hpr_solve_batch(
         )
         arrays = ckpt.load_state(check=lambda a: a["s"].shape == (R * n,))
         if arrays is not None:
-            state = tuple(
-                jnp.asarray(arrays[k])
-                for k in ("chi", "biases", "s", "keys", "t", "m_final",
-                          "active", "steps")
-            )
+            state = tuple(jnp.asarray(arrays[k]) for k in _HPR_BATCH_FIELDS)
 
     if state is None:
         rng = np.random.default_rng(seed)
@@ -399,14 +402,16 @@ def hpr_solve_batch(
     if ckpt is None:
         state = run_chunk(*state, jnp.int32(TT + 2))
     else:
-        while bool(jnp.any(state[6])):
-            t_end = jnp.minimum(state[4] + jnp.int32(chunk_sweeps), TT + 2)
-            state = run_chunk(*state, t_end)
-            if bool(jnp.any(state[6])) and ckpt.due():
-                names = ("chi", "biases", "s", "keys", "t", "m_final",
-                         "active", "steps")
-                ckpt.maybe_save({k: np.asarray(v) for k, v in zip(names, state)})
-        ckpt.remove()
+        state = ckpt.drive(
+            state,
+            advance=lambda st: run_chunk(
+                *st, jnp.minimum(st[4] + jnp.int32(chunk_sweeps), TT + 2)
+            ),
+            active=lambda st: bool(jnp.any(st[6])),
+            payload=lambda st: {
+                k: np.asarray(v) for k, v in zip(_HPR_BATCH_FIELDS, st)
+            },
+        )
 
     _, _, s_u, _, _, m_final, _, steps = state
     s = np.asarray(s_u).reshape(R, n)

@@ -388,25 +388,18 @@ def simulated_annealing(
     if ckpt is None:
         state = _sa_loop(nbr, state, *loop_args, **loop_kwargs)
     else:
-        while bool(jnp.any(state.active)):
-            state = _sa_loop(
-                nbr, state._replace(chunk_t=jnp.zeros((), jnp.int32)),
+        state = ckpt.drive(
+            state,
+            advance=lambda st: _sa_loop(
+                nbr, st._replace(chunk_t=jnp.zeros((), jnp.int32)),
                 *loop_args, chunk_steps=int(chunk_steps), **loop_kwargs,
-            )
-            if ckpt.due():
-                ckpt.maybe_save(
-                    {
-                        "s": np.asarray(state.s),
-                        "sum_end": np.asarray(state.sum_end),
-                        "a": np.asarray(state.a),
-                        "b": np.asarray(state.b),
-                        "t": np.asarray(state.t),
-                        "m_final": np.asarray(state.m_final),
-                        "active": np.asarray(state.active),
-                        "key": np.asarray(state.key),
-                    }
-                )
-        ckpt.remove()
+            ),
+            active=lambda st: bool(jnp.any(st.active)),
+            payload=lambda st: {
+                k: np.asarray(v)
+                for k, v in st._asdict().items() if k != "chunk_t"
+            },
+        )
 
     mag = np.asarray(state.s).astype(np.float64).sum(axis=1) / n
     return SAResult(

@@ -362,32 +362,34 @@ def sa_sharded(
         place_sharded(mesh, jnp.asarray(uniforms.astype(np_dt)), P(replica_axis, None)),
     )
 
-    while True:
-        s_dev, mag, key_dev, a_dev, b_dev, t_dev, m_final_dev, active_dev, \
-            sum_end_dev = chunk_fn(nbr_dev, *state, *consts)
-        state = (s_dev, key_dev, a_dev, b_dev, t_dev, m_final_dev,
-                 active_dev, sum_end_dev)
-        if not bool(np.asarray(active_dev)[:R].any()):
-            break
-        if ckpt is not None and ckpt.due():
-            ckpt.maybe_save(
-                {
-                    "s": np.asarray(s_dev)[:R, :n],
-                    "key": np.asarray(key_dev)[:R],
-                    "a": np.asarray(a_dev)[:R],
-                    "b": np.asarray(b_dev)[:R],
-                    "t": np.asarray(t_dev)[:R],
-                    "m_final": np.asarray(m_final_dev)[:R],
-                    "active": np.asarray(active_dev)[:R],
-                    "sum_end": np.asarray(sum_end_dev)[:R],
-                }
-            )
-    if ckpt is not None:
-        ckpt.remove()
+    fields = ("s", "key", "a", "b", "t", "m_final", "active", "sum_end")
 
+    def advance(st):
+        out = chunk_fn(nbr_dev, *st, *consts)   # (s, mag, key, a, b, t, ...)
+        return (out[0], *out[2:])
+
+    def still_active(st):
+        return bool(np.asarray(st[6])[:R].any())
+
+    def snapshot(st):
+        full = {k: np.asarray(v) for k, v in zip(fields, st)}
+        full["s"] = full["s"][:R, :n]           # unpadded/global state
+        return {k: (v if k == "s" else v[:R]) for k, v in full.items()}
+
+    if ckpt is None:
+        while still_active(state):              # one chunk runs to completion
+            state = advance(state)
+    else:
+        state = ckpt.drive(
+            state, advance=advance, active=still_active, payload=snapshot
+        )
+
+    s_final = np.asarray(state[0])[:R, :n]
+    # same arithmetic as the unsharded solver's mag_reached
+    mag = (s_final.astype(np.float64).sum(axis=1) / n).astype(np_dt)
     return SAResult(
-        s=np.asarray(s_dev)[:R, :n],
-        mag_reached=np.asarray(mag)[:R],
-        num_steps=np.asarray(t_dev)[:R],
-        m_final=np.asarray(m_final_dev)[:R],
+        s=s_final,
+        mag_reached=mag,
+        num_steps=np.asarray(state[4])[:R],
+        m_final=np.asarray(state[5])[:R],
     )

@@ -165,6 +165,20 @@ class ChainCheckpointer:
     def remove(self) -> None:
         self._pc.remove()
 
+    def drive(self, state, *, advance, active, payload):
+        """The shared chunk loop: run ``advance(state)`` until
+        ``active(state)`` is False, saving a due snapshot between chunks —
+        never of a finished state, so an abort in the final window cannot
+        leave a stale done-snapshot — then remove the file. ``payload`` is
+        only called when a save is actually due (snapshots can be large
+        device-to-host copies). Returns the final state."""
+        while active(state):
+            state = advance(state)
+            if active(state) and self.due():
+                self.maybe_save(payload(state))
+        self.remove()
+        return state
+
 
 class PeriodicCheckpointer:
     """Time-triggered checkpointing (the notebook's ``saving_time`` sketch,
