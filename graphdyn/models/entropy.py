@@ -400,6 +400,8 @@ def entropy_ensemble_union(
     lambdas: np.ndarray | None = None,
     ent_floor_mode: str = "all",
     checkpointer=None,
+    checkpoint_path: str | None = None,
+    checkpoint_interval_s: float = 30.0,
 ) -> UnionEnsembleEntropyResult:
     """The λ ladder over an ARBITRARY graph ensemble as one device program,
     via the disjoint union (:func:`graphdyn.graphs.disjoint_union`).
@@ -415,8 +417,14 @@ def entropy_ensemble_union(
     λ ladder) done natively. ``chi0`` resumes from a previous result's union
     ``chi``; ``checkpointer`` (a
     :class:`graphdyn.utils.io.PeriodicCheckpointer`) saves the warm-start
-    state + results-so-far after a λ point at most every ``interval_s`` —
-    resume with the restored ``chi`` as ``chi0`` and the remaining ladder.
+    state + results-so-far after a λ point at most every ``interval_s`` for
+    callers that manage resume themselves.
+
+    ``checkpoint_path`` is the managed alternative (mutually exclusive with
+    ``checkpointer``): exact λ-granular auto-resume with the same contract
+    as :func:`entropy_grid` — an identity-validated restart re-enters the
+    ladder at the first unvisited λ with the saved warm-start chi, a
+    mismatched run is refused, and the file is removed on completion.
     """
     from graphdyn.graphs import disjoint_union
     from graphdyn.ops.bdcm import (
@@ -484,25 +492,100 @@ def entropy_ensemble_union(
             eps_clamp=float(config.eps_clamp),
         )
 
-    chi = data.init_messages(seed) if chi0 is None else jnp.asarray(chi0, data.dtype)
+    # managed checkpoint_path mode: identity-validated λ-granular auto-resume
+    prefix = None
+    managed = checkpoint_path is not None
+    extra_meta = {"seed": seed}
+    if managed:
+        if checkpointer is not None:
+            raise ValueError(
+                "pass either checkpoint_path (managed resume) or "
+                "checkpointer (caller-managed), not both"
+            )
+        from graphdyn.utils.io import (
+            PeriodicCheckpointer, load_validated, run_fingerprint,
+        )
+
+        union_id = run_fingerprint(
+            *[g.edges for g in graphs], [int(g.n) for g in graphs], config,
+            seed, np.asarray(lambdas, float), ent_floor_mode,
+            None if chi0 is None else np.asarray(chi0),
+        )
+        extra_meta["union_id"] = union_id
+        prefix = load_validated(
+            checkpoint_path, "union_id", union_id, "union-ensemble"
+        )
+        checkpointer = PeriodicCheckpointer(
+            checkpoint_path, interval_s=checkpoint_interval_s
+        )
+
+    lambdas = np.asarray(lambdas, float)
+    k0 = 0
+    pre = None
+    if prefix is not None:
+        arrays, meta = prefix
+        chi = jnp.asarray(arrays["chi"], data.dtype)
+        seg = {
+            k: np.asarray(arrays[k])
+            for k in ("lambdas", "ent", "m_init", "ent1", "sweeps")
+        }
+        if "prev_lambdas" in arrays:
+            # twice-interrupted: the snapshot carries the earlier stitched
+            # segments alongside the current one
+            pre = {
+                k: np.concatenate([np.asarray(arrays["prev_" + k]), seg[k]])
+                for k in seg
+            }
+        else:
+            pre = seg
+        k0 = int(pre["lambdas"].size)
+        failed_prev = bool(meta.get("failed", False))
+        stopped = failed_prev or stop_fn(pre["ent1"][-1]) or k0 >= lambdas.size
+        if stopped:
+            if managed:
+                checkpointer.remove()
+            return UnionEnsembleEntropyResult(
+                lambdas=pre["lambdas"],
+                ent=pre["ent"],
+                m_init=pre["m_init"],
+                ent1=pre["ent1"],
+                sweeps=pre["sweeps"],
+                nonconverged=float(meta["lmbd"]) if failed_prev else 0.0,
+                chi=np.asarray(chi),
+                edge_gid=edge_gid_np,
+            )
+    else:
+        chi = data.init_messages(seed) if chi0 is None else jnp.asarray(
+            chi0, data.dtype
+        )
 
     visited, ents, m_inits, ent1s, sweeps, nonconverged, chi = _run_ladder(
-        lambdas, chi, data.dtype,
+        lambdas[k0:], chi, data.dtype,
         set_leaves=set_leaves,
         fixed_point=fixed_point,
         observe=observables,
         eps=config.eps,
         stop_fn=stop_fn,
         checkpointer=checkpointer,
-        checkpoint_meta={"seed": seed},
-        checkpoint_extra_arrays={"edge_gid": edge_gid_np},
+        checkpoint_meta=extra_meta,
+        checkpoint_extra_arrays={
+            "edge_gid": edge_gid_np,
+            **({f"prev_{k}": v for k, v in pre.items()} if pre is not None else {}),
+        },
     )
+    if managed:
+        checkpointer.remove()
+
+    def stitch(prev_key, new_rows):
+        new = np.array(new_rows)
+        return np.concatenate([pre[prev_key], new]) if pre is not None else new
+
     return UnionEnsembleEntropyResult(
-        lambdas=np.array(visited),
-        ent=np.array(ents),
-        m_init=np.array(m_inits),
-        ent1=np.array(ent1s),
-        sweeps=np.array(sweeps),
+        lambdas=stitch("lambdas", visited),
+        ent=stitch("ent", ents),
+        m_init=stitch("m_init", m_inits),
+        ent1=stitch("ent1", ent1s),
+        sweeps=stitch("sweeps", sweeps),
         nonconverged=nonconverged,
         chi=np.asarray(chi),
         edge_gid=edge_gid_np,
@@ -600,21 +683,17 @@ def entropy_grid(
     resume_cell = None
     if checkpoint_path is not None:
         from graphdyn.utils.io import (
-            Checkpoint, PeriodicCheckpointer, run_fingerprint,
+            PeriodicCheckpointer, load_validated, run_fingerprint,
         )
 
         grid_id = run_fingerprint(
             n, np.asarray(deg_grid, float), config, seed, graph_method,
             class_bucket,
         )
-        loaded = Checkpoint(checkpoint_path).load()
+        loaded = load_validated(checkpoint_path, "grid_id", grid_id,
+                                "entropy grid")
         if loaded is not None:
             arrays, meta = loaded
-            if meta.get("grid_id") != grid_id:
-                raise ValueError(
-                    f"checkpoint at {checkpoint_path!r} is from a different "
-                    f"entropy grid run (meta {meta}); refusing to resume"
-                )
             start_di, start_rep = int(meta["deg_index"]), int(meta["rep"])
             for key, arr in grids.items():
                 if key in arrays:

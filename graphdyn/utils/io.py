@@ -117,6 +117,23 @@ def load_resume_prefix(ck: Checkpoint, expect: dict[str, Any]):
     return arrays, int(meta["next_rep"])
 
 
+def load_validated(path: str, id_key: str, id_value, what: str):
+    """Load a checkpoint and refuse it unless ``meta[id_key]`` equals the
+    caller's run identity — the shared load-or-refuse half of the λ-driver
+    resume protocol (``entropy_grid``, ``entropy_ensemble_union``). Returns
+    ``(arrays, meta)`` or None when no checkpoint exists."""
+    loaded = Checkpoint(path).load()
+    if loaded is None:
+        return None
+    arrays, meta = loaded
+    if meta.get(id_key) != id_value:
+        raise ValueError(
+            f"checkpoint at {path!r} is from a different {what} run "
+            f"(meta {meta}); refusing to resume"
+        )
+    return arrays, meta
+
+
 class ChainCheckpointer:
     """The chain-level exact-resume protocol shared by the solvers
     (``simulated_annealing``, ``sa_sharded``, ``hpr_solve``,

@@ -338,3 +338,48 @@ def test_union_ensemble_checkpointing(tmp_path):
         graphs, cfg, chi0=arrays["chi"], lambdas=np.array([0.2])
     )
     assert r2.lambdas.size == 1 and np.isfinite(r2.ent1).all()
+
+
+def test_union_ensemble_managed_resume_bit_exact(tmp_path, abort_after_save):
+    """checkpoint_path mode: an interrupted union-ensemble ladder resumes at
+    the first unvisited λ with the saved warm-start chi — identical results
+    to the uninterrupted run, surviving a double interruption; mismatched
+    runs refused."""
+    import os
+
+    from conftest import CheckpointAbort
+    from graphdyn.graphs import erdos_renyi_graph
+    from graphdyn.models.entropy import entropy_ensemble_union
+
+    graphs = [erdos_renyi_graph(40, 1.3 / 39, seed=k) for k in range(3)]
+    cfg = EntropyConfig(lmbd_max=0.4, lmbd_step=0.1)
+    kw = dict(seed=5, checkpoint_interval_s=0.0)
+    base = entropy_ensemble_union(graphs, cfg, seed=5)
+
+    p = str(tmp_path / "uck")
+    with abort_after_save(n=2):
+        with pytest.raises(CheckpointAbort):
+            entropy_ensemble_union(graphs, cfg, checkpoint_path=p, **kw)
+    assert os.path.exists(p + ".npz")
+    with abort_after_save(n=1):   # second interruption inside the continuation
+        with pytest.raises(CheckpointAbort):
+            entropy_ensemble_union(graphs, cfg, checkpoint_path=p, **kw)
+    resumed = entropy_ensemble_union(graphs, cfg, checkpoint_path=p, **kw)
+    np.testing.assert_array_equal(base.lambdas, resumed.lambdas)
+    np.testing.assert_array_equal(base.ent, resumed.ent)
+    np.testing.assert_array_equal(base.m_init, resumed.m_init)
+    np.testing.assert_array_equal(base.ent1, resumed.ent1)
+    assert base.nonconverged == resumed.nonconverged
+    assert not os.path.exists(p + ".npz")
+
+    # a different ensemble is refused
+    with abort_after_save(n=1):
+        with pytest.raises(CheckpointAbort):
+            entropy_ensemble_union(graphs, cfg, checkpoint_path=p, **kw)
+    with pytest.raises(ValueError, match="refusing to resume"):
+        entropy_ensemble_union(graphs[:2], cfg, checkpoint_path=p, **kw)
+    # both checkpoint modes at once is an error
+    from graphdyn.utils.io import PeriodicCheckpointer
+    with pytest.raises(ValueError, match="not both"):
+        entropy_ensemble_union(graphs, cfg, checkpoint_path=p,
+                               checkpointer=PeriodicCheckpointer(p), **kw)
