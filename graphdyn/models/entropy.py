@@ -447,6 +447,37 @@ def entropy_ensemble_union(
 
     if lambdas is None:
         lambdas = lambda_ladder(config)
+
+    # managed checkpoint_path mode: identity-validated λ-granular auto-resume.
+    # This precedes the all-edgeless shortcut so the contract (mutual
+    # exclusion, foreign-checkpoint refusal, removal on completion) holds on
+    # that path too.
+    prefix = None
+    managed = checkpoint_path is not None
+    extra_meta = {"seed": seed}
+    if managed:
+        if checkpointer is not None:
+            raise ValueError(
+                "pass either checkpoint_path (managed resume) or "
+                "checkpointer (caller-managed), not both"
+            )
+        from graphdyn.utils.io import (
+            PeriodicCheckpointer, load_validated, run_fingerprint,
+        )
+
+        union_id = run_fingerprint(
+            *[g.edges for g in graphs], [int(g.n) for g in graphs], config,
+            seed, np.asarray(lambdas, float), ent_floor_mode,
+            None if chi0 is None else np.asarray(chi0),
+        )
+        extra_meta["union_id"] = union_id
+        prefix = load_validated(
+            checkpoint_path, "union_id", union_id, "union-ensemble"
+        )
+        checkpointer = PeriodicCheckpointer(
+            checkpoint_path, interval_s=checkpoint_interval_s
+        )
+
     if gu.num_edges == 0:
         # every member is edgeless (all isolates): the analytic closed form
         # IS the whole answer — φ_g = −λ·n_iso/n, m_init = 1 per member
@@ -456,6 +487,8 @@ def entropy_ensemble_union(
         ent = -lam[:, None] * n_iso_a[None, :] / n_tot_a[None, :]
         m0 = np.broadcast_to(n_iso_a / n_tot_a, (lam.size, G)).copy()
         K = 2 ** (dyn.p + dyn.c)
+        if managed:
+            checkpointer.remove()
         return UnionEnsembleEntropyResult(
             lambdas=lam,
             ent=ent,
@@ -490,33 +523,6 @@ def entropy_ensemble_union(
             zi_fn(chi, lmbd), zij_fn(chi), mterm_fn(chi),
             lmbd, node_gid, edge_gid, n_iso_v, n_tot_v, G,
             eps_clamp=float(config.eps_clamp),
-        )
-
-    # managed checkpoint_path mode: identity-validated λ-granular auto-resume
-    prefix = None
-    managed = checkpoint_path is not None
-    extra_meta = {"seed": seed}
-    if managed:
-        if checkpointer is not None:
-            raise ValueError(
-                "pass either checkpoint_path (managed resume) or "
-                "checkpointer (caller-managed), not both"
-            )
-        from graphdyn.utils.io import (
-            PeriodicCheckpointer, load_validated, run_fingerprint,
-        )
-
-        union_id = run_fingerprint(
-            *[g.edges for g in graphs], [int(g.n) for g in graphs], config,
-            seed, np.asarray(lambdas, float), ent_floor_mode,
-            None if chi0 is None else np.asarray(chi0),
-        )
-        extra_meta["union_id"] = union_id
-        prefix = load_validated(
-            checkpoint_path, "union_id", union_id, "union-ensemble"
-        )
-        checkpointer = PeriodicCheckpointer(
-            checkpoint_path, interval_s=checkpoint_interval_s
         )
 
     lambdas = np.asarray(lambdas, float)

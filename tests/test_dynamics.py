@@ -179,3 +179,43 @@ def test_int8_gather_schedules_bit_identical(rng):
         b = batched_rollout(jnp.asarray(g.nbr), jnp.asarray(s), 6, rule,
                             "stay", gather="per_slot")
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_majority_stay_monotone(rng):
+    """Majority dynamics with stay ties is a monotone map: s <= s' pointwise
+    implies step(s) <= step(s') — the lattice property behind the
+    strategic-initialization search (raising any spin can only help reach
+    the +1 consensus)."""
+    from graphdyn.graphs import erdos_renyi_graph
+    from graphdyn.ops.dynamics import batched_rollout
+
+    g = erdos_renyi_graph(150, 4.0 / 149, seed=6)
+    import jax.numpy as jnp
+
+    for _ in range(5):
+        s_lo = rng.choice(np.array([-1, 1], dtype=np.int8), size=g.n)
+        raise_idx = rng.choice(g.n, size=g.n // 4, replace=False)
+        s_hi = s_lo.copy()
+        s_hi[raise_idx] = 1
+        out = np.asarray(batched_rollout(
+            jnp.asarray(g.nbr), jnp.asarray(np.stack([s_lo, s_hi])), 8
+        ))
+        assert np.all(out[0] <= out[1])
+
+
+def test_consensus_states_absorbing(rng):
+    """The homogeneous states are fixed points of majority/stay (all-+1 is
+    the target attractor, `SA_RRG.py:23-26`); under minority/change they are
+    NOT (checked so the test cannot pass vacuously)."""
+    from graphdyn.graphs import random_regular_graph
+    from graphdyn.ops.dynamics import run_dynamics
+
+    g = random_regular_graph(100, 3, seed=4)
+    for target in (1, -1):
+        s = np.full(g.n, target, np.int8)
+        out = np.asarray(run_dynamics(g, s, 5, "majority", "stay", backend="cpu"))
+        np.testing.assert_array_equal(out, s)
+        flipped = np.asarray(
+            run_dynamics(g, s, 1, "minority", "change", backend="cpu")
+        )
+        assert np.all(flipped == -s)
