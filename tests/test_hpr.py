@@ -207,3 +207,33 @@ def test_hpr_batch_checkpoint_resume_bit_exact(tmp_path, abort_after_save):
                             checkpoint_interval_s=0.0, chunk_sweeps=7)
     with pytest.raises(ValueError, match="refusing to resume"):
         hpr_solve_batch(g, cfg, n_replicas=5, seed=2, checkpoint_path=p2)
+
+
+def test_hpr_batch_mesh_checkpoint_resume(tmp_path, abort_after_save):
+    """Checkpointing composes with replica-mesh sharding: snapshots gather
+    the sharded state to host, and a resumed run re-places it on the mesh
+    with identical results (the config-2 preemption scenario)."""
+    import os
+
+    from conftest import CheckpointAbort
+    from graphdyn.models.hpr import hpr_solve_batch
+    from graphdyn.parallel.mesh import device_pool, make_mesh
+
+    g = random_regular_graph(30, 3, seed=1)
+    mesh = make_mesh((8,), ("replica",), devices=device_pool(8))
+    cfg = HPRConfig(max_sweeps=2000)
+    base = hpr_solve_batch(g, cfg, n_replicas=8, seed=0, mesh=mesh)
+
+    p = str(tmp_path / "hbm_ck")
+    with abort_after_save(n=1):
+        with pytest.raises(CheckpointAbort):
+            hpr_solve_batch(g, cfg, n_replicas=8, seed=0, mesh=mesh,
+                            checkpoint_path=p, checkpoint_interval_s=0.0,
+                            chunk_sweeps=5)
+    assert os.path.exists(p + ".npz")
+    resumed = hpr_solve_batch(g, cfg, n_replicas=8, seed=0, mesh=mesh,
+                              checkpoint_path=p, chunk_sweeps=50)
+    np.testing.assert_array_equal(base.s, resumed.s)
+    np.testing.assert_array_equal(base.num_steps, resumed.num_steps)
+    np.testing.assert_array_equal(base.m_final, resumed.m_final)
+    assert not os.path.exists(p + ".npz")
