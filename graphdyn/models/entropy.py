@@ -250,6 +250,93 @@ def entropy_sweep(
     )
 
 
+_LADDER_ROW_KEYS = ("lambdas", "ent", "m_init", "ent1", "sweeps")
+
+
+def _ladder_rows(out):
+    """Convert a :func:`_run_ladder` 7-tuple into ``(rows dict,
+    nonconverged, chi)`` — the one place that mapping lives."""
+    visited, ents, m_inits, ent1s, sweeps, nonconverged, chi = out
+    rows = dict(zip(
+        _LADDER_ROW_KEYS,
+        (np.array(visited), np.array(ents), np.array(m_inits),
+         np.array(ent1s), np.array(sweeps)),
+    ))
+    return rows, nonconverged, chi
+
+
+def _run_managed_ladder(
+    checkpoint_path,
+    interval_s,
+    *,
+    id_key,
+    id_value,
+    what,
+    lambdas,
+    stop_fn,
+    chi_init,
+    dtype,
+    ladder_fn,
+    base_meta,
+    extra_arrays=None,
+):
+    """The managed λ-ladder resume protocol shared by the ensemble entropy
+    solvers: identity-validated load (:func:`graphdyn.utils.io
+    .load_validated`), re-entry at the first unvisited λ with the saved
+    warm-start chi, prefix stitching that survives repeated interruptions
+    (snapshots carry the already-stitched earlier segments as ``prev_*``),
+    and removal on completion.
+
+    ``ladder_fn(lambdas_rest, chi, checkpointer, meta, extra_arrays)`` runs
+    the solver-specific :func:`_run_ladder` call and returns its 7-tuple;
+    ``chi_init()`` builds the cold-start messages. Returns ``(rows dict,
+    nonconverged, chi)`` with rows keyed by :data:`_LADDER_ROW_KEYS`.
+    """
+    from graphdyn.utils.io import PeriodicCheckpointer, load_validated
+
+    lambdas = np.asarray(lambdas, float)
+    prefix = load_validated(checkpoint_path, id_key, id_value, what)
+    checkpointer = PeriodicCheckpointer(checkpoint_path, interval_s=interval_s)
+    meta = {**base_meta, id_key: id_value}
+
+    k0 = 0
+    pre = None
+    if prefix is not None:
+        arrays, pmeta = prefix
+        chi = jnp.asarray(arrays["chi"], dtype)
+        seg = {k: np.asarray(arrays[k]) for k in _LADDER_ROW_KEYS}
+        if "prev_lambdas" in arrays:
+            # twice-interrupted: the snapshot carries the earlier stitched
+            # segments alongside the current one
+            pre = {
+                k: np.concatenate([np.asarray(arrays["prev_" + k]), seg[k]])
+                for k in seg
+            }
+        else:
+            pre = seg
+        k0 = int(pre["lambdas"].size)
+        failed_prev = bool(pmeta.get("failed", False))
+        if failed_prev or stop_fn(pre["ent1"][-1]) or k0 >= lambdas.size:
+            checkpointer.remove()
+            return pre, (float(pmeta["lmbd"]) if failed_prev else 0.0), chi
+    else:
+        chi = chi_init()
+
+    out = ladder_fn(
+        lambdas[k0:], chi, checkpointer, meta,
+        {
+            **(extra_arrays or {}),
+            **({f"prev_{k}": v for k, v in pre.items()} if pre is not None else {}),
+        },
+    )
+    checkpointer.remove()
+
+    rows, nonconverged, chi = _ladder_rows(out)
+    if pre is not None:
+        rows = {k: np.concatenate([pre[k], rows[k]]) for k in rows}
+    return rows, nonconverged, chi
+
+
 class EnsembleEntropyResult(NamedTuple):
     lambdas: np.ndarray    # ladder values visited [count]
     ent: np.ndarray        # φ [count, G]
@@ -269,6 +356,9 @@ def entropy_ensemble(
     seed: int = 0,
     lambdas: np.ndarray | None = None,
     ent_floor_mode: str = "all",
+    chi0=None,
+    checkpoint_path: str | None = None,
+    checkpoint_interval_s: float = 30.0,
 ) -> EnsembleEntropyResult:
     """The λ ladder over a *structurally congruent* graph ensemble (e.g.
     RRG(n, d) instances) as ONE vmapped device program — the BASELINE
@@ -281,6 +371,11 @@ def entropy_ensemble(
     ``all`` (default) or ``any`` instance crossing, per ``ent_floor_mode``.
     Isolated nodes are not supported here — use :func:`entropy_sweep`
     per-graph for ensembles with isolates.
+
+    ``chi0`` warm-starts from a previous result's ``chi``;
+    ``checkpoint_path`` enables the managed exact λ-granular auto-resume
+    shared with :func:`entropy_ensemble_union` (identity-validated restart,
+    prefix stitching across repeated interruptions, removal on completion).
     """
     from graphdyn.ops.bdcm import (
         EnsembleBDCM,
@@ -327,22 +422,49 @@ def entropy_ensemble(
 
     if lambdas is None:
         lambdas = lambda_ladder(config)
-    chi = ens.init_messages(seed)
 
-    visited, ents, m_inits, ent1s, sweeps, nonconverged, chi = _run_ladder(
-        lambdas, chi, ens.dtype,
-        set_leaves=set_leaves,
-        fixed_point=fixed_point,
-        observe=lambda c, lm: (phi_fn(c, lm), minit_fn(c)),
-        eps=config.eps,
-        stop_fn=stop_fn,
-    )
+    def chi_init():
+        return (
+            ens.init_messages(seed) if chi0 is None
+            else jnp.asarray(chi0, ens.dtype)
+        )
+
+    def ladder_fn(lam, chi, ck, meta, xtra):
+        return _run_ladder(
+            lam, chi, ens.dtype,
+            set_leaves=set_leaves,
+            fixed_point=fixed_point,
+            observe=lambda c, lm: (phi_fn(c, lm), minit_fn(c)),
+            eps=config.eps,
+            stop_fn=stop_fn,
+            checkpointer=ck,
+            checkpoint_meta=meta,
+            checkpoint_extra_arrays=xtra,
+        )
+
+    if checkpoint_path is not None:
+        from graphdyn.utils.io import run_fingerprint
+
+        ens_id = run_fingerprint(
+            *[g.edges for g in graphs], [int(g.n) for g in graphs], config,
+            seed, np.asarray(lambdas, float), ent_floor_mode,
+            None if chi0 is None else np.asarray(chi0),
+        )
+        rows, nonconverged, chi = _run_managed_ladder(
+            checkpoint_path, checkpoint_interval_s,
+            id_key="ens_id", id_value=ens_id, what="congruent-ensemble",
+            lambdas=lambdas, stop_fn=stop_fn, chi_init=chi_init,
+            dtype=ens.dtype, ladder_fn=ladder_fn, base_meta={"seed": seed},
+        )
+        return EnsembleEntropyResult(
+            **rows, nonconverged=nonconverged, chi=np.asarray(chi),
+        )
+
+    rows, nonconverged, chi = _ladder_rows(ladder_fn(
+        np.asarray(lambdas, float), chi_init(), None, None, None
+    ))
     return EnsembleEntropyResult(
-        lambdas=np.array(visited),
-        ent=np.array(ents),
-        m_init=np.array(m_inits),
-        ent1=np.array(ent1s),
-        sweeps=np.array(sweeps),
+        **rows,
         nonconverged=nonconverged,
         chi=np.asarray(chi),
     )
@@ -448,34 +570,24 @@ def entropy_ensemble_union(
     if lambdas is None:
         lambdas = lambda_ladder(config)
 
-    # managed checkpoint_path mode: identity-validated λ-granular auto-resume.
-    # This precedes the all-edgeless shortcut so the contract (mutual
-    # exclusion, foreign-checkpoint refusal, removal on completion) holds on
-    # that path too.
-    prefix = None
+    # managed checkpoint_path mode: identity-validated λ-granular auto-resume
+    # (the shared protocol, :func:`_run_managed_ladder`). Identity computed
+    # before the all-edgeless shortcut so the contract (mutual exclusion,
+    # foreign-checkpoint refusal, removal on completion) holds there too.
     managed = checkpoint_path is not None
-    extra_meta = {"seed": seed}
+    union_id = None
     if managed:
         if checkpointer is not None:
             raise ValueError(
                 "pass either checkpoint_path (managed resume) or "
                 "checkpointer (caller-managed), not both"
             )
-        from graphdyn.utils.io import (
-            PeriodicCheckpointer, load_validated, run_fingerprint,
-        )
+        from graphdyn.utils.io import run_fingerprint
 
         union_id = run_fingerprint(
             *[g.edges for g in graphs], [int(g.n) for g in graphs], config,
             seed, np.asarray(lambdas, float), ent_floor_mode,
             None if chi0 is None else np.asarray(chi0),
-        )
-        extra_meta["union_id"] = union_id
-        prefix = load_validated(
-            checkpoint_path, "union_id", union_id, "union-ensemble"
-        )
-        checkpointer = PeriodicCheckpointer(
-            checkpoint_path, interval_s=checkpoint_interval_s
         )
 
     if gu.num_edges == 0:
@@ -488,7 +600,11 @@ def entropy_ensemble_union(
         m0 = np.broadcast_to(n_iso_a / n_tot_a, (lam.size, G)).copy()
         K = 2 ** (dyn.p + dyn.c)
         if managed:
-            checkpointer.remove()
+            from graphdyn.utils.io import Checkpoint, load_validated
+
+            load_validated(checkpoint_path, "union_id", union_id,
+                           "union-ensemble")
+            Checkpoint(checkpoint_path).remove()
         return UnionEnsembleEntropyResult(
             lambdas=lam,
             ent=ent,
@@ -525,73 +641,41 @@ def entropy_ensemble_union(
             eps_clamp=float(config.eps_clamp),
         )
 
-    lambdas = np.asarray(lambdas, float)
-    k0 = 0
-    pre = None
-    if prefix is not None:
-        arrays, meta = prefix
-        chi = jnp.asarray(arrays["chi"], data.dtype)
-        seg = {
-            k: np.asarray(arrays[k])
-            for k in ("lambdas", "ent", "m_init", "ent1", "sweeps")
-        }
-        if "prev_lambdas" in arrays:
-            # twice-interrupted: the snapshot carries the earlier stitched
-            # segments alongside the current one
-            pre = {
-                k: np.concatenate([np.asarray(arrays["prev_" + k]), seg[k]])
-                for k in seg
-            }
-        else:
-            pre = seg
-        k0 = int(pre["lambdas"].size)
-        failed_prev = bool(meta.get("failed", False))
-        stopped = failed_prev or stop_fn(pre["ent1"][-1]) or k0 >= lambdas.size
-        if stopped:
-            if managed:
-                checkpointer.remove()
-            return UnionEnsembleEntropyResult(
-                lambdas=pre["lambdas"],
-                ent=pre["ent"],
-                m_init=pre["m_init"],
-                ent1=pre["ent1"],
-                sweeps=pre["sweeps"],
-                nonconverged=float(meta["lmbd"]) if failed_prev else 0.0,
-                chi=np.asarray(chi),
-                edge_gid=edge_gid_np,
-            )
-    else:
-        chi = data.init_messages(seed) if chi0 is None else jnp.asarray(
-            chi0, data.dtype
+    def chi_init():
+        return (
+            data.init_messages(seed) if chi0 is None
+            else jnp.asarray(chi0, data.dtype)
         )
 
-    visited, ents, m_inits, ent1s, sweeps, nonconverged, chi = _run_ladder(
-        lambdas[k0:], chi, data.dtype,
-        set_leaves=set_leaves,
-        fixed_point=fixed_point,
-        observe=observables,
-        eps=config.eps,
-        stop_fn=stop_fn,
-        checkpointer=checkpointer,
-        checkpoint_meta=extra_meta,
-        checkpoint_extra_arrays={
-            "edge_gid": edge_gid_np,
-            **({f"prev_{k}": v for k, v in pre.items()} if pre is not None else {}),
-        },
-    )
-    if managed:
-        checkpointer.remove()
+    def ladder_fn(lam, chi, ck, meta, xtra):
+        return _run_ladder(
+            lam, chi, data.dtype,
+            set_leaves=set_leaves,
+            fixed_point=fixed_point,
+            observe=observables,
+            eps=config.eps,
+            stop_fn=stop_fn,
+            checkpointer=ck,
+            checkpoint_meta=meta,
+            checkpoint_extra_arrays=xtra,
+        )
 
-    def stitch(prev_key, new_rows):
-        new = np.array(new_rows)
-        return np.concatenate([pre[prev_key], new]) if pre is not None else new
+    if managed:
+        rows, nonconverged, chi = _run_managed_ladder(
+            checkpoint_path, checkpoint_interval_s,
+            id_key="union_id", id_value=union_id, what="union-ensemble",
+            lambdas=lambdas, stop_fn=stop_fn, chi_init=chi_init,
+            dtype=data.dtype, ladder_fn=ladder_fn, base_meta={"seed": seed},
+            extra_arrays={"edge_gid": edge_gid_np},
+        )
+    else:
+        rows, nonconverged, chi = _ladder_rows(ladder_fn(
+            np.asarray(lambdas, float), chi_init(), checkpointer,
+            {"seed": seed}, {"edge_gid": edge_gid_np},
+        ))
 
     return UnionEnsembleEntropyResult(
-        lambdas=stitch("lambdas", visited),
-        ent=stitch("ent", ents),
-        m_init=stitch("m_init", m_inits),
-        ent1=stitch("ent1", ent1s),
-        sweeps=stitch("sweeps", sweeps),
+        **rows,
         nonconverged=nonconverged,
         chi=np.asarray(chi),
         edge_gid=edge_gid_np,

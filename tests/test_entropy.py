@@ -383,3 +383,40 @@ def test_union_ensemble_managed_resume_bit_exact(tmp_path, abort_after_save):
     with pytest.raises(ValueError, match="not both"):
         entropy_ensemble_union(graphs, cfg, checkpoint_path=p,
                                checkpointer=PeriodicCheckpointer(p), **kw)
+
+
+def test_congruent_ensemble_managed_resume_bit_exact(tmp_path, abort_after_save):
+    """checkpoint_path mode on the vmapped congruent-ensemble ladder mirrors
+    the union path: interrupted runs resume λ-granularly to identical
+    results; mismatched runs refused."""
+    import os
+
+    from conftest import CheckpointAbort
+    from graphdyn.graphs import random_regular_graph
+    from graphdyn.models.entropy import entropy_ensemble
+
+    graphs = [random_regular_graph(40, 3, seed=k) for k in range(3)]
+    cfg = EntropyConfig(lmbd_max=0.3, lmbd_step=0.1)
+    base = entropy_ensemble(graphs, cfg, seed=4)
+
+    p = str(tmp_path / "eck")
+    with abort_after_save(n=2):
+        with pytest.raises(CheckpointAbort):
+            entropy_ensemble(graphs, cfg, seed=4, checkpoint_path=p,
+                             checkpoint_interval_s=0.0)
+    assert os.path.exists(p + ".npz")
+    resumed = entropy_ensemble(graphs, cfg, seed=4, checkpoint_path=p,
+                               checkpoint_interval_s=0.0)
+    np.testing.assert_array_equal(base.lambdas, resumed.lambdas)
+    np.testing.assert_array_equal(base.ent, resumed.ent)
+    np.testing.assert_array_equal(base.ent1, resumed.ent1)
+    np.testing.assert_array_equal(base.sweeps, resumed.sweeps)
+    assert not os.path.exists(p + ".npz")
+
+    with abort_after_save(n=1):
+        with pytest.raises(CheckpointAbort):
+            entropy_ensemble(graphs, cfg, seed=4, checkpoint_path=p,
+                             checkpoint_interval_s=0.0)
+    with pytest.raises(ValueError, match="refusing to resume"):
+        entropy_ensemble(graphs, cfg, seed=99, checkpoint_path=p,
+                         checkpoint_interval_s=0.0)
