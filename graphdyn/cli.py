@@ -66,6 +66,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sa.add_argument("--checkpoint-interval", type=float, default=30.0)
     sa.add_argument(
+        "--rollout-mode", choices=["full", "lightcone"], default="full",
+        help="candidate evaluation: full graph re-roll (reference cost "
+             "structure) or O(ball) light-cone roll vs a cached trajectory "
+             "(bit-identical chains)",
+    )
+    sa.add_argument(
         "--sharded", action="store_true",
         help="run the multi-chip solver (replica x node mesh over all "
              "visible devices) instead of the per-repetition driver",
@@ -141,6 +147,12 @@ def main(argv=None) -> int:
             a_cap_frac=args.a_cap_frac, b_cap_frac=args.b_cap_frac,
         )
         if args.sharded:
+            if args.rollout_mode != "full":
+                raise SystemExit(
+                    "--rollout-mode lightcone is not supported with --sharded "
+                    "(the mesh solver evaluates candidates with the sharded "
+                    "full rollout); drop one of the flags"
+                )
             import jax
 
             from graphdyn.graphs import random_regular_graph
@@ -188,6 +200,7 @@ def main(argv=None) -> int:
             max_steps=args.max_steps, save_path=args.out, backend=args.backend,
             checkpoint_path=args.checkpoint,
             checkpoint_interval_s=args.checkpoint_interval,
+            rollout_mode=args.rollout_mode,
         )
         print(json.dumps({
             "solver": "sa",

@@ -62,6 +62,8 @@ class _SAState(NamedTuple):
     key: jnp.ndarray       # PRNG key per replica [R]
     chunk_t: jnp.ndarray   # int32[] — steps taken in the current chunk (see
     #                        `simulated_annealing(checkpoint_path=...)`)
+    traj: jnp.ndarray      # int8[R, T+1, n+1] cached trajectory (light-cone
+    #                        mode; empty [R, 0, 0] in full mode)
 
 
 def _batched_end_sum(nbr, s, steps: int, R_coef: int, C_coef: int):
@@ -123,11 +125,22 @@ def metropolis_anneal_update(
     return do, sum_end_new, a_new, b_new, t_new, m_final_new, active_new
 
 
-@partial(jax.jit, static_argnames=("rollout_steps", "R_coef", "C_coef"))
-def _sa_init(nbr, s0, key0, a0, b0, *, rollout_steps: int, R_coef: int, C_coef: int):
+@partial(
+    jax.jit,
+    static_argnames=("rollout_steps", "R_coef", "C_coef", "lightcone"),
+)
+def _sa_init(nbr, s0, key0, a0, b0, *, rollout_steps: int, R_coef: int,
+             C_coef: int, lightcone: bool = False):
     R, n = s0.shape
     dt = a0.dtype
-    sum_end0 = _batched_end_sum(nbr, s0, rollout_steps, R_coef, C_coef)
+    if lightcone:
+        from graphdyn.ops.lightcone import batched_trajectory
+
+        traj = batched_trajectory(nbr, s0, rollout_steps, R_coef, C_coef)
+        sum_end0 = traj[:, rollout_steps, :n].astype(jnp.int32).sum(axis=1)
+    else:
+        traj = jnp.zeros((R, 0, 0), jnp.int8)
+        sum_end0 = _batched_end_sum(nbr, s0, rollout_steps, R_coef, C_coef)
     m0 = sum_end0.astype(dt) / n
     return _SAState(
         s=s0,
@@ -139,6 +152,7 @@ def _sa_init(nbr, s0, key0, a0, b0, *, rollout_steps: int, R_coef: int, C_coef: 
         active=m0 < 1.0,
         key=key0,
         chunk_t=jnp.zeros((), jnp.int32),
+        traj=traj,
     )
 
 
@@ -166,13 +180,23 @@ def _sa_loop(
     injected: bool,
     stream_len: int,
     chunk_steps: int | None = None,
+    lc_tables=None,
 ):
     """Run the SA while-loop from ``state`` until every replica stops — or,
     with ``chunk_steps``, for at most that many more steps (the state is then
     a host-visible exact-resume point: re-entering with it continues the
-    chain bit-for-bit, since the loop body is step-index-driven)."""
+    chain bit-for-bit, since the loop body is step-index-driven).
+
+    With ``lc_tables`` (a :class:`graphdyn.ops.lightcone.LightconeTables`),
+    candidate flips are evaluated by rolling only the flip's light cone
+    against the cached trajectory in ``state.traj`` — O(ball) per step
+    instead of O(n·d) — with bit-identical chain decisions (integer
+    dynamics; tested)."""
     R, n = state.s.shape
     dt = state.a.dtype
+    lightcone = lc_tables is not None
+    if lightcone:
+        from graphdyn.ops.lightcone import lightcone_accept, lightcone_flip_delta
 
     def cond(st: _SAState):
         go = jnp.any(st.active)
@@ -187,8 +211,16 @@ def _sa_loop(
         )
         ridx = jnp.arange(R)
         s_i = st.s[ridx, i].astype(jnp.int32)
-        s_flip = st.s.at[ridx, i].set((-s_i).astype(jnp.int8))
-        sum_end_flip = _batched_end_sum(nbr, s_flip, rollout_steps, R_coef, C_coef)
+        if lightcone:
+            delta, vstack = lightcone_flip_delta(
+                lc_tables, st.traj, i, R_coef, C_coef, rollout_steps
+            )
+            sum_end_flip = st.sum_end + delta
+        else:
+            s_flip = st.s.at[ridx, i].set((-s_i).astype(jnp.int8))
+            sum_end_flip = _batched_end_sum(
+                nbr, s_flip, rollout_steps, R_coef, C_coef
+            )
 
         do, sum_end_new, a_new, b_new, t_new, m_final, active = (
             metropolis_anneal_update(
@@ -198,10 +230,15 @@ def _sa_loop(
                 max_steps=max_steps, n=n,
             )
         )
-        s_new = jnp.where(do[:, None], s_flip, st.s)
+        if lightcone:
+            traj_new = lightcone_accept(lc_tables, st.traj, i, vstack, do)
+            s_new = traj_new[:, 0, :n]
+        else:
+            traj_new = st.traj
+            s_new = jnp.where(do[:, None], s_flip, st.s)
         return _SAState(
             s_new, sum_end_new, a_new, b_new, t_new, m_final, active, st.key,
-            st.chunk_t + 1,
+            st.chunk_t + 1, traj_new,
         )
 
     return lax.while_loop(cond, body, state)
@@ -288,8 +325,24 @@ def simulated_annealing(
     checkpoint_path: str | None = None,
     checkpoint_interval_s: float = 30.0,
     chunk_steps: int = 100_000,
+    rollout_mode: str = "full",
+    lc_tables=None,
 ) -> SAResult:
     """Run batched SA chains.
+
+    ``rollout_mode``:
+
+    - ``"full"`` (default): every candidate flip re-rolls the whole graph
+      (the reference's cost structure, one rollout per step after the
+      3-to-1 fold).
+    - ``"lightcone"``: candidates roll only the flip's radius-``(p+c−1)``
+      ball against a cached trajectory (:mod:`graphdyn.ops.lightcone`) —
+      O(ball) ≈ O(d^(p+c−1)) per step instead of O(n·d), bit-identical
+      chain decisions (integer dynamics; parity-tested). Host-side table
+      build is O(n·ball); best for the reference regimes n ≲ 1e5. Pass
+      ``lc_tables`` (from :func:`graphdyn.ops.lightcone
+      .build_lightcone_tables`) to amortize the build across calls on the
+      same graph.
 
     ``a0``/``b0`` may be per-replica arrays — that is the temperature-ladder
     axis of BASELINE.json config 5. ``proposals``/``uniforms`` (``[R, L]``)
@@ -319,11 +372,21 @@ def simulated_annealing(
     (R, seed, s0, a0, b0, proposals, uniforms,
      max_steps, stream_len, injected) = prep
 
+    if rollout_mode not in ("full", "lightcone"):
+        raise ValueError(
+            f"rollout_mode must be 'full' or 'lightcone', got {rollout_mode!r}"
+        )
     if backend == "cpu":
         if checkpoint_path is not None:
             raise ValueError(
                 "checkpoint_path requires the jax backend (the numpy oracle "
                 "has no chunked resume); drop --checkpoint or use backend='jax'"
+            )
+        if rollout_mode != "full":
+            raise ValueError(
+                "rollout_mode='lightcone' is a device-path optimization; the "
+                "numpy oracle always evaluates candidates with the full "
+                "rollout (chains are bit-identical either way)"
             )
         np_scalar = np.float32 if dtype == jnp.float32 else np.float64
         return _sa_reference_numpy(
@@ -334,6 +397,14 @@ def simulated_annealing(
     np_dt = np.float32 if dtype == jnp.float32 else np.float64
     nbr = jnp.asarray(graph.nbr)
     keys = jax.vmap(jax.random.PRNGKey)(np.arange(R, dtype=np.uint32) + np.uint32(seed))
+
+    if rollout_mode == "lightcone":
+        from graphdyn.ops.lightcone import batched_trajectory, build_lightcone_tables
+
+        if lc_tables is None:
+            lc_tables = build_lightcone_tables(graph, rollout)
+    else:
+        lc_tables = None
 
     ckpt = None
     state = None
@@ -354,8 +425,14 @@ def simulated_annealing(
         )
         arrays = ckpt.load_state(check=lambda a: a["s"].shape == (R, n))
         if arrays is not None:
+            s_res = jnp.asarray(arrays["s"])
+            # traj is a pure function of s — recomputed, never persisted
+            traj_res = (
+                batched_trajectory(nbr, s_res, rollout, R_coef, C_coef)
+                if lc_tables is not None else jnp.zeros((R, 0, 0), jnp.int8)
+            )
             state = _SAState(
-                s=jnp.asarray(arrays["s"]),
+                s=s_res,
                 sum_end=jnp.asarray(arrays["sum_end"]),
                 a=jnp.asarray(arrays["a"].astype(np_dt)),
                 b=jnp.asarray(arrays["b"].astype(np_dt)),
@@ -364,6 +441,7 @@ def simulated_annealing(
                 active=jnp.asarray(arrays["active"]),
                 key=jnp.asarray(arrays["key"]),
                 chunk_t=jnp.zeros((), jnp.int32),
+                traj=traj_res,
             )
 
     if state is None:
@@ -371,11 +449,13 @@ def simulated_annealing(
             nbr, jnp.asarray(s0), keys,
             jnp.asarray(a0.astype(np_dt)), jnp.asarray(b0.astype(np_dt)),
             rollout_steps=rollout, R_coef=R_coef, C_coef=C_coef,
+            lightcone=lc_tables is not None,
         )
 
     loop_kwargs = dict(
         rollout_steps=rollout, R_coef=R_coef, C_coef=C_coef,
         max_steps=int(max_steps), injected=injected, stream_len=stream_len,
+        lc_tables=lc_tables,
     )
     loop_args = (
         jnp.asarray(np_dt(config.par_a)),
@@ -397,7 +477,8 @@ def simulated_annealing(
             active=lambda st: bool(jnp.any(st.active)),
             payload=lambda st: {
                 k: np.asarray(v)
-                for k, v in st._asdict().items() if k != "chunk_t"
+                for k, v in st._asdict().items()
+                if k not in ("chunk_t", "traj")   # traj: derived, recomputed
             },
         )
 
@@ -470,6 +551,7 @@ def sa_ensemble(
     backend: str = "jax_tpu",
     checkpoint_path: str | None = None,
     checkpoint_interval_s: float = 30.0,
+    rollout_mode: str = "full",
 ) -> SAEnsembleResult:
     """The reference's experiment driver (`SA_RRG.py:58-92`): ``n_stat``
     repetitions, each on a freshly sampled RRG(n, d). Each repetition runs as
@@ -516,6 +598,7 @@ def sa_ensemble(
             max_steps=max_steps, backend=backend,
             checkpoint_path=chain_ckpt,
             checkpoint_interval_s=checkpoint_interval_s,
+            rollout_mode=rollout_mode if backend != "cpu" else "full",
         )
         mag[k] = res.mag_reached[0]
         steps[k] = res.num_steps[0]

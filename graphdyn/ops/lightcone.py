@@ -1,0 +1,166 @@
+"""Light-cone SA proposal evaluation — O(ball) instead of O(n) per flip.
+
+The reference evaluates every Metropolis candidate by re-rolling the FULL
+graph for ``p+c−1`` synchronous steps (`SA_RRG.py:32-37`: two rollouts per
+``E_delta``, a third for the stop test — SURVEY.md §3.1 calls this the
+single biggest performance lever). But synchronous dynamics has a finite
+propagation speed of one hop per step: flipping spin i at t=0 can only
+change the trajectory inside the radius-t ball around i ("light cone"), so
+after ``R = p+c−1`` steps the end-state delta lives entirely inside
+``B_R(i)`` — ~``1 + d·((d−1)^R − 1)/(d−2)`` nodes on a d-regular graph
+(53 at d=4, R=3) versus n = 10⁴..10⁶ for the full rollout.
+
+Mechanism: the solver carries the full cached trajectory ``S[t], t=0..R``
+of the *current* configuration. A candidate flip rolls only the ball,
+gathering neighbor values from the updated ball slots when the neighbor is
+inside the ball and from the cached trajectory when outside (nodes at
+distance > t are provably unchanged at step t). The end-sum delta is the
+masked sum of (new − cached) over the ball; an accepted flip scatters the
+ball columns back into the cache. All arithmetic is small-integer exact, so
+the chain is bit-identical to the full-rollout solver (tested under
+injected common-random-number streams).
+
+Tables are host-precomputed per graph (`build_lightcone_tables`):
+``ball[n, B]`` (BFS-ordered ball node ids, self at slot 0, padded with the
+ghost id n), ``nbr_slot[n, B, dmax]`` (each ball node's neighbors as ball
+slots, −1 when outside), ``nbr_glob[n, B, dmax]`` (the same neighbors as
+global ids for the cached gather; ghost-padded with n). The trajectory
+cache stores an extra ghost column that is always 0, so ghost gathers are
+neutral and ghost scatters are no-ops.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class LightconeTables(NamedTuple):
+    ball: jnp.ndarray       # int32[n, B] — ball node ids, self at slot 0
+    nbr_slot: jnp.ndarray   # int32[n, B, dmax] — ball slot of each neighbor, -1 outside
+    nbr_glob: jnp.ndarray   # int32[n, B, dmax] — global id of each neighbor (n = ghost)
+    radius: int
+    ball_max: int
+
+
+def build_lightcone_tables(graph, radius: int) -> LightconeTables:
+    """Host-side BFS ball tables for every node. O(n · ball) time/memory —
+    intended for the SA regimes (n ≲ 1e5); the full-rollout mode remains
+    for giant graphs where n·B tables would dominate HBM."""
+    n = graph.n
+    nbr = np.asarray(graph.nbr)
+    dmax = nbr.shape[1]
+    balls = []
+    for i in range(n):
+        dist = {i: 0}
+        order = [i]
+        frontier = [i]
+        for t in range(1, radius + 1):
+            nxt = []
+            for j in frontier:
+                for k in nbr[j]:
+                    if k < n and k not in dist:
+                        dist[int(k)] = t
+                        nxt.append(int(k))
+            order.extend(sorted(nxt))
+            frontier = nxt
+        balls.append(order)
+    B = max(len(b) for b in balls)
+
+    ball = np.full((n, B), n, np.int32)
+    nbr_slot = np.full((n, B, dmax), -1, np.int32)
+    nbr_glob = np.full((n, B, dmax), n, np.int32)
+    for i, order in enumerate(balls):
+        ball[i, : len(order)] = order
+        slot_of = {j: s for s, j in enumerate(order)}
+        for s, j in enumerate(order):
+            for a, k in enumerate(nbr[j]):
+                nbr_glob[i, s, a] = k
+                if int(k) in slot_of:
+                    nbr_slot[i, s, a] = slot_of[int(k)]
+    return LightconeTables(
+        ball=jnp.asarray(ball),
+        nbr_slot=jnp.asarray(nbr_slot),
+        nbr_glob=jnp.asarray(nbr_glob),
+        radius=radius,
+        ball_max=B,
+    )
+
+
+def batched_trajectory(nbr, s, steps: int, R_coef: int, C_coef: int):
+    """Full trajectory cache ``int8[R, steps+1, n+1]`` (ghost column 0) of
+    the batched rollout — the light-cone solver's carried state. Same
+    per-step arithmetic as :func:`graphdyn.ops.dynamics
+    .batched_rollout_impl`."""
+    from graphdyn.ops.dynamics import batched_rollout_impl
+
+    Rr, n = s.shape
+    frames = [s]
+    cur = s
+    for _ in range(steps):
+        cur = batched_rollout_impl(nbr, cur, 1, R_coef, C_coef)
+        frames.append(cur)
+    traj = jnp.stack(frames, axis=1)                         # [R, T+1, n]
+    ghost = jnp.zeros((Rr, steps + 1, 1), s.dtype)
+    return jnp.concatenate([traj, ghost], axis=2)            # [R, T+1, n+1]
+
+
+@partial(jax.jit, static_argnames=("R_coef", "C_coef", "radius"))
+def lightcone_flip_delta(tables: LightconeTables, traj, i,
+                         R_coef: int, C_coef: int, radius: int):
+    """Per-replica candidate evaluation: roll only the ball of each
+    replica's proposal ``i`` against its cached trajectory.
+
+    ``traj: int8[R, T+1, n+1]``, ``i: int32[R]``. Returns
+    ``(delta int32[R], vstack int8[R, T+1, B])`` where ``vstack`` holds the
+    flipped-ball trajectory for the accept-time scatter (slot 0 is i)."""
+    n = traj.shape[2] - 1
+
+    def one(traj_r, i_r):
+        ball = tables.ball[i_r]                      # [B]
+        slots = tables.nbr_slot[i_r]                 # [B, d]
+        globs = tables.nbr_glob[i_r]                 # [B, d]
+        mask = ball < n                              # [B]
+        v = traj_r[0][ball].astype(jnp.int32) * mask # padded slots -> 0
+        v = v.at[0].set(-v[0])                       # the candidate flip
+        frames = [v]
+        for t in range(radius):
+            cache_t = traj_r[t].astype(jnp.int32)    # [n+1], ghost col = 0
+            inside = slots >= 0
+            nbvals = jnp.where(
+                inside,
+                v[jnp.clip(slots, 0)],
+                cache_t[globs],
+            )                                        # [B, d]
+            sums = nbvals.sum(axis=1)
+            v = jnp.where(
+                mask, R_coef * jnp.sign(2 * sums + C_coef * v), 0
+            )
+            frames.append(v)
+        end_cached = traj_r[radius][ball].astype(jnp.int32) * mask
+        delta = jnp.where(mask, frames[-1] - end_cached, 0).sum()
+        return delta.astype(jnp.int32), jnp.stack(frames).astype(jnp.int8)
+
+    return jax.vmap(one)(traj, i)
+
+
+@jax.jit
+def lightcone_accept(tables: LightconeTables, traj, i, vstack, do):
+    """Scatter accepted flips' ball trajectories into the cache.
+
+    ``do: bool[R]`` masks accepted replicas; rejected replicas keep their
+    cache untouched. Ghost ball slots scatter 0 into the ghost column — a
+    no-op by the ghost invariant."""
+
+    def one(traj_r, i_r, v_r, do_r):
+        ball = tables.ball[i_r]                      # [B]
+        cur = jnp.swapaxes(traj_r[:, ball], 0, 1)    # [B, T+1]
+        new = jnp.where(do_r, jnp.swapaxes(v_r, 0, 1), cur)
+        return traj_r.at[:, ball].set(jnp.swapaxes(new, 0, 1))
+
+    return jax.vmap(one)(traj, i, vstack, do)

@@ -239,3 +239,56 @@ def test_sa_ensemble_driver_resume(tmp_path, abort_after_save):
                                                           "next_rep": 1})
     with pytest.raises(ValueError, match="different"):
         sa_ensemble(30, 3, cfg, checkpoint_path=p, **kw)
+
+
+def test_lightcone_bit_parity_with_full():
+    """Light-cone candidate evaluation (O(ball) per step) is bit-identical
+    to the full-rollout solver under injected common-random-number streams —
+    spins, step counts, sentinels — on RRG and ragged ER graphs."""
+    from graphdyn.graphs import erdos_renyi_graph
+
+    for gname, g in [
+        ("rrg", random_regular_graph(60, 3, seed=5)),
+        ("er", erdos_renyi_graph(70, 3.0 / 69, seed=8)),   # ragged + isolates
+    ]:
+        rng = np.random.default_rng(11)
+        R, L = 3, 3000
+        s0 = (2 * rng.integers(0, 2, size=(R, g.n)) - 1).astype(np.int8)
+        proposals = rng.integers(0, g.n, size=(R, L)).astype(np.int32)
+        uniforms = rng.random(size=(R, L))
+        for p, c in [(1, 1), (3, 1), (2, 2)]:
+            cfg = SAConfig(dynamics=DynamicsConfig(p=p, c=c))
+            kw = dict(s0=s0, proposals=proposals, uniforms=uniforms,
+                      backend="jax")
+            full = simulated_annealing(g, cfg, rollout_mode="full", **kw)
+            lc = simulated_annealing(g, cfg, rollout_mode="lightcone", **kw)
+            np.testing.assert_array_equal(full.s, lc.s, err_msg=f"{gname} p={p} c={c}")
+            np.testing.assert_array_equal(full.num_steps, lc.num_steps)
+            np.testing.assert_array_equal(full.m_final, lc.m_final)
+            np.testing.assert_array_equal(full.mag_reached, lc.mag_reached)
+
+
+def test_lightcone_checkpoint_resume(tmp_path, abort_after_save):
+    """Light-cone mode composes with exact resume: the trajectory cache is
+    derived state, recomputed on restore, and the chain continues
+    bit-for-bit."""
+    import os
+
+    from conftest import CheckpointAbort
+
+    cfg = SAConfig(dynamics=DynamicsConfig(p=2, c=1))
+    g, s0, proposals, uniforms = _small_setup(n=50, R=3, L=4000, seed=13)
+    kw = dict(s0=s0, proposals=proposals, uniforms=uniforms, backend="jax",
+              rollout_mode="lightcone")
+    base = simulated_annealing(g, cfg, **kw)
+
+    p = str(tmp_path / "lc_ck")
+    with abort_after_save(n=1):
+        with pytest.raises(CheckpointAbort):
+            simulated_annealing(g, cfg, checkpoint_path=p,
+                                checkpoint_interval_s=0.0, chunk_steps=40, **kw)
+    assert os.path.exists(p + ".npz")
+    resumed = simulated_annealing(g, cfg, checkpoint_path=p, chunk_steps=64, **kw)
+    np.testing.assert_array_equal(base.s, resumed.s)
+    np.testing.assert_array_equal(base.num_steps, resumed.num_steps)
+    np.testing.assert_array_equal(base.m_final, resumed.m_final)
