@@ -62,6 +62,9 @@ def run(n, R, steps):
         rate,
         "mcmc-steps/s",
         vs_baseline=cpu / dev,
+        # r01/r02 recorded this metric cold (jit compile inside the timed
+        # region); flagged so cross-round diffs don't misread the change
+        timing="steady_state",
     )
 
     # light-cone candidate evaluation (O(ball) per step vs O(n·d); chains
@@ -77,6 +80,7 @@ def run(n, R, steps):
         "mcmc-steps/s",
         vs_baseline=cpu / lc,
         vs_full_rollout=dev / lc,
+        timing="steady_state",
     )
 
 

@@ -403,6 +403,17 @@ def simulated_annealing(
 
         if lc_tables is None:
             lc_tables = build_lightcone_tables(graph, rollout)
+        elif (
+            lc_tables.radius != rollout
+            or lc_tables.ball.shape[0] != n
+        ):
+            # a mismatched table would make the chain silently diverge (JAX
+            # gathers clamp instead of erroring) — refuse up front
+            raise ValueError(
+                f"lc_tables were built for radius={lc_tables.radius}, "
+                f"n={lc_tables.ball.shape[0]}; this run needs radius="
+                f"{rollout} (p+c-1), n={n}"
+            )
     else:
         lc_tables = None
 
@@ -598,7 +609,7 @@ def sa_ensemble(
             max_steps=max_steps, backend=backend,
             checkpoint_path=chain_ckpt,
             checkpoint_interval_s=checkpoint_interval_s,
-            rollout_mode=rollout_mode if backend != "cpu" else "full",
+            rollout_mode=rollout_mode,  # cpu+lightcone raises there, loudly
         )
         mag[k] = res.mag_reached[0]
         steps[k] = res.num_steps[0]
