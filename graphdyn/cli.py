@@ -132,6 +132,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--plot", default=None, metavar="PNG",
         help="render the s(m_init) curve family (one per degree) to this file",
     )
+    ent.add_argument(
+        "--union", type=int, default=None, metavar="G",
+        help="instead of the deg x rep grid, run each degree as ONE "
+             "disjoint-union device program over G ER instances "
+             "(entropy_ensemble_union — per-member phi/m_init via segment "
+             "sums); npz keys gain a member axis",
+    )
 
     return ap
 
@@ -253,6 +260,71 @@ def main(argv=None) -> int:
             ent_floor=args.ent_floor, num_rep=args.num_rep,
             dtype=args.dtype,
         )
+        if args.union is not None:
+            from graphdyn.graphs import erdos_renyi_graph
+            from graphdyn.models.entropy import entropy_ensemble_union
+            from graphdyn.utils.io import save_results_npz
+
+            per_deg = []                       # indexed by degree position
+            for di, deg in enumerate(args.deg):
+                graphs = [
+                    erdos_renyi_graph(
+                        args.n, deg / (args.n - 1),
+                        seed=args.seed + 1000 * di + k,
+                    )
+                    for k in range(args.union)
+                ]
+                ck = (
+                    f"{args.checkpoint}_deg{di}" if args.checkpoint else None
+                )
+                per_deg.append(entropy_ensemble_union(
+                    graphs, cfg, seed=args.seed + 1000 * di,
+                    checkpoint_path=ck,
+                    checkpoint_interval_s=args.checkpoint_interval,
+                ))
+            if args.out:
+                save_results_npz(
+                    args.out,
+                    deg=np.asarray(args.deg),
+                    **{
+                        f"{k}_deg{di}": getattr(per_deg[di], k)
+                        for di in range(len(args.deg))
+                        for k in ("lambdas", "ent", "m_init", "ent1", "sweeps")
+                    },
+                )
+            if args.plot:
+                from types import SimpleNamespace
+
+                from graphdyn.plotting import plot_entropy_curve
+
+                ax = None
+                for di, deg in enumerate(args.deg):
+                    r = per_deg[di]
+                    finite = np.isfinite(r.ent1) & np.isfinite(r.m_init)
+                    cnt = np.maximum(finite.sum(axis=1), 1)   # member mean
+                    mean = SimpleNamespace(
+                        m_init=np.where(finite, r.m_init, 0).sum(axis=1) / cnt,
+                        ent1=np.where(finite, r.ent1, 0).sum(axis=1) / cnt,
+                    )
+                    ax = plot_entropy_curve(mean, ax=ax, label=f"deg={deg:g}")
+                ax.figure.tight_layout()
+                ax.figure.savefig(args.plot)
+            print(json.dumps({
+                "solver": "entropy_union",
+                "deg": list(args.deg),
+                "members": args.union,
+                "ent1_first_lambda": {
+                    str(deg): per_deg[di].ent1[0].tolist()
+                    for di, deg in enumerate(args.deg)
+                },
+                "nonconverged": {
+                    str(deg): per_deg[di].nonconverged
+                    for di, deg in enumerate(args.deg)
+                },
+                "out": args.out,
+                "plot": args.plot,
+            }))
+            return 0
         out = entropy_grid(
             args.n, np.asarray(args.deg), cfg, seed=args.seed,
             verbose=args.verbose, save_path=args.out,

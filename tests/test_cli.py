@@ -79,3 +79,25 @@ def test_cli_entropy_dtype_f64(tmp_path, capsys):
 
     line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert line["solver"] == "entropy"
+
+
+def test_cli_entropy_union(tmp_path, capsys):
+    """`entropy --union G` runs each degree as one disjoint-union program
+    and persists per-degree member-axis grids."""
+    import json
+
+    from graphdyn.cli import main
+    from graphdyn.utils.io import load_results_npz
+
+    p = str(tmp_path / "union.npz")
+    rc = main([
+        "entropy", "--n", "50", "--deg", "1.0", "1.4", "--union", "3",
+        "--lmbd-max", "0.2", "--out", p,
+    ])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["solver"] == "entropy_union"
+    assert len(doc["ent1_first_lambda"]["1.0"]) == 3      # member axis
+    saved = load_results_npz(p)
+    assert saved["ent1_deg0"].shape[1] == 3
+    assert saved["ent1_deg1"].shape[1] == 3
