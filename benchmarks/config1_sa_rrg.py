@@ -21,28 +21,35 @@ from graphdyn.graphs import random_regular_graph
 from graphdyn.models.sa import simulated_annealing
 
 
-def run(n, R, steps):
+def _setup(n, R, steps):
+    """Shared graph + config + injected-stream setup (seed 0)."""
     g = random_regular_graph(n, 3, seed=0)
     cfg = SAConfig(dynamics=DynamicsConfig(p=3, c=1))
     rng = np.random.default_rng(0)
     s0 = (2 * rng.integers(0, 2, size=(R, n)) - 1).astype(np.int8)
     proposals = rng.integers(0, n, size=(R, steps)).astype(np.int32)
     uniforms = rng.random(size=(R, steps))
+    return g, cfg, s0, proposals, uniforms
 
-    def timed_steady(**kw):
-        """Run twice with identical inputs (deterministic chains) and time
-        the second call — jit compile and any host-side table build land in
-        the warm-up, so the metric measures per-step throughput."""
-        simulated_annealing(
-            g, cfg, s0=s0, proposals=proposals, uniforms=uniforms,
-            max_steps=steps - 1, backend="jax_tpu", **kw,
-        )
+
+def _timed_steady(g, cfg, s0, proposals, uniforms, steps, **kw):
+    """Run twice with identical inputs (deterministic chains) and time the
+    second call — jit compile and any host-side table build land in the
+    warm-up, so the metric measures per-step throughput."""
+    for _ in range(2):
         t0 = time.perf_counter()
         simulated_annealing(
             g, cfg, s0=s0, proposals=proposals, uniforms=uniforms,
             max_steps=steps - 1, backend="jax_tpu", **kw,
         )
-        return time.perf_counter() - t0
+    return time.perf_counter() - t0
+
+
+def run(n, R, steps):
+    g, cfg, s0, proposals, uniforms = _setup(n, R, steps)
+
+    def timed_steady(**kw):
+        return _timed_steady(g, cfg, s0, proposals, uniforms, steps, **kw)
 
     # device path (one candidate rollout per step)
     dev = timed_steady()
@@ -84,8 +91,32 @@ def run(n, R, steps):
     )
 
 
+def run_lightcone_scaling(n, R, steps):
+    """One extra shape at 10× the BASELINE n, light-cone only: per-step work
+    is O(ball), so the rate should hold roughly flat while the full rollout
+    scales O(n) — the measured form of the scaling claim (see the known
+    CPU-backend accept-scatter ceiling in graphdyn/ops/lightcone.py)."""
+    from graphdyn.ops.lightcone import build_lightcone_tables
+
+    g, cfg, s0, proposals, uniforms = _setup(n, R, steps)
+    tables = build_lightcone_tables(g, cfg.dynamics.p + cfg.dynamics.c - 1)
+    lc = _timed_steady(
+        g, cfg, s0, proposals, uniforms, steps,
+        rollout_mode="lightcone", lc_tables=tables,
+    )
+    report(
+        "sa_mcmc_steps_per_sec_lightcone_n%d_r%d" % (n, R),
+        R * steps / lc,
+        "mcmc-steps/s",
+        timing="steady_state",
+    )
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--full", action="store_true")
     a = ap.parse_args()
     run(10_000 if a.full else 2000, 32, 2000 if a.full else 400)
+    run_lightcone_scaling(
+        100_000 if a.full else 20_000, 32, 1000 if a.full else 200
+    )

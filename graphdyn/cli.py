@@ -281,6 +281,7 @@ def main(argv=None) -> int:
                     graphs, cfg, seed=args.seed + 1000 * di,
                     checkpoint_path=ck,
                     checkpoint_interval_s=args.checkpoint_interval,
+                    verbose=args.verbose,
                 ))
             if args.out:
                 save_results_npz(
@@ -301,10 +302,18 @@ def main(argv=None) -> int:
                 for di, deg in enumerate(args.deg):
                     r = per_deg[di]
                     finite = np.isfinite(r.ent1) & np.isfinite(r.m_init)
-                    cnt = np.maximum(finite.sum(axis=1), 1)   # member mean
+                    cnt = finite.sum(axis=1)                  # member mean;
+                    none = cnt == 0                           # all-degraded λ
+                    cnt = np.maximum(cnt, 1)                  # rows -> NaN
                     mean = SimpleNamespace(
-                        m_init=np.where(finite, r.m_init, 0).sum(axis=1) / cnt,
-                        ent1=np.where(finite, r.ent1, 0).sum(axis=1) / cnt,
+                        m_init=np.where(
+                            none, np.nan,
+                            np.where(finite, r.m_init, 0).sum(axis=1) / cnt,
+                        ),
+                        ent1=np.where(
+                            none, np.nan,
+                            np.where(finite, r.ent1, 0).sum(axis=1) / cnt,
+                        ),
                     )
                     ax = plot_entropy_curve(mean, ax=ax, label=f"deg={deg:g}")
                 ax.figure.tight_layout()

@@ -149,7 +149,9 @@ def _run_ladder(
         if failed:
             nonconverged = float(lmbd)
         if verbose:
-            print(f"lambda={lmbd:.2f} t={t} m_init={m0:.5f} ent1={e1:.5f}")
+            m_s = f"{m0:.5f}" if np.ndim(m0) == 0 else f"{np.mean(m0):.5f}(mean)"
+            e_s = f"{e1:.5f}" if np.ndim(e1) == 0 else f"{np.mean(e1):.5f}(mean)"
+            print(f"lambda={lmbd:.2f} t={t} m_init={m_s} ent1={e_s}")
         if checkpointer is not None and checkpointer.due():
             checkpointer.maybe_save(
                 {
@@ -524,6 +526,7 @@ def entropy_ensemble_union(
     checkpointer=None,
     checkpoint_path: str | None = None,
     checkpoint_interval_s: float = 30.0,
+    verbose: bool = False,
 ) -> UnionEnsembleEntropyResult:
     """The λ ladder over an ARBITRARY graph ensemble as one device program,
     via the disjoint union (:func:`graphdyn.graphs.disjoint_union`).
@@ -658,6 +661,7 @@ def entropy_ensemble_union(
             checkpointer=ck,
             checkpoint_meta=meta,
             checkpoint_extra_arrays=xtra,
+            verbose=verbose,
         )
 
     if managed:

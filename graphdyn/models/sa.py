@@ -62,8 +62,9 @@ class _SAState(NamedTuple):
     key: jnp.ndarray       # PRNG key per replica [R]
     chunk_t: jnp.ndarray   # int32[] — steps taken in the current chunk (see
     #                        `simulated_annealing(checkpoint_path=...)`)
-    traj: jnp.ndarray      # int8[R, T+1, n+1] cached trajectory (light-cone
-    #                        mode; empty [R, 0, 0] in full mode)
+    traj: jnp.ndarray      # int8[R, T+1, n+2] cached trajectory + ghost and
+    #                        trash columns (light-cone mode; [R, 0, 0] in
+    #                        full mode)
 
 
 def _batched_end_sum(nbr, s, steps: int, R_coef: int, C_coef: int):
@@ -210,13 +211,18 @@ def _sa_loop(
             injected=injected, stream_len=stream_len, n=n, dt=dt,
         )
         ridx = jnp.arange(R)
-        s_i = st.s[ridx, i].astype(jnp.int32)
         if lightcone:
+            # st.s is carried UNCHANGED (stale after the first accept): a
+            # live [R, n] spin copy per step would defeat the O(ball)
+            # design, so current spins live in traj[:, 0]; readers go
+            # through current_s() in simulated_annealing
+            s_i = st.traj[ridx, 0, i].astype(jnp.int32)
             delta, vstack = lightcone_flip_delta(
                 lc_tables, st.traj, i, R_coef, C_coef, rollout_steps
             )
             sum_end_flip = st.sum_end + delta
         else:
+            s_i = st.s[ridx, i].astype(jnp.int32)
             s_flip = st.s.at[ridx, i].set((-s_i).astype(jnp.int8))
             sum_end_flip = _batched_end_sum(
                 nbr, s_flip, rollout_steps, R_coef, C_coef
@@ -232,7 +238,7 @@ def _sa_loop(
         )
         if lightcone:
             traj_new = lightcone_accept(lc_tables, st.traj, i, vstack, do)
-            s_new = traj_new[:, 0, :n]
+            s_new = st.s                              # stays the placeholder
         else:
             traj_new = st.traj
             s_new = jnp.where(do[:, None], s_flip, st.s)
@@ -484,6 +490,20 @@ def simulated_annealing(
         jnp.asarray(proposals),
         jnp.asarray(uniforms.astype(np_dt)),
     )
+    def current_s(st):
+        """In light-cone mode the carried ``s`` is loop-invariant (spins
+        live in traj[:, 0] to avoid an O(R·n) copy per step)."""
+        return st.traj[:, 0, :n] if lc_tables is not None else st.s
+
+    def payload(st):
+        out = {
+            k: np.asarray(v)
+            for k, v in st._asdict().items()
+            if k not in ("chunk_t", "traj", "s")  # traj: derived, recomputed
+        }
+        out["s"] = np.asarray(current_s(st))
+        return out
+
     if ckpt is None:
         state = _sa_loop(nbr, state, *loop_args, **loop_kwargs)
     else:
@@ -494,16 +514,13 @@ def simulated_annealing(
                 *loop_args, chunk_steps=int(chunk_steps), **loop_kwargs,
             ),
             active=lambda st: bool(jnp.any(st.active)),
-            payload=lambda st: {
-                k: np.asarray(v)
-                for k, v in st._asdict().items()
-                if k not in ("chunk_t", "traj")   # traj: derived, recomputed
-            },
+            payload=payload,
         )
 
-    mag = np.asarray(state.s).astype(np.float64).sum(axis=1) / n
+    s_final = np.asarray(current_s(state))
+    mag = s_final.astype(np.float64).sum(axis=1) / n
     return SAResult(
-        s=np.asarray(state.s),
+        s=s_final,
         mag_reached=mag.astype(np_dt),
         num_steps=np.asarray(state.t),
         m_final=np.asarray(state.m_final),

@@ -27,6 +27,15 @@ slots, −1 when outside), ``nbr_glob[n, B, dmax]`` (the same neighbors as
 global ids for the cached gather; ghost-padded with n). The trajectory
 cache stores an extra ghost column that is always 0, so ghost gathers are
 neutral and ghost scatters are no-ops.
+
+Known ceiling (measured, CPU backend): the accept-time scatter into the
+carried cache is NOT aliased in place by XLA:CPU even with the
+read-free trash-column formulation — each step copies the O(R·T·n) buffer,
+which caps very-large-n throughput (delta-only: 14k steps/s at n=2e4;
+with accept: ~2k). The mode still wins 8.5×/15× at n=1e4/2e4 overall
+because the full rollout pays O(n) arithmetic AND the copy. On TPU the
+in-place carry-scatter pattern (the KV-cache update shape) is expected to
+alias; measure via benchmarks/config1_sa_rrg.py when a chip is reachable.
 """
 
 from __future__ import annotations
@@ -101,8 +110,12 @@ def build_lightcone_tables(graph, radius: int) -> LightconeTables:
 
 
 def batched_trajectory(nbr, s, steps: int, R_coef: int, C_coef: int):
-    """Full trajectory cache ``int8[R, steps+1, n+1]`` (ghost column 0) of
-    the batched rollout — the light-cone solver's carried state. Same
+    """Full trajectory cache ``int8[R, steps+1, n+2]`` of the batched
+    rollout — the light-cone solver's carried state. Column ``n`` is the
+    ghost (always 0, read by out-of-ball/ragged gathers); column ``n+1`` is
+    the trash target rejected flips scatter into (so the accept scatter
+    never has to READ the cache, which lets XLA alias it in-place inside
+    the solver's while-loop instead of copying O(n) per step). Same
     per-step arithmetic as :func:`graphdyn.ops.dynamics
     .batched_rollout_impl`."""
     from graphdyn.ops.dynamics import batched_rollout_impl
@@ -114,8 +127,8 @@ def batched_trajectory(nbr, s, steps: int, R_coef: int, C_coef: int):
         cur = batched_rollout_impl(nbr, cur, 1, R_coef, C_coef)
         frames.append(cur)
     traj = jnp.stack(frames, axis=1)                         # [R, T+1, n]
-    ghost = jnp.zeros((Rr, steps + 1, 1), s.dtype)
-    return jnp.concatenate([traj, ghost], axis=2)            # [R, T+1, n+1]
+    pad = jnp.zeros((Rr, steps + 1, 2), s.dtype)             # ghost + trash
+    return jnp.concatenate([traj, pad], axis=2)              # [R, T+1, n+2]
 
 
 @partial(jax.jit, static_argnames=("R_coef", "C_coef", "radius"))
@@ -124,10 +137,10 @@ def lightcone_flip_delta(tables: LightconeTables, traj, i,
     """Per-replica candidate evaluation: roll only the ball of each
     replica's proposal ``i`` against its cached trajectory.
 
-    ``traj: int8[R, T+1, n+1]``, ``i: int32[R]``. Returns
+    ``traj: int8[R, T+1, n+2]``, ``i: int32[R]``. Returns
     ``(delta int32[R], vstack int8[R, T+1, B])`` where ``vstack`` holds the
     flipped-ball trajectory for the accept-time scatter (slot 0 is i)."""
-    n = traj.shape[2] - 1
+    n = traj.shape[2] - 2
 
     def one(traj_r, i_r):
         ball = tables.ball[i_r]                      # [B]
@@ -138,7 +151,7 @@ def lightcone_flip_delta(tables: LightconeTables, traj, i,
         v = v.at[0].set(-v[0])                       # the candidate flip
         frames = [v]
         for t in range(radius):
-            cache_t = traj_r[t].astype(jnp.int32)    # [n+1], ghost col = 0
+            cache_t = traj_r[t].astype(jnp.int32)    # [n+2], ghost col n = 0
             inside = slots >= 0
             nbvals = jnp.where(
                 inside,
@@ -161,14 +174,17 @@ def lightcone_flip_delta(tables: LightconeTables, traj, i,
 def lightcone_accept(tables: LightconeTables, traj, i, vstack, do):
     """Scatter accepted flips' ball trajectories into the cache.
 
-    ``do: bool[R]`` masks accepted replicas; rejected replicas keep their
-    cache untouched. Ghost ball slots scatter 0 into the ghost column — a
-    no-op by the ghost invariant."""
+    ``do: bool[R]`` masks accepted replicas. Rejected replicas redirect the
+    whole scatter into the trash column ``n+1`` instead of masking against
+    the current values — the scatter then never READS the cache, so XLA can
+    update the while-loop carry in place rather than copying the O(n)
+    buffer every step. Accepted ghost ball slots write 0 into the ghost
+    column — a no-op by the ghost invariant."""
+    n = traj.shape[2] - 2
 
     def one(traj_r, i_r, v_r, do_r):
         ball = tables.ball[i_r]                      # [B]
-        cur = jnp.swapaxes(traj_r[:, ball], 0, 1)    # [B, T+1]
-        new = jnp.where(do_r, jnp.swapaxes(v_r, 0, 1), cur)
-        return traj_r.at[:, ball].set(jnp.swapaxes(new, 0, 1))
+        tgt = jnp.where(do_r, ball, n + 1)           # reject -> trash column
+        return traj_r.at[:, tgt].set(v_r, mode="promise_in_bounds")  # [T+1, B]
 
     return jax.vmap(one)(traj, i, vstack, do)
