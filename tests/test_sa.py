@@ -256,13 +256,25 @@ def test_lightcone_bit_parity_with_full():
         s0 = (2 * rng.integers(0, 2, size=(R, g.n)) - 1).astype(np.int8)
         proposals = rng.integers(0, g.n, size=(R, L)).astype(np.int32)
         uniforms = rng.random(size=(R, L))
-        for p, c in [(1, 1), (3, 1), (2, 2)]:
-            cfg = SAConfig(dynamics=DynamicsConfig(p=p, c=c))
+        for p, c, rule, tie, budget in [
+            # majority/stay configs keep the full L-step parity coverage
+            (1, 1, "majority", "stay", None),
+            (3, 1, "majority", "stay", None),
+            (2, 2, "majority", "stay", None),
+            # one hop per step holds for ANY local synchronous rule — the
+            # cone argument is rule-independent; these chains may never
+            # consense, so bound them (sentinel fires identically)
+            (2, 1, "minority", "change", 1500),
+            (2, 1, "majority", "change", 1500),
+        ]:
+            cfg = SAConfig(dynamics=DynamicsConfig(p=p, c=c, rule=rule, tie=tie))
             kw = dict(s0=s0, proposals=proposals, uniforms=uniforms,
-                      backend="jax")
+                      backend="jax", max_steps=budget)
             full = simulated_annealing(g, cfg, rollout_mode="full", **kw)
             lc = simulated_annealing(g, cfg, rollout_mode="lightcone", **kw)
-            np.testing.assert_array_equal(full.s, lc.s, err_msg=f"{gname} p={p} c={c}")
+            np.testing.assert_array_equal(
+                full.s, lc.s, err_msg=f"{gname} p={p} c={c} {rule}/{tie}"
+            )
             np.testing.assert_array_equal(full.num_steps, lc.num_steps)
             np.testing.assert_array_equal(full.m_final, lc.m_final)
             np.testing.assert_array_equal(full.mag_reached, lc.mag_reached)
