@@ -46,45 +46,66 @@ def run_replicas(n, R, sweeps):
     """Replica-batched iteration throughput (BASELINE config 2's `256
     replicas` axis): R chains' sweep+marginals as one device program.
 
-    Replicas batch as a DISJOINT-UNION graph (R structural copies side by
-    side, `graphdyn.graphs.replicate_disjoint`): the edge axis stays the one
-    big lane dimension, so memory scales linearly in R — a ``vmap`` over a
-    leading replica axis instead makes XLA pad the replica dim to 128 lanes
-    (R-independent 2.3 GB temps at n=1e5, measured OOM). On a multi-device
-    slice the union's edge/node-blocked state shards over a 1-D mesh (chains
-    are disjoint, so shard-crossing gathers are rare). Capacity is still
-    *measured*: halve R on device OOM until the program fits.
+    Replicas batch as a DISJOINT-UNION graph in the REPLICA-MAJOR edge
+    layout (`graphdyn.models.hpr.union_setup`): the edge axis stays the one
+    big lane dimension (memory linear in R — a leading-axis ``vmap`` pads
+    the replica dim to 128 lanes, measured R-independent 2.3 GB temps at
+    n=1e5, OOM), and replica r owns contiguous rows [r·2E, (r+1)·2E). On a
+    multi-device slice the program runs under ``shard_map`` with each device
+    sweeping its own R/n_dev-replica block with purely LOCAL gathers — the
+    canonical-union layout instead made GSPMD all-gather chi every sweep
+    (the round-3 17× per-combo collapse). Capacity is still *measured*:
+    halve R on device OOM until the program fits.
     """
     from benchmarks.common import halve_on_oom
+    from graphdyn.config import HPRConfig
+    from graphdyn.models.hpr import union_setup
 
     n_dev = len(jax.devices())
     g = random_regular_graph(n, 3, seed=0)
+    cfg = HPRConfig()
 
     def attempt(R):
-        from graphdyn.graphs import replicate_disjoint
+        import numpy as np
 
-        gu = replicate_disjoint(g, R)
-        data = BDCMData(gu, p=1, c=1)
-        sweep = make_sweep(data, damp=0.4, mask_invalid_src=False, with_bias=True)
-        marginals = make_marginals(data)
-        chi = data.init_messages(0)
-        bias = jnp.ones((data.num_directed, data.K), jnp.float32)
-        if n_dev > 1:
+        from graphdyn.models.hpr import _draw_union_chi
+
+        # shard only when each device gets a whole replica block; small or
+        # non-divisible R (halve_on_oom can floor at 1) runs single-device
+        use_mesh = n_dev > 1 and R >= n_dev and R % n_dev == 0
+        R_local = R // n_dev if use_mesh else R
+        setup = union_setup(g, cfg, R_local)
+        bias_l = jnp.ones((setup.data.num_directed, setup.data.K), jnp.float32)
+
+        def body_local(chi):
+            chi = setup.sweep(chi, jnp.float32(25.0), bias_l)
+            return chi, setup.marginals(chi)
+
+        chi = jnp.asarray(_draw_union_chi(
+            np.random.default_rng(0), R, 2 * g.num_edges, setup.data.K,
+            "float32",
+        ))
+        if use_mesh:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from graphdyn.parallel.mesh import make_mesh
 
             mesh = make_mesh((n_dev,), ("replica",))
-            chi = jax.device_put(chi, NamedSharding(mesh, P("replica")))
-            bias = jax.device_put(bias, NamedSharding(mesh, P("replica")))
+            rep = P("replica")
+            body = jax.jit(jax.shard_map(
+                body_local, mesh=mesh, in_specs=(rep,), out_specs=(rep, rep),
+                check_vma=False,
+            ))
+            chi = jax.device_put(chi, NamedSharding(mesh, rep))
+        else:
+            body = jax.jit(body_local)
 
-        @jax.jit
-        def body(chi):
-            chi = sweep(chi, jnp.float32(25.0), bias)
-            return chi, marginals(chi)
+        class _Data:
+            num_directed = 2 * g.num_edges * R
+            K = setup.data.K
 
         (_, _), dt = timed(lambda c: body(c), chi, iters=sweeps)
-        return data, dt
+        return _Data, dt
 
     requested = R
     (data, dt), R = halve_on_oom(attempt, R, floor=1, multiple=max(n_dev, 1))

@@ -67,6 +67,12 @@ class HPRConfig:
     eps_clamp: float = 1e-15    # marginal Z clamp (`:147`)
     n_replicas: int = 1
     seed: int = 0
+    dtype: str = "float32"      # messages/marginals/biases dtype. The
+                                # reference runs the whole solver in float64
+                                # (`HPR_pytorch_RRG.py:11`); 'float64'
+                                # reproduces that (requires jax_enable_x64),
+                                # 'float32' is the TPU-first throughput
+                                # default.
 
 
 @dataclass(frozen=True)

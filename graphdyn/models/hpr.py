@@ -73,15 +73,21 @@ class _HPRSetup(NamedTuple):
     gamma: jnp.ndarray
     TT: int
     n: int
+    dtype: jnp.dtype         # messages/marginals/biases dtype
+                             # (HPRConfig.dtype; the reference is f64,
+                             # `HPR_pytorch_RRG.py:11`)
 
 
-def _prep(graph: Graph, config: HPRConfig, *, use_pallas="auto") -> _HPRSetup:
+def _prep(
+    graph: Graph, config: HPRConfig, *, tables: object = None, use_pallas="auto"
+) -> _HPRSetup:
     dyn = config.dynamics
     n = graph.n
-    tables = build_edge_tables(graph)
+    tables = tables if tables is not None else build_edge_tables(graph)
+    dtype = jnp.dtype(config.dtype)
     data = BDCMData(
         graph, tables, p=dyn.p, c=dyn.c, attr_value=dyn.attr_value,
-        rule=dyn.rule, tie=dyn.tie,
+        rule=dyn.rule, tie=dyn.tie, dtype=dtype,
     )
     sweep = make_sweep(
         data, damp=config.damp, eps_clamp=0.0, mask_invalid_src=False,
@@ -110,11 +116,12 @@ def _prep(graph: Graph, config: HPRConfig, *, use_pallas="auto") -> _HPRSetup:
         marginals=marginals,
         bias_to_edge=bias_to_edge,
         m_of_end_batch=m_of_end_batch,
-        lmbd=jnp.float32(config.lmbd),
-        pie=jnp.float32(config.pie),
-        gamma=jnp.float32(config.gamma),
+        lmbd=jnp.asarray(config.lmbd, dtype),
+        pie=jnp.asarray(config.pie, dtype),
+        gamma=jnp.asarray(config.gamma, dtype),
         TT=int(config.max_sweeps),
         n=n,
+        dtype=dtype,
     )
 
 
@@ -161,12 +168,12 @@ def hpr_solve(
             minus_wins = marg[:, 1] >= marg[:, 0]
             new_bias = jnp.where(
                 minus_wins[:, None],
-                jnp.array([pie, 1 - pie]),
-                jnp.array([1 - pie, pie]),
+                jnp.stack([pie, 1 - pie]),
+                jnp.stack([1 - pie, pie]),
             )
             key, ku = jax.random.split(key)
-            u = jax.random.uniform(ku, (n,))
-            update = u < 1.0 - (1.0 + t.astype(jnp.float32)) ** (-gamma)
+            u = jax.random.uniform(ku, (n,), setup.dtype)
+            update = u < 1.0 - (1.0 + t.astype(setup.dtype)) ** (-gamma)
             biases = jnp.where(update[:, None], new_bias, biases)
             s = jnp.where(biases[:, 0] > biases[:, 1], 1, -1).astype(jnp.int8)
             t = t + 1
@@ -201,7 +208,7 @@ def hpr_solve(
             chi0 = data.init_messages(rng)
         biases0 = rng.random((n, 2))
         biases0 /= biases0.sum(axis=1, keepdims=True)
-        biases0 = jnp.asarray(biases0, jnp.float32)
+        biases0 = jnp.asarray(biases0, setup.dtype)
         s0 = jnp.where(biases0[:, 0] > biases0[:, 1], 1, -1).astype(jnp.int8)
         state = (
             jnp.asarray(chi0), biases0, s0, jax.random.PRNGKey(seed),
@@ -245,6 +252,171 @@ class HPRBatchResult(NamedTuple):
     elapsed_s: float
 
 
+def union_setup(graph: Graph, config: HPRConfig, R: int) -> _HPRSetup:
+    """R-replica disjoint-union HPr setup in the REPLICA-MAJOR edge layout
+    (:func:`graphdyn.graphs.replicate_edge_tables`): replica ``r``'s directed
+    edges occupy the contiguous rows ``[r·2E, (r+1)·2E)``, so every gather in
+    the sweep, marginals, and bias scatter stays inside one replica's block
+    and a 1-D replica sharding of the state is communication-free."""
+    from graphdyn.graphs import replicate_disjoint, replicate_edge_tables
+
+    gu = replicate_disjoint(graph, R)
+    tabs = replicate_edge_tables(build_edge_tables(graph), R, graph.n)
+    return _prep(gu, config, tables=tabs)
+
+
+def _draw_union_chi(rng, R: int, twoE: int, K: int, np_dt) -> np.ndarray:
+    """Row-normalized random chi for the R-replica union, drawn replica-by-
+    replica straight into the target dtype. ``init_messages`` would draw the
+    whole union in float64 before casting — ~20 GB host at config-2 scale."""
+    out = np.empty((R * twoE, K, K), np_dt)
+    for r in range(R):
+        blk = rng.random((twoE, K, K))
+        blk /= blk.sum(axis=(1, 2), keepdims=True)
+        out[r * twoE : (r + 1) * twoE] = blk
+    return out
+
+
+def _make_hpr_batch_body(setup: _HPRSetup, graph: Graph, R_blk: int):
+    """One HPr iteration over an ``R_blk``-replica union block: sweep,
+    marginals, reinforcement, per-replica rollout stop-test, freeze masks.
+    Shared verbatim by the single-device program and the per-shard body of
+    the mesh path (each shard's block IS such a union), so the sharded and
+    unsharded solvers cannot drift.
+
+    The sweep clock ``t`` is carried as an all-equal ``int32[R_blk]`` vector
+    rather than a scalar: the sharded path can then declare every carried
+    array replica-sharded (no scalar outputs whose replication ``shard_map``
+    cannot express)."""
+    n = graph.n
+    dyn_steps = setup.data.p + setup.data.c - 1
+    R_coef, C_coef = rule_coefficients(setup.data.rule, setup.data.tie)
+    twoE = setup.data.num_directed // R_blk
+    node_rep = jnp.asarray(np.repeat(np.arange(R_blk), n))
+    edge_rep = jnp.asarray(np.repeat(np.arange(R_blk), twoE))
+    nbr_b = jnp.asarray(graph.nbr)
+    lmbd, pie, gamma, TT = setup.lmbd, setup.pie, setup.gamma, setup.TT
+
+    def m_per_replica(s_u):
+        # chains are structural copies of the BASE graph — roll them as a
+        # batch over its neighbor table instead of one union-wide rollout
+        s_end = batched_rollout_impl(
+            nbr_b, s_u.reshape(R_blk, n), dyn_steps, R_coef, C_coef
+        )
+        return s_end.astype(jnp.int32).sum(axis=1).astype(jnp.float32) / n
+
+    def body(chi, biases, s, keys, t, m_final, active, steps):
+        chi_new = setup.sweep(chi, lmbd, setup.bias_to_edge(biases))
+        marg = setup.marginals(chi_new)                  # [R_blk·n, 2]
+        minus_wins = marg[:, 1] >= marg[:, 0]
+        new_bias = jnp.where(
+            minus_wins[:, None],
+            jnp.stack([pie, 1 - pie]),
+            jnp.stack([1 - pie, pie]),
+        )
+        ks = jax.vmap(jax.random.split)(keys)            # [R_blk, 2, key]
+        keys_new, ku = ks[:, 0], ks[:, 1]
+        u = jax.vmap(
+            lambda k: jax.random.uniform(k, (n,), biases.dtype)
+        )(ku).reshape(R_blk * n)
+        update = u < 1.0 - (1.0 + t[0].astype(biases.dtype)) ** (-gamma)
+        biases_new = jnp.where(update[:, None], new_bias, biases)
+        s_new = jnp.where(
+            biases_new[:, 0] > biases_new[:, 1], 1, -1
+        ).astype(jnp.int8)
+        t_new = t + 1
+        m_new = jnp.where(t_new[0] > TT, 2.0, m_per_replica(s_new))
+        # frozen chains keep their final state
+        ae = active[edge_rep]
+        an = active[node_rep]
+        chi = jnp.where(ae[:, None, None], chi_new, chi)
+        biases = jnp.where(an[:, None], biases_new, biases)
+        s = jnp.where(an, s_new, s)
+        keys = jnp.where(active[:, None], keys_new, keys)
+        m_final = jnp.where(active, m_new, m_final)
+        steps = jnp.where(active, t_new, steps)
+        active = active & (m_final < 1.0) & (t_new[0] <= TT)
+        return chi, biases, s, keys, t_new, m_final, active, steps
+
+    return body, m_per_replica
+
+
+def make_hpr_batch_chunk(
+    graph: Graph,
+    config: HPRConfig,
+    Rtot: int,
+    *,
+    mesh=None,
+    replica_axis: str = "replica",
+):
+    """Build the jitted chunk program ``(chi, biases, s, keys, t, m_final,
+    active, steps, t_end) -> same-shape state`` advancing ``Rtot`` batched
+    HPr chains until all stop or the sweep clock reaches ``t_end``.
+
+    With a ``mesh``, the program is a ``shard_map`` over the ``replica``
+    axis: each device runs its own ``Rtot/n_shards``-replica union block
+    with purely local gathers (the replica-major layout guarantees
+    block-diagonal index tables); the only communication is one scalar
+    ``psum`` per sweep keeping the mesh-wide stop test in lockstep — the
+    TPU-first answer to the reference's one-chain-per-process replica loop
+    (`HPR_pytorch_RRG.py:259`). Exposed for the config-2 benchmark so it
+    measures the exact shipped program.
+    """
+    if mesh is None:
+        setup = union_setup(graph, config, Rtot)
+        body, m_per_replica = _make_hpr_batch_body(setup, graph, Rtot)
+
+        @jax.jit
+        def run_chunk(chi, biases, s, keys, t, m_final, active, steps, t_end):
+            def cond(st):
+                return jnp.any(st[6]) & (st[4][0] < t_end)
+
+            def bdy(st):
+                return body(*st)
+
+            return lax.while_loop(
+                cond, bdy, (chi, biases, s, keys, t, m_final, active, steps)
+            )
+
+        return run_chunk, setup
+
+    from jax.sharding import PartitionSpec as P
+
+    shards = int(mesh.shape[replica_axis])
+    if Rtot % shards:
+        raise ValueError(f"Rtot={Rtot} not divisible by {shards} replica shards")
+    R_local = Rtot // shards
+    setup_l = union_setup(graph, config, R_local)
+    body_l, _ = _make_hpr_batch_body(setup_l, graph, R_local)
+    rep = P(replica_axis)
+
+    def chunk_l(chi, biases, s, keys, t, m_final, active, steps, t_end):
+        def cond(st):
+            return (st[8] > 0) & (st[4][0] < t_end)
+
+        def bdy(st):
+            out = body_l(*st[:8])
+            live = lax.psum(jnp.any(out[6]).astype(jnp.int32), replica_axis)
+            return (*out, live)
+
+        live0 = lax.psum(jnp.any(active).astype(jnp.int32), replica_axis)
+        out = lax.while_loop(
+            cond, bdy, (chi, biases, s, keys, t, m_final, active, steps, live0)
+        )
+        return out[:8]
+
+    run_chunk = jax.jit(
+        jax.shard_map(
+            chunk_l,
+            mesh=mesh,
+            in_specs=(rep,) * 8 + (P(),),
+            out_specs=(rep,) * 8,
+            check_vma=False,
+        )
+    )
+    return run_chunk, setup_l
+
+
 def hpr_solve_batch(
     graph: Graph,
     config: HPRConfig | None = None,
@@ -261,103 +433,49 @@ def hpr_solve_batch(
     program — the BASELINE config-2 replica axis (`N=1e5, 256 replicas`).
 
     The reference runs one chain per process (`HPR_pytorch_RRG.py:342-356`).
-    Here chains batch as a DISJOINT-UNION graph
-    (:func:`graphdyn.graphs.replicate_disjoint` — R structural copies side
-    by side): chi stays ``[R·2E, K, K]`` with the edge axis as the one big
-    TPU lane dimension, so memory scales linearly in R. A leading-axis
-    ``vmap`` instead makes XLA pick the replica axis as the 128-lane dim —
-    every R < 128 pads to 128 (measured: R-independent 2.3 GB input copies
-    at n=1e5, OOM). Chains stay independent (no edges between copies);
-    finished chains freeze via per-replica masks gathered to the node/edge
-    axes, inside one ``lax.while_loop``. Pass a ``mesh`` to split the
-    edge/node-blocked state over devices; note the directed-edge layout
-    ([all forward | all reverse]) puts a replica's two blocks on different
-    shards, so GSPMD inserts gathers for reverse-edge reads — the sharding
-    trades some ICI traffic for HBM capacity rather than being
-    communication-free.
+    Here chains batch as a DISJOINT-UNION graph in the replica-major edge
+    layout (:func:`union_setup`): chi stays ``[R·2E, K, K]`` with the edge
+    axis as the one big TPU lane dimension (memory linear in R; a
+    leading-axis ``vmap`` instead pads the replica axis to 128 lanes —
+    measured R-independent 2.3 GB copies at n=1e5, OOM), and replica ``r``
+    owns the contiguous rows ``[r·2E, (r+1)·2E)``. Chains stay independent;
+    finished chains freeze via per-replica masks, inside one
+    ``lax.while_loop``. With a ``mesh``, replicas round up to the shard
+    count (padding chains start frozen) and the loop runs under
+    ``shard_map`` with purely local gathers and one scalar ``psum`` per
+    sweep (:func:`make_hpr_batch_chunk`) — results are bit-identical to the
+    unsharded program (tested), because every shard block computes exactly
+    the unsharded per-replica arithmetic.
 
     ``checkpoint_path``: exact-resume checkpointing with the same contract
     as :func:`hpr_solve` (chunked loop, full state snapshot, fingerprint-
-    validated resume, removed on completion). chi dominates the snapshot
-    size (``R·2E·K²`` floats), so pick ``checkpoint_interval_s``
-    accordingly at config-2 scale.
+    validated resume, removed on completion). Snapshots store the UNPADDED
+    R chains, so a run may resume on a different mesh shape. chi dominates
+    the snapshot size (``R·2E·K²`` floats), so pick
+    ``checkpoint_interval_s`` accordingly at config-2 scale.
     """
     t_start = time.perf_counter()
     config = config or HPRConfig()
     R = n_replicas if n_replicas is not None else config.n_replicas
     n = graph.n
     E = graph.num_edges
+    twoE = 2 * E
     dyn = config.dynamics
-    R_coef, C_coef = rule_coefficients(dyn.rule, dyn.tie)
-    rollout_steps = dyn.p + dyn.c - 1
+    T = dyn.p + dyn.c
+    K = 2**T
+    np_dt = np.dtype(config.dtype)
 
-    from graphdyn.graphs import replicate_disjoint
+    shards = int(mesh.shape[replica_axis]) if mesh is not None else 1
+    R_pad = (-R) % shards
+    Rtot = R + R_pad
 
-    gu = replicate_disjoint(graph, R)
-    setup = _prep(gu, config)
-    data, bias_to_edge = setup.data, setup.bias_to_edge
-    lmbd, pie, gamma, TT = setup.lmbd, setup.pie, setup.gamma, setup.TT
-
-    nbr_u = jnp.asarray(gu.nbr)
-    # replica of union node i is i // n; directed union edges are all
-    # forward copies [r·E, (r+1)·E) then all reverses at +R·E
-    node_rep = jnp.asarray(np.repeat(np.arange(R), n))
-    edge_rep = jnp.asarray(
-        np.concatenate([np.repeat(np.arange(R), E)] * 2)
+    run_chunk, setup = make_hpr_batch_chunk(
+        graph, config, Rtot, mesh=mesh, replica_axis=replica_axis
     )
-
-    def m_per_replica(s_u):
-        s_end = batched_rollout_impl(
-            nbr_u, s_u[None], rollout_steps, R_coef, C_coef
-        )[0]
-        return (
-            s_end.astype(jnp.int32).reshape(R, n).sum(axis=1).astype(jnp.float32)
-            / n
-        )
-
-    @jax.jit
-    def run_chunk(chi, biases, s, keys, t, m_final, active, steps, t_end):
-        def cond(st):
-            return jnp.any(st[6]) & (st[4] < t_end)
-
-        def body(st):
-            chi, biases, s, keys, t, m_final, active, steps = st
-            chi_new = setup.sweep(chi, lmbd, bias_to_edge(biases))
-            marg = setup.marginals(chi_new)                  # [R·n, 2]
-            minus_wins = marg[:, 1] >= marg[:, 0]
-            new_bias = jnp.where(
-                minus_wins[:, None],
-                jnp.array([pie, 1 - pie]),
-                jnp.array([1 - pie, pie]),
-            )
-            ks = jax.vmap(jax.random.split)(keys)            # [R, 2, key]
-            keys_new, ku = ks[:, 0], ks[:, 1]
-            u = jax.vmap(lambda k: jax.random.uniform(k, (n,)))(ku).reshape(R * n)
-            update = u < 1.0 - (1.0 + t.astype(jnp.float32)) ** (-gamma)
-            biases_new = jnp.where(update[:, None], new_bias, biases)
-            s_new = jnp.where(
-                biases_new[:, 0] > biases_new[:, 1], 1, -1
-            ).astype(jnp.int8)
-            t_new = t + 1
-            m_new = jnp.where(t_new > TT, 2.0, m_per_replica(s_new))
-            # frozen chains keep their final state
-            ae = active[edge_rep]
-            an = active[node_rep]
-            chi = jnp.where(ae[:, None, None], chi_new, chi)
-            biases = jnp.where(an[:, None], biases_new, biases)
-            s = jnp.where(an, s_new, s)
-            keys = jnp.where(active[:, None], keys_new, keys)
-            m_final = jnp.where(active, m_new, m_final)
-            steps = jnp.where(active, t_new, steps)
-            active = active & (m_final < 1.0) & (t_new <= TT)
-            return chi, biases, s, keys, t_new, m_final, active, steps
-
-        return lax.while_loop(
-            cond, body, (chi, biases, s, keys, t, m_final, active, steps)
-        )
+    TT = setup.TT
 
     ckpt = None
-    state = None
+    arrays = None
     if checkpoint_path is not None:
         from graphdyn.utils.io import ChainCheckpointer, run_fingerprint
 
@@ -369,35 +487,77 @@ def hpr_solve_batch(
             interval_s=checkpoint_interval_s,
         )
         arrays = ckpt.load_state(check=lambda a: a["s"].shape == (R * n,))
-        if arrays is not None:
-            state = tuple(jnp.asarray(arrays[k]) for k in _HPR_BATCH_FIELDS)
 
-    if state is None:
+    if arrays is None:
         rng = np.random.default_rng(seed)
-        chi0 = jnp.asarray(data.init_messages(rng))
+        chi0 = _draw_union_chi(rng, R, twoE, K, np_dt)
         biases0 = rng.random((R * n, 2))
         biases0 /= biases0.sum(axis=1, keepdims=True)
-        biases0 = jnp.asarray(biases0, jnp.float32)
+        biases0 = biases0.astype(np_dt)
         # one root key per chain: distinct seeds give fully disjoint streams
-        keys = jax.random.split(jax.random.PRNGKey(seed), R)
-        s0 = jnp.where(biases0[:, 0] > biases0[:, 1], 1, -1).astype(jnp.int8)
-        m0 = m_per_replica(s0)
-        state = (
-            chi0, biases0, s0, keys, jnp.int32(0), m0,
-            m0 < 1.0, jnp.zeros((R,), jnp.int32),
-        )
+        keys0 = np.asarray(jax.random.split(jax.random.PRNGKey(seed), R))
+        s0 = np.where(biases0[:, 0] > biases0[:, 1], 1, -1).astype(np.int8)
+        arrays = {
+            "chi": chi0, "biases": biases0, "s": s0, "keys": keys0,
+            "t": np.zeros(R, np.int32), "m_final": None, "active": None,
+            "steps": np.zeros(R, np.int32),
+        }
 
-    if mesh is not None:
+    def pad_rows(x, blk, fill):
+        """Append R_pad frozen-chain blocks of ``blk`` rows each."""
+        if not R_pad:
+            return x
+        pad = np.full((R_pad * blk,) + x.shape[1:], fill, x.dtype)
+        return np.concatenate([x, pad])
+
+    chi_h = pad_rows(arrays["chi"], twoE, 1.0 / (K * K))
+    biases_h = pad_rows(arrays["biases"], n, 0.5)
+    s_h = pad_rows(arrays["s"], n, 1)
+    keys_h = pad_rows(arrays["keys"], 1, 0)
+    # pad chains carry the REAL sweep clock: each shard's while-loop cond
+    # reads its local t[0], so a resumed run with t=0 pad rows would leave
+    # the pad shard looping past the others' exit — straight into a psum
+    # with no partners
+    t_h = pad_rows(arrays["t"], 1, int(arrays["t"][0]) if R else 0)
+    steps_h = pad_rows(arrays["steps"], 1, 0)
+
+    def place(x):
+        x = jnp.asarray(x)
+        if mesh is None:
+            return x
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        shard = NamedSharding(mesh, P(replica_axis))
-        state = (
-            jax.device_put(state[0], shard),       # chi [R·2E, K, K]
-            jax.device_put(state[1], shard),       # biases [R·n, 2]
-            jax.device_put(state[2], shard),       # s [R·n]
-            jax.device_put(state[3], shard),       # keys [R]
-            *state[4:],
+        return jax.device_put(x, NamedSharding(mesh, P(replica_axis)))
+
+    if arrays["m_final"] is None:
+        # initial stop-test: the same base-graph batched rollout the body
+        # uses, run once host-driven on the unpadded chains
+        R_coef, C_coef = rule_coefficients(dyn.rule, dyn.tie)
+        s_end = np.asarray(
+            jax.jit(batched_rollout_impl, static_argnums=(2, 3, 4))(
+                jnp.asarray(graph.nbr),
+                jnp.asarray(arrays["s"].reshape(R, n)),
+                dyn.p + dyn.c - 1, R_coef, C_coef,
+            )
         )
+        m0 = (s_end.astype(np.int64).sum(axis=1) / n).astype(np.float32)
+        arrays["m_final"] = m0
+        arrays["active"] = m0 < 1.0
+
+    m_final_h = pad_rows(arrays["m_final"].astype(np.float32), 1, 1.0)
+    active_h = pad_rows(arrays["active"].astype(bool), 1, False)
+
+    state = tuple(
+        place(x)
+        for x in (chi_h, biases_h, s_h, keys_h, t_h, m_final_h, active_h, steps_h)
+    )
+
+    def snapshot(st):
+        sl = {"chi": R * twoE, "biases": R * n, "s": R * n}
+        return {
+            k: np.asarray(v)[: sl.get(k, R)]
+            for k, v in zip(_HPR_BATCH_FIELDS, st)
+        }
 
     if ckpt is None:
         state = run_chunk(*state, jnp.int32(TT + 2))
@@ -405,21 +565,19 @@ def hpr_solve_batch(
         state = ckpt.drive(
             state,
             advance=lambda st: run_chunk(
-                *st, jnp.minimum(st[4] + jnp.int32(chunk_sweeps), TT + 2)
+                *st, jnp.minimum(st[4][0] + jnp.int32(chunk_sweeps), TT + 2)
             ),
-            active=lambda st: bool(jnp.any(st[6])),
-            payload=lambda st: {
-                k: np.asarray(v) for k, v in zip(_HPR_BATCH_FIELDS, st)
-            },
+            active=lambda st: bool(np.asarray(st[6])[:R].any()),
+            payload=snapshot,
         )
 
     _, _, s_u, _, _, m_final, _, steps = state
-    s = np.asarray(s_u).reshape(R, n)
+    s = np.asarray(s_u)[: R * n].reshape(R, n)
     return HPRBatchResult(
         s=s,
         mag_reached=s.astype(np.float64).mean(axis=1).astype(np.float32),
-        num_steps=np.asarray(steps),
-        m_final=np.asarray(m_final),
+        num_steps=np.asarray(steps)[:R],
+        m_final=np.asarray(m_final)[:R],
         elapsed_s=time.perf_counter() - t_start,
     )
 

@@ -400,6 +400,8 @@ class EnsembleBDCM:
     def __init__(self, datas: list[BDCMData]):
         if not datas:
             raise ValueError("empty ensemble")
+        for dd in datas:
+            _require_halved_layout(dd, "EnsembleBDCM")   # chi[:E]/chi[E:]
         d0 = datas[0]
         sig = [(c.d, c.idx.shape[0]) for c in d0.edge_classes]
         nsig = [(c.d, c.idx.shape[0]) for c in d0.node_classes]
@@ -620,9 +622,23 @@ def make_leaf_setter(data: BDCMData):
     return set_leaves
 
 
+def _require_halved_layout(data: BDCMData, what: str) -> None:
+    """The Z_ij/φ/m_init observables pair forward and reverse messages by
+    slicing chi into halves (``chi[:E]``/``chi[E:]``); a permuted edge layout
+    (``EdgeTables.rev_map`` set, e.g. the replica-major union tables of
+    :func:`graphdyn.graphs.replicate_edge_tables`) breaks that pairing."""
+    if getattr(data.tables, "rev_map", None) is not None:
+        raise ValueError(
+            f"{what} requires the canonical [forward | reverse] directed-edge "
+            "layout; got permuted tables (rev_map set). Build BDCMData from "
+            "build_edge_tables(...) for partition-function observables."
+        )
+
+
 def make_edge_partition(data: BDCMData, eps_clamp: float = 0.0):
     """Jitted ``chi -> Z_ij[E]``: per-undirected-edge partition function with
     endpoint-valid trajectories only (`ipynb:146-155`)."""
+    _require_halved_layout(data, "make_edge_partition")
     valid = jnp.asarray(data.valid, data.dtype)
     mask2 = valid[:, None] * valid[None, :]
     return lambda chi: _zij_exec(chi, mask2, float(eps_clamp))
@@ -711,6 +727,7 @@ def make_free_entropy(data: BDCMData, *, n_total: int, n_iso: int, eps_clamp: fl
     ``(Σ ln Z_i − Σ ln Z_ij − λ·n_iso)/n_total`` (`ipynb:318-322`), with the
     analytic isolated-node term. The isolate counts are traced scalars, so
     the compiled program is shared across graphs of the same shape."""
+    _require_halved_layout(data, "make_free_entropy")
     valid, x0, ntables, spec = _zi_args(data, eps_clamp)
     validf = jnp.asarray(data.valid, data.dtype)
     mask2 = validf[:, None] * validf[None, :]
@@ -745,6 +762,7 @@ def make_m_init_edge_terms(data: BDCMData, eps_clamp: float = 0.0):
     mean initial magnetization (the summand of `ipynb:325-338`, before the
     edge sum). Lets callers aggregate per graph-ensemble member via segment
     sums (the union-ensemble entropy path)."""
+    _require_halved_layout(data, "make_m_init_edge_terms")
     validf = jnp.asarray(data.valid, data.dtype)
     mask2 = validf[:, None] * validf[None, :]
     x0 = jnp.asarray(data.x0, data.dtype)

@@ -237,3 +237,120 @@ def test_hpr_batch_mesh_checkpoint_resume(tmp_path, abort_after_save):
     np.testing.assert_array_equal(base.num_steps, resumed.num_steps)
     np.testing.assert_array_equal(base.m_final, resumed.m_final)
     assert not os.path.exists(p + ".npz")
+
+
+def test_replicate_edge_tables_layout_equivalence():
+    """The replica-major union tables (`graphdyn.graphs.replicate_edge_tables`)
+    are a pure permutation of the canonical union tables: one biased sweep +
+    marginals agree row-for-row under the layout permutation. This is the
+    layout-equivalence guarantee behind the communication-free config-2
+    replica sharding."""
+    import jax.numpy as jnp
+
+    from graphdyn.graphs import (
+        build_edge_tables,
+        replicate_disjoint,
+        replicate_edge_tables,
+    )
+    from graphdyn.ops.bdcm import BDCMData, make_marginals, make_sweep
+
+    g = random_regular_graph(12, 3, seed=3)
+    n, E, R = g.n, g.num_edges, 3
+    gu = replicate_disjoint(g, R)
+    data_def = BDCMData(gu, p=1, c=1)                       # canonical layout
+    tabs = replicate_edge_tables(build_edge_tables(g), R, n)
+    data_new = BDCMData(gu, tabs, p=1, c=1)                 # replica-major
+
+    # new directed id r*2E+e  <->  canonical id r*E+e (fwd) / R*E+r*E+(e-E)
+    new2def = np.empty(2 * R * E, np.int64)
+    for r in range(R):
+        new2def[r * 2 * E : r * 2 * E + E] = r * E + np.arange(E)
+        new2def[r * 2 * E + E : (r + 1) * 2 * E] = R * E + r * E + np.arange(E)
+    assert np.array_equal(np.sort(new2def), np.arange(2 * R * E))
+    np.testing.assert_array_equal(
+        np.asarray(data_new.tables.src), np.asarray(data_def.tables.src)[new2def]
+    )
+    # rev consistency: reversing in the new layout matches the canonical rule
+    np.testing.assert_array_equal(
+        new2def[tabs.rev(np.arange(2 * R * E))],
+        data_def.tables.rev(new2def),
+    )
+
+    rng = np.random.default_rng(0)
+    chi_new = rng.random((2 * R * E, data_new.K, data_new.K)).astype(np.float32)
+    bias_new = rng.random((2 * R * E, data_new.K)).astype(np.float32)
+    chi_def = np.empty_like(chi_new)
+    bias_def = np.empty_like(bias_new)
+    chi_def[new2def] = chi_new
+    bias_def[new2def] = bias_new
+
+    kw = dict(damp=0.4, mask_invalid_src=False, with_bias=True)
+    out_new = np.asarray(
+        make_sweep(data_new, **kw)(jnp.asarray(chi_new), 25.0, jnp.asarray(bias_new))
+    )
+    out_def = np.asarray(
+        make_sweep(data_def, **kw)(jnp.asarray(chi_def), 25.0, jnp.asarray(bias_def))
+    )
+    np.testing.assert_allclose(out_def[new2def], out_new, rtol=1e-6, atol=0)
+
+    marg_new = np.asarray(make_marginals(data_new)(jnp.asarray(out_new)))
+    marg_def = np.asarray(make_marginals(data_def)(jnp.asarray(out_def)))
+    np.testing.assert_allclose(marg_def, marg_new, rtol=1e-6, atol=0)
+
+    # the halves-slicing observables refuse the permuted layout
+    from graphdyn.ops.bdcm import make_edge_partition
+
+    with pytest.raises(ValueError, match="rev_map"):
+        make_edge_partition(data_new)
+
+
+@pytest.mark.parametrize("R", [8, 5])
+def test_hpr_batch_sharded_bit_identical_to_unsharded(R):
+    """The shard_map replica program equals the unsharded union program
+    bit-for-bit (every shard block computes exactly the unsharded
+    per-replica arithmetic); R=5 exercises frozen pad chains on the 8-way
+    mesh."""
+    from graphdyn.models.hpr import hpr_solve_batch
+    from graphdyn.parallel.mesh import device_pool, make_mesh
+
+    g = random_regular_graph(30, 3, seed=1)
+    mesh = make_mesh((8,), ("replica",), devices=device_pool(8))
+    cfg = HPRConfig(max_sweeps=2000)
+    base = hpr_solve_batch(g, cfg, n_replicas=R, seed=0)
+    sharded = hpr_solve_batch(g, cfg, n_replicas=R, seed=0, mesh=mesh)
+    np.testing.assert_array_equal(base.s, sharded.s)
+    np.testing.assert_array_equal(base.num_steps, sharded.num_steps)
+    np.testing.assert_array_equal(base.m_final, sharded.m_final)
+
+
+def test_hpr_float64_axis():
+    """HPRConfig.dtype='float64' runs the whole solver in f64 — the
+    reference's precision (`HPR_pytorch_RRG.py:11`,
+    torch.set_default_dtype(torch.float64))."""
+    import jax
+
+    g = random_regular_graph(60, 4, seed=1)
+    cfg64 = HPRConfig(
+        dynamics=DynamicsConfig(p=1, c=1), max_sweeps=3000, dtype="float64"
+    )
+    jax.config.update("jax_enable_x64", True)
+    try:
+        res = hpr_solve(g, cfg64, seed=0)
+        from graphdyn.models.hpr import hpr_solve_batch
+
+        batch = hpr_solve_batch(g, cfg64, n_replicas=2, seed=0)
+    finally:
+        jax.config.update("jax_enable_x64", False)
+    assert res.chi.dtype == np.float64
+    assert res.biases.dtype == np.float64
+    assert res.m_final == 1.0
+    out = end_state(g, res.s, p=1, c=1, backend="cpu")
+    assert np.all(out == 1)
+    assert np.all((batch.m_final == 1.0) | (batch.m_final == 2.0))
+
+    # f32 and f64 both solve the instance; trajectories may legitimately
+    # diverge (reinforcement thresholds amplify rounding), which is exactly
+    # why the axis exists
+    cfg32 = HPRConfig(dynamics=DynamicsConfig(p=1, c=1), max_sweeps=3000)
+    res32 = hpr_solve(g, cfg32, seed=0)
+    assert res32.m_final == 1.0
