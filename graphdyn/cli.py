@@ -296,25 +296,16 @@ def main(argv=None) -> int:
             if args.plot:
                 from types import SimpleNamespace
 
-                from graphdyn.plotting import plot_entropy_curve
+                from graphdyn.plotting import masked_mean, plot_entropy_curve
 
                 ax = None
                 for di, deg in enumerate(args.deg):
                     r = per_deg[di]
-                    finite = np.isfinite(r.ent1) & np.isfinite(r.m_init)
-                    cnt = finite.sum(axis=1)                  # member mean;
-                    none = cnt == 0                           # all-degraded λ
-                    cnt = np.maximum(cnt, 1)                  # rows -> NaN
-                    mean = SimpleNamespace(
-                        m_init=np.where(
-                            none, np.nan,
-                            np.where(finite, r.m_init, 0).sum(axis=1) / cnt,
-                        ),
-                        ent1=np.where(
-                            none, np.nan,
-                            np.where(finite, r.ent1, 0).sum(axis=1) / cnt,
-                        ),
-                    )
+                    ok = np.isfinite(r.m_init) & np.isfinite(r.ent1)
+                    mean = SimpleNamespace(   # member mean over jointly
+                        m_init=masked_mean(r.m_init, ok, axis=1),  # finite
+                        ent1=masked_mean(r.ent1, ok, axis=1),      # members;
+                    )                         # all-degraded λ rows -> NaN
                     ax = plot_entropy_curve(mean, ax=ax, label=f"deg={deg:g}")
                 ax.figure.tight_layout()
                 ax.figure.savefig(args.plot)

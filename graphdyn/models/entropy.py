@@ -722,6 +722,13 @@ class EntropyGridResult(NamedTuple):
     counts: np.ndarray          # [deg, rep] — the λ at which BP failed to
                                 # converge, or 0 (the reference's `counts`,
                                 # `ipynb:429-431`)
+    n_lambda: np.ndarray | None = None
+                                # [deg, rep] — number of λ ladder points
+                                # actually visited (early exits leave the
+                                # tail untouched); the explicit mask for
+                                # grid averaging, instead of inferring
+                                # visitedness from exact-zero sentinels.
+                                # None on grids built by pre-r4 callers
 
 
 def entropy_grid(
@@ -765,11 +772,13 @@ def entropy_grid(
     max_degrees = np.zeros((D, Rr))
     mean_degrees_total = np.zeros((D, Rr))
     counts = np.zeros((D, Rr))
+    n_lambda = np.zeros((D, Rr), np.int64)
     grids = {
         "grid_ent": ent, "grid_m_init": m_init, "grid_ent1": ent1,
         "grid_counts": counts, "grid_nodes_isolated": nodes_isolated,
         "grid_mean_degrees": mean_degrees, "grid_max_degrees": max_degrees,
         "grid_mean_degrees_total": mean_degrees_total,
+        "grid_n_lambda": n_lambda,
     }
 
     checkpointer = None
@@ -841,7 +850,8 @@ def entropy_grid(
                 if failed:
                     counts[di, rep] = cell_resume["last_lmbd"]
                 if failed or cell_resume["last_e1"] < config.ent_floor or k0 >= L:
-                    continue                    # cell had already stopped
+                    n_lambda[di, rep] = k0      # cell had already stopped
+                    continue
 
             ck = None
             if checkpointer is not None:
@@ -861,6 +871,7 @@ def entropy_grid(
             m_init[di, rep, sl] = res.m_init
             ent1[di, rep, sl] = res.ent1
             counts[di, rep] = res.nonconverged
+            n_lambda[di, rep] = k0 + k
 
     out = EntropyGridResult(
         deg=np.asarray(deg_grid),
@@ -872,6 +883,7 @@ def entropy_grid(
         max_degrees=max_degrees,
         mean_degrees_total=mean_degrees_total,
         counts=counts,
+        n_lambda=n_lambda,
     )
     if save_path:
         from graphdyn.utils.io import save_results_npz

@@ -486,7 +486,12 @@ def hpr_solve_batch(
             fp=run_fingerprint(graph.edges, config, R),
             interval_s=checkpoint_interval_s,
         )
-        arrays = ckpt.load_state(check=lambda a: a["s"].shape == (R * n,))
+        # t must be the all-equal [R] sweep-clock vector (scalar in pre-r4
+        # snapshots — those are refused by the fingerprint already, this
+        # keeps the refusal a clean ValueError rather than an index error)
+        arrays = ckpt.load_state(
+            check=lambda a: a["s"].shape == (R * n,) and a["t"].shape == (R,)
+        )
 
     if arrays is None:
         rng = np.random.default_rng(seed)
@@ -613,9 +618,11 @@ def hpr_ensemble(
     ``checkpoint_path`` makes the driver preemption-safe, exactly as in
     :func:`graphdyn.models.sa.sa_ensemble`: completed repetitions snapshot
     with the next repetition index, the in-flight chain checkpoints at
-    ``<path>_chain`` (exact resume), graphs re-derive from ``seed + k``."""
+    ``<path>_chain<k>`` (exact resume), graphs re-derive from ``seed + k``."""
     from graphdyn.graphs import random_regular_graph
-    from graphdyn.utils.io import Checkpoint, load_resume_prefix, save_results_npz
+    from graphdyn.utils.io import (
+        Checkpoint, PeriodicCheckpointer, load_resume_prefix, save_results_npz,
+    )
 
     config = config or HPRConfig()
     mag = np.empty(n_rep, np.float64)
@@ -626,6 +633,10 @@ def hpr_ensemble(
 
     start_k = 0
     ck = Checkpoint(checkpoint_path) if checkpoint_path else None
+    # driver snapshots share the chain checkpoint's interval (the conf array
+    # is [n_rep, n]; unconditional per-rep writes would dominate fast reps)
+    pc = (PeriodicCheckpointer(checkpoint_path, interval_s=checkpoint_interval_s)
+          if checkpoint_path else None)
     run_id = {"seed": seed, "n_rep": n_rep, "n": n, "d": d,
               "graph_method": graph_method, "config": repr(config)}
     if ck is not None:
@@ -641,7 +652,10 @@ def hpr_ensemble(
         g = random_regular_graph(n, d, seed=seed + k, method=graph_method)
         res = hpr_solve(
             g, config, seed=seed + k,
-            checkpoint_path=(checkpoint_path + "_chain") if checkpoint_path else None,
+            # per-rep chain path — see sa_ensemble: interval-gated driver
+            # snapshots can lag the in-flight rep, and a shared chain file
+            # from a later rep would wedge the earlier rep's resume
+            checkpoint_path=(checkpoint_path + f"_chain{k}") if checkpoint_path else None,
             checkpoint_interval_s=checkpoint_interval_s,
         )
         mag[k] = float(res.mag_reached)
@@ -649,8 +663,8 @@ def hpr_ensemble(
         steps[k] = res.num_steps
         graphs[k] = g.nbr
         times[k] = res.elapsed_s
-        if ck is not None:
-            ck.save(
+        if pc is not None:
+            pc.maybe_save(
                 {"mag_reached": mag, "conf": conf, "num_steps": steps,
                  "time": times},
                 {**run_id, "next_rep": k + 1},

@@ -440,10 +440,15 @@ def simulated_annealing(
             raise ValueError(f"chunk_steps must be >= 1, got {chunk_steps}")
         ckpt = ChainCheckpointer(
             checkpoint_path, kind="sa_chain", seed=seed,
-            # full run identity: same graph, config, budget, dtype, x64 mode
+            # full run identity: same graph, config, budget, dtype, x64
+            # mode — and, in injected mode, the caller-supplied streams
+            # themselves (a resume under different streams would otherwise
+            # pass validation and splice a chimera chain)
             fp=run_fingerprint(
                 graph.edges, config, int(max_steps), bool(injected),
                 np_dt, bool(jax.config.jax_enable_x64),
+                *((np.asarray(proposals), np.asarray(uniforms))
+                  if injected else ()),
             ),
             interval_s=checkpoint_interval_s,
             extra_meta={"R": int(R)},
@@ -596,11 +601,13 @@ def sa_ensemble(
 
     ``checkpoint_path`` makes the whole driver preemption-safe: completed
     repetitions are snapshotted (with the next repetition index), and the
-    in-flight chain checkpoints its own state at ``<path>_chain`` (exact
+    in-flight chain checkpoints its own state at ``<path>_chain<k>`` (exact
     resume — see :func:`simulated_annealing`). Graphs re-derive from
     ``seed + k``, so a resumed run records identical graphs."""
     from graphdyn.graphs import random_regular_graph
-    from graphdyn.utils.io import Checkpoint, load_resume_prefix, save_results_npz
+    from graphdyn.utils.io import (
+        Checkpoint, PeriodicCheckpointer, load_resume_prefix, save_results_npz,
+    )
 
     config = config or SAConfig()
     mag = np.empty(n_stat, np.float64)
@@ -611,6 +618,12 @@ def sa_ensemble(
 
     start_k = 0
     ck = Checkpoint(checkpoint_path) if checkpoint_path else None
+    # driver snapshots share the chain checkpoint's interval: the payload
+    # includes the [n_stat, n] conf array, so unconditional per-rep writes
+    # would dominate fast-rep runs; a lost tail of completed reps simply
+    # recomputes on resume
+    pc = (PeriodicCheckpointer(checkpoint_path, interval_s=checkpoint_interval_s)
+          if checkpoint_path else None)
     run_id = {"seed": seed, "n_stat": n_stat, "n": n, "d": d,
               "max_steps": max_steps, "graph_method": graph_method,
               "config": repr(config), "backend": backend}
@@ -626,9 +639,15 @@ def sa_ensemble(
     for k in range(start_k, n_stat):
         g = random_regular_graph(n, d, seed=seed + k, method=graph_method)
         chain_ckpt = (
-            checkpoint_path + "_chain"
+            checkpoint_path + f"_chain{k}"
             if checkpoint_path and backend != "cpu" else None
-        )   # driver-level resume still works for the numpy-oracle backend
+        )   # driver-level resume still works for the numpy-oracle backend.
+        # Per-rep chain paths: driver snapshots are interval-gated, so
+        # next_rep may lag the in-flight rep after a preemption — a SHARED
+        # chain path would then hold a later rep's snapshot, which the
+        # earlier rep's fingerprint check refuses (resume permanently
+        # wedged). Per-rep files are either resumed when their rep re-runs
+        # or removed on that rep's completion.
         res = simulated_annealing(
             g, config, n_replicas=1, seed=seed + k,
             max_steps=max_steps, backend=backend,
@@ -641,8 +660,8 @@ def sa_ensemble(
         conf[k] = res.s[0]
         graphs[k] = g.nbr
         m_final[k] = res.m_final[0]
-        if ck is not None:
-            ck.save(
+        if pc is not None:
+            pc.maybe_save(
                 {
                     "mag_reached": mag, "num_steps": steps,
                     "conf": conf, "m_final": m_final,

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from graphdyn.config import SAConfig
 from graphdyn.models.sa import (
@@ -177,7 +177,7 @@ def make_sharded_sa_solver(
         mesh=mesh,
         in_specs=(P(node_axis, None), P(replica_axis, node_axis)),
         out_specs=rep,
-        check_rep=False,
+        check_vma=False,
     ))
     chunk_fn = jax.jit(shard_map(
         chunk,
@@ -194,7 +194,7 @@ def make_sharded_sa_solver(
             P(replica_axis, node_axis),
             rep, rep, rep, rep, rep, rep, rep, rep,
         ),
-        check_rep=False,
+        check_vma=False,
     ))
     return init_fn, chunk_fn
 
@@ -256,10 +256,14 @@ def sa_sharded(
         ckpt = ChainCheckpointer(
             checkpoint_path, kind="sa_sharded_chain", seed=seed,
             # run identity deliberately excludes the mesh shape: state is
-            # saved unpadded/global, so resuming on a different mesh works
+            # saved unpadded/global, so resuming on a different mesh works.
+            # Injected streams ARE identity (resuming under different
+            # streams would splice a chimera chain)
             fp=run_fingerprint(
                 graph.edges, config, int(max_steps), bool(injected),
                 np_dt, bool(jax.config.jax_enable_x64),
+                *((np.asarray(proposals), np.asarray(uniforms))
+                  if injected else ()),
             ),
             interval_s=checkpoint_interval_s,
             extra_meta={"R": int(R)},

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from graphdyn.ops.dynamics import rule_coefficients
 
@@ -118,7 +118,7 @@ def make_sharded_rollout(
         mesh=mesh,
         in_specs=(P(node_axis, None), P(replica_axis, node_axis)),
         out_specs=P(replica_axis, node_axis),
-        check_rep=False,
+        check_vma=False,
     )
     return jax.jit(f)
 
@@ -219,7 +219,7 @@ def make_sharded_sa_step(
             P(replica_axis),
             P(),
         ),
-        check_rep=False,
+        check_vma=False,
     )
     return jax.jit(f)
 

@@ -23,6 +23,21 @@ def _mpl():
     return plt
 
 
+def masked_mean(values, visited=None, axis: int = 0) -> np.ndarray:
+    """Mean of ``values`` over ``axis`` restricted to ``visited`` AND finite
+    entries; positions with no contributing entries give NaN (so downstream
+    finite-masking drops them). The one masking rule shared by the grid
+    'mean' plot and the CLI's union-ensemble member mean — degraded −inf/NaN
+    members must not poison the mean of the finite ones at the same λ."""
+    v = np.asarray(values, float)
+    ok = np.isfinite(v)
+    if visited is not None:
+        ok &= visited
+    cnt = ok.sum(axis=axis)
+    mean = np.where(ok, v, 0.0).sum(axis=axis) / np.maximum(cnt, 1)
+    return np.where(cnt == 0, np.nan, mean)
+
+
 def plot_entropy_curve(result, *, ax=None, label=None, save_path=None):
     """Plot one tilted-entropy curve s(m_init) from an
     :class:`~graphdyn.models.entropy.EntropyResult` (or any object with
@@ -64,15 +79,24 @@ def plot_entropy_grid(grid, *, rep: int | str = "mean", save_path=None):
         m = np.asarray(grid.m_init[di], float)     # [rep, λ]
         s = np.asarray(grid.ent1[di], float)
         if rep == "mean":
-            # untouched entries stay 0; −inf/NaN (degraded reps) must not
-            # poison the mean of the finite reps at the same λ
-            visited = ((m != 0) | (s != 0)) & np.isfinite(m) & np.isfinite(s)
-            with np.errstate(invalid="ignore"):
-                cnt = np.maximum(visited.sum(axis=0), 1)
-                m_v = np.where(visited, m, 0.0).sum(axis=0) / cnt
-                s_v = np.where(visited, s, 0.0).sum(axis=0) / cnt
-            keep = visited.any(axis=0)
-            m_v, s_v = m_v[keep], s_v[keep]
+            # visited λ cells come from the explicit per-rep count when the
+            # grid carries it; legacy grids (or cells restored from old
+            # checkpoints) fall back to the zero-value sentinel, OR-ed in so
+            # a legitimately-(0, 0) visited point is kept when counted
+            lam_count = getattr(grid, "n_lambda", None)
+            visited = (m != 0) | (s != 0)
+            if lam_count is not None:
+                counted = (
+                    np.arange(m.shape[1])[None, :]
+                    < np.asarray(lam_count)[di][:, None]
+                )
+                visited |= counted
+            # joint finiteness: a rep degraded in EITHER grid drops out of
+            # BOTH means, so each plotted (m, s) point averages one
+            # population
+            visited &= np.isfinite(m) & np.isfinite(s)
+            m_v = masked_mean(m, visited)
+            s_v = masked_mean(s, visited)
         else:
             m_v, s_v = m[int(rep)], s[int(rep)]
         finite = np.isfinite(m_v) & np.isfinite(s_v)

@@ -76,11 +76,14 @@ class Checkpoint:
         os.replace(tmp, self.path + ".npz")
 
     def remove(self) -> None:
-        """Delete the checkpoint file if present (end-of-run cleanup)."""
-        try:
-            os.remove(self.path + ".npz")
-        except FileNotFoundError:
-            pass
+        """Delete the checkpoint file if present (end-of-run cleanup), plus
+        any stale temp file a preemption between ``np.savez`` and
+        ``os.replace`` may have left behind."""
+        for p in (self.path + ".npz", self.path + ".tmp.npz"):
+            try:
+                os.remove(p)
+            except FileNotFoundError:
+                pass
 
     def load(self) -> tuple[dict[str, np.ndarray], dict[str, Any]] | None:
         if not os.path.exists(self.path + ".npz"):

@@ -1,0 +1,126 @@
+#!/usr/bin/env python3
+"""Physics end-to-end at reference scale (round-4 deliverable).
+
+Two committed demonstrations that the solvers actually solve the thesis
+problem at the reference's own constants, not just at test scale:
+
+(a) SA at `SA_RRG.py:44-56`: n=10⁴, d=4, p=3, c=1, a₀=0.015n, b₀=0.01n,
+    anneal ×1.0005 capped at 4.5n/5n — chains run until
+    m(s_endstate) = 1 and report the achieved initial magnetization
+    ``mag_reached`` and step count (`SA_RRG.py:86-88`).
+(b) HPr at `HPR_pytorch_RRG.py:222-237`: n=10⁴, d=4, p=c=1, λ_eff=25,
+    π=0.3, γ=0.1 — run to consensus, report sweep count and wall-clock
+    (the reference's persisted `time`, `HPR:364`).
+
+Writes ``physics_r04.json``; RESULTS_r04.md summarizes it.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from graphdyn.utils.platform import apply_force_platform
+
+apply_force_platform()
+
+import numpy as np
+
+from graphdyn.config import DynamicsConfig, HPRConfig, SAConfig
+from graphdyn.graphs import random_regular_graph
+from graphdyn.models.hpr import hpr_solve
+from graphdyn.models.sa import simulated_annealing
+from graphdyn.ops.dynamics import end_state
+
+
+def run_sa(n=10_000, d=4, replicas=4, max_steps=100_000_000, out=None):
+    import jax
+
+    g = random_regular_graph(n, d, seed=0)
+    cfg = SAConfig(dynamics=DynamicsConfig(p=3, c=1), max_steps=max_steps)
+    t0 = time.time()
+    res = simulated_annealing(
+        g, cfg, n_replicas=replicas, seed=0, rollout_mode="lightcone"
+    )
+    elapsed = time.time() - t0
+    rows = []
+    for r in range(replicas):
+        verified = bool(
+            np.all(np.asarray(end_state(g, res.s[r], 3, 1, backend="cpu")) == 1)
+        ) if res.m_final[r] == 1.0 else False
+        rows.append({
+            "replica": r,
+            "m_final": float(res.m_final[r]),
+            "mag_reached": float(res.mag_reached[r]),
+            "num_steps": int(res.num_steps[r]),
+            "endstate_all_plus1_verified": verified,
+        })
+        print(f"SA replica {r}: m_final={res.m_final[r]} "
+              f"mag_reached={res.mag_reached[r]:.4f} steps={res.num_steps[r]} "
+              f"verified={verified}", flush=True)
+    result = {
+        "task": "SA at reference constants (SA_RRG.py:44-56)",
+        "n": n, "d": d, "p": 3, "c": 1, "replicas": replicas,
+        "max_steps": max_steps, "platform": jax.default_backend(),
+        "elapsed_s": round(elapsed, 1),
+        "chains": rows,
+        "consensus_fraction": float(np.mean([r["m_final"] == 1.0 for r in rows])),
+        "median_steps_to_consensus": (
+            float(np.median([r["num_steps"] for r in rows if r["m_final"] == 1.0]))
+            if any(r["m_final"] == 1.0 for r in rows) else None
+        ),
+    }
+    if out:
+        _merge(out, "sa", result)
+    return result
+
+
+def run_hpr(n=10_000, d=4, out=None):
+    import jax
+
+    g = random_regular_graph(n, d, seed=0)
+    cfg = HPRConfig(dynamics=DynamicsConfig(p=1, c=1))   # TT=10^4, λ_eff=25
+    t0 = time.time()
+    res = hpr_solve(g, cfg, seed=0)
+    elapsed = time.time() - t0
+    verified = bool(
+        np.all(np.asarray(end_state(g, res.s, 1, 1, backend="cpu")) == 1)
+    ) if res.m_final == 1.0 else False
+    print(f"HPr: m_final={res.m_final} mag_reached={float(res.mag_reached):.4f} "
+          f"sweeps={res.num_steps} wall={elapsed:.1f}s verified={verified}",
+          flush=True)
+    result = {
+        "task": "HPr at reference constants (HPR_pytorch_RRG.py:222-237)",
+        "n": n, "d": d, "p": 1, "c": 1,
+        "platform": jax.default_backend(),
+        "m_final": float(res.m_final),
+        "mag_reached": float(res.mag_reached),
+        "num_sweeps": int(res.num_steps),
+        "wall_clock_s": round(elapsed, 1),
+        "endstate_all_plus1_verified": verified,
+    }
+    if out:
+        _merge(out, "hpr", result)
+    return result
+
+
+def _merge(path, key, value):
+    data = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            data = json.load(f)
+    data[key] = value
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1)
+    print(f"updated {path} [{key}]", flush=True)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "both"
+    out = sys.argv[2] if len(sys.argv) > 2 else "physics_r04.json"
+    if which in ("hpr", "both"):
+        run_hpr(out=out)
+    if which in ("sa", "both"):
+        run_sa(out=out)

@@ -156,10 +156,12 @@ def test_hpr_ensemble_driver_resume(tmp_path, abort_after_save):
     p = str(tmp_path / "hpr_grid")
     with abort_after_save(when=lambda meta: meta.get("next_rep") == 1):
         with pytest.raises(CheckpointAbort):
-            hpr_ensemble(50, 4, cfg, checkpoint_path=p, **kw)
+            hpr_ensemble(50, 4, cfg, checkpoint_path=p,
+                         checkpoint_interval_s=0.0, **kw)
     assert os.path.exists(p + ".npz")
 
-    resumed = hpr_ensemble(50, 4, cfg, checkpoint_path=p, **kw)
+    resumed = hpr_ensemble(50, 4, cfg, checkpoint_path=p,
+                         checkpoint_interval_s=0.0, **kw)
     np.testing.assert_array_equal(base.conf, resumed.conf)
     np.testing.assert_array_equal(base.num_steps, resumed.num_steps)
     np.testing.assert_array_equal(base.graphs, resumed.graphs)

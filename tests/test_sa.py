@@ -224,10 +224,12 @@ def test_sa_ensemble_driver_resume(tmp_path, abort_after_save):
     p = str(tmp_path / "sa_grid")
     with abort_after_save(when=lambda meta: meta.get("next_rep") == 2):
         with pytest.raises(CheckpointAbort):    # die after rep 2 of 3 lands
-            sa_ensemble(30, 3, cfg, checkpoint_path=p, **kw)
+            sa_ensemble(30, 3, cfg, checkpoint_path=p,
+                        checkpoint_interval_s=0.0, **kw)
     assert os.path.exists(p + ".npz")
 
-    resumed = sa_ensemble(30, 3, cfg, checkpoint_path=p, **kw)
+    resumed = sa_ensemble(30, 3, cfg, checkpoint_path=p,
+                        checkpoint_interval_s=0.0, **kw)
     np.testing.assert_array_equal(base.conf, resumed.conf)
     np.testing.assert_array_equal(base.num_steps, resumed.num_steps)
     np.testing.assert_array_equal(base.graphs, resumed.graphs)
@@ -238,7 +240,8 @@ def test_sa_ensemble_driver_resume(tmp_path, abort_after_save):
                                                           "n_stat": 3,
                                                           "next_rep": 1})
     with pytest.raises(ValueError, match="different"):
-        sa_ensemble(30, 3, cfg, checkpoint_path=p, **kw)
+        sa_ensemble(30, 3, cfg, checkpoint_path=p,
+                        checkpoint_interval_s=0.0, **kw)
 
 
 def test_lightcone_bit_parity_with_full():
