@@ -119,6 +119,42 @@ def run_replicas(n, R, sweeps):
     )
 
 
+def run_t3(n, sweeps):
+    """T=3 regime (p=2, c=1, d=4 ⇒ K=8, 125-slot ρ-lattice): the trajectory
+    horizon the fused Pallas DP kernel accelerates (PALLAS_TPU.md §2
+    measured 4.1× at (d−1, T) = (3, 3) on chip), exercised END-TO-END as an
+    HPr iteration (sweep + marginals) with the kernel on vs off. Off-TPU
+    both rows take the XLA path (auto disables Pallas), so the A/B is
+    meaningful on chip; the config still runs everywhere as a T-scaling
+    throughput number (`HPR_pytorch_RRG.py:241-242` — the 2^{2T} combo
+    axis)."""
+    g = random_regular_graph(n, 4, seed=0)
+    data = BDCMData(g, p=2, c=1)
+    marginals = make_marginals(data)
+    chi = data.init_messages(0)
+    bias = jnp.ones((data.num_directed, data.K), jnp.float32)
+    for use_pallas, tag in (("auto", "pallas_auto"), (False, "xla")):
+        sweep = make_sweep(
+            data, damp=0.4, mask_invalid_src=False, with_bias=True,
+            use_pallas=use_pallas,
+        )
+
+        @jax.jit
+        def body(chi, sweep=sweep, marginals=marginals, bias=bias):
+            chi = sweep(chi, jnp.float32(25.0), bias)
+            return chi, marginals(chi)
+
+        (_, _), dt = timed(lambda c: body(c), chi, iters=sweeps)
+        report(
+            "hpr_t3_message_updates_per_sec_d4_rrg_n%d_%s" % (n, tag),
+            data.num_directed * data.K * data.K / dt,
+            "message-combos/s",
+            sweeps_per_sec=1.0 / dt,
+            T=3,
+            backend=jax.default_backend(),
+        )
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--full", action="store_true")
@@ -126,6 +162,8 @@ if __name__ == "__main__":
     if a.full:
         run(100_000, 20)
         run_replicas(100_000, 256, 5)
+        run_t3(100_000, 10)
     else:
         run(10_000, 20)
         run_replicas(10_000, 8, 5)
+        run_t3(10_000, 5)

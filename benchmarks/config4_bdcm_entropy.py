@@ -62,6 +62,30 @@ def run(n, n_graphs, n_lambda):
         union=True,
     )
 
+    # mesh-sharded union path: same workload with every fixed point
+    # edge-sharded over the devices (make_sharded_fixed_point); on one
+    # device this is skipped — the unsharded number above is the metric
+    import jax
+
+    n_dev = len(jax.devices())
+    if n_dev > 1:
+        from graphdyn.parallel.mesh import make_mesh
+
+        emesh = make_mesh((n_dev,), ("edge",))
+        t0 = time.perf_counter()
+        res = entropy_ensemble_union(
+            er_graphs, cfg, seed=0, lambdas=lambdas, mesh=emesh
+        )
+        dt = time.perf_counter() - t0
+        report(
+            "bdcm_entropy_union_mesh_graph_lambda_points_per_sec_n%d" % n,
+            res.lambdas.size * n_graphs / dt,
+            "graph-lambda-points/s",
+            graphs=n_graphs,
+            union=True,
+            mesh="%dx1" % n_dev,
+        )
+
     # vmapped congruent-ensemble path (RRG members share one signature)
     from graphdyn.graphs import random_regular_graph
     from graphdyn.models.entropy import entropy_ensemble

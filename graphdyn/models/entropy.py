@@ -527,6 +527,8 @@ def entropy_ensemble_union(
     checkpoint_path: str | None = None,
     checkpoint_interval_s: float = 30.0,
     verbose: bool = False,
+    mesh=None,
+    edge_axis: str = "edge",
 ) -> UnionEnsembleEntropyResult:
     """The λ ladder over an ARBITRARY graph ensemble as one device program,
     via the disjoint union (:func:`graphdyn.graphs.disjoint_union`).
@@ -550,6 +552,13 @@ def entropy_ensemble_union(
     as :func:`entropy_grid` — an identity-validated restart re-enters the
     ladder at the first unvisited λ with the saved warm-start chi, a
     mismatched run is refused, and the file is removed on completion.
+
+    ``mesh``: run every fixed point edge-sharded over the mesh's
+    ``edge_axis`` (:func:`graphdyn.parallel.sharded.make_sharded_fixed_point`
+    — the per-class DP tensors, the memory/FLOP hot spot, split across
+    devices; chi stays replicated). The ~10² sweeps per λ dominate the
+    ladder, so the once-per-λ observables run unsharded; results match the
+    single-device path to roundoff (tested on the 8-device CPU mesh).
     """
     from graphdyn.graphs import disjoint_union
     from graphdyn.ops.bdcm import (
@@ -623,7 +632,16 @@ def entropy_ensemble_union(
         gu, p=dyn.p, c=dyn.c, attr_value=dyn.attr_value,
         rule=dyn.rule, tie=dyn.tie, dtype=config.dtype,
     )
-    fixed_point = make_fixed_point(data, config)
+    if mesh is not None:
+        from graphdyn.parallel.sharded import make_sharded_fixed_point
+
+        fixed_point = make_sharded_fixed_point(
+            data, mesh, damp=config.damp, eps=float(config.eps),
+            max_sweeps=int(config.max_sweeps),
+            eps_clamp=config.eps_clamp, edge_axis=edge_axis,
+        )
+    else:
+        fixed_point = make_fixed_point(data, config)
     set_leaves = make_leaf_setter(data)
     zi_fn = make_node_partition(data, eps_clamp=config.eps_clamp)
     zij_fn = make_edge_partition(data, eps_clamp=config.eps_clamp)

@@ -233,7 +233,7 @@ def place_sharded(mesh: Mesh, x, spec: P):
 # ---------------------------------------------------------------------------
 
 
-def make_sharded_sweep(
+def _sharded_sweep_body(
     data,
     mesh: Mesh,
     *,
@@ -242,19 +242,10 @@ def make_sharded_sweep(
     mask_invalid_src: bool = True,
     edge_axis: str = "edge",
 ):
-    """Edge-parallel BDCM sweep ``(chi, lmbd) -> chi'`` over ``mesh``.
-
-    The reference's BP sweeps are single-device (`HPR_pytorch_RRG.py:348`,
-    `ER_BDCM_entropy.ipynb:424`). For giant single graphs the per-class DP
-    tensors (``[Ed, K, (d+1)^T]`` — the memory hot spot, SURVEY.md §7 "hard
-    parts") dominate; here they shard over the mesh's ``edge_axis`` via GSPMD
-    sharding constraints: the message array stays replicated (it is small —
-    the DP state is what explodes), each device computes the DP + contraction
-    for its slice of every degree class, and XLA inserts the (all_gather /
-    scatter) collectives over ICI. Numerically identical to
-    :func:`graphdyn.ops.bdcm.make_sweep` — covered by the sharded-vs-unsharded
-    equivalence test on the simulated CPU mesh (SURVEY.md §4.4).
-    """
+    """Shared core of :func:`make_sharded_sweep` and
+    :func:`make_sharded_fixed_point`: builds the padded per-class tables and
+    returns ``(sweep_body(chi, lmbd) -> chi', replicated_sharding)`` for the
+    callers to jit (standalone or inside a while_loop)."""
     import jax.numpy as jnp
 
     from graphdyn.ops.bdcm import class_update
@@ -287,8 +278,7 @@ def make_sharded_sweep(
     shard = NamedSharding(mesh, P(edge_axis))
     replicated = NamedSharding(mesh, P())
 
-    @partial(jax.jit, out_shardings=replicated)
-    def sweep(chi, lmbd):
+    def sweep_body(chi, lmbd):
         tilt = jnp.exp(-lmbd * x0)
         for d, idx, in_edges, A in classes:
             chi_in = jax.lax.with_sharding_constraint(
@@ -303,4 +293,75 @@ def make_sharded_sweep(
             chi = chi.at[idx].set(upd)
         return chi
 
-    return sweep
+    return sweep_body, replicated
+
+
+def make_sharded_sweep(
+    data,
+    mesh: Mesh,
+    *,
+    damp: float,
+    eps_clamp: float = 0.0,
+    mask_invalid_src: bool = True,
+    edge_axis: str = "edge",
+):
+    """Edge-parallel BDCM sweep ``(chi, lmbd) -> chi'`` over ``mesh``.
+
+    The reference's BP sweeps are single-device (`HPR_pytorch_RRG.py:348`,
+    `ER_BDCM_entropy.ipynb:424`). For giant single graphs the per-class DP
+    tensors (``[Ed, K, (d+1)^T]`` — the memory hot spot, SURVEY.md §7 "hard
+    parts") dominate; here they shard over the mesh's ``edge_axis`` via GSPMD
+    sharding constraints: the message array stays replicated (it is small —
+    the DP state is what explodes), each device computes the DP + contraction
+    for its slice of every degree class, and XLA inserts the (all_gather /
+    scatter) collectives over ICI. Numerically identical to
+    :func:`graphdyn.ops.bdcm.make_sweep` — covered by the sharded-vs-unsharded
+    equivalence test on the simulated CPU mesh (SURVEY.md §4.4).
+    """
+    sweep_body, replicated = _sharded_sweep_body(
+        data, mesh, damp=damp, eps_clamp=eps_clamp,
+        mask_invalid_src=mask_invalid_src, edge_axis=edge_axis,
+    )
+    return jax.jit(sweep_body, out_shardings=replicated)
+
+
+def make_sharded_fixed_point(
+    data,
+    mesh: Mesh,
+    *,
+    damp: float,
+    eps: float,
+    max_sweeps: int,
+    eps_clamp: float = 0.0,
+    edge_axis: str = "edge",
+):
+    """Edge-sharded BP fixed point ``(chi, lmbd) -> (chi*, sweeps, delta)``:
+    the entropy solvers' hot loop (`ipynb:420-432` — one fixed point per λ,
+    ~10²–10³ sweeps each) with every sweep's per-class DP sharded over
+    ``edge_axis`` exactly as :func:`make_sharded_sweep` (same padded class
+    tables, same arithmetic per edge — results match the unsharded
+    :func:`graphdyn.models.entropy.make_fixed_point` to roundoff; tested).
+    The convergence test ``max|Δchi|`` is a global reduction XLA lowers to
+    one small all-reduce per sweep."""
+    sweep_body, replicated = _sharded_sweep_body(
+        data, mesh, damp=damp, eps_clamp=eps_clamp,
+        mask_invalid_src=True, edge_axis=edge_axis,
+    )
+
+    @partial(jax.jit, out_shardings=(replicated, replicated, replicated))
+    def fixed_point(chi, lmbd):
+        def cond(st):
+            _, delta, t = st
+            return (delta > eps) & (t < max_sweeps)
+
+        def body(st):
+            chi, _, t = st
+            new = sweep_body(chi, lmbd)
+            return new, jnp.abs(new - chi).max(), t + 1
+
+        chi_out, delta, t = lax.while_loop(
+            cond, body, (chi, jnp.asarray(jnp.inf, chi.dtype), 0)
+        )
+        return chi_out, t, delta
+
+    return fixed_point

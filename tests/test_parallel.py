@@ -143,3 +143,24 @@ def test_sharded_sweep_f64_matches_unsharded():
         )
     finally:
         jax.config.update("jax_enable_x64", prev_x64)
+
+
+def test_union_entropy_mesh_matches_unsharded():
+    """entropy_ensemble_union(mesh=...) — every fixed point edge-sharded via
+    make_sharded_fixed_point — reproduces the single-device ladder
+    (BASELINE config 4 under mesh parallelism)."""
+    from graphdyn.config import EntropyConfig
+    from graphdyn.models.entropy import entropy_ensemble_union
+
+    graphs = [erdos_renyi_graph(60, 1.8 / 59, seed=k) for k in range(4)]
+    cfg = EntropyConfig(lmbd_max=1.0, lmbd_step=0.5, max_sweeps=300)
+    base = entropy_ensemble_union(graphs, cfg, seed=0)
+    emesh = make_mesh((8,), ("edge",), devices=device_pool(8))
+    sh = entropy_ensemble_union(graphs, cfg, seed=0, mesh=emesh)
+    np.testing.assert_array_equal(base.lambdas, sh.lambdas)
+    # reduction orders differ by roundoff, so a fixed point sitting within
+    # roundoff of eps can converge one sweep apart between the paths
+    assert np.all(np.abs(base.sweeps - sh.sweeps) <= 1)
+    np.testing.assert_allclose(base.ent, sh.ent, rtol=2e-5, atol=1e-7)
+    np.testing.assert_allclose(base.m_init, sh.m_init, rtol=2e-5, atol=1e-7)
+    np.testing.assert_allclose(base.ent1, sh.ent1, rtol=2e-5, atol=1e-7)
