@@ -164,6 +164,17 @@ def main():
                 "int8_rate": v8,
                 "torch_cpu_rate": base,
                 "packed_replicas": R_packed,
+                # fraction of the kernel's own HBM-streaming bound on a
+                # v5e-class chip (~800 GB/s => ~1.6e12 packed spin-updates/s
+                # at n=1e6 d=3 — ARCHITECTURE.md roofline). The bound is
+                # derived for the FULL shape, so report it only there (and
+                # it is only meaningful when backend == tpu); smoke's n=1e5
+                # working set is partly cache-resident, not HBM-streaming
+                **(
+                    {"roofline_fraction_v5e": value / 1.6e12}
+                    if not args.smoke else {}
+                ),
+                "backend": jax.default_backend(),
             }
         )
     )
