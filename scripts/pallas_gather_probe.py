@@ -1,0 +1,172 @@
+#!/usr/bin/env python3
+"""Random-row gather probe: XLA take vs Pallas explicit row-DMA.
+
+The packed dynamics kernel's gap to its HBM-streaming roofline is gather
+*randomness* (ARCHITECTURE.md): per step it reads ``n·d`` randomly-indexed
+``[1, W]`` uint32 rows. This probe grounds the roofline refinement in
+measurements, answering two questions on the real chip:
+
+1. What random-row rate (rows/s and effective GB/s) does XLA's native
+   gather achieve as a function of row width W? If rows/s is ~constant in
+   W, the kernel is ACCESS-RATE-bound, not bandwidth-bound — and the
+   wide-replica lever in bench.py (4× W ⇒ ~4× headline) is the fix, no
+   custom kernel needed.
+2. Can a Pallas kernel with explicitly pipelined per-row HBM→VMEM DMAs
+   (depth-S double buffering, the guide's sparse-gather pattern) beat the
+   XLA gather at the same shape? If the two land close, XLA is already at
+   the hardware's random-access limit and the written analysis closes
+   VERDICT r3 task 8; if Pallas wins big, it graduates into the dynamics
+   kernel.
+
+Runs in interpret mode off-TPU (correctness only); rates are meaningful on
+chip. Emits one JSON line per (impl, W) combo.
+"""
+
+import argparse
+import functools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import timed   # applies GRAPHDYN_FORCE_PLATFORM
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def xla_gather_rate(n_src, n_idx, W, iters=3, seed=0):
+    rng = np.random.default_rng(seed)
+    src = jnp.asarray(rng.integers(0, 2**32, size=(n_src, W), dtype=np.uint32))
+    idx = jnp.asarray(rng.integers(0, n_src, size=n_idx).astype(np.int32))
+    f = jax.jit(lambda s, i: jnp.take(s, i, axis=0))
+    out, dt = timed(f, src, idx, iters=iters)
+    return n_idx / dt, n_idx * W * 4 / dt, out
+
+
+def pallas_gather(src, idx, *, block=256, depth=8, interpret=False):
+    """out[i] = src[idx[i]] via per-row async copies, depth-``depth``
+    pipelined. Grid tiles the index vector; indices ride in SMEM; the source
+    stays in HBM (memory_space=ANY) and rows land in a VMEM ring buffer."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_idx = idx.shape[0]
+    W = src.shape[1]
+    assert n_idx % block == 0
+
+    def kernel(idx_ref, src_ref, out_ref, scratch, sems):
+        def dma(k):
+            slot = jax.lax.rem(k, depth)
+            return pltpu.make_async_copy(
+                src_ref.at[pl.ds(idx_ref[k], 1), :],
+                scratch.at[pl.ds(slot, 1), :],
+                sems.at[slot],
+            )
+
+        def warm(k, _):
+            dma(k).start()
+            return 0
+
+        jax.lax.fori_loop(0, min(depth, block), warm, 0)
+
+        def body(k, _):
+            dma(k).wait()
+            slot = jax.lax.rem(k, depth)
+            out_ref[pl.ds(k, 1), :] = scratch[pl.ds(slot, 1), :]
+
+            # refill the slot only AFTER its row is consumed (k+depth shares
+            # slot(k)); lookahead depth-1 DMAs stay in flight
+            @pl.when(k + depth < block)
+            def _():
+                dma(k + depth).start()
+
+            return 0
+
+        jax.lax.fori_loop(0, block, body, 0)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n_idx // block,),
+        in_specs=[
+            pl.BlockSpec((block,), lambda i: (i,), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((block, W), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_idx, W), src.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((depth, W), src.dtype),
+            pltpu.SemaphoreType.DMA((depth,)),
+        ],
+        interpret=interpret,
+    )(idx, src)
+
+
+def pallas_gather_rate(n_src, n_idx, W, iters=3, seed=0, depth=8, interpret=False):
+    rng = np.random.default_rng(seed)
+    src = jnp.asarray(rng.integers(0, 2**32, size=(n_src, W), dtype=np.uint32))
+    idx = jnp.asarray(rng.integers(0, n_src, size=n_idx).astype(np.int32))
+    f = jax.jit(functools.partial(pallas_gather, depth=depth, interpret=interpret))
+    out, dt = timed(f, src, idx, iters=iters)
+    return n_idx / dt, n_idx * W * 4 / dt, out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-src", type=int, default=1_000_000)
+    ap.add_argument("--n-idx", type=int, default=3 * 1_000_000)
+    ap.add_argument("--widths", type=int, nargs="+", default=[128, 512, 1024])
+    ap.add_argument("--depth", type=int, default=8)
+    ap.add_argument("--check", action="store_true",
+                    help="small-shape correctness check (interpret off-TPU)")
+    args = ap.parse_args()
+
+    on_tpu = jax.default_backend() == "tpu"
+    if args.check or not on_tpu:
+        rng = np.random.default_rng(1)
+        src = jnp.asarray(rng.integers(0, 2**32, size=(512, 128), dtype=np.uint32))
+        idx = jnp.asarray(rng.integers(0, 512, size=1024).astype(np.int32))
+        out = pallas_gather(src, idx, block=256, depth=4, interpret=not on_tpu)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(src)[np.asarray(idx)])
+        print(json.dumps({"check": "ok", "backend": jax.default_backend()}))
+        if not on_tpu:
+            return 0
+
+    for W in args.widths:
+        # constant gathered BYTES across widths (and block-aligned), so the
+        # rows/s-vs-W trend isolates the access-rate question; also keeps
+        # the W=1024 output buffer inside a v5e's 16 GB HBM
+        n_idx = max(256, (args.n_idx * 128 // W) // 256 * 256)
+        try:
+            rows, bw, out_x = xla_gather_rate(args.n_src, n_idx, W)
+            print(json.dumps({
+                "impl": "xla_take", "W": W, "n_idx": n_idx,
+                "rows_per_s": rows, "GBps": bw / 1e9,
+            }), flush=True)
+        except Exception as e:  # noqa: BLE001 — record (e.g. OOM), keep probing
+            print(json.dumps({
+                "impl": "xla_take", "W": W, "error": str(e)[:300],
+            }), flush=True)
+            continue
+        try:
+            prows, pbw, out_p = pallas_gather_rate(
+                args.n_src, n_idx, W, depth=args.depth
+            )
+            match = bool(jnp.array_equal(out_x, out_p))   # device-side; one
+            print(json.dumps({                            # scalar to host
+                "impl": "pallas_row_dma", "W": W, "depth": args.depth,
+                "n_idx": n_idx,
+                "rows_per_s": prows, "GBps": pbw / 1e9, "matches_xla": match,
+            }), flush=True)
+        except Exception as e:  # noqa: BLE001 — record, keep probing
+            print(json.dumps({
+                "impl": "pallas_row_dma", "W": W, "error": str(e)[:300],
+            }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,11 @@ timeout 1800 python scripts/packed_gather_experiment.py \
     > "$OUT/gather_experiment.jsonl" 2> "$OUT/gather_experiment.err"
 echo "[tpu-session] gather rc=$?" >&2
 
+echo "[tpu-session] pallas random-row gather probe ..." >&2
+timeout 1800 python scripts/pallas_gather_probe.py \
+    > "$OUT/pallas_gather_probe.jsonl" 2> "$OUT/pallas_gather_probe.err"
+echo "[tpu-session] probe rc=$?" >&2
+
 echo "[tpu-session] five BASELINE configs (full) ..." >&2
 # per-config budget x5 must fit inside the outer budget, or the aggregator
 # dies before writing --out and every completed config's result is lost
