@@ -154,10 +154,12 @@ def main():
     # skipped on OOM rather than guessed
     rate_wide = 0.0
     R_wide = 4 * R_packed
+    from benchmarks.common import is_oom
+
     try:
         rate_wide = packed_rate(g_bfs, R_wide, max(steps // 4, 2))
     except Exception as e:  # noqa: BLE001 — device OOM only
-        if not ("RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e)):
+        if not is_oom(e):
             raise
     value = max(rate_natural, rate_bfs, rate_wide)
     v8 = int8_rate(g, R_int8, steps)

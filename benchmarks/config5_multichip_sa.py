@@ -107,9 +107,22 @@ def run_step(n, R, n_temps):
     )
 
 
-def run_solver(n, R, n_temps, max_steps):
-    """End-to-end sharded solve: the consensus-stop loop with sentinels."""
-    mesh, rep_shards, node_shards = _mesh()
+def run_solver(n, R, n_temps, max_steps, rollout_mode="full"):
+    """End-to-end sharded solve: the consensus-stop loop with sentinels.
+
+    ``rollout_mode='lightcone'`` runs the O(ball) candidate path on a
+    replica-only mesh (each device holds whole replicas + trajectory
+    caches) — the design the giant-graph × many-replica config-5 shape
+    wants when per-device memory allows it."""
+    if rollout_mode == "lightcone":
+        n_dev = len(jax.devices())
+        mesh, rep_shards, node_shards = (
+            make_mesh((n_dev, 1), ("replica", "node"),
+                      devices=device_pool(n_dev)),
+            n_dev, 1,
+        )
+    else:
+        mesh, rep_shards, node_shards = _mesh()
     g = random_regular_graph(n, 5, seed=0)
     Rtot = max(R * n_temps, rep_shards)
     cfg = SAConfig(dynamics=DynamicsConfig(p=1, c=1))
@@ -123,6 +136,7 @@ def run_solver(n, R, n_temps, max_steps):
         res = sa_sharded(
             g, cfg, mesh=mesh, n_replicas=Rt, seed=0,
             a0=ladder * g.n, max_steps=max_steps,
+            rollout_mode=rollout_mode,
         )
         return res, time.perf_counter() - t0
 
@@ -133,8 +147,9 @@ def run_solver(n, R, n_temps, max_steps):
     )
     converged = res.m_final == 1.0
     steps_total = int(res.num_steps.sum())
+    suffix = "_lightcone" if rollout_mode == "lightcone" else ""
     report(
-        "multichip_sa_solver_steps_per_sec_d5_n%d" % n,
+        "multichip_sa_solver%s_steps_per_sec_d5_n%d" % (suffix, n),
         steps_total / dt,
         "mcmc-steps/s",
         mesh=f"{rep_shards}x{node_shards}",
@@ -154,6 +169,8 @@ if __name__ == "__main__":
     if a.full:
         run_step(1_000_000, 1024, 16)
         run_solver(20_000, 4, 4, max_steps=300_000)
+        run_solver(100_000, 4, 4, max_steps=300_000, rollout_mode="lightcone")
     else:
         run_step(50_000, 16, 4)
         run_solver(1_000, 2, 2, max_steps=150_000)
+        run_solver(1_000, 2, 2, max_steps=150_000, rollout_mode="lightcone")

@@ -154,12 +154,6 @@ def main(argv=None) -> int:
             a_cap_frac=args.a_cap_frac, b_cap_frac=args.b_cap_frac,
         )
         if args.sharded:
-            if args.rollout_mode != "full":
-                raise SystemExit(
-                    "--rollout-mode lightcone is not supported with --sharded "
-                    "(the mesh solver evaluates candidates with the sharded "
-                    "full rollout); drop one of the flags"
-                )
             import jax
 
             from graphdyn.graphs import random_regular_graph
@@ -168,7 +162,12 @@ def main(argv=None) -> int:
             from graphdyn.utils.io import save_results_npz
 
             n_dev = len(jax.devices())
-            node_shards = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
+            # lightcone needs whole replicas per device (replica-only mesh);
+            # full mode splits the node axis when it can
+            if args.rollout_mode == "lightcone":
+                node_shards = 1
+            else:
+                node_shards = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
             mesh = make_mesh(
                 (max(n_dev // node_shards, 1), node_shards), ("replica", "node")
             )
@@ -185,6 +184,7 @@ def main(argv=None) -> int:
                 seed=args.seed, max_steps=args.max_steps,
                 checkpoint_path=args.checkpoint,
                 checkpoint_interval_s=args.checkpoint_interval,
+                rollout_mode=args.rollout_mode,
             )
             if args.out:
                 save_results_npz(

@@ -74,6 +74,7 @@ def make_sharded_sa_solver(
     replica_axis: str = "replica",
     node_axis: str = "node",
     chunk_steps: int | None = None,
+    lightcone: bool = False,
 ):
     """Build the jitted sharded solver pair ``(init_fn, chunk_fn)``.
 
@@ -91,8 +92,25 @@ def make_sharded_sa_solver(
     The caller builds the initial ``active`` mask — shard-padding replicas
     must start inactive so they cannot keep the mesh loop alive (an all-+1
     pad row is at consensus under majority dynamics, but not under e.g.
-    ``rule='minority'``)."""
+    ``rule='minority'``).
+
+    ``lightcone=True`` (replica-only meshes: node axis size 1, enforced by
+    the caller) evaluates candidates O(ball) against a per-replica cached
+    trajectory instead of the full sharded rollout — the same
+    :mod:`graphdyn.ops.lightcone` ops as the unsharded solver, so chains
+    stay bit-identical across all three solvers under injected streams. The
+    signatures change: ``init_fn(nbr, s0) -> (traj, sum_end)`` and
+    ``chunk_fn`` carries ``traj`` (int8[Rl, T+1, n+2]) instead of ``s``,
+    with the three light-cone tables appended as replicated args."""
     R_coef, C_coef = rule_coefficients(rule, tie)
+    if lightcone:
+        return _make_lightcone_solver(
+            mesh, n_real=n_real, rollout_steps=rollout_steps,
+            max_steps=max_steps, R_coef=R_coef, C_coef=C_coef,
+            injected=injected, stream_len=stream_len,
+            replica_axis=replica_axis, node_axis=node_axis,
+            chunk_steps=chunk_steps,
+        )
 
     def _rollout_tools(nbr_local, n_block):
         mask = _real_mask(node_axis, n_block, n_real)
@@ -199,6 +217,123 @@ def make_sharded_sa_solver(
     return init_fn, chunk_fn
 
 
+def _make_lightcone_solver(
+    mesh: Mesh,
+    *,
+    n_real: int,
+    rollout_steps: int,
+    max_steps: int,
+    R_coef: int,
+    C_coef: int,
+    injected: bool,
+    stream_len: int,
+    replica_axis: str,
+    node_axis: str,
+    chunk_steps: int | None,
+):
+    """The replica-only-mesh light-cone solver pair (see
+    :func:`make_sharded_sa_solver`). Each device owns whole replicas (node
+    axis size 1), so the unsharded O(ball) candidate evaluation runs
+    per-shard verbatim; the only collective is the one-scalar live count
+    keeping the mesh loop in lockstep."""
+    from graphdyn.ops.lightcone import (
+        LightconeTables,
+        batched_trajectory,
+        lightcone_accept,
+        lightcone_flip_delta,
+    )
+
+    def init(nbr_local, s0_local):
+        traj = batched_trajectory(
+            nbr_local, s0_local, rollout_steps, R_coef, C_coef
+        )
+        sum_end = (
+            traj[:, rollout_steps, :n_real].astype(jnp.int32).sum(axis=1)
+        )
+        return traj, sum_end
+
+    def chunk(nbr_local, traj_in, key, a, b, t, m_final_in, active_in,
+              sum_end_in, par_a, par_b, a_cap, b_cap, proposals, uniforms,
+              ball, nbr_slot, nbr_glob):
+        tables = LightconeTables(
+            ball, nbr_slot, nbr_glob, rollout_steps, ball.shape[1]
+        )
+        Rl = traj_in.shape[0]
+        dt = a.dtype
+
+        def cond(st):
+            go = st[9] > 0
+            if chunk_steps is not None:
+                go = go & (st[8] < chunk_steps)
+            return go
+
+        def body(st):
+            traj, key, a, b, t, m_final, active, sum_end, chunk_t, _ = st
+            i, u = draw_sa_proposal(
+                key, t, proposals, uniforms,
+                injected=injected, stream_len=stream_len, n=n_real, dt=dt,
+            )
+            ridx = jnp.arange(Rl)
+            # current spins live in traj[:, 0] (the carried cache); see
+            # models.sa._sa_loop — identical step arithmetic
+            s_i = traj[ridx, 0, i].astype(jnp.int32)
+            delta, vstack = lightcone_flip_delta(
+                tables, traj, i, R_coef, C_coef, rollout_steps
+            )
+            do, sum_end_new, a_new, b_new, t_new, m_final_new, active_new = (
+                metropolis_anneal_update(
+                    active, a, b, t, m_final, sum_end, sum_end + delta,
+                    s_i, u,
+                    par_a=par_a, par_b=par_b, a_cap=a_cap, b_cap=b_cap,
+                    max_steps=max_steps, n=n_real,
+                )
+            )
+            traj_new = lightcone_accept(tables, traj, i, vstack, do)
+            live = lax.psum(
+                jnp.any(active_new).astype(jnp.int32), replica_axis
+            )
+            return (traj_new, key, a_new, b_new, t_new, m_final_new,
+                    active_new, sum_end_new, chunk_t + 1, live)
+
+        live0 = lax.psum(jnp.any(active_in).astype(jnp.int32), replica_axis)
+        out = lax.while_loop(cond, body, (
+            traj_in, key, a, b, t, m_final_in, active_in, sum_end_in,
+            jnp.zeros((), jnp.int32), live0,
+        ))
+        traj = out[0]
+        mag = (
+            traj[:, 0, :n_real].astype(jnp.int32).sum(axis=1).astype(dt)
+            / n_real
+        )
+        return (traj, mag, out[1], out[2], out[3], out[4], out[5], out[6],
+                out[7])
+
+    rep = P(replica_axis)
+    init_fn = jax.jit(shard_map(
+        init,
+        mesh=mesh,
+        in_specs=(P(node_axis, None), P(replica_axis, node_axis)),
+        out_specs=(rep, rep),
+        check_vma=False,
+    ))
+    chunk_fn = jax.jit(shard_map(
+        chunk,
+        mesh=mesh,
+        in_specs=(
+            P(node_axis, None),            # nbr
+            rep,                           # traj
+            rep, rep, rep, rep, rep, rep, rep,  # key a b t m_final active sum_end
+            P(), P(), P(), P(),            # par_a, par_b, a_cap, b_cap
+            P(replica_axis, None),         # proposals
+            P(replica_axis, None),         # uniforms
+            P(), P(), P(),                 # ball, nbr_slot, nbr_glob
+        ),
+        out_specs=(rep,) * 9,
+        check_vma=False,
+    ))
+    return init_fn, chunk_fn
+
+
 def sa_sharded(
     graph,
     config: SAConfig | None = None,
@@ -218,6 +353,8 @@ def sa_sharded(
     checkpoint_path: str | None = None,
     checkpoint_interval_s: float = 30.0,
     chunk_steps: int = 100_000,
+    rollout_mode: str = "full",
+    lc_tables=None,
 ) -> SAResult:
     """Run batched SA chains to completion over a device mesh.
 
@@ -226,10 +363,21 @@ def sa_sharded(
     per-replica ``a0``/``b0`` carry the temperature ladder, injected
     ``proposals``/``uniforms`` enable bitwise parity testing; the same
     ``checkpoint_path`` exact-resume contract — state is saved UNPADDED, so
-    a run may resume on a different mesh shape, bit-exactly when the
-    collective reduction order matches). Replicas pad up to the replica-axis
-    size with already-converged all-+1 dummies; the node axis pads via
-    :func:`pad_nodes`. Results are sliced back to the caller's shapes.
+    a run may resume on a different mesh shape — or under a different
+    ``rollout_mode`` (the snapshot is mode-agnostic: spins + chain
+    scalars) — bit-exactly when the collective reduction order matches).
+    Replicas pad up to the replica-axis size with already-converged all-+1
+    dummies; the node axis pads via :func:`pad_nodes`. Results are sliced
+    back to the caller's shapes.
+
+    ``rollout_mode='lightcone'`` (replica-only meshes: the mesh's node axis
+    must have size 1) evaluates candidates O(ball) per step against a
+    per-replica trajectory cache instead of the O(n·d) sharded rollout —
+    the BASELINE config-5 shape (giant graph × many replicas) where memory
+    allows each device a whole-graph cache. Chains are bit-identical to
+    both full-rollout solvers (tested under injected streams). Pass
+    ``lc_tables`` (:func:`graphdyn.ops.lightcone.build_lightcone_tables`)
+    to amortize table construction across calls.
     """
     config = config or SAConfig()
     n = graph.n
@@ -245,6 +393,33 @@ def sa_sharded(
     node_shards = int(mesh.shape[node_axis])
     np_dt = np.float32 if dtype == jnp.float32 else np.float64
     t_dt = np.int64 if jax.config.jax_enable_x64 else np.int32
+
+    if rollout_mode not in ("full", "lightcone"):
+        raise ValueError(
+            f"rollout_mode must be 'full' or 'lightcone', got {rollout_mode!r}"
+        )
+    lightcone = rollout_mode == "lightcone"
+    rollout = dyn.p + dyn.c - 1
+    if lightcone:
+        if node_shards != 1:
+            raise ValueError(
+                "rollout_mode='lightcone' needs a replica-only mesh (node "
+                f"axis size 1, got {node_shards}): each device holds whole "
+                "replicas and their trajectory caches"
+            )
+        from graphdyn.ops.lightcone import build_lightcone_tables
+
+        if lc_tables is None:
+            lc_tables = build_lightcone_tables(graph, rollout)
+        elif lc_tables.radius != rollout or lc_tables.ball.shape[0] != n:
+            raise ValueError(
+                f"lc_tables were built for a different graph or radius "
+                f"(tables: radius={lc_tables.radius}, "
+                f"n={lc_tables.ball.shape[0]}; run: radius={rollout}, "
+                f"n={n}); rebuild with build_lightcone_tables"
+            )
+    elif lc_tables is not None:
+        raise ValueError("lc_tables given but rollout_mode is 'full'")
 
     ckpt = None
     restored = None
@@ -339,20 +514,33 @@ def sa_sharded(
         replica_axis=replica_axis,
         node_axis=node_axis,
         chunk_steps=int(chunk_steps) if ckpt is not None else None,
+        lightcone=lightcone,
     )
     nbr_dev = place_sharded(mesh, jnp.asarray(nbr_pad), P(node_axis, None))
     s_dev, key_dev, a_dev, b_dev, t_dev = place_state()
 
-    if sum_end_h is None:
-        sum_end_h = np.asarray(init_fn(nbr_dev, s_dev))[:R]
-        m_final_h = (sum_end_h.astype(np_dt) / np_dt(n)).astype(np_dt)
-        active_h = m_final_h < 1.0
+    if lightcone:
+        # traj is a pure function of s — recomputed, never persisted (same
+        # as the unsharded solver's resume); sum_end from the cache's last
+        # frame equals the restored value by construction
+        traj_dev, sum_end_dev = init_fn(nbr_dev, s_dev)
+        if sum_end_h is None:
+            sum_end_h = np.asarray(sum_end_dev)[:R]
+            m_final_h = (sum_end_h.astype(np_dt) / np_dt(n)).astype(np_dt)
+            active_h = m_final_h < 1.0
+        carried0 = traj_dev
+    else:
+        if sum_end_h is None:
+            sum_end_h = np.asarray(init_fn(nbr_dev, s_dev))[:R]
+            m_final_h = (sum_end_h.astype(np_dt) / np_dt(n)).astype(np_dt)
+            active_h = m_final_h < 1.0
+        carried0 = s_dev
 
     def place_rep(x, fill):
         return place_sharded(mesh, jnp.asarray(pad_rep(x, fill)), P(replica_axis))
 
     state = (
-        s_dev, key_dev, a_dev, b_dev, t_dev,
+        carried0, key_dev, a_dev, b_dev, t_dev,
         place_rep(m_final_h, 1.0),                 # pad rows: at consensus
         place_rep(active_h, False),                # pad rows: frozen
         place_rep(sum_end_h, n),
@@ -365,20 +553,35 @@ def sa_sharded(
         place_sharded(mesh, jnp.asarray(proposals), P(replica_axis, None)),
         place_sharded(mesh, jnp.asarray(uniforms.astype(np_dt)), P(replica_axis, None)),
     )
+    if lightcone:
+        repl = P()
+        consts = consts + (
+            place_sharded(mesh, lc_tables.ball, repl),
+            place_sharded(mesh, lc_tables.nbr_slot, repl),
+            place_sharded(mesh, lc_tables.nbr_glob, repl),
+        )
 
     fields = ("s", "key", "a", "b", "t", "m_final", "active", "sum_end")
 
+    def extract_s(carried):
+        """Current spins from the carried state — traj frame 0 in lightcone
+        mode (the cache IS the live state; `models.sa._sa_loop`). Slices on
+        DEVICE first: the full traj cache is [Rtot, T+1, n+2] int8 and a
+        checkpoint only needs the [R, n] spin frame on the host."""
+        sl = carried[:R, 0, :n] if lightcone else carried[:R, :n]
+        return np.asarray(sl)
+
     def advance(st):
-        out = chunk_fn(nbr_dev, *st, *consts)   # (s, mag, key, a, b, t, ...)
+        out = chunk_fn(nbr_dev, *st, *consts)   # (s|traj, mag, key, a, b, ...)
         return (out[0], *out[2:])
 
     def still_active(st):
         return bool(np.asarray(st[6])[:R].any())
 
     def snapshot(st):
-        full = {k: np.asarray(v) for k, v in zip(fields, st)}
-        full["s"] = full["s"][:R, :n]           # unpadded/global state
-        return {k: (v if k == "s" else v[:R]) for k, v in full.items()}
+        full = {k: np.asarray(v)[:R] for k, v in zip(fields[1:], st[1:])}
+        full["s"] = extract_s(st[0])            # unpadded/global state
+        return full
 
     if ckpt is None:
         while still_active(state):              # one chunk runs to completion
@@ -388,7 +591,7 @@ def sa_sharded(
             state, advance=advance, active=still_active, payload=snapshot
         )
 
-    s_final = np.asarray(state[0])[:R, :n]
+    s_final = extract_s(state[0])
     # same arithmetic as the unsharded solver's mag_reached
     mag = (s_final.astype(np.float64).sum(axis=1) / n).astype(np_dt)
     return SAResult(

@@ -165,3 +165,42 @@ def test_sharded_checkpoint_resume_bit_exact(tmp_path, abort_after_save):
                                     "R": 4})
     with pytest.raises(ValueError, match="refusing to resume"):
         sa_sharded(g, cfg, mesh=_mesh(4, 2), checkpoint_path=p2, **kw)
+
+
+def test_lightcone_sharded_bit_parity_and_resume(tmp_path, abort_after_save):
+    """rollout_mode='lightcone' on a replica-only mesh is bit-identical to
+    BOTH full-rollout solvers under injected streams; a checkpoint written
+    by the full-mode mesh solver resumes under lightcone mode (the snapshot
+    is mode-agnostic: spins + chain scalars); a node-sharded mesh is
+    refused."""
+    import os
+
+    g, s0, proposals, uniforms = _setup(n=60, d=4, R=4, L=2000, seed=21)
+    cfg = SAConfig()                      # p=3, c=1 — radius-3 light cones
+    kw = dict(s0=s0, proposals=proposals, uniforms=uniforms)
+
+    ref = simulated_annealing(g, cfg, **kw)
+    lc = sa_sharded(g, cfg, mesh=_mesh(8, 1), rollout_mode="lightcone", **kw)
+    np.testing.assert_array_equal(ref.s, lc.s)
+    np.testing.assert_array_equal(ref.num_steps, lc.num_steps)
+    np.testing.assert_array_equal(ref.m_final, lc.m_final)
+
+    with pytest.raises(ValueError, match="replica-only"):
+        sa_sharded(g, cfg, mesh=_mesh(4, 2), rollout_mode="lightcone", **kw)
+
+    # cross-mode resume: interrupt a full-mode run, finish it in lightcone
+    # mode — identical to the uninterrupted chain
+    from conftest import CheckpointAbort
+
+    p = str(tmp_path / "lc_ck")
+    with abort_after_save(n=1):
+        with pytest.raises(CheckpointAbort):
+            sa_sharded(g, cfg, mesh=_mesh(8, 1), checkpoint_path=p,
+                       checkpoint_interval_s=0.0, chunk_steps=25, **kw)
+    assert os.path.exists(p + ".npz")
+    resumed = sa_sharded(g, cfg, mesh=_mesh(8, 1), rollout_mode="lightcone",
+                         checkpoint_path=p, chunk_steps=5000, **kw)
+    np.testing.assert_array_equal(ref.s, resumed.s)
+    np.testing.assert_array_equal(ref.num_steps, resumed.num_steps)
+    assert not os.path.exists(p + ".npz")
+
