@@ -102,6 +102,28 @@ def run(n, n_graphs, n_lambda):
         vmapped=True,
     )
 
+    # graph-axis-sharded congruent ensemble: instances are independent, so
+    # the vmapped program partitions embarrassingly over the mesh (shard
+    # count capped so the graph count divides it)
+    g_shards = n_dev
+    while n_graphs % g_shards:
+        g_shards //= 2
+    if n_dev > 1 and g_shards > 1:
+        from graphdyn.parallel.mesh import make_mesh
+
+        gmesh = make_mesh((g_shards,), ("graph",), devices=jax.devices()[:g_shards])
+        t0 = time.perf_counter()
+        res = entropy_ensemble(graphs, cfg, seed=0, lambdas=lambdas, mesh=gmesh)
+        dt = time.perf_counter() - t0
+        report(
+            "bdcm_entropy_ensemble_mesh_graph_lambda_points_per_sec_n%d" % n,
+            res.lambdas.size * n_graphs / dt,
+            "graph-lambda-points/s",
+            graphs=n_graphs,
+            vmapped=True,
+            mesh="%dx1" % g_shards,
+        )
+
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()

@@ -405,29 +405,11 @@ def simulated_annealing(
     keys = jax.vmap(jax.random.PRNGKey)(np.arange(R, dtype=np.uint32) + np.uint32(seed))
 
     if rollout_mode == "lightcone":
-        from graphdyn.ops.lightcone import batched_trajectory, build_lightcone_tables
+        from graphdyn.ops.lightcone import (
+            batched_trajectory, resolve_lightcone_tables,
+        )
 
-        if lc_tables is None:
-            lc_tables = build_lightcone_tables(graph, rollout)
-        elif (
-            lc_tables.radius != rollout
-            or lc_tables.ball.shape[0] != n
-            # slot 0 of every ball is the node itself, so nbr_glob[:, 0, :]
-            # IS the adjacency the tables were built from — a full graph
-            # identity check, not just a shape check
-            or lc_tables.nbr_glob.shape[2] != graph.nbr.shape[1]
-            or not np.array_equal(
-                np.asarray(lc_tables.nbr_glob[:, 0, :]), np.asarray(graph.nbr)
-            )
-        ):
-            # a mismatched table would make the chain silently diverge (JAX
-            # gathers clamp instead of erroring) — refuse up front
-            raise ValueError(
-                f"lc_tables were built for a different graph or radius "
-                f"(tables: radius={lc_tables.radius}, "
-                f"n={lc_tables.ball.shape[0]}; run: radius={rollout} "
-                f"(p+c-1), n={n}); rebuild with build_lightcone_tables"
-            )
+        lc_tables = resolve_lightcone_tables(graph, rollout, lc_tables)
     else:
         lc_tables = None
 

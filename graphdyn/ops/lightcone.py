@@ -57,6 +57,32 @@ class LightconeTables(NamedTuple):
     ball_max: int
 
 
+def resolve_lightcone_tables(graph, radius: int, lc_tables=None) -> LightconeTables:
+    """Build tables for ``graph``/``radius``, or validate caller-supplied
+    ones. Slot 0 of every ball is the node itself, so ``nbr_glob[:, 0, :]``
+    IS the adjacency the tables were built from — a full graph identity
+    check, not just a shape check. A mismatched table would make the chain
+    silently diverge (JAX gathers clamp instead of erroring), so refuse up
+    front. One guard shared by the unsharded and mesh SA solvers."""
+    if lc_tables is None:
+        return build_lightcone_tables(graph, radius)
+    if (
+        lc_tables.radius != radius
+        or lc_tables.ball.shape[0] != graph.n
+        or lc_tables.nbr_glob.shape[2] != graph.nbr.shape[1]
+        or not np.array_equal(
+            np.asarray(lc_tables.nbr_glob[:, 0, :]), np.asarray(graph.nbr)
+        )
+    ):
+        raise ValueError(
+            f"lc_tables were built for a different graph or radius "
+            f"(tables: radius={lc_tables.radius}, "
+            f"n={lc_tables.ball.shape[0]}; run: radius={radius} "
+            f"(p+c-1), n={graph.n}); rebuild with build_lightcone_tables"
+        )
+    return lc_tables
+
+
 def build_lightcone_tables(graph, radius: int) -> LightconeTables:
     """Host-side BFS ball tables for every node. O(n · ball) time/memory —
     intended for the SA regimes (n ≲ 1e5); the full-rollout mode remains

@@ -407,17 +407,9 @@ def sa_sharded(
                 f"axis size 1, got {node_shards}): each device holds whole "
                 "replicas and their trajectory caches"
             )
-        from graphdyn.ops.lightcone import build_lightcone_tables
+        from graphdyn.ops.lightcone import resolve_lightcone_tables
 
-        if lc_tables is None:
-            lc_tables = build_lightcone_tables(graph, rollout)
-        elif lc_tables.radius != rollout or lc_tables.ball.shape[0] != n:
-            raise ValueError(
-                f"lc_tables were built for a different graph or radius "
-                f"(tables: radius={lc_tables.radius}, "
-                f"n={lc_tables.ball.shape[0]}; run: radius={rollout}, "
-                f"n={n}); rebuild with build_lightcone_tables"
-            )
+        lc_tables = resolve_lightcone_tables(graph, rollout, lc_tables)
     elif lc_tables is not None:
         raise ValueError("lc_tables given but rollout_mode is 'full'")
 

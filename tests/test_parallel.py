@@ -164,3 +164,21 @@ def test_union_entropy_mesh_matches_unsharded():
     np.testing.assert_allclose(base.ent, sh.ent, rtol=2e-5, atol=1e-7)
     np.testing.assert_allclose(base.m_init, sh.m_init, rtol=2e-5, atol=1e-7)
     np.testing.assert_allclose(base.ent1, sh.ent1, rtol=2e-5, atol=1e-7)
+
+
+def test_vmapped_entropy_mesh_matches_unsharded():
+    """entropy_ensemble(mesh=...) — the congruent-ensemble GRAPH axis
+    sharded over the mesh — reproduces the single-device ladder."""
+    from graphdyn.config import EntropyConfig
+    from graphdyn.models.entropy import entropy_ensemble
+
+    graphs = [random_regular_graph(24, 3, seed=k) for k in range(8)]
+    cfg = EntropyConfig(lmbd_max=1.0, lmbd_step=0.5, max_sweeps=300)
+    base = entropy_ensemble(graphs, cfg, seed=0)
+    gmesh = make_mesh((8,), ("graph",), devices=device_pool(8))
+    sh = entropy_ensemble(graphs, cfg, seed=0, mesh=gmesh)
+    np.testing.assert_array_equal(base.lambdas, sh.lambdas)
+    assert np.all(np.abs(base.sweeps - sh.sweeps) <= 1)
+    np.testing.assert_allclose(base.ent, sh.ent, rtol=2e-5, atol=1e-7)
+    np.testing.assert_allclose(base.m_init, sh.m_init, rtol=2e-5, atol=1e-7)
+    np.testing.assert_allclose(base.ent1, sh.ent1, rtol=2e-5, atol=1e-7)
