@@ -431,12 +431,30 @@ def replicate_disjoint(graph: Graph, R: int) -> Graph:
     union instead keeps ONE big edge axis ``[R·2E]`` as the lane dim — the
     layout the unbatched sweep already uses — so memory scales linearly in
     R. Per-replica observables are reshapes ``[R·n] -> [R, n]``.
+
+    Built by direct tiling of the base tables — identical to
+    ``graph_from_edges`` over the shifted edge list (the stable grouped
+    scatter preserves each node's incident order under the block shift;
+    tested), without its O(R·E log(R·E)) sort: at config-2 scale (n=1e5,
+    R=256) that sort costs ~30 s of host time per solver call.
     """
     n = graph.n
     E = graph.num_edges
-    offs = (np.arange(R, dtype=np.int64) * n)[:, None, None]     # [R, 1, 1]
-    edges = (graph.edges.astype(np.int64)[None] + offs).reshape(R * E, 2)
-    return graph_from_edges(R * n, edges, dmax=graph.dmax)
+    dmax = graph.dmax
+    noff = np.arange(R, dtype=np.int64) * n
+    edges = (
+        graph.edges.astype(np.int64)[None] + noff[:, None, None]
+    ).reshape(R * E, 2)
+    nbr = graph.nbr.astype(np.int64)
+    # ghost slot n -> union ghost R*n; real neighbors shift per replica
+    nbr_u = np.where(
+        nbr[None] == n, R * n, nbr[None] + noff[:, None, None]
+    ).reshape(R * n, dmax)
+    return Graph(
+        nbr=nbr_u.astype(np.int32),
+        deg=np.tile(graph.deg, R).astype(np.int32),
+        edges=edges.astype(np.int32),
+    )
 
 
 def replicate_edge_tables(tables: EdgeTables, R: int, n: int) -> EdgeTables:

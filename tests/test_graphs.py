@@ -197,3 +197,27 @@ def test_replicate_disjoint_sweep_equivalence():
             outU[R * g.num_edges + r * g.num_edges : R * g.num_edges + (r + 1) * g.num_edges],
             out1[g.num_edges :], rtol=1e-6, atol=1e-7,
         )
+
+
+def test_replicate_disjoint_equals_graph_from_edges():
+    """The direct-tiling union equals graph_from_edges over the shifted edge
+    list field-for-field (incident order preserved), on ragged ER and RRG."""
+    from graphdyn.graphs import (
+        erdos_renyi_graph,
+        graph_from_edges,
+        random_regular_graph,
+        replicate_disjoint,
+    )
+
+    for g in (
+        random_regular_graph(40, 3, seed=1),
+        erdos_renyi_graph(60, 2.5 / 59, seed=2),     # ragged + maybe isolates
+    ):
+        R = 3
+        gu = replicate_disjoint(g, R)
+        noff = (np.arange(R, dtype=np.int64) * g.n)[:, None, None]
+        edges = (g.edges.astype(np.int64)[None] + noff).reshape(-1, 2)
+        want = graph_from_edges(R * g.n, edges, dmax=g.dmax)
+        np.testing.assert_array_equal(gu.nbr, want.nbr)
+        np.testing.assert_array_equal(gu.deg, want.deg)
+        np.testing.assert_array_equal(gu.edges, want.edges)
