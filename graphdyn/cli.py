@@ -41,6 +41,15 @@ def _dynamics(args) -> DynamicsConfig:
     )
 
 
+def _add_dtype_flag(ap, help_text: str) -> None:
+    """The shared --dtype axis (one definition; float64 requires x64, which
+    main() enables before building any config)."""
+    ap.add_argument(
+        "--dtype", choices=["float32", "float64"], default="float32",
+        help=help_text,
+    )
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="graphdyn")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -104,6 +113,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="path prefix for preemption-safe exact resume (driver + chain)",
     )
     hpr.add_argument("--checkpoint-interval", type=float, default=30.0)
+    _add_dtype_flag(hpr, "float64 matches the reference's solver precision "
+                          "(`HPR_pytorch_RRG.py:11`; enables x64)")
 
     ent = sub.add_parser("entropy", help="BDCM entropy λ-sweep (notebook)")
     ent.add_argument("--n", type=int, default=1000)
@@ -124,10 +135,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="path prefix for time-triggered saves + exact λ-granular resume",
     )
     ent.add_argument("--checkpoint-interval", type=float, default=30.0)
-    ent.add_argument(
-        "--dtype", choices=["float32", "float64"], default="float32",
-        help="float64 matches the reference's precision (enables x64)",
-    )
+    _add_dtype_flag(ent, "float64 matches the reference's precision "
+                          "(enables x64)")
     ent.add_argument(
         "--plot", default=None, metavar="PNG",
         help="render the s(m_init) curve family (one per degree) to this file",
@@ -145,6 +154,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    if getattr(args, "dtype", None) == "float64":
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
 
     if args.cmd == "sa":
         cfg = SAConfig(
@@ -222,7 +236,7 @@ def main(argv=None) -> int:
         cfg = HPRConfig(
             dynamics=_dynamics(args),
             damp=args.damp, lmbd=args.lmbd, pie=args.pie, gamma=args.gamma,
-            max_sweeps=args.max_sweeps,
+            max_sweeps=args.max_sweeps, dtype=args.dtype,
         )
         out = hpr_ensemble(
             args.n, args.d, cfg, n_rep=args.n_rep, seed=args.seed,
@@ -249,10 +263,6 @@ def main(argv=None) -> int:
                 raise SystemExit(
                     "--plot requires matplotlib, which is not installed"
                 )
-        if args.dtype == "float64":
-            import jax
-
-            jax.config.update("jax_enable_x64", True)
         cfg = EntropyConfig(
             dynamics=_dynamics(args),
             lmbd_max=args.lmbd_max, lmbd_step=args.lmbd_step,
