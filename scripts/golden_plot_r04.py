@@ -1,0 +1,68 @@
+#!/usr/bin/env python3
+"""Render the golden-curve comparison figure from GOLDEN_r04.json.
+
+The notebook exists to produce the tilted-entropy curve s(m_init)
+(`code/README.md:1`, `ER_BDCM_entropy.ipynb:18-46`); this figure overlays
+the framework's float64 curves (8 ER instances, networkx sampler,
+notebook-exact config) with the reference's ten stored (m_init, ent1)
+triples — the visual form of the GOLDEN_r04.json claim that the reference
+run is statistically indistinguishable from the framework's ensemble.
+
+Two identities only: the framework instance curves (one muted blue, they
+are an ensemble, not eight series) and the reference points (warm orange,
+distinct marker). Single axis pair, recessive grid, direct legend.
+"""
+
+import json
+import sys
+
+import numpy as np
+
+FRAMEWORK = "#4269d0"   # muted blue — ensemble curves
+REFERENCE = "#e4632d"   # warm orange — the ten stored triples
+
+
+def main(src="GOLDEN_r04.json", out="golden_curve_r04.png"):
+    import matplotlib
+
+    matplotlib.use("Agg", force=True)
+    import matplotlib.pyplot as plt
+
+    with open(src) as f:
+        art = json.load(f)
+
+    fig, ax = plt.subplots(figsize=(6.0, 4.2), dpi=150)
+    for row in art["per_seed"]:
+        m = np.asarray(row["m_init"], float)
+        s = np.asarray(row["ent1"], float)
+        keep = np.isfinite(m) & np.isfinite(s) & (s > -0.2)
+        ax.plot(
+            m[keep], s[keep], color=FRAMEWORK, lw=1.2, alpha=0.55,
+            label="graphdyn float64 (8 instances)" if row["seed"] == 0 else None,
+            zorder=2,
+        )
+    golden = art["spread_at_golden_lambdas"]
+    gm = [v["golden_m_init"] for v in golden.values()]
+    ge = [v["golden_ent1"] for v in golden.values()]
+    ax.plot(
+        gm, ge, ls="none", marker="o", ms=6, mfc=REFERENCE, mec="white",
+        mew=1.0, label="reference stored run (ipynb:18-46)", zorder=3,
+    )
+    ax.set_xlabel(r"$m_{\mathrm{init}}$")
+    ax.set_ylabel(r"$s(m_{\mathrm{init}}) = \phi + \lambda\, m_{\mathrm{init}}$")
+    ax.set_title(
+        "BDCM tilted entropy, ER deg=1.0, n=1000, p=c=1 (float64)",
+        fontsize=10,
+    )
+    ax.axhline(0.0, color="0.8", lw=0.8, zorder=1)
+    ax.grid(True, color="0.92", lw=0.6, zorder=0)
+    for spine in ("top", "right"):
+        ax.spines[spine].set_visible(False)
+    ax.legend(frameon=False, fontsize=8, loc="upper left")
+    fig.tight_layout()
+    fig.savefig(out)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])
