@@ -32,13 +32,21 @@ def main(src="GOLDEN_r04.json", out="golden_curve_r04.png"):
         art = json.load(f)
 
     fig, ax = plt.subplots(figsize=(6.0, 4.2), dpi=150)
-    for row in art["per_seed"]:
+    for i, row in enumerate(art["per_seed"]):
         m = np.asarray(row["m_init"], float)
         s = np.asarray(row["ent1"], float)
-        keep = np.isfinite(m) & np.isfinite(s) & (s > -0.2)
+        # mask (don't drop) degraded points so the line BREAKS there
+        # instead of bridging a gap with fabricated segments
+        bad = ~(np.isfinite(m) & np.isfinite(s))
+        m, s = m.copy(), s.copy()
+        m[bad] = np.nan
+        s[bad] = np.nan
         ax.plot(
-            m[keep], s[keep], color=FRAMEWORK, lw=1.2, alpha=0.55,
-            label="graphdyn float64 (8 instances)" if row["seed"] == 0 else None,
+            m, s, color=FRAMEWORK, lw=1.2, alpha=0.55,
+            label=(
+                f"graphdyn float64 ({len(art['per_seed'])} instances)"
+                if i == 0 else None
+            ),
             zorder=2,
         )
     golden = art["spread_at_golden_lambdas"]

@@ -89,3 +89,16 @@ def test_packed_consensus_fraction_matches_unpacked():
     # sanity: majority dynamics on dense ER from random init reaches some
     # +1-consensus replicas after 8 steps (or the test is vacuous)
     assert want_p1 + want_m1 > 0
+
+
+def test_packed_many_words_matches_int8(rng):
+    """Multi-word replica axis (W=7 here; the bench's wide-replica lever
+    runs W=512): per-word arithmetic is identical, so a direct parity spot
+    check over several words pins the W-genericity."""
+    g = random_regular_graph(120, 3, seed=9)
+    R = 224                                  # 7 full words
+    s = rng.choice(np.array([-1, 1], dtype=np.int8), size=(R, g.n))
+    got = packed_end_state(g, s, 5, "majority", "stay")
+    for r in (0, 31, 32, 63, 100, 223):      # word boundaries + interior
+        want = run_dynamics(g, s[r], 5, "majority", "stay", backend="cpu")
+        np.testing.assert_array_equal(got[r], want)
