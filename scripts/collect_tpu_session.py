@@ -1,0 +1,84 @@
+#!/usr/bin/env python3
+"""Fold a completed tpu_bench_session output directory into the round's
+benchmark artifacts.
+
+Usage: python scripts/collect_tpu_session.py SESSION_DIR [BENCH_CONFIGS_JSON]
+
+- Parses ``bench_headline.json`` (one JSON line) and the per-config JSON
+  lines inside ``configs_tpu.json``.
+- Merges them into the round's BENCH_CONFIGS artifact under a
+  ``tpu_full`` key (keeping the existing cpu_smoke section), with the
+  session's gather/probe JSONL files summarized alongside.
+- Prints a one-screen summary for the commit message.
+"""
+
+import json
+import os
+import sys
+
+
+def read_json_lines(path):
+    rows = []
+    if not os.path.exists(path):
+        return rows
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    rows.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+    return rows
+
+
+def main(session_dir, bench_configs="BENCH_CONFIGS_r04.json"):
+    out = {}
+
+    head = read_json_lines(os.path.join(session_dir, "bench_headline.json"))
+    if head:
+        out["headline"] = head[-1]
+
+    cfg_path = os.path.join(session_dir, "configs_tpu.json")
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            out["configs"] = json.load(f)
+
+    for name in ("gather_experiment", "pallas_gather_probe"):
+        rows = read_json_lines(os.path.join(session_dir, f"{name}.jsonl"))
+        if rows:
+            out[name] = rows
+
+    doc = {}
+    if os.path.exists(bench_configs):
+        with open(bench_configs) as f:
+            doc = json.load(f)
+    doc["tpu_full"] = out
+    stamp = "tpu_full captured from " + os.path.basename(session_dir)
+    if stamp not in doc.get("status", ""):          # reruns stay idempotent
+        doc["status"] = doc.get("status", "") + " | " + stamp
+    with open(bench_configs, "w") as f:
+        json.dump(doc, f, indent=1)
+
+    print(f"merged into {bench_configs}:")
+    if "headline" in out:
+        h = out["headline"]
+        v = h.get("value")
+        v = f"{v:.3e}" if isinstance(v, (int, float)) else repr(v)
+        print(f"  headline: {v} {h.get('unit')} "
+              f"(roofline_fraction={h.get('roofline_fraction_v5e')}"
+              f"{', ERROR: ' + str(h['error']) if 'error' in h else ''})")
+    for row in out.get("pallas_gather_probe", []):
+        print(f"  probe: {row}")
+    cfgs = out.get("configs")
+    if isinstance(cfgs, dict):
+        cfgs = cfgs.get("configs", [])
+    for c in cfgs or []:
+        if isinstance(c, dict):
+            print(f"  {c.get('config')}: rc={c.get('rc')} "
+                  f"metrics={len(c.get('metrics', []))}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(*sys.argv[1:]))

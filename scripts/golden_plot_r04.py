@@ -35,9 +35,10 @@ def main(src="GOLDEN_r04.json", out="golden_curve_r04.png"):
     for i, row in enumerate(art["per_seed"]):
         m = np.asarray(row["m_init"], float)
         s = np.asarray(row["ent1"], float)
-        # mask (don't drop) degraded points so the line BREAKS there
-        # instead of bridging a gap with fabricated segments
-        bad = ~(np.isfinite(m) & np.isfinite(s))
+        # mask (don't drop) degraded points — non-finite OR far below the
+        # entropy floor — so the line BREAKS there instead of bridging a
+        # gap with fabricated segments
+        bad = ~(np.isfinite(m) & np.isfinite(s)) | (s < -0.2)
         m, s = m.copy(), s.copy()
         m[bad] = np.nan
         s[bad] = np.nan
