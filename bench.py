@@ -137,6 +137,13 @@ def main():
         n, R_packed, R_int8, steps = 100_000, 1024, 8, 5
     else:
         n, R_packed, R_int8, steps = 1_000_000, 4096, 64, 20
+        if jax.default_backend() == "cpu":
+            # a CPU fallback (wedged TPU relay) at full step counts runs
+            # for hours and the driver records a timeout instead of a
+            # number; full-scale ARRAYS with minimal steps still measure a
+            # valid per-second rate (the emitted "steps" field records the
+            # degradation)
+            steps = 2
 
     from graphdyn.graphs import bfs_order, permute_nodes
 
@@ -182,6 +189,7 @@ def main():
                 "torch_cpu_rate": base,
                 "packed_replicas": R_packed,
                 "packed_replicas_best": R_wide if value == rate_wide else R_packed,
+                "steps": steps,
                 # fraction of the kernel's own HBM-streaming bound on a
                 # v5e-class chip (~800 GB/s => ~1.6e12 packed spin-updates/s
                 # at n=1e6 d=3 — ARCHITECTURE.md roofline). The bound is

@@ -33,11 +33,19 @@ def read_json_lines(path):
 
 
 def main(session_dir, bench_configs="BENCH_CONFIGS_r04.json"):
+    session_dir = os.path.normpath(session_dir)
     out = {}
 
     head = read_json_lines(os.path.join(session_dir, "bench_headline.json"))
     if head:
         out["headline"] = head[-1]
+        backend = out["headline"].get("backend")
+        if backend and backend != "tpu":
+            # a wedged-relay CPU fallback must not masquerade as chip data
+            out["warning"] = (
+                f"headline backend is {backend!r}, not 'tpu' — the session "
+                "ran on a fallback backend; rates are NOT chip numbers"
+            )
 
     cfg_path = os.path.join(session_dir, "configs_tpu.json")
     if os.path.exists(cfg_path):
@@ -65,9 +73,11 @@ def main(session_dir, bench_configs="BENCH_CONFIGS_r04.json"):
         h = out["headline"]
         v = h.get("value")
         v = f"{v:.3e}" if isinstance(v, (int, float)) else repr(v)
-        print(f"  headline: {v} {h.get('unit')} "
+        print(f"  headline: {v} {h.get('unit')} backend={h.get('backend')} "
               f"(roofline_fraction={h.get('roofline_fraction_v5e')}"
               f"{', ERROR: ' + str(h['error']) if 'error' in h else ''})")
+    if "warning" in out:
+        print(f"  WARNING: {out['warning']}")
     for row in out.get("pallas_gather_probe", []):
         print(f"  probe: {row}")
     cfgs = out.get("configs")
@@ -81,4 +91,7 @@ def main(session_dir, bench_configs="BENCH_CONFIGS_r04.json"):
 
 
 if __name__ == "__main__":
+    if not 2 <= len(sys.argv) <= 3:
+        print(__doc__.strip().splitlines()[2])   # the Usage line
+        sys.exit(2)
     sys.exit(main(*sys.argv[1:]))
