@@ -32,6 +32,13 @@ def _sync(x):
     fence(x)
 
 
+def _mark(msg):
+    """Stage marker on stderr: locates where a wedged/slow run is spending
+    time (host build vs tunnel transfer vs compile vs compute) without
+    touching the single-JSON-line stdout contract."""
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
+
 def packed_rate(g, R, steps, iters=3):
     import jax
     import jax.numpy as jnp
@@ -42,10 +49,15 @@ def packed_rate(g, R, steps, iters=3):
     W = R // 32
     nbr = jnp.asarray(g.nbr)
     deg = jnp.asarray(g.deg)
-    rng = np.random.default_rng(0)
-    sp = jnp.asarray(rng.integers(0, 2**32, size=(n, W), dtype=np.uint32))
+    from benchmarks.common import draw_u32
+
+    _mark(f"packed_rate n={n} R={R}: on-device spin-word draw "
+          f"({n * W * 4 / 1e6:.0f} MB state)")
+    sp = draw_u32(0, (n, W))
+    _mark("packed_rate: state resident; compile+warmup")
     f = jax.jit(lambda sp: packed_rollout(nbr, deg, sp, steps))
     _sync(f(sp))
+    _mark("packed_rate: warm; timing")
     t0 = time.perf_counter()
     for _ in range(iters):
         sp = f(sp)                      # chained: each call consumes the last
@@ -59,10 +71,11 @@ def int8_rate(g, R, steps, iters=3):
 
     from graphdyn.ops.dynamics import batched_rollout_impl, rule_coefficients
 
+    from benchmarks.common import draw_pm1_int8
+
     R_coef, C_coef = rule_coefficients("majority", "stay")
     nbr = jnp.asarray(g.nbr)
-    rng = np.random.default_rng(0)
-    s = jnp.asarray((2 * rng.integers(0, 2, size=(R, g.n)) - 1).astype(np.int8))
+    s = draw_pm1_int8(0, (R, g.n))
     f = jax.jit(lambda s: batched_rollout_impl(nbr, s, steps, R_coef, C_coef))
     _sync(f(s))
     t0 = time.perf_counter()
@@ -147,12 +160,15 @@ def main():
 
     from graphdyn.graphs import bfs_order, permute_nodes
 
+    _mark(f"building d=3 RRG n={n}")
     g = random_regular_graph(n, 3, seed=0)
     rate_natural = packed_rate(g, R_packed, steps)
+    _mark(f"natural order rate {rate_natural:.3e}; BFS reorder")
     # BFS node relabeling: neighbors' spin-word rows land near each other in
     # HBM, improving gather locality (dynamics are label-equivariant, tested)
     g_bfs, _ = permute_nodes(g, bfs_order(g))
     rate_bfs = packed_rate(g_bfs, R_packed, steps)
+    _mark(f"bfs order rate {rate_bfs:.3e}; wide-replica row")
     # wide-replica lever: updates/row-access scale with W while bytes/update
     # stay constant, so if the gather is access-rate-bound (not
     # bandwidth-bound) a 4x wider word is ~4x the headline. R=16384 is the
@@ -169,7 +185,9 @@ def main():
         if not is_oom(e):
             raise
     value = max(rate_natural, rate_bfs, rate_wide)
+    _mark(f"wide rate {rate_wide:.3e}; int8 row")
     v8 = int8_rate(g, R_int8, steps)
+    _mark(f"int8 rate {v8:.3e}; torch baseline")
     base = torch_cpu_rate(g)
     print(
         json.dumps(

@@ -40,6 +40,34 @@ def _sync(out):
             break
 
 
+def draw_u32(seed: int, shape, out_shardings=None):
+    """Uniform uint32 words drawn ON DEVICE (optionally directly into a
+    sharding). Benchmark states must never be host-drawn then uploaded: a
+    512 MB+ host→device payload over the tunneled TPU link is the r04
+    session's measured failure mode."""
+    import jax
+    import jax.numpy as jnp
+
+    f = lambda: jax.random.bits(jax.random.key(seed), shape, jnp.uint32)  # noqa: E731
+    out = jax.jit(f, out_shardings=out_shardings)()
+    _sync(out)
+    return out
+
+
+def draw_pm1_int8(seed: int, shape, out_shardings=None):
+    """±1 int8 spins drawn ON DEVICE (see :func:`draw_u32` for why)."""
+    import jax
+    import jax.numpy as jnp
+
+    def f():
+        b = jax.random.bernoulli(jax.random.key(seed), 0.5, shape)
+        return 2 * b.astype(jnp.int8) - 1
+
+    out = jax.jit(f, out_shardings=out_shardings)()
+    _sync(out)
+    return out
+
+
 def timed(fn, *args, warmup: int = 1, iters: int = 3):
     """Run ``fn`` ``warmup`` times uncounted, then ``iters`` timed; returns
     (last_result, seconds_per_iter)."""

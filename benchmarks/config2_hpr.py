@@ -24,7 +24,7 @@ def run(n, sweeps):
     data = BDCMData(g, p=1, c=1)
     sweep = make_sweep(data, damp=0.4, mask_invalid_src=False, with_bias=True)
     marginals = make_marginals(data)
-    chi = data.init_messages(0)
+    chi = data.init_messages_device(0)      # no host chi upload (tunneled link)
     bias = jnp.ones((data.num_directed, data.K), jnp.float32)
 
     @jax.jit
@@ -74,17 +74,17 @@ def run_replicas(n, R, sweeps):
         # non-divisible R (halve_on_oom can floor at 1) runs single-device
         use_mesh = n_dev > 1 and R >= n_dev and R % n_dev == 0
         R_local = R // n_dev if use_mesh else R
-        setup = union_setup(g, cfg, R_local)
+        # single-device: union tables + chi built ON DEVICE — the host
+        # builders' ~4 GB upload is what the tunneled TPU link cannot
+        # sustain (r04 session); the mesh path keeps the host build (its
+        # chi must be host-sharded across devices anyway)
+        setup = union_setup(g, cfg, R_local, device=not use_mesh)
         bias_l = jnp.ones((setup.data.num_directed, setup.data.K), jnp.float32)
 
         def body_local(chi):
             chi = setup.sweep(chi, jnp.float32(25.0), bias_l)
             return chi, setup.marginals(chi)
 
-        chi = jnp.asarray(_draw_union_chi(
-            np.random.default_rng(0), R, 2 * g.num_edges, setup.data.K,
-            "float32",
-        ))
         if use_mesh:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -96,9 +96,16 @@ def run_replicas(n, R, sweeps):
                 body_local, mesh=mesh, in_specs=(rep,), out_specs=(rep, rep),
                 check_vma=False,
             ))
-            chi = jax.device_put(chi, NamedSharding(mesh, rep))
+            chi = jax.device_put(
+                jnp.asarray(_draw_union_chi(
+                    np.random.default_rng(0), R, 2 * g.num_edges,
+                    setup.data.K, "float32",
+                )),
+                NamedSharding(mesh, rep),
+            )
         else:
             body = jax.jit(body_local)
+            chi = setup.data.init_messages_device(0)
 
         class _Data:
             num_directed = 2 * g.num_edges * R
@@ -131,7 +138,7 @@ def run_t3(n, sweeps):
     g = random_regular_graph(n, 4, seed=0)
     data = BDCMData(g, p=2, c=1)
     marginals = make_marginals(data)
-    chi = data.init_messages(0)
+    chi = data.init_messages_device(0)      # no host chi upload (tunneled link)
     bias = jnp.ones((data.num_directed, data.K), jnp.float32)
     for use_pallas, tag in (("auto", "pallas_auto"), (False, "xla")):
         sweep = make_sweep(

@@ -64,16 +64,26 @@ def run_step(n, R, n_temps):
     Rtot -= Rtot % max(rep_shards, 1)
 
     def attempt(Rtot):
-        rng = np.random.default_rng(0)
-        s = (2 * rng.integers(0, 2, size=(Rtot, n_pad)) - 1).astype(np.int8)
+        from jax.sharding import NamedSharding
+
+        from benchmarks.common import draw_pm1_int8
+
         nbr_d = place_sharded(mesh, jnp.asarray(nbr_pad), P("node", None))
-        s_d = place_sharded(mesh, jnp.asarray(s), P("replica", "node"))
+        # spins drawn ON DEVICE, directly into the target sharding: the host
+        # draw is 16 GB at the full config-5 shape — unholdable on the 1-core
+        # host and unshippable over the tunneled TPU link (r04 session)
+        s_d = draw_pm1_int8(
+            0, (Rtot, n_pad),
+            out_shardings=NamedSharding(mesh, P("replica", "node")),
+        )
 
         rollout = make_sharded_rollout(mesh, n_real=g.n, steps=1)
         s_end = rollout(nbr_d, s_d)
-        sum_end = jnp.asarray(
-            np.asarray(s_end)[:, : g.n].astype(np.int64).sum(axis=1), jnp.int32
-        )
+        # device-side reduction (a host round-trip here pulls the full
+        # [Rtot, n_pad] spin state back over the link)
+        sum_end = jax.jit(
+            lambda se: se[:, : g.n].astype(jnp.int32).sum(axis=1)
+        )(s_end)
         # temperature ladder: a0 varies per replica block (BASELINE config 5)
         ladder = np.linspace(0.005, 0.03, n_temps)
         a0 = np.resize(np.repeat(ladder, max(Rtot // n_temps, 1)), Rtot)

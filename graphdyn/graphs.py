@@ -505,6 +505,70 @@ def replicate_edge_tables(tables: EdgeTables, R: int, n: int) -> EdgeTables:
     )
 
 
+def replicate_disjoint_device(graph: Graph, R: int) -> Graph:
+    """:func:`replicate_disjoint` computed ON DEVICE: the returned ``Graph``
+    holds jnp arrays built by offset arithmetic from the base graph's (small)
+    host tables. Purpose: over a tunneled/remote device link the union's
+    ``[R·n, dmax]`` neighbor table (~300 MB at config-2 scale) never crosses
+    host→device — only the base tables do. Same layout contract as the host
+    builder (tested equal)."""
+    import jax.numpy as jnp
+
+    n, E, dmax = graph.n, graph.num_edges, graph.dmax
+    _check_i32(R, n)                    # ids here are node ids, ghost = R*n
+    noff = (jnp.arange(R, dtype=jnp.int32) * n)[:, None, None]
+    nbr = jnp.asarray(graph.nbr)
+    nbr_u = jnp.where(nbr[None] == n, R * n, nbr[None] + noff)
+    edges_u = jnp.asarray(graph.edges)[None] + noff
+    return Graph(
+        nbr=nbr_u.reshape(R * n, dmax).astype(jnp.int32),
+        deg=jnp.tile(jnp.asarray(graph.deg), R),
+        edges=edges_u.reshape(R * E, 2).astype(jnp.int32),
+    )
+
+
+def _check_i32(R: int, period: int):
+    if R * period >= 2**31:
+        raise ValueError(
+            f"device union ids overflow int32 (R={R} x period={period}); "
+            "use the host builders"
+        )
+
+
+def _rep_ids_device(t: np.ndarray, R: int, period: int, ghost: int, ghost_u: int):
+    """Tile a table of (ghost-padded) ids across R replicas on device:
+    replica r's copy is offset by ``r·period``; ``ghost`` maps to
+    ``ghost_u`` unshifted. int32 throughout (range-guarded) so the helpers
+    behave identically with and without x64."""
+    import jax.numpy as jnp
+
+    _check_i32(R, period)
+    t = jnp.asarray(np.asarray(t).astype(np.int32))
+    off = (jnp.arange(R, dtype=jnp.int32) * period).reshape((R,) + (1,) * t.ndim)
+    out = jnp.where(t == ghost, ghost_u, t + off)
+    return out.reshape((R * t.shape[0],) + t.shape[1:])
+
+
+def replicate_edge_tables_device(tables: EdgeTables, R: int, n: int) -> EdgeTables:
+    """:func:`replicate_edge_tables` computed ON DEVICE (same replica-major
+    layout; jnp members). See :func:`replicate_disjoint_device` for why."""
+    import jax.numpy as jnp
+
+    twoE = tables.num_directed
+    E = tables.num_edges
+    ghost, ghost_u = twoE, R * twoE
+    base_rev = (np.arange(twoE, dtype=np.int64) + E) % max(twoE, 1)
+    return EdgeTables(
+        src=_rep_ids_device(tables.src, R, n, -1, -1),      # no ghost nodes
+        dst=_rep_ids_device(tables.dst, R, n, -1, -1),
+        edge_deg=jnp.tile(jnp.asarray(tables.edge_deg), R),
+        in_edges=_rep_ids_device(tables.in_edges, R, twoE, ghost, ghost_u),
+        node_in_edges=_rep_ids_device(tables.node_in_edges, R, twoE, ghost, ghost_u),
+        node_out_edges=_rep_ids_device(tables.node_out_edges, R, twoE, ghost, ghost_u),
+        rev_map=_rep_ids_device(base_rev, R, twoE, -1, -1),
+    )
+
+
 def disjoint_union(graphs) -> tuple[Graph, np.ndarray, np.ndarray]:
     """Disjoint union of arbitrary graphs (graph k's nodes shifted by the
     cumulative node count).

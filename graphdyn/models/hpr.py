@@ -79,16 +79,18 @@ class _HPRSetup(NamedTuple):
 
 
 def _prep(
-    graph: Graph, config: HPRConfig, *, tables: object = None, use_pallas="auto"
+    graph: Graph, config: HPRConfig, *, tables: object = None,
+    use_pallas="auto", data: BDCMData | None = None,
 ) -> _HPRSetup:
     dyn = config.dynamics
     n = graph.n
     tables = tables if tables is not None else build_edge_tables(graph)
     dtype = jnp.dtype(config.dtype)
-    data = BDCMData(
-        graph, tables, p=dyn.p, c=dyn.c, attr_value=dyn.attr_value,
-        rule=dyn.rule, tie=dyn.tie, dtype=dtype,
-    )
+    if data is None:
+        data = BDCMData(
+            graph, tables, p=dyn.p, c=dyn.c, attr_value=dyn.attr_value,
+            rule=dyn.rule, tie=dyn.tie, dtype=dtype,
+        )
     sweep = make_sweep(
         data, damp=config.damp, eps_clamp=0.0, mask_invalid_src=False,
         with_bias=True, use_pallas=use_pallas,
@@ -97,7 +99,10 @@ def _prep(
     R_coef, C_coef = rule_coefficients(dyn.rule, dyn.tie)
     rollout_steps = dyn.p + dyn.c - 1
 
-    src = jnp.asarray(tables.src.astype(np.int64))
+    src = jnp.asarray(
+        tables.src.astype(np.int64) if isinstance(tables.src, np.ndarray)
+        else tables.src            # device tables are int32 (range-guarded)
+    )
     sel_plus = jnp.asarray(data.x0 == 1)
     nbr = jnp.asarray(graph.nbr)
 
@@ -252,12 +257,30 @@ class HPRBatchResult(NamedTuple):
     elapsed_s: float
 
 
-def union_setup(graph: Graph, config: HPRConfig, R: int) -> _HPRSetup:
+def union_setup(
+    graph: Graph, config: HPRConfig, R: int, *, device: bool = False
+) -> _HPRSetup:
     """R-replica disjoint-union HPr setup in the REPLICA-MAJOR edge layout
     (:func:`graphdyn.graphs.replicate_edge_tables`): replica ``r``'s directed
     edges occupy the contiguous rows ``[r·2E, (r+1)·2E)``, so every gather in
     the sweep, marginals, and bias scatter stays inside one replica's block
-    and a 1-D replica sharding of the state is communication-free."""
+    and a 1-D replica sharding of the state is communication-free.
+
+    ``device=True`` builds the union tables ON DEVICE by offset-tiling the
+    base tables (:func:`graphdyn.ops.bdcm.replicate_bdcm_device`) — the
+    host→device link then carries ~10 MB instead of ~4 GB at config-2 scale,
+    which a tunneled TPU transport cannot sustain. Single-device placement
+    only (the mesh path shards per-replica blocks itself)."""
+    if device:
+        from graphdyn.ops.bdcm import BDCMData, replicate_bdcm_device
+
+        dyn = config.dynamics
+        base = BDCMData(
+            graph, p=dyn.p, c=dyn.c, attr_value=dyn.attr_value,
+            rule=dyn.rule, tie=dyn.tie, dtype=jnp.dtype(config.dtype),
+        )
+        data_u = replicate_bdcm_device(base, R)
+        return _prep(data_u.graph, config, tables=data_u.tables, data=data_u)
     from graphdyn.graphs import replicate_disjoint, replicate_edge_tables
 
     gu = replicate_disjoint(graph, R)

@@ -178,6 +178,77 @@ class BDCMData:
         chi /= chi.sum(axis=(1, 2), keepdims=True)
         return jnp.asarray(chi, self.dtype)
 
+    def init_messages_device(self, seed: int = 0) -> jnp.ndarray:
+        """Random row-normalized chi drawn ON DEVICE (different stream from
+        :meth:`init_messages` — both are valid random inits; this one never
+        ships a [2E, K, K] host buffer over the device link)."""
+        K, twoE, dt = self.K, self.num_directed, self.dtype
+
+        @jax.jit
+        def draw():
+            u = jax.random.uniform(jax.random.key(seed), (twoE, K, K), dt)
+            return u / u.sum(axis=(1, 2), keepdims=True)
+
+        return draw()
+
+
+def replicate_bdcm_device(base: BDCMData, R: int) -> BDCMData:
+    """R-replica disjoint-union ``BDCMData`` in the replica-major layout
+    (:func:`graphdyn.graphs.replicate_edge_tables`), with every union-sized
+    table computed ON DEVICE from the base graph's host tables.
+
+    Rationale: the host builders materialize ~4 GB of union tables at
+    BASELINE config-2 scale (n=1e5, R=256) that must then cross the
+    host→device link — which over the tunneled TPU transport is the
+    round-4 session's measured failure mode. Here the link carries only the
+    base tables (~10 MB); the union's classes/tables are offset-tiled jnp
+    arrays. The degree-class structure of a disjoint union of R copies is
+    exactly the base structure tiled, so no host ``degree_classes`` pass is
+    needed. Layout equality with the host path is tested
+    (tests/test_hpr.py)."""
+    import copy
+
+    from graphdyn.graphs import (
+        _rep_ids_device,
+        replicate_disjoint_device,
+        replicate_edge_tables_device,
+    )
+
+    g, t = base.graph, base.tables
+    n, twoE = g.n, t.num_directed
+    ghost, ghost_u = twoE, R * twoE
+
+    # shallow-copy the base, then override every union-sized field: scalar
+    # config and the [K]-shaped factor data (valid/x0/leaf01, per-class A/Ai)
+    # are edge-count independent and carry over; a future BDCMData attribute
+    # is inherited rather than silently missing
+    u = copy.copy(base)
+    u.graph = replicate_disjoint_device(g, R)
+    u.tables = replicate_edge_tables_device(t, R, n)
+    u.leaf_idx = _rep_ids_device(base.leaf_idx, R, twoE, ghost, ghost_u)
+    u.edge_classes = [
+        _EdgeClass(
+            d=cls.d,
+            idx=_rep_ids_device(cls.idx, R, twoE, ghost, ghost_u),
+            in_edges=_rep_ids_device(cls.in_edges, R, twoE, ghost, ghost_u),
+            A=cls.A,
+        )
+        for cls in base.edge_classes
+    ]
+    u.node_classes = [
+        _NodeClass(
+            d=cls.d,
+            idx=_rep_ids_device(cls.idx, R, n, g.n, R * g.n),
+            in_edges=_rep_ids_device(cls.in_edges, R, twoE, ghost, ghost_u),
+            Ai=cls.Ai,
+        )
+        for cls in base.node_classes
+    ]
+    u.num_directed = R * twoE
+    u.num_edges = R * t.num_edges
+    u.n = R * n
+    return u
+
 
 def _neighbor_dp(chi_in, d: int, T: int, K: int):
     """ρ-lattice DP: LL[e, x_i, ρ] = Σ over assignments of the d incoming
@@ -795,7 +866,11 @@ def make_marginals(data: BDCMData, eps: float = 1e-15):
     E = data.num_edges
     sel_plus = jnp.asarray(data.x0 == 1, data.dtype)
     rev = jnp.asarray(data.tables.rev(np.arange(2 * E)))
-    out_edges = jnp.asarray(data.tables.node_out_edges.astype(np.int64))
+    out_edges = data.tables.node_out_edges
+    out_edges = jnp.asarray(
+        out_edges.astype(np.int64) if isinstance(out_edges, np.ndarray)
+        else out_edges              # device tables are int32 (range-guarded)
+    )
 
     @jax.jit
     def marginals(chi):

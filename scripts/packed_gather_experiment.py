@@ -78,14 +78,12 @@ def main():
 
     g = random_regular_graph(args.n, args.d, seed=1)
     g, _ = permute_nodes(g, bfs_order(g))
+    from benchmarks.common import draw_u32
+
     nbr = jnp.asarray(g.nbr)
     deg = jnp.asarray(g.deg)
     nbr_sorted = jnp.asarray(np.sort(g.nbr, axis=1))
-    sp = jnp.asarray(
-        np.random.default_rng(0).integers(
-            0, 2**32, size=(args.n, args.w), dtype=np.uint32
-        )
-    )
+    sp = draw_u32(0, (args.n, args.w))
 
     for name, gather, tbl in [
         ("A_fused_gather", "fused", nbr),
@@ -109,11 +107,10 @@ def main():
     # int8 kernel A/B (the SA solver's hot rollout — ops.dynamics)
     from graphdyn.ops.dynamics import batched_rollout
 
+    from benchmarks.common import draw_pm1_int8
+
     R8 = 64
-    s8 = jnp.asarray(
-        (2 * np.random.default_rng(1).integers(0, 2, size=(R8, args.n)) - 1)
-        .astype(np.int8)
-    )
+    s8 = draw_pm1_int8(1, (R8, args.n))
     for name, gather in [("int8_A_fused", "fused"), ("int8_B_per_slot", "per_slot")]:
         rate = time_chained(
             lambda x, g=gather: batched_rollout(nbr, x, args.steps, gather=g),

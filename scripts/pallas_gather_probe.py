@@ -38,10 +38,23 @@ import jax
 import jax.numpy as jnp
 
 
+def _draw(n_src, n_idx, W, seed):
+    """Source rows + indices drawn ON DEVICE — a host upload of the source
+    (4 GB at W=1024) over the tunneled link wedges the relay (r04 session)."""
+    from benchmarks.common import draw_u32
+
+    src = draw_u32(seed, (n_src, W))
+    idx = jax.jit(
+        lambda: jax.random.randint(
+            jax.random.key(seed + 1), (n_idx,), 0, n_src, jnp.int32
+        )
+    )()
+    jax.block_until_ready(idx)
+    return src, idx
+
+
 def xla_gather_rate(n_src, n_idx, W, iters=3, seed=0):
-    rng = np.random.default_rng(seed)
-    src = jnp.asarray(rng.integers(0, 2**32, size=(n_src, W), dtype=np.uint32))
-    idx = jnp.asarray(rng.integers(0, n_src, size=n_idx).astype(np.int32))
+    src, idx = _draw(n_src, n_idx, W, seed)
     f = jax.jit(lambda s, i: jnp.take(s, i, axis=0))
     out, dt = timed(f, src, idx, iters=iters)
     return n_idx / dt, n_idx * W * 4 / dt, out
@@ -106,9 +119,7 @@ def pallas_gather(src, idx, *, block=256, depth=8, interpret=False):
 
 
 def pallas_gather_rate(n_src, n_idx, W, iters=3, seed=0, depth=8, interpret=False):
-    rng = np.random.default_rng(seed)
-    src = jnp.asarray(rng.integers(0, 2**32, size=(n_src, W), dtype=np.uint32))
-    idx = jnp.asarray(rng.integers(0, n_src, size=n_idx).astype(np.int32))
+    src, idx = _draw(n_src, n_idx, W, seed)
     f = jax.jit(functools.partial(pallas_gather, depth=depth, interpret=interpret))
     out, dt = timed(f, src, idx, iters=iters)
     return n_idx / dt, n_idx * W * 4 / dt, out

@@ -1,0 +1,23 @@
+#!/bin/bash
+# TPU relay canary: append one status line per probe to the log. Each probe
+# is a fresh interpreter (the wedge hits at client setup, so a persistent
+# process would only measure its own cached connection). Usage:
+#   nohup bash scripts/tpu_canary.sh [logfile] [interval_s] &
+LOG="${1:-/tmp/tpu_canary.log}"
+INT="${2:-120}"
+cd "$(dirname "$0")/.."
+while true; do
+    out=$(timeout 90 python - <<'EOF' 2>/dev/null
+import jax, time
+t0 = time.time()
+d = jax.devices()
+x = jax.numpy.ones((128, 128)) @ jax.numpy.ones((128, 128))
+x.block_until_ready()
+print(f"UP {d[0].platform} {time.time()-t0:.1f}s")
+EOF
+    )
+    rc=$?
+    if [ $rc -ne 0 ] || [ -z "$out" ]; then out="DOWN rc=$rc"; fi
+    echo "$(date -u +%H:%M:%S) $out" >> "$LOG"
+    sleep "$INT"
+done

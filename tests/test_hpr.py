@@ -306,6 +306,68 @@ def test_replicate_edge_tables_layout_equivalence():
         make_edge_partition(data_new)
 
 
+def test_union_setup_device_bit_identical_to_host():
+    """The ON-DEVICE union builders (`replicate_disjoint_device`,
+    `replicate_edge_tables_device`, `replicate_bdcm_device` — the tunneled-
+    link path that never ships union-sized tables host→device) produce the
+    same tables and bit-identical sweep/marginals/bias as the host builders.
+    An ER instance exercises ghost padding (ragged degrees, leaf edges)."""
+    import jax.numpy as jnp
+
+    from graphdyn.config import HPRConfig
+    from graphdyn.graphs import (
+        build_edge_tables,
+        erdos_renyi_graph,
+        remove_isolates,
+        replicate_disjoint,
+        replicate_disjoint_device,
+        replicate_edge_tables,
+        replicate_edge_tables_device,
+    )
+    from graphdyn.models.hpr import union_setup
+
+    R = 3
+    for g in (
+        random_regular_graph(20, 3, seed=3),
+        remove_isolates(erdos_renyi_graph(40, 2.0 / 39, seed=1))[0],
+    ):
+        th = replicate_edge_tables(build_edge_tables(g), R, g.n)
+        td = replicate_edge_tables_device(build_edge_tables(g), R, g.n)
+        for f in ("src", "dst", "edge_deg", "in_edges", "node_in_edges",
+                  "node_out_edges", "rev_map"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(td, f)), np.asarray(getattr(th, f)),
+                err_msg=f,
+            )
+        gh, gd = replicate_disjoint(g, R), replicate_disjoint_device(g, R)
+        for f in ("nbr", "deg", "edges"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(gd, f)), np.asarray(getattr(gh, f)),
+                err_msg=f,
+            )
+
+        cfg = HPRConfig()
+        sh = union_setup(g, cfg, R)
+        sd = union_setup(g, cfg, R, device=True)
+        chi = sh.data.init_messages(0)
+        bias = jnp.ones((sh.data.num_directed, sh.data.K), jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(sh.sweep(chi, jnp.float32(25.0), bias)),
+            np.asarray(sd.sweep(chi, jnp.float32(25.0), bias)),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sh.marginals(chi)), np.asarray(sd.marginals(chi))
+        )
+        nb = jnp.asarray(np.random.default_rng(0).random((sh.n, 2)), jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(sh.bias_to_edge(nb)), np.asarray(sd.bias_to_edge(nb))
+        )
+        # the on-device chi draw is row-normalized with the right shape
+        chi_d = np.asarray(sd.data.init_messages_device(0))
+        assert chi_d.shape == (sd.data.num_directed, sd.data.K, sd.data.K)
+        np.testing.assert_allclose(chi_d.sum(axis=(1, 2)), 1.0, rtol=1e-5)
+
+
 @pytest.mark.parametrize("R", [8, 5])
 def test_hpr_batch_sharded_bit_identical_to_unsharded(R):
     """The shard_map replica program equals the unsharded union program
