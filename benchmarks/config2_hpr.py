@@ -66,10 +66,6 @@ def run_replicas(n, R, sweeps):
     cfg = HPRConfig()
 
     def attempt(R):
-        import numpy as np
-
-        from graphdyn.models.hpr import _draw_union_chi
-
         # shard only when each device gets a whole replica block; small or
         # non-divisible R (halve_on_oom can floor at 1) runs single-device
         use_mesh = n_dev > 1 and R >= n_dev and R % n_dev == 0
@@ -96,13 +92,19 @@ def run_replicas(n, R, sweeps):
                 body_local, mesh=mesh, in_specs=(rep,), out_specs=(rep, rep),
                 check_vma=False,
             ))
-            chi = jax.device_put(
-                jnp.asarray(_draw_union_chi(
-                    np.random.default_rng(0), R, 2 * g.num_edges,
-                    setup.data.K, "float32",
-                )),
-                NamedSharding(mesh, rep),
-            )
+            # chi drawn ON DEVICE straight into the replica sharding (the
+            # per-row normalization is elementwise over the sharded axis) —
+            # a host draw at reference scale is ~10 GB over the link
+            K = setup.data.K
+            rows = 2 * g.num_edges * R
+
+            def draw_chi():
+                u = jax.random.uniform(jax.random.key(0), (rows, K, K))
+                return u / u.sum(axis=(1, 2), keepdims=True)
+
+            chi = jax.jit(
+                draw_chi, out_shardings=NamedSharding(mesh, rep)
+            )()
         else:
             body = jax.jit(body_local)
             chi = setup.data.init_messages_device(0)

@@ -530,8 +530,8 @@ def replicate_disjoint_device(graph: Graph, R: int) -> Graph:
 def _check_i32(R: int, period: int):
     if R * period >= 2**31:
         raise ValueError(
-            f"device union ids overflow int32 (R={R} x period={period}); "
-            "use the host builders"
+            f"union ids exceed int32 (R={R} x period={period}); split the "
+            "replicas across several smaller unions"
         )
 
 

@@ -371,6 +371,7 @@ def make_hpr_batch_chunk(
     *,
     mesh=None,
     replica_axis: str = "replica",
+    device_tables: bool = False,
 ):
     """Build the jitted chunk program ``(chi, biases, s, keys, t, m_final,
     active, steps, t_end) -> same-shape state`` advancing ``Rtot`` batched
@@ -385,8 +386,13 @@ def make_hpr_batch_chunk(
     (`HPR_pytorch_RRG.py:259`). Exposed for the config-2 benchmark so it
     measures the exact shipped program.
     """
+    if device_tables and mesh is not None:
+        raise ValueError(
+            "device_tables=True is incompatible with mesh= (the mesh path "
+            "host-shards its per-device union blocks)"
+        )
     if mesh is None:
-        setup = union_setup(graph, config, Rtot)
+        setup = union_setup(graph, config, Rtot, device=device_tables)
         body, m_per_replica = _make_hpr_batch_body(setup, graph, Rtot)
 
         @jax.jit
@@ -451,6 +457,7 @@ def hpr_solve_batch(
     checkpoint_path: str | None = None,
     checkpoint_interval_s: float = 30.0,
     chunk_sweeps: int = 200,
+    device_init: bool = False,
 ) -> HPRBatchResult:
     """Run R independent HPr chains on ONE graph as a single batched device
     program — the BASELINE config-2 replica axis (`N=1e5, 256 replicas`).
@@ -476,6 +483,14 @@ def hpr_solve_batch(
     R chains, so a run may resume on a different mesh shape. chi dominates
     the snapshot size (``R·2E·K²`` floats), so pick
     ``checkpoint_interval_s`` accordingly at config-2 scale.
+
+    ``device_init=True`` builds the union tables AND the initial state
+    (chi, biases, keys) on device — nothing union-sized ever crosses the
+    host↔device link, which a tunneled TPU transport cannot sustain at
+    config-2 scale. The device streams differ from the host ``seed``
+    streams (both are valid random inits). Incompatible with ``mesh``
+    (host-sharded placement) and ``checkpoint_path`` (snapshots pull chi
+    back to host every interval — the same link problem in reverse).
     """
     t_start = time.perf_counter()
     config = config or HPRConfig()
@@ -488,12 +503,18 @@ def hpr_solve_batch(
     K = 2**T
     np_dt = np.dtype(config.dtype)
 
+    if device_init and mesh is not None:
+        raise ValueError("device_init=True is incompatible with mesh=")
+    if device_init and checkpoint_path is not None:
+        raise ValueError("device_init=True is incompatible with checkpoint_path=")
+
     shards = int(mesh.shape[replica_axis]) if mesh is not None else 1
     R_pad = (-R) % shards
     Rtot = R + R_pad
 
     run_chunk, setup = make_hpr_batch_chunk(
-        graph, config, Rtot, mesh=mesh, replica_axis=replica_axis
+        graph, config, Rtot, mesh=mesh, replica_axis=replica_axis,
+        device_tables=device_init,
     )
     TT = setup.TT
 
@@ -517,14 +538,38 @@ def hpr_solve_batch(
         )
 
     if arrays is None:
-        rng = np.random.default_rng(seed)
-        chi0 = _draw_union_chi(rng, R, twoE, K, np_dt)
-        biases0 = rng.random((R * n, 2))
-        biases0 /= biases0.sum(axis=1, keepdims=True)
-        biases0 = biases0.astype(np_dt)
-        # one root key per chain: distinct seeds give fully disjoint streams
-        keys0 = np.asarray(jax.random.split(jax.random.PRNGKey(seed), R))
-        s0 = np.where(biases0[:, 0] > biases0[:, 1], 1, -1).astype(np.int8)
+        if device_init:
+            dt = setup.dtype
+            # one root, three fold_in-derived purposes: chi, biases, and the
+            # per-chain update keys come from independent streams (sharing
+            # the root key across purposes would make the chains' key
+            # material a prefix of chi's bit stream)
+            root = jax.random.key(seed)
+            k_chi = jax.random.fold_in(root, 0)
+            k_bias = jax.random.fold_in(root, 1)
+
+            @jax.jit
+            def _draw_init():
+                u = jax.random.uniform(k_chi, (R * twoE, K, K), dt)
+                chi = u / u.sum(axis=(1, 2), keepdims=True)
+                b = jax.random.uniform(k_bias, (R * n, 2), dt)
+                b = b / b.sum(axis=1, keepdims=True)
+                return chi, b, jnp.where(b[:, 0] > b[:, 1], 1, -1).astype(jnp.int8)
+
+            chi0, biases0, s0 = _draw_init()
+            keys0 = jax.random.split(
+                jax.random.fold_in(jax.random.PRNGKey(seed), 2), R
+            )
+        else:
+            rng = np.random.default_rng(seed)
+            chi0 = _draw_union_chi(rng, R, twoE, K, np_dt)
+            biases0 = rng.random((R * n, 2))
+            biases0 /= biases0.sum(axis=1, keepdims=True)
+            biases0 = biases0.astype(np_dt)
+            # one root key per chain: distinct seeds give fully disjoint
+            # streams
+            keys0 = np.asarray(jax.random.split(jax.random.PRNGKey(seed), R))
+            s0 = np.where(biases0[:, 0] > biases0[:, 1], 1, -1).astype(np.int8)
         arrays = {
             "chi": chi0, "biases": biases0, "s": s0, "keys": keys0,
             "t": np.zeros(R, np.int32), "m_final": None, "active": None,
@@ -559,16 +604,19 @@ def hpr_solve_batch(
 
     if arrays["m_final"] is None:
         # initial stop-test: the same base-graph batched rollout the body
-        # uses, run once host-driven on the unpadded chains
+        # uses, run once host-driven on the unpadded chains. Only the [R]
+        # sum vector crosses device->host (the [R, n] end state stays on
+        # device); the f64 division happens on host, as always
         R_coef, C_coef = rule_coefficients(dyn.rule, dyn.tie)
-        s_end = np.asarray(
-            jax.jit(batched_rollout_impl, static_argnums=(2, 3, 4))(
-                jnp.asarray(graph.nbr),
-                jnp.asarray(arrays["s"].reshape(R, n)),
-                dyn.p + dyn.c - 1, R_coef, C_coef,
-            )
+        s_end = jax.jit(batched_rollout_impl, static_argnums=(2, 3, 4))(
+            jnp.asarray(graph.nbr),
+            jnp.asarray(arrays["s"]).reshape(R, n),
+            dyn.p + dyn.c - 1, R_coef, C_coef,
         )
-        m0 = (s_end.astype(np.int64).sum(axis=1) / n).astype(np.float32)
+        sums = np.asarray(
+            jax.jit(lambda se: se.astype(jnp.int32).sum(axis=1))(s_end)
+        )
+        m0 = (sums.astype(np.int64) / n).astype(np.float32)
         arrays["m_final"] = m0
         arrays["active"] = m0 < 1.0
 

@@ -7,6 +7,13 @@ LOG="${1:-/tmp/tpu_canary.log}"
 INT="${2:-120}"
 cd "$(dirname "$0")/.."
 while true; do
+    # a bench session owns the chip exclusively: probing while it runs both
+    # contends for the device and pollutes its timings — pause instead
+    if [ -f /tmp/tpu_canary.pause ]; then
+        echo "$(date -u +%H:%M:%S) PAUSED" >> "$LOG"
+        sleep "$INT"
+        continue
+    fi
     out=$(timeout 90 python - <<'EOF' 2>/dev/null
 import jax, time
 t0 = time.time()

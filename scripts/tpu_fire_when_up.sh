@@ -1,0 +1,23 @@
+#!/bin/bash
+# Watch the TPU canary log; the first time an UP line appears, fire the
+# one-shot chip session into the given outdir (exactly once) and exit.
+#   nohup bash scripts/tpu_fire_when_up.sh tpu_session_r04 &
+cd "$(dirname "$0")/.."
+OUT="${1:-tpu_session_r04}"
+LOG="${2:-/tmp/tpu_canary.log}"
+FLAG="$OUT/.fired"
+mkdir -p "$OUT"
+while true; do
+    if [ -f "$FLAG" ]; then exit 0; fi
+    if tail -n 1 "$LOG" 2>/dev/null | grep -q " UP "; then
+        date -u > "$FLAG"
+        touch /tmp/tpu_canary.pause      # the session owns the chip now
+        trap 'rm -f /tmp/tpu_canary.pause' EXIT   # unpause even if killed
+        echo "[fire-when-up] canary UP at $(date -u +%H:%M:%S); launching session" \
+            >> "$OUT/session.log"
+        bash scripts/tpu_bench_session.sh "$OUT" >> "$OUT/session.log" 2>&1
+        rm -f /tmp/tpu_canary.pause
+        exit 0
+    fi
+    sleep 30
+done

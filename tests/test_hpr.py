@@ -368,6 +368,34 @@ def test_union_setup_device_bit_identical_to_host():
         np.testing.assert_allclose(chi_d.sum(axis=(1, 2)), 1.0, rtol=1e-5)
 
 
+def test_hpr_batch_device_init():
+    """`hpr_solve_batch(device_init=True)` — the tunneled-link path where
+    tables and the initial state are built on device — solves chains and
+    refuses the incompatible mesh/checkpoint combinations."""
+    from graphdyn.models.hpr import hpr_solve_batch
+    from graphdyn.parallel.mesh import make_mesh
+
+    g = random_regular_graph(200, 3, seed=1)
+    cfg = HPRConfig(dynamics=DynamicsConfig(p=1, c=1), max_sweeps=4000)
+    res = hpr_solve_batch(g, cfg, n_replicas=3, seed=0, device_init=True)
+    assert res.s.shape == (3, g.n)
+    assert np.all((res.m_final == 1.0) | (res.m_final == 2.0))
+    assert np.any(res.m_final == 1.0)           # at least one chain solves
+    for s_k, mf in zip(res.s, res.m_final):
+        if mf == 1.0:
+            assert np.all(end_state(g, s_k, p=1, c=1, backend="cpu") == 1)
+
+    with pytest.raises(ValueError, match="mesh"):
+        hpr_solve_batch(
+            g, cfg, n_replicas=2, device_init=True,
+            mesh=make_mesh((1,), ("replica",)),
+        )
+    with pytest.raises(ValueError, match="checkpoint"):
+        hpr_solve_batch(
+            g, cfg, n_replicas=2, device_init=True, checkpoint_path="/tmp/x",
+        )
+
+
 @pytest.mark.parametrize("R", [8, 5])
 def test_hpr_batch_sharded_bit_identical_to_unsharded(R):
     """The shard_map replica program equals the unsharded union program
