@@ -92,19 +92,14 @@ def run_replicas(n, R, sweeps):
                 body_local, mesh=mesh, in_specs=(rep,), out_specs=(rep, rep),
                 check_vma=False,
             ))
-            # chi drawn ON DEVICE straight into the replica sharding (the
-            # per-row normalization is elementwise over the sharded axis) —
-            # a host draw at reference scale is ~10 GB over the link
-            K = setup.data.K
-            rows = 2 * g.num_edges * R
+            # chi drawn ON DEVICE straight into the replica sharding — a
+            # host draw at reference scale is ~10 GB over the link
+            from graphdyn.ops.bdcm import draw_chi_device
 
-            def draw_chi():
-                u = jax.random.uniform(jax.random.key(0), (rows, K, K))
-                return u / u.sum(axis=(1, 2), keepdims=True)
-
-            chi = jax.jit(
-                draw_chi, out_shardings=NamedSharding(mesh, rep)
-            )()
+            chi = draw_chi_device(
+                jax.random.key(0), 2 * g.num_edges * R, setup.data.K,
+                jnp.float32, out_shardings=NamedSharding(mesh, rep),
+            )
         else:
             body = jax.jit(body_local)
             chi = setup.data.init_messages_device(0)

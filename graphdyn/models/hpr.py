@@ -544,19 +544,21 @@ def hpr_solve_batch(
             # per-chain update keys come from independent streams (sharing
             # the root key across purposes would make the chains' key
             # material a prefix of chi's bit stream)
+            from graphdyn.ops.bdcm import draw_chi_device
+
             root = jax.random.key(seed)
-            k_chi = jax.random.fold_in(root, 0)
+            chi0 = draw_chi_device(
+                jax.random.fold_in(root, 0), R * twoE, K, dt
+            )
             k_bias = jax.random.fold_in(root, 1)
 
             @jax.jit
-            def _draw_init():
-                u = jax.random.uniform(k_chi, (R * twoE, K, K), dt)
-                chi = u / u.sum(axis=(1, 2), keepdims=True)
+            def _draw_bias():
                 b = jax.random.uniform(k_bias, (R * n, 2), dt)
                 b = b / b.sum(axis=1, keepdims=True)
-                return chi, b, jnp.where(b[:, 0] > b[:, 1], 1, -1).astype(jnp.int8)
+                return b, jnp.where(b[:, 0] > b[:, 1], 1, -1).astype(jnp.int8)
 
-            chi0, biases0, s0 = _draw_init()
+            biases0, s0 = _draw_bias()
             keys0 = jax.random.split(
                 jax.random.fold_in(jax.random.PRNGKey(seed), 2), R
             )

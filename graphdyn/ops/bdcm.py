@@ -182,14 +182,23 @@ class BDCMData:
         """Random row-normalized chi drawn ON DEVICE (different stream from
         :meth:`init_messages` — both are valid random inits; this one never
         ships a [2E, K, K] host buffer over the device link)."""
-        K, twoE, dt = self.K, self.num_directed, self.dtype
+        return draw_chi_device(
+            jax.random.key(seed), self.num_directed, self.K, self.dtype
+        )
 
-        @jax.jit
-        def draw():
-            u = jax.random.uniform(jax.random.key(seed), (twoE, K, K), dt)
-            return u / u.sum(axis=(1, 2), keepdims=True)
 
-        return draw()
+def draw_chi_device(key, rows: int, K: int, dtype, out_shardings=None):
+    """Row-normalized random chi ``[rows, K, K]`` drawn ON DEVICE, optionally
+    straight into a sharding — the one draw behind
+    :meth:`BDCMData.init_messages_device` and the solvers'/benchmarks'
+    device-resident init paths (the per-row normalization is elementwise
+    over the row axis, so any 1-D row sharding is valid)."""
+
+    def f():
+        u = jax.random.uniform(key, (rows, K, K), dtype)
+        return u / u.sum(axis=(1, 2), keepdims=True)
+
+    return jax.jit(f, out_shardings=out_shardings)()
 
 
 def replicate_bdcm_device(base: BDCMData, R: int) -> BDCMData:

@@ -35,4 +35,9 @@ timeout 9000 python scripts/run_baseline_configs.py \
     --out "$OUT/configs_tpu.json" --full --timeout 1500 >&2
 echo "[tpu-session] configs rc=$?" >&2
 
+echo "[tpu-session] physics on chip (HPr at reference constants) ..." >&2
+timeout 1200 python scripts/physics_r04.py hpr "$OUT/physics_tpu.json" \
+    > "$OUT/physics_tpu.log" 2>&1
+echo "[tpu-session] physics rc=$?" >&2
+
 echo "[tpu-session] done; artifacts in $OUT" >&2

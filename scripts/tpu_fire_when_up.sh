@@ -11,8 +11,8 @@ while true; do
     if [ -f "$FLAG" ]; then exit 0; fi
     if tail -n 1 "$LOG" 2>/dev/null | grep -q " UP "; then
         date -u > "$FLAG"
-        touch /tmp/tpu_canary.pause      # the session owns the chip now
         trap 'rm -f /tmp/tpu_canary.pause' EXIT   # unpause even if killed
+        touch /tmp/tpu_canary.pause      # the session owns the chip now
         echo "[fire-when-up] canary UP at $(date -u +%H:%M:%S); launching session" \
             >> "$OUT/session.log"
         bash scripts/tpu_bench_session.sh "$OUT" >> "$OUT/session.log" 2>&1
