@@ -218,7 +218,10 @@ def main():
     except Exception as e:  # noqa: BLE001 — emit partials, then bail
         return _fail(e)
     _mark(f"int8 rate {v8:.3e}; torch baseline")
-    base = torch_cpu_rate(g)
+    try:
+        base = torch_cpu_rate(g)
+    except Exception as e:  # noqa: BLE001 — emit the device rates we have
+        return _fail(e)
     print(
         json.dumps(
             {

@@ -115,6 +115,18 @@ def build_parser() -> argparse.ArgumentParser:
     hpr.add_argument("--checkpoint-interval", type=float, default=30.0)
     _add_dtype_flag(hpr, "float64 matches the reference's solver precision "
                           "(`HPR_pytorch_RRG.py:11`; enables x64)")
+    hpr.add_argument(
+        "--batch-replicas", type=int, default=0, metavar="R",
+        help="run R independent chains on ONE graph as a single batched "
+             "device program (hpr_solve_batch) instead of --n-rep "
+             "fresh-graph repetitions",
+    )
+    hpr.add_argument(
+        "--device-init", action="store_true",
+        help="with --batch-replicas: build union tables and the initial "
+             "state on device (nothing union-sized crosses the host link; "
+             "incompatible with --checkpoint)",
+    )
 
     ent = sub.add_parser("entropy", help="BDCM entropy λ-sweep (notebook)")
     ent.add_argument("--n", type=int, default=1000)
@@ -231,13 +243,48 @@ def main(argv=None) -> int:
             "out": args.out,
         }))
     elif args.cmd == "hpr":
-        from graphdyn.models.hpr import hpr_ensemble
-
         cfg = HPRConfig(
             dynamics=_dynamics(args),
             damp=args.damp, lmbd=args.lmbd, pie=args.pie, gamma=args.gamma,
             max_sweeps=args.max_sweeps, dtype=args.dtype,
         )
+        if args.device_init and not args.batch_replicas:
+            raise SystemExit("--device-init requires --batch-replicas")
+        if args.device_init and args.checkpoint:
+            raise SystemExit(
+                "--device-init is incompatible with --checkpoint (snapshots "
+                "pull the union state back over the host link every interval)"
+            )
+        if args.batch_replicas:
+            from graphdyn.graphs import random_regular_graph
+            from graphdyn.models.hpr import hpr_solve_batch
+
+            g = random_regular_graph(args.n, args.d, seed=args.seed)
+            res = hpr_solve_batch(
+                g, cfg, n_replicas=args.batch_replicas, seed=args.seed,
+                checkpoint_path=args.checkpoint,
+                checkpoint_interval_s=args.checkpoint_interval,
+                device_init=args.device_init,
+            )
+            if args.out:
+                from graphdyn.utils.io import save_results_npz
+
+                save_results_npz(
+                    args.out, conf=res.s, mag_reached=res.mag_reached,
+                    num_steps=res.num_steps, m_final=res.m_final,
+                    time=res.elapsed_s,
+                )
+            print(json.dumps({
+                "solver": "hpr_batch",
+                "mag_reached": res.mag_reached.tolist(),
+                "num_steps": res.num_steps.tolist(),
+                "m_final": res.m_final.tolist(),
+                "elapsed_s": res.elapsed_s,
+                "out": args.out,
+            }))
+            return 0
+        from graphdyn.models.hpr import hpr_ensemble
+
         out = hpr_ensemble(
             args.n, args.d, cfg, n_rep=args.n_rep, seed=args.seed,
             save_path=args.out,

@@ -5,8 +5,14 @@
 #   nohup bash scripts/tpu_canary.sh [logfile] [interval_s] &
 LOG="${1:-/tmp/tpu_canary.log}"
 INT="${2:-120}"
+MAX_S="${3:-28800}"     # self-expire (default 8h): a probe colliding with
+T0=$(date +%s)          # the driver's own round-end chip run could wedge it
 cd "$(dirname "$0")/.."
 while true; do
+    if [ $(( $(date +%s) - T0 )) -ge "$MAX_S" ]; then
+        echo "$(date -u +%H:%M:%S) EXPIRED after ${MAX_S}s" >> "$LOG"
+        exit 0
+    fi
     # a bench session owns the chip exclusively: probing while it runs both
     # contends for the device and pollutes its timings — pause instead
     if [ -f /tmp/tpu_canary.pause ]; then

@@ -9,6 +9,12 @@ FLAG="$OUT/.fired"
 mkdir -p "$OUT"
 while true; do
     if [ -f "$FLAG" ]; then exit 0; fi
+    if tail -n 1 "$LOG" 2>/dev/null | grep -q "EXPIRED"; then
+        # the canary stopped probing — nothing will ever flip the log to UP,
+        # so waiting on it is pointless; exit rather than poll a dead file
+        echo "[fire-when-up] canary expired; exiting unfired" >> "$OUT/session.log"
+        exit 0
+    fi
     if tail -n 1 "$LOG" 2>/dev/null | grep -q " UP "; then
         date -u > "$FLAG"
         trap 'rm -f /tmp/tpu_canary.pause' EXIT   # unpause even if killed

@@ -32,6 +32,30 @@ def test_cli_hpr(tmp_path, capsys):
     assert "time" in load_results_npz(out)
 
 
+def test_cli_hpr_batch_device_init(tmp_path, capsys):
+    """--batch-replicas runs hpr_solve_batch (one graph, R chains);
+    --device-init selects the device-resident union/init path."""
+    import numpy as np
+
+    out = str(tmp_path / "hprb.npz")
+    rc = main([
+        "hpr", "--n", "60", "--d", "3", "--p", "1", "--c", "1",
+        "--max-sweeps", "1500", "--batch-replicas", "2", "--device-init",
+        "--out", out,
+    ])
+    assert rc == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["solver"] == "hpr_batch" and len(line["m_final"]) == 2
+    saved = np.load(out)
+    assert saved["conf"].shape == (2, 60)
+
+    with __import__("pytest").raises(SystemExit, match="batch-replicas"):
+        main(["hpr", "--n", "40", "--device-init"])
+    with __import__("pytest").raises(SystemExit, match="checkpoint"):
+        main(["hpr", "--n", "40", "--batch-replicas", "2", "--device-init",
+              "--checkpoint", "/tmp/ck"])
+
+
 def test_cli_entropy(tmp_path, capsys):
     out = str(tmp_path / "er.npz")
     rc = main([
