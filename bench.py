@@ -166,14 +166,14 @@ def main():
     partial = {"packed_rate_natural_order": 0.0, "packed_rate_bfs_order": 0.0,
                "packed_rate_wide": 0.0, "int8_rate": 0.0}
 
-    def _fail(e):
+    def _fail(e, stage="device"):
         best = max(v for v in partial.values())
         print(json.dumps({
             "metric": "spin_updates_per_sec_per_chip_d3_rrg_n%d" % n,
             "value": best,
             "unit": "spin-updates/s",
             "vs_baseline": 0.0,
-            "error": f"device failed mid-run: {str(e)[:200]}",
+            "error": f"{stage} failed mid-run: {str(e)[:200]}",
             **partial,
             "backend": jax.default_backend(),
         }))
@@ -221,7 +221,7 @@ def main():
     try:
         base = torch_cpu_rate(g)
     except Exception as e:  # noqa: BLE001 — emit the device rates we have
-        return _fail(e)
+        return _fail(e, stage="torch-cpu baseline")
     print(
         json.dumps(
             {

@@ -248,6 +248,8 @@ def main(argv=None) -> int:
             damp=args.damp, lmbd=args.lmbd, pie=args.pie, gamma=args.gamma,
             max_sweeps=args.max_sweeps, dtype=args.dtype,
         )
+        if args.batch_replicas < 0:
+            raise SystemExit("--batch-replicas must be >= 1")
         if args.device_init and not args.batch_replicas:
             raise SystemExit("--device-init requires --batch-replicas")
         if args.device_init and args.checkpoint:

@@ -92,37 +92,45 @@ def batched_rollout_impl(nbr, s, steps: int, R_coef: int, C_coef: int,
       default, pending on-chip A/B (scripts/tpu_bench_session.sh).
     """
     dmax = nbr.shape[-1]
+    n = s.shape[-1]
+    if steps <= 0:
+        return s
+
+    # the ghost column rides IN the loop carry (see ops.packed.packed_rollout:
+    # an in-body concatenate costs an extra full read+write of the state per
+    # step). Ghost column n is self-neighbored: its sums and spin are 0, so
+    # sign keeps it 0 under every (rule, tie) — no per-step forcing needed.
+    nbr_ext = jnp.concatenate([nbr, jnp.full((1, dmax), n, nbr.dtype)], axis=0)
 
     if gather == "per_slot":
-        def neighbor_sums(sb):
-            sb_ext = jnp.concatenate(
-                [sb, jnp.zeros((sb.shape[0], 1), sb.dtype)], axis=1
-            )
-            sums = jnp.zeros(sb.shape, jnp.int32)
+        def neighbor_sums(sb_ext):
+            sums = jnp.zeros(sb_ext.shape, jnp.int32)
             for j in range(dmax):
-                sums = sums + jnp.take(sb_ext, nbr[:, j], axis=1).astype(jnp.int32)
+                sums = sums + jnp.take(
+                    sb_ext, nbr_ext[:, j], axis=1
+                ).astype(jnp.int32)
             return sums
     elif gather == "fused":
-        n = s.shape[-1]
-        flat_nbr = nbr.reshape(-1)
+        flat_nbr = nbr_ext.reshape(-1)
 
-        def neighbor_sums(sb):
-            s_ext = jnp.concatenate(
-                [sb.astype(jnp.int32), jnp.zeros((sb.shape[0], 1), jnp.int32)],
-                axis=1,
+        def neighbor_sums(sb_ext):
+            g = jnp.take(sb_ext.astype(jnp.int32), flat_nbr, axis=1).reshape(
+                sb_ext.shape[0], n + 1, dmax
             )
-            g = jnp.take(s_ext, flat_nbr, axis=1).reshape(sb.shape[0], n, dmax)
             return g.sum(axis=2)
     else:
         raise ValueError(f"gather must be 'fused' or 'per_slot', got {gather!r}")
 
-    def body(_, sb):
-        sums = neighbor_sums(sb)
+    def body(_, sb_ext):
+        sums = neighbor_sums(sb_ext)
         return (
-            R_coef * jnp.sign(2 * sums + C_coef * sb.astype(jnp.int32))
+            R_coef * jnp.sign(2 * sums + C_coef * sb_ext.astype(jnp.int32))
         ).astype(jnp.int8)
 
-    return lax.fori_loop(0, steps, body, s) if steps > 0 else s
+    s_ext0 = jnp.concatenate(
+        [s, jnp.zeros((s.shape[0], 1), s.dtype)], axis=1
+    )
+    return lax.fori_loop(0, steps, body, s_ext0)[:, :n]
 
 
 @partial(jax.jit, static_argnames=("steps", "rule", "tie", "gather"))

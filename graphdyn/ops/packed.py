@@ -120,42 +120,57 @@ def packed_rollout(nbr, deg, sp, steps: int, rule: str = "majority",
     if gather not in ("per_slot", "fused"):
         raise ValueError(f"gather must be 'per_slot' or 'fused', got {gather!r}")
     n, dmax = nbr.shape
+    if steps <= 0:
+        return sp
     n_planes = max(int(np.ceil(np.log2(dmax + 1))), 1)
-    flat_nbr = nbr.reshape(-1)
 
-    thr = (deg // 2).astype(jnp.uint32)
-    deg_even = (deg % 2 == 0)
+    # the ghost row rides IN the loop carry: re-building the ghost-extended
+    # state with a concatenate inside the body costs a full extra read+write
+    # of the [n, W] state per step (~33% of the streaming traffic at d=3 —
+    # the headline shape). The tables extend once: ghost row n is
+    # self-neighbored with degree 0, and its word is forced back to zero
+    # each step (tie->change would flip it; everything else preserves it).
+    nbr_ext = jnp.concatenate([nbr, jnp.full((1, dmax), n, nbr.dtype)], axis=0)
+    deg_ext = jnp.concatenate([deg, jnp.zeros((1,), deg.dtype)])
+    flat_nbr = nbr_ext.reshape(-1)
+
+    thr = (deg_ext // 2).astype(jnp.uint32)
+    deg_even = (deg_ext % 2 == 0)
     even_mask = jnp.where(deg_even, _FULL, jnp.uint32(0))[:, None]
     thr_bits = [
         jnp.where((thr >> k) & 1 == 1, _FULL, jnp.uint32(0))[:, None]
         for k in range(n_planes)
     ]
 
-    def body(_, sp):
-        sp_ext = jnp.concatenate([sp, jnp.zeros((1, sp.shape[1]), sp.dtype)], axis=0)
+    def body(_, sp_ext):
         if gather == "per_slot":
-            planes = [jnp.zeros_like(sp) for _ in range(n_planes)]
+            planes = [jnp.zeros_like(sp_ext) for _ in range(n_planes)]
             for j in range(dmax):
-                _csa_add_one(planes, jnp.take(sp_ext, nbr[:, j], axis=0))
+                _csa_add_one(planes, jnp.take(sp_ext, nbr_ext[:, j], axis=0))
         else:
-            g = jnp.take(sp_ext, flat_nbr, axis=0).reshape(n, dmax, sp.shape[1])
+            g = jnp.take(sp_ext, flat_nbr, axis=0).reshape(
+                n + 1, dmax, sp_ext.shape[1]
+            )
             planes = _csa_planes(g, dmax, n_planes)
         gt, eq = _compare_planes(planes, thr_bits)
         win = gt                                     # 2cnt > deg
         tie_mask = eq & even_mask                    # 2cnt == deg
         # loss = ~(win | tie_mask) implicitly
         if tie == TieBreak.STAY:
-            tie_bit = sp
+            tie_bit = sp_ext
         else:
-            tie_bit = ~sp
+            tie_bit = ~sp_ext
         out = win | (tie_mask & tie_bit)
         if rule == Rule.MINORITY:
             # minority: +1 iff sum<0, tie -> (stay: s, change: ~s)
             loss = ~(win | tie_mask)
             out = loss | (tie_mask & tie_bit)
-        return out
+        return out.at[n].set(jnp.uint32(0))          # ghost word stays zero
 
-    return lax.fori_loop(0, steps, body, sp) if steps > 0 else sp
+    sp_ext0 = jnp.concatenate(
+        [sp, jnp.zeros((1, sp.shape[1]), sp.dtype)], axis=0
+    )
+    return lax.fori_loop(0, steps, body, sp_ext0)[:n]
 
 
 @partial(jax.jit, static_argnames=("target",))

@@ -2,7 +2,9 @@
 # TPU relay canary: append one status line per probe to the log. Each probe
 # is a fresh interpreter (the wedge hits at client setup, so a persistent
 # process would only measure its own cached connection). Usage:
-#   nohup bash scripts/tpu_canary.sh [logfile] [interval_s] &
+#   nohup bash scripts/tpu_canary.sh [logfile] [interval_s] [max_age_s] &
+# After max_age_s (default 8h) the canary logs EXPIRED and exits, so a stray
+# probe cannot collide with a later chip run it knows nothing about.
 LOG="${1:-/tmp/tpu_canary.log}"
 INT="${2:-120}"
 MAX_S="${3:-28800}"     # self-expire (default 8h): a probe colliding with
