@@ -57,6 +57,11 @@ def main(session_dir, bench_configs="BENCH_CONFIGS_r04.json"):
         if rows:
             out[name] = rows
 
+    phys_path = os.path.join(session_dir, "physics_tpu.json")
+    if os.path.exists(phys_path):
+        with open(phys_path) as f:
+            out["physics"] = json.load(f)
+
     doc = {}
     if os.path.exists(bench_configs):
         with open(bench_configs) as f:
