@@ -59,8 +59,20 @@ def main(session_dir, bench_configs="BENCH_CONFIGS_r04.json"):
 
     phys_path = os.path.join(session_dir, "physics_tpu.json")
     if os.path.exists(phys_path):
-        with open(phys_path) as f:
-            out["physics"] = json.load(f)
+        try:
+            with open(phys_path) as f:
+                out["physics"] = json.load(f)
+        except json.JSONDecodeError as e:
+            # a killed physics stage leaves a partial file; keep merging the
+            # other artifacts (same tolerance as read_json_lines)
+            out["physics_error"] = f"unparseable physics_tpu.json: {e}"
+
+    if not out.get("headline") and not out.get("configs"):
+        # a wedged session leaves empty files: refuse to stamp the round doc
+        # as 'captured' over nothing (the fallback warning can only fire when
+        # a headline row exists at all)
+        print(f"no usable artifacts in {session_dir}; round doc left unchanged")
+        return 1
 
     doc = {}
     if os.path.exists(bench_configs):
