@@ -1,5 +1,12 @@
 """Device-mesh parallelism: replica/temperature sharding, psum ensemble
 reductions, node-sharded dynamics for giant graphs."""
 
-from graphdyn.parallel.mesh import make_mesh, device_pool, replicate, shard_batch  # noqa: F401
+from graphdyn.parallel.mesh import (  # noqa: F401
+    device_pool,
+    init_multihost,
+    make_hybrid_mesh,
+    make_mesh,
+    replicate,
+    shard_batch,
+)
 from graphdyn.parallel.sa_sharded import make_sharded_sa_solver, sa_sharded  # noqa: F401

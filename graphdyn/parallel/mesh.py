@@ -28,6 +28,108 @@ def make_mesh(
     return Mesh(devices[:need].reshape(shape), axis_names)
 
 
+def init_multihost(**kwargs) -> int:
+    """Initialize JAX's multi-host runtime (one controller process per host)
+    and return ``jax.process_count()``.
+
+    This is the TPU-native analogue of an NCCL/MPI world setup: on TPU pods
+    the coordinator/rank/world-size resolve automatically from the
+    environment, so a bare ``init_multihost()`` works under any standard
+    launcher; pass ``coordinator_address=/num_processes=/process_id=`` to
+    override (forwarded to ``jax.distributed.initialize``). Idempotent and a
+    no-op for single-process runs, so drivers can call it unconditionally.
+    After it returns, ``jax.devices()`` is the GLOBAL device set and
+    :func:`make_mesh` spans every host.
+
+    Axis placement guidance (ARCHITECTURE.md "Parallelism model"): keep
+    node/edge-sharded axes inside one host (their all_gather/psum ride ICI);
+    put replica/ensemble axes across hosts — replica sharding is
+    communication-free in the solvers (replica-major unions, per-device SA
+    chains), so DCN only ever carries the scalar per-sweep stop-test psum.
+    :func:`make_hybrid_mesh` builds exactly that layout.
+    """
+    import jax.distributed
+
+    import os
+
+    if not jax.distributed.is_initialized():
+        try:
+            jax.distributed.initialize(**kwargs)
+        except (ValueError, RuntimeError):
+            # Benign single-process cases: no coordinator config to form a
+            # world from (ValueError), or the XLA backend is already up —
+            # e.g. a driver that used jax before opting into multi-host
+            # (RuntimeError). Swallowing either on a REAL pod would make N
+            # hosts silently run N duplicate single-host jobs, so surface
+            # the failure whenever multi-host intent is stated (kwargs) or
+            # a multi-host environment is detectable.
+            detected = any(
+                os.environ.get(v)
+                for v in (
+                    "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+                    "MEGASCALE_COORDINATOR_ADDRESS",
+                )
+            # single-host TPU VMs also set TPU_WORKER_HOSTNAMES (one
+            # entry); only a multi-worker list signals a pod
+            ) or ("," in os.environ.get("TPU_WORKER_HOSTNAMES", ""))
+            if kwargs or detected:
+                raise
+    return jax.process_count()
+
+
+def make_hybrid_mesh(
+    ici_shape: tuple[int, ...],
+    axis_names: tuple[str, ...],
+    *,
+    dcn_axis: str | None = None,
+) -> Mesh:
+    """Mesh spanning all hosts with ``dcn_axis`` (default: the first axis)
+    split across hosts over DCN and the remaining axes inside each host over
+    ICI. ``ici_shape`` gives the per-host shape of the non-DCN axes; the DCN
+    axis size is ``jax.process_count()``.
+
+    Single-process runs degrade to an ordinary :func:`make_mesh` with a
+    size-1 DCN axis, so the same program text runs on a laptop, one TPU
+    host, or a multi-host pod slice.
+    """
+    if dcn_axis is None:
+        dcn_axis = axis_names[0]
+    if dcn_axis not in axis_names:
+        raise ValueError(f"dcn_axis {dcn_axis!r} not in axis_names {axis_names}")
+    k = axis_names.index(dcn_axis)
+    ici_axes = [a for a in axis_names if a != dcn_axis]
+    if len(ici_shape) != len(ici_axes):
+        raise ValueError(
+            f"ici_shape {ici_shape} must give one size per non-DCN axis "
+            f"{tuple(ici_axes)}"
+        )
+    n_local = len(jax.local_devices())
+    if int(np.prod(ici_shape)) != n_local:
+        # the multi-process path (create_hybrid_device_mesh) requires the
+        # per-host ICI shape to cover the local devices exactly; enforcing
+        # the same fit single-process keeps 'validated on a laptop' meaning
+        # 'runs on the pod' instead of failing only at deployment
+        raise ValueError(
+            f"prod(ici_shape)={int(np.prod(ici_shape))} must equal the "
+            f"per-host device count {n_local}"
+        )
+    n_proc = jax.process_count()
+    full_shape = list(ici_shape)
+    full_shape.insert(k, n_proc)
+    if n_proc == 1:
+        return make_mesh(tuple(full_shape), axis_names)
+    from jax.experimental import mesh_utils
+
+    mesh_shape = list(ici_shape)
+    mesh_shape.insert(k, 1)                      # per-host granule: ICI only
+    dcn_shape = [1] * len(axis_names)
+    dcn_shape[k] = n_proc
+    devices = mesh_utils.create_hybrid_device_mesh(
+        tuple(mesh_shape), tuple(dcn_shape)
+    )
+    return Mesh(devices, axis_names)
+
+
 def device_pool(n_devices: int):
     """Return at least ``n_devices`` devices, preferring the default platform
     and falling back to the (possibly simulated) CPU host platform — covers

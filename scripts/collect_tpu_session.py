@@ -49,8 +49,13 @@ def main(session_dir, bench_configs="BENCH_CONFIGS_r04.json"):
 
     cfg_path = os.path.join(session_dir, "configs_tpu.json")
     if os.path.exists(cfg_path):
-        with open(cfg_path) as f:
-            out["configs"] = json.load(f)
+        try:
+            with open(cfg_path) as f:
+                out["configs"] = json.load(f)
+        except json.JSONDecodeError as e:
+            # a killed aggregator leaves an empty/truncated file; the
+            # no-usable-artifacts guard below must still get to run
+            out["configs_error"] = f"unparseable configs_tpu.json: {e}"
 
     for name in ("gather_experiment", "pallas_gather_probe"):
         rows = read_json_lines(os.path.join(session_dir, f"{name}.jsonl"))

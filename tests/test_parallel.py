@@ -182,3 +182,41 @@ def test_vmapped_entropy_mesh_matches_unsharded():
     np.testing.assert_allclose(base.ent, sh.ent, rtol=2e-5, atol=1e-7)
     np.testing.assert_allclose(base.m_init, sh.m_init, rtol=2e-5, atol=1e-7)
     np.testing.assert_allclose(base.ent1, sh.ent1, rtol=2e-5, atol=1e-7)
+
+
+def test_multihost_helpers_single_process():
+    """init_multihost is an idempotent no-op single-process; make_hybrid_mesh
+    degrades to an ordinary mesh with a size-1 DCN axis, and a solver program
+    runs on it unchanged (the same text scales to a pod slice, where the DCN
+    axis takes jax.process_count())."""
+    import pytest
+
+    from graphdyn.parallel.mesh import init_multihost, make_hybrid_mesh
+
+    assert init_multihost() == 1
+    assert init_multihost() == 1                    # idempotent
+
+    m = make_hybrid_mesh((8,), ("host", "replica"), dcn_axis="host")
+    assert dict(m.shape) == {"host": 1, "replica": 8}
+    m3 = make_hybrid_mesh((2, 4), ("replica", "node", "host"), dcn_axis="host")
+    assert dict(m3.shape) == {"replica": 2, "node": 4, "host": 1}
+
+    with pytest.raises(ValueError, match="not in axis_names"):
+        make_hybrid_mesh((8,), ("a", "b"), dcn_axis="c")
+    with pytest.raises(ValueError, match="one size per"):
+        make_hybrid_mesh((2, 4), ("a", "b"), dcn_axis="a")
+    # per-host ICI shape must cover the local devices exactly — the same
+    # fit create_hybrid_device_mesh enforces multi-process
+    with pytest.raises(ValueError, match="per-host device count"):
+        make_hybrid_mesh((4,), ("host", "replica"), dcn_axis="host")
+
+    # a sharded observable runs on the hybrid mesh's ICI axis
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x = jax.device_put(
+        jnp.arange(16.0).reshape(8, 2), NamedSharding(m, P("replica", None))
+    )
+    total = jax.jit(lambda v: v.sum())(x)
+    assert float(total) == 120.0
