@@ -82,6 +82,7 @@ def make_hybrid_mesh(
     axis_names: tuple[str, ...],
     *,
     dcn_axis: str | None = None,
+    devices=None,
 ) -> Mesh:
     """Mesh spanning all hosts with ``dcn_axis`` (default: the first axis)
     split across hosts over DCN and the remaining axes inside each host over
@@ -90,7 +91,17 @@ def make_hybrid_mesh(
 
     Single-process runs degrade to an ordinary :func:`make_mesh` with a
     size-1 DCN axis, so the same program text runs on a laptop, one TPU
-    host, or a multi-host pod slice.
+    host, or a multi-host pod slice. ``devices`` overrides the local device
+    pool in that single-process fallback (e.g. ``device_pool(8)`` on a
+    plugin-pinned machine whose simulated mesh lives on the CPU platform);
+    multi-process, device placement is topology-driven
+    (``mesh_utils.create_hybrid_device_mesh``) and ``devices`` must be None.
+
+    Scope note: the *jitted chunk programs* of the solvers are SPMD-correct
+    on such a mesh, but the convenience drivers (`sa_sharded`,
+    `hpr_solve_batch(mesh=...)`) do host-side fetch/persist between chunks
+    and are single-controller today — on a pod, drive the chunk programs
+    directly (or gather results with `jax.experimental.multihost_utils`).
     """
     if dcn_axis is None:
         dcn_axis = axis_names[0]
@@ -103,21 +114,43 @@ def make_hybrid_mesh(
             f"ici_shape {ici_shape} must give one size per non-DCN axis "
             f"{tuple(ici_axes)}"
         )
-    n_local = len(jax.local_devices())
-    if int(np.prod(ici_shape)) != n_local:
-        # the multi-process path (create_hybrid_device_mesh) requires the
-        # per-host ICI shape to cover the local devices exactly; enforcing
-        # the same fit single-process keeps 'validated on a laptop' meaning
-        # 'runs on the pod' instead of failing only at deployment
+    n_proc = jax.process_count()
+    need = int(np.prod(ici_shape))
+    if devices is not None:
+        if n_proc > 1:
+            raise ValueError(
+                "devices= override is single-process only (multi-process "
+                "placement is topology-driven)"
+            )
+        pool = list(devices)
+    elif n_proc > 1:
+        pool = jax.local_devices()
+    else:
+        pool = jax.local_devices()
+        if len(pool) != need:
+            # same platform fallback as device_pool (plugin-pinned default
+            # platform vs a simulated CPU mesh) — but never a slice: the
+            # exact-fit rule below stays meaningful
+            try:
+                cpu = jax.devices("cpu")
+            except RuntimeError:
+                cpu = []
+            if len(cpu) == need:
+                pool = cpu
+    n_local = len(pool)
+    # the multi-process path (create_hybrid_device_mesh) requires the
+    # per-host ICI shape to cover the local devices exactly; enforcing the
+    # same fit single-process keeps 'validated on a laptop' meaning 'runs
+    # on the pod' instead of failing only at deployment
+    if need != n_local:
         raise ValueError(
-            f"prod(ici_shape)={int(np.prod(ici_shape))} must equal the "
+            f"prod(ici_shape)={need} must equal the "
             f"per-host device count {n_local}"
         )
-    n_proc = jax.process_count()
     full_shape = list(ici_shape)
     full_shape.insert(k, n_proc)
     if n_proc == 1:
-        return make_mesh(tuple(full_shape), axis_names)
+        return make_mesh(tuple(full_shape), axis_names, devices=pool)
     from jax.experimental import mesh_utils
 
     mesh_shape = list(ici_shape)

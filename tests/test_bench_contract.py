@@ -1,0 +1,33 @@
+"""The driver's contract with bench.py: stdout is exactly ONE JSON line with
+the headline metric fields (the round artifact `BENCH_r{N}.json` is parsed
+from it). A formatting regression here silently voids a whole round's
+benchmark, so the contract is pinned as a test (smoke shapes, forced-CPU
+subprocess — the same invocation path the driver uses)."""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_smoke_emits_one_json_line():
+    env = dict(os.environ)
+    env["GRAPHDYN_FORCE_PLATFORM"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--smoke"],
+        capture_output=True, text=True, timeout=560, cwd=ROOT, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be ONE JSON line, got {lines!r}"
+    row = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "backend",
+                "packed_rate_natural_order", "packed_rate_bfs_order",
+                "int8_rate", "torch_cpu_rate"):
+        assert key in row, key
+    assert row["value"] > 0
+    assert row["unit"] == "spin-updates/s"
+    # the smoke row must not carry the full-shape-only roofline fraction
+    assert "roofline_fraction_v5e" not in row
