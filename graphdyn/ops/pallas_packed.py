@@ -1,0 +1,176 @@
+"""Pallas TPU packed-dynamics kernel: explicit per-row HBM→VMEM DMA.
+
+The XLA packed kernel (`graphdyn.ops.packed.packed_rollout`) is bound by the
+random-row gather of neighbor spin words (`ARCHITECTURE.md` roofline: the
+measured headline sits well below the HBM streaming bound, and
+`scripts/pallas_gather_probe.py` measures whether explicitly pipelined
+per-row DMAs beat XLA's gather at the same shape). This module is the
+gather probe's pattern graduated into the full dynamics step: for each node
+the kernel DMAs its ``d`` neighbor rows ``[1, W]`` from HBM into a VMEM
+ring buffer (depth-``depth`` double buffering, the guide's sparse-gather
+recipe), folds them with the carry-save bit-plane adder, and writes the
+packed update — no ``[n, d, W]`` gather intermediate, and the access
+stream is software-pipelined ``depth`` rows ahead.
+
+Scope (v1, deliberately narrow — the BASELINE headline shapes): uniform
+ODD degree (d=3 / d=5 regular graphs ⇒ no ties, so the tie-break never
+needs the node's own spin row), majority or minority rule. Everything else
+falls back to the XLA kernel. Correctness off-chip is interpret-mode
+tested bit-for-bit against `packed_rollout` (tests/test_pallas_packed.py);
+whether it *wins* on chip is exactly what `scripts/pallas_gather_probe.py`
+and the session A/B measure — if XLA's gather already saturates the
+random-access limit, this kernel is the written answer to why the roofline
+gap is irreducible (VERDICT r3 task 8).
+
+Reference anchor: the hot update `SA_RRG.py:18-20` / the ensemble dynamics
+this accelerates, `SURVEY.md` §2.1.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from graphdyn.ops.packed import _compare_planes, _csa_add_one
+from graphdyn.ops.dynamics import Rule
+
+
+def pallas_packed_supported(deg: np.ndarray, rule: str, tie: str) -> bool:
+    """v1 applicability: uniform odd degree (tie-break unreachable), and a
+    rule whose no-tie update is a pure comparator (majority/minority)."""
+    deg = np.asarray(deg)
+    if deg.size == 0 or (deg != deg.flat[0]).any():
+        return False
+    return int(deg.flat[0]) % 2 == 1 and rule in ("majority", "minority")
+
+
+def _maj_planes(rows, d: int, thr: int):
+    """planes-of-count comparator for uniform degree: cnt > thr (bitwise,
+    per replica-lane) — the XLA kernel's `_compare_planes` with the
+    threshold as broadcast scalar constants. Returns the packed win mask."""
+    n_planes = max(int(np.ceil(np.log2(d + 1))), 1)
+    planes = [jnp.zeros_like(rows[0]) for _ in range(n_planes)]
+    for r in rows:
+        _csa_add_one(planes, r)
+    thr_bits = [
+        jnp.uint32(0xFFFFFFFF) if (thr >> k) & 1 else jnp.uint32(0)
+        for k in range(n_planes)
+    ]
+    gt, _ = _compare_planes(planes, thr_bits)
+    return gt
+
+
+def _make_kernel(B: int, d: int, depth: int, minority: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    thr = d // 2
+
+    def kernel(nbr_ref, sp_ref, out_ref, scratch, sems):
+        def dma(k):
+            slot = jax.lax.rem(k, depth)
+            return pltpu.make_async_copy(
+                sp_ref.at[pl.ds(nbr_ref[k // d, k % d], 1), :],
+                scratch.at[pl.ds(slot, 1), :],
+                sems.at[slot],
+            )
+
+        def warm(k, _):
+            dma(k).start()
+            return 0
+
+        jax.lax.fori_loop(0, min(depth, B * d), warm, 0)
+
+        def body(b, _):
+            rows = []
+            for j in range(d):                     # d is static & small
+                k = b * d + j
+                dma(k).wait()
+                rows.append(scratch[pl.ds(jax.lax.rem(k, depth), 1), :])
+
+                @pl.when(k + depth < B * d)
+                def _():
+                    dma(k + depth).start()
+
+            win = _maj_planes(rows, d, thr)        # cnt > d//2
+            out_ref[pl.ds(b, 1), :] = ~win if minority else win
+            return 0
+
+        jax.lax.fori_loop(0, B, body, 0)
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("rule", "block", "depth", "interpret"))
+def pallas_packed_step(nbr, sp, *, rule: str = "majority", block: int = 256,
+                       depth: int = 8, interpret: bool = False):
+    """One synchronous packed update ``sp: uint32[n, W] -> uint32[n, W]``
+    for a UNIFORM-ODD-degree graph (``nbr: int32[n, d]``, no ghost slots in
+    real rows — callers gate on :func:`pallas_packed_supported`).
+
+    The node axis is padded to ``block`` internally; pad rows gather row 0
+    (a real row — harmless, their output is sliced off).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    rule = Rule(rule)
+    n, d = nbr.shape
+    W = sp.shape[1]
+    pad = (-n) % block
+    n_pad = n + pad
+    if pad:
+        nbr = jnp.concatenate(
+            [nbr, jnp.zeros((pad, d), nbr.dtype)], axis=0
+        )
+    out = pl.pallas_call(
+        _make_kernel(block, d, depth, rule == Rule.MINORITY),
+        grid=(n_pad // block,),
+        in_specs=[
+            pl.BlockSpec((block, d), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((block, W), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, W), sp.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((depth, W), sp.dtype),
+            pltpu.SemaphoreType.DMA((depth,)),
+        ],
+        interpret=interpret,
+    )(nbr, sp)
+    return out[:n]
+
+
+@partial(
+    jax.jit, static_argnames=("steps", "rule", "block", "depth", "interpret")
+)
+def _rollout_jit(nbr, sp, *, steps, rule, block, depth, interpret):
+    step = partial(
+        pallas_packed_step, rule=rule, block=block, depth=depth,
+        interpret=interpret,
+    )
+    return jax.lax.fori_loop(0, steps, lambda _, s: step(nbr, s), sp)
+
+
+def pallas_packed_rollout(nbr, deg, sp, steps: int, rule: str = "majority",
+                          tie: str = "stay", *, block: int = 256,
+                          depth: int = 8, interpret: bool = False):
+    """Drop-in variant of `packed_rollout` for supported shapes (uniform odd
+    degree, majority/minority — ``tie`` accepted for signature parity but
+    unreachable at odd degree). Raises ValueError when unsupported; callers
+    A/B against the XLA kernel explicitly (benchmarks), so silent fallback
+    would defeat the measurement. The loop itself is jitted (same caching
+    as `packed_rollout`, so rate A/Bs compare kernels, not dispatch)."""
+    if not pallas_packed_supported(np.asarray(deg), Rule(rule).value, tie):
+        raise ValueError(
+            "pallas_packed_rollout v1 requires uniform odd degree and "
+            "majority/minority rule"
+        )
+    return _rollout_jit(
+        nbr, sp, steps=steps, rule=Rule(rule).value, block=block,
+        depth=depth, interpret=interpret,
+    )

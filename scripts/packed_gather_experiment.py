@@ -104,6 +104,33 @@ def main():
             flush=True,
         )
 
+    # D: full Pallas dynamics step with explicitly pipelined per-row DMAs
+    # (graphdyn.ops.pallas_packed — the gather probe's pattern graduated
+    # into the kernel). Chip-only: interpret mode is not a rate.
+    import jax
+
+    if jax.default_backend() == "tpu":
+        from graphdyn.ops.pallas_packed import pallas_packed_rollout
+
+        for depth in (8, 16):
+            try:
+                rate = time_chained(
+                    lambda x, dp=depth: pallas_packed_rollout(
+                        nbr, g.deg, x, args.steps, depth=dp
+                    ),
+                    sp, args.n * args.w * 32 * args.steps,
+                )
+                print(json.dumps({
+                    "variant": "D_pallas_row_dma", "depth": depth,
+                    "spin_updates_per_sec": rate,
+                    "n": args.n, "W": args.w, "d": args.d,
+                }), flush=True)
+            except Exception as e:  # noqa: BLE001 — record, keep going
+                print(json.dumps({
+                    "variant": "D_pallas_row_dma", "depth": depth,
+                    "error": str(e)[:300],
+                }), flush=True)
+
     # int8 kernel A/B (the SA solver's hot rollout — ops.dynamics)
     from graphdyn.ops.dynamics import batched_rollout
 
