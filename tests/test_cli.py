@@ -3,6 +3,7 @@
 import json
 
 import numpy as np
+import pytest
 
 from graphdyn.cli import main
 from graphdyn.utils.io import load_results_npz
@@ -49,9 +50,9 @@ def test_cli_hpr_batch_device_init(tmp_path, capsys):
     saved = np.load(out)
     assert saved["conf"].shape == (2, 60)
 
-    with __import__("pytest").raises(SystemExit, match="batch-replicas"):
+    with pytest.raises(SystemExit, match="batch-replicas"):
         main(["hpr", "--n", "40", "--device-init"])
-    with __import__("pytest").raises(SystemExit, match="checkpoint"):
+    with pytest.raises(SystemExit, match="checkpoint"):
         main(["hpr", "--n", "40", "--batch-replicas", "2", "--device-init",
               "--checkpoint", "/tmp/ck"])
 
