@@ -28,6 +28,12 @@ timeout 1800 python scripts/pallas_gather_probe.py \
     > "$OUT/pallas_gather_probe.jsonl" 2> "$OUT/pallas_gather_probe.err"
 echo "[tpu-session] probe rc=$?" >&2
 
+echo "[tpu-session] pallas on-chip validation (BDCM + packed kernels) ..." >&2
+timeout 1800 python scripts/pallas_tpu_validate.py \
+    > "$OUT/pallas_validate.log" 2>&1
+echo "[tpu-session] pallas validate rc=$?" >&2
+cp -f PALLAS_TPU.json "$OUT/PALLAS_TPU.json" 2>/dev/null
+
 echo "[tpu-session] five BASELINE configs (full) ..." >&2
 # per-config budget x5 must fit inside the outer budget, or the aggregator
 # dies before writing --out and every completed config's result is lost
