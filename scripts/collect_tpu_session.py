@@ -62,6 +62,18 @@ def main(session_dir, bench_configs="BENCH_CONFIGS_r04.json"):
         if rows:
             out[name] = rows
 
+    pv_path = os.path.join(session_dir, "PALLAS_TPU.json")
+    if os.path.exists(pv_path):
+        try:
+            with open(pv_path) as f:
+                pv = json.load(f)
+            out["pallas_validate"] = {
+                "packed_equivalence": pv.get("packed_equivalence"),
+                "backend": pv.get("info", {}).get("backend"),
+            }
+        except json.JSONDecodeError as e:
+            out["pallas_validate_error"] = str(e)
+
     phys_path = os.path.join(session_dir, "physics_tpu.json")
     if os.path.exists(phys_path):
         try:
