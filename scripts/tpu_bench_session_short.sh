@@ -1,0 +1,25 @@
+#!/bin/bash
+# Abbreviated chip session for a late relay recovery: headline bench +
+# gather A/B/C/D + DMA probe only (~30-60 min), so it cannot collide with
+# the driver's own round-end bench the way the multi-hour full session
+# would. Usage: bash scripts/tpu_bench_session_short.sh [outdir]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/tpu_session_short}"
+mkdir -p "$OUT"
+
+echo "[tpu-short] headline bench ..." >&2
+timeout 1500 python bench.py > "$OUT/bench_headline.json" 2> "$OUT/bench_headline.err"
+echo "[tpu-short] bench rc=$? $(tail -c 300 "$OUT/bench_headline.json")" >&2
+
+echo "[tpu-short] gather experiment ..." >&2
+timeout 1200 python scripts/packed_gather_experiment.py \
+    > "$OUT/gather_experiment.jsonl" 2> "$OUT/gather_experiment.err"
+echo "[tpu-short] gather rc=$?" >&2
+
+echo "[tpu-short] pallas random-row gather probe ..." >&2
+timeout 900 python scripts/pallas_gather_probe.py \
+    > "$OUT/pallas_gather_probe.jsonl" 2> "$OUT/pallas_gather_probe.err"
+echo "[tpu-short] probe rc=$?" >&2
+
+echo "[tpu-short] done; artifacts in $OUT" >&2

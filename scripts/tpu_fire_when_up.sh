@@ -1,10 +1,14 @@
 #!/bin/bash
 # Watch the TPU canary log; the first time an UP line appears, fire the
 # one-shot chip session into the given outdir (exactly once) and exit.
-#   nohup bash scripts/tpu_fire_when_up.sh tpu_session_r04 &
+# After FULL_UNTIL (epoch seconds; 0 = always full) the abbreviated
+# session runs instead — a multi-hour full session fired late would
+# still be holding the chip when the driver's own round-end bench runs.
+#   nohup bash scripts/tpu_fire_when_up.sh tpu_session_r04 [log] [full_until] &
 cd "$(dirname "$0")/.."
 OUT="${1:-tpu_session_r04}"
 LOG="${2:-/tmp/tpu_canary.log}"
+FULL_UNTIL="${3:-0}"
 FLAG="$OUT/.fired"
 mkdir -p "$OUT"
 while true; do
@@ -19,9 +23,13 @@ while true; do
         date -u > "$FLAG"
         trap 'rm -f /tmp/tpu_canary.pause' EXIT   # unpause even if killed
         touch /tmp/tpu_canary.pause      # the session owns the chip now
-        echo "[fire-when-up] canary UP at $(date -u +%H:%M:%S); launching session" \
+        SESSION=scripts/tpu_bench_session.sh
+        if [ "$FULL_UNTIL" -gt 0 ] && [ "$(date +%s)" -gt "$FULL_UNTIL" ]; then
+            SESSION=scripts/tpu_bench_session_short.sh
+        fi
+        echo "[fire-when-up] canary UP at $(date -u +%H:%M:%S); launching $SESSION" \
             >> "$OUT/session.log"
-        bash scripts/tpu_bench_session.sh "$OUT" >> "$OUT/session.log" 2>&1
+        bash "$SESSION" "$OUT" >> "$OUT/session.log" 2>&1
         rm -f /tmp/tpu_canary.pause
         exit 0
     fi
