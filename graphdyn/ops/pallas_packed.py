@@ -35,8 +35,46 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from graphdyn.ops.packed import _compare_planes, _csa_add_one
-from graphdyn.ops.dynamics import Rule
+from graphdyn.ops.packed import _FULL, _compare_planes, _csa_add_one
+from graphdyn.ops.dynamics import Rule, TieBreak
+
+
+def _row_dma_pipeline(sp_ref, scratch, sems, idx_fn, total: int, depth: int):
+    """The shared software pipeline of both kernels: per-row HBM→VMEM async
+    copies through a depth-``depth`` ring buffer. Returns ``(warm,
+    consume)``: call ``warm()`` once, then ``consume(k)`` for k = 0..total-1
+    in order — it waits row k, returns its VMEM view, and starts the
+    prefetch of row ``k+depth`` (slot k's refill must wait until row k is
+    consumed; ``depth-1`` lookahead DMAs stay in flight)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def dma(k):
+        slot = jax.lax.rem(k, depth)
+        return pltpu.make_async_copy(
+            sp_ref.at[pl.ds(idx_fn(k), 1), :],
+            scratch.at[pl.ds(slot, 1), :],
+            sems.at[slot],
+        )
+
+    def warm():
+        def start(k, _):
+            dma(k).start()
+            return 0
+
+        jax.lax.fori_loop(0, min(depth, total), start, 0)
+
+    def consume(k):
+        dma(k).wait()
+        row = scratch[pl.ds(jax.lax.rem(k, depth), 1), :]
+
+        @pl.when(k + depth < total)
+        def _():
+            dma(k + depth).start()
+
+        return row
+
+    return warm, consume
 
 
 def pallas_packed_supported(deg: np.ndarray, rule: str, tie: str) -> bool:
@@ -66,37 +104,19 @@ def _maj_planes(rows, d: int, thr: int):
 
 def _make_kernel(B: int, d: int, depth: int, minority: bool):
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     thr = d // 2
 
     def kernel(nbr_ref, sp_ref, out_ref, scratch, sems):
-        def dma(k):
-            slot = jax.lax.rem(k, depth)
-            return pltpu.make_async_copy(
-                sp_ref.at[pl.ds(nbr_ref[k // d, k % d], 1), :],
-                scratch.at[pl.ds(slot, 1), :],
-                sems.at[slot],
-            )
-
-        def warm(k, _):
-            dma(k).start()
-            return 0
-
-        jax.lax.fori_loop(0, min(depth, B * d), warm, 0)
+        warm, consume = _row_dma_pipeline(
+            sp_ref, scratch, sems,
+            lambda k: nbr_ref[k // d, k % d], B * d, depth,
+        )
+        warm()
 
         def body(b, _):
-            rows = []
-            for j in range(d):                     # d is static & small
-                k = b * d + j
-                dma(k).wait()
-                rows.append(scratch[pl.ds(jax.lax.rem(k, depth), 1), :])
-
-                @pl.when(k + depth < B * d)
-                def _():
-                    dma(k + depth).start()
-
-            win = _maj_planes(rows, d, thr)        # cnt > d//2
+            rows = [consume(b * d + j) for j in range(d)]   # d static
+            win = _maj_planes(rows, d, thr)                 # cnt > d//2
             out_ref[pl.ds(b, 1), :] = ~win if minority else win
             return 0
 
@@ -142,6 +162,128 @@ def pallas_packed_step(nbr, sp, *, rule: str = "majority", block: int = 256,
         ],
         interpret=interpret,
     )(nbr, sp)
+    return out[:n]
+
+
+def _make_general_kernel(B: int, dmax: int, depth: int, rule: Rule,
+                         tie: str, n_real: int):
+    """General-degree packed step: per-node thresholds/even masks from SMEM
+    scalars, ghost neighbor slots fetch the all-zero ghost row, the node's
+    own spin row arrives as a pipelined input block (rows are contiguous per
+    grid block) for the tie-break. Rows at or past ``n_real`` (the ghost row
+    and block padding) are forced to zero so the ghost-extended state can be
+    carried across steps unchanged."""
+    from jax.experimental import pallas as pl
+
+    n_planes = max(int(np.ceil(np.log2(dmax + 1))), 1)
+    full = _FULL
+
+    def kernel(nbr_ref, deg_ref, sp_ref, own_ref, out_ref, scratch, sems):
+        blk = pl.program_id(0)
+        warm, consume = _row_dma_pipeline(
+            sp_ref, scratch, sems,
+            lambda k: nbr_ref[k // dmax, k % dmax], B * dmax, depth,
+        )
+        warm()
+
+        def body(b, _):
+            rows = [consume(b * dmax + j) for j in range(dmax)]  # static dmax
+            planes = [jnp.zeros_like(rows[0]) for _ in range(n_planes)]
+            for r in rows:
+                _csa_add_one(planes, r)
+            deg_b = deg_ref[b]
+            thr = deg_b // 2
+            thr_bits = [
+                jnp.where((thr >> k) & 1 == 1, full, jnp.uint32(0))
+                for k in range(n_planes)
+            ]
+            gt, eq = _compare_planes(planes, thr_bits)
+            even_mask = jnp.where(deg_b % 2 == 0, full, jnp.uint32(0))
+            win = gt
+            tie_mask = eq & even_mask
+            own = own_ref[pl.ds(b, 1), :]
+            tie_bit = own if tie == "stay" else ~own
+            out = win | (tie_mask & tie_bit)
+            if rule == Rule.MINORITY:
+                loss = ~(win | tie_mask)
+                out = loss | (tie_mask & tie_bit)
+            # ghost + pad rows stay zero so the carry is reusable
+            beyond = (blk * B + b) >= n_real
+            out_ref[pl.ds(b, 1), :] = jnp.where(beyond, jnp.uint32(0), out)
+            return 0
+
+        jax.lax.fori_loop(0, B, body, 0)
+
+    return kernel
+
+
+@partial(
+    jax.jit,
+    static_argnames=("rule", "tie", "n_real", "block", "depth", "interpret"),
+)
+def _general_step_ext(nbr_pad, deg_pad, sp_ext, *, rule, tie, n_real,
+                      block, depth, interpret):
+    """One general packed step on the ghost-extended padded state
+    ``sp_ext: uint32[n_pad, W]`` (row ``n_real`` = ghost zeros, further rows
+    = block padding). Returns the same-shape updated state."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_pad, dmax = nbr_pad.shape
+    W = sp_ext.shape[1]
+    return pl.pallas_call(
+        _make_general_kernel(block, dmax, depth, Rule(rule), tie, n_real),
+        grid=(n_pad // block,),
+        in_specs=[
+            pl.BlockSpec((block, dmax), lambda i: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((block,), lambda i: (i,), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((block, W), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block, W), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, W), sp_ext.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((depth, W), sp_ext.dtype),
+            pltpu.SemaphoreType.DMA((depth,)),
+        ],
+        interpret=interpret,
+    )(nbr_pad, deg_pad, sp_ext, sp_ext)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("steps", "rule", "tie", "block", "depth", "interpret"),
+)
+def pallas_packed_rollout_general(nbr, deg, sp, steps: int,
+                                  rule: str = "majority", tie: str = "stay",
+                                  *, block: int = 256, depth: int = 8,
+                                  interpret: bool = False):
+    """General-degree packed rollout (ragged/even degrees, ghost padding,
+    all four (rule, tie) pairs) with the same per-row-DMA pipeline as the
+    uniform-odd v1 kernel. The ghost-extended state is built once and
+    carried across steps (the XLA kernel's ghost-carry design); each node
+    costs ``dmax`` row DMAs plus its own-row block read for the tie-break.
+    Bit-parity with `packed_rollout` is interpret-mode tested."""
+    tie = str(TieBreak(tie).value)
+    n, dmax = nbr.shape
+    W = sp.shape[1]
+    n_pad = -((-(n + 1)) // block) * block        # room for the ghost row
+    pad = n_pad - n
+    nbr_pad = jnp.concatenate(
+        [nbr, jnp.full((pad, dmax), n, nbr.dtype)], axis=0
+    )
+    deg_pad = jnp.concatenate([deg, jnp.zeros((pad,), deg.dtype)])
+    sp_ext = jnp.concatenate(
+        [sp, jnp.zeros((pad, W), sp.dtype)], axis=0
+    )
+    step = partial(
+        _general_step_ext, rule=Rule(rule).value, tie=tie, n_real=n,
+        block=block, depth=depth, interpret=interpret,
+    )
+    out = jax.lax.fori_loop(
+        0, steps, lambda _, s: step(nbr_pad, deg_pad, s), sp_ext
+    )
     return out[:n]
 
 

@@ -110,26 +110,39 @@ def main():
     import jax
 
     if jax.default_backend() == "tpu":
-        from graphdyn.ops.pallas_packed import pallas_packed_rollout
+        from graphdyn.ops.pallas_packed import (
+            pallas_packed_rollout,
+            pallas_packed_rollout_general,
+        )
 
-        for depth in (8, 16):
-            try:
-                rate = time_chained(
-                    lambda x, dp=depth: pallas_packed_rollout(
-                        nbr, g.deg, x, args.steps, depth=dp
-                    ),
-                    sp, args.n * args.w * 32 * args.steps,
-                )
-                print(json.dumps({
-                    "variant": "D_pallas_row_dma", "depth": depth,
-                    "spin_updates_per_sec": rate,
-                    "n": args.n, "W": args.w, "d": args.d,
-                }), flush=True)
-            except Exception as e:  # noqa: BLE001 — record, keep going
-                print(json.dumps({
-                    "variant": "D_pallas_row_dma", "depth": depth,
-                    "error": str(e)[:300],
-                }), flush=True)
+        variants = [
+            ("D_pallas_row_dma",
+             lambda x, dp: pallas_packed_rollout(
+                 nbr, g.deg, x, args.steps, depth=dp)),
+            # E: the general-degree kernel on the same uniform graph — its
+            # overhead vs D (SMEM threshold reads + own-row block) is the
+            # cost of ragged/even-degree support
+            ("E_pallas_general",
+             lambda x, dp: pallas_packed_rollout_general(
+                 nbr, jnp.asarray(g.deg), x, args.steps, depth=dp)),
+        ]
+        for name, fn in variants:
+            for depth in (8, 16):
+                try:
+                    rate = time_chained(
+                        lambda x, f=fn, dp=depth: f(x, dp),
+                        sp, args.n * args.w * 32 * args.steps,
+                    )
+                    print(json.dumps({
+                        "variant": name, "depth": depth,
+                        "spin_updates_per_sec": rate,
+                        "n": args.n, "W": args.w, "d": args.d,
+                    }), flush=True)
+                except Exception as e:  # noqa: BLE001 — record, keep going
+                    print(json.dumps({
+                        "variant": name, "depth": depth,
+                        "error": str(e)[:300],
+                    }), flush=True)
 
     # int8 kernel A/B (the SA solver's hot rollout — ops.dynamics)
     from graphdyn.ops.dynamics import batched_rollout

@@ -36,6 +36,35 @@ def test_pallas_packed_matches_xla(rule, d):
     )
 
 
+@pytest.mark.parametrize("rule", ["majority", "minority"])
+@pytest.mark.parametrize("tie", ["stay", "change"])
+def test_pallas_packed_general_matches_xla(rule, tie):
+    """The general-degree kernel (v2: per-node thresholds, ghost slots,
+    own-row tie-break, ghost-carried state) is bit-identical to the XLA
+    kernel on ragged ER and even-degree RRG shapes — the full (rule, tie)
+    matrix, including the tie paths v1 cannot reach."""
+    from graphdyn.graphs import remove_isolates
+    from graphdyn.ops.pallas_packed import pallas_packed_rollout_general
+
+    for g in (
+        remove_isolates(erdos_renyi_graph(150, 3.0 / 149, seed=0))[0],
+        random_regular_graph(120, 4, seed=1),
+    ):
+        rng = np.random.default_rng(0)
+        R = 64
+        sp = jnp.asarray(pack_spins(
+            (2 * rng.integers(0, 2, size=(R, g.n)) - 1).astype(np.int8)
+        ))
+        ref = packed_rollout(
+            jnp.asarray(g.nbr), jnp.asarray(g.deg), sp, 4, rule, tie
+        )
+        out = pallas_packed_rollout_general(
+            jnp.asarray(g.nbr), jnp.asarray(g.deg), sp, 4, rule, tie,
+            block=64, depth=4, interpret=True,
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
 def test_pallas_packed_padding_and_gates():
     # n not a multiple of block exercises the pad-row path
     g = random_regular_graph(70, 3, seed=1)
