@@ -52,6 +52,15 @@ def main(session_dir, bench_configs="BENCH_CONFIGS_r04.json"):
         try:
             with open(cfg_path) as f:
                 out["configs"] = json.load(f)
+            if isinstance(out["configs"], dict):
+                cfg_backend = out["configs"].get("backend")
+                if cfg_backend not in (None, "unknown", "tpu", "axon"):
+                    # same guard as the headline: a fallback backend's config
+                    # rates must not merge into the round doc as chip numbers
+                    out["configs_warning"] = (
+                        f"configs backend is {cfg_backend!r}, not the chip — "
+                        "rates are NOT chip numbers"
+                    )
         except json.JSONDecodeError as e:
             # a killed aggregator leaves an empty/truncated file; the
             # no-usable-artifacts guard below must still get to run
@@ -84,7 +93,12 @@ def main(session_dir, bench_configs="BENCH_CONFIGS_r04.json"):
             # other artifacts (same tolerance as read_json_lines)
             out["physics_error"] = f"unparseable physics_tpu.json: {e}"
 
-    if not out.get("headline") and not out.get("configs"):
+    cfgs_present = out.get("configs")
+    if isinstance(cfgs_present, dict):
+        # the aggregator writes a valid-but-empty doc at startup; an empty
+        # configs list is NOT a usable artifact for the guard below
+        cfgs_present = cfgs_present.get("configs")
+    if not out.get("headline") and not cfgs_present:
         # a wedged session leaves empty files: refuse to stamp the round doc
         # as 'captured' over nothing (the fallback warning can only fire when
         # a headline row exists at all)

@@ -12,8 +12,16 @@ Usage: PYTHONPATH=/root/repo:/root/.axon_site python scripts/pallas_tpu_validate
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from graphdyn.utils.platform import apply_force_platform
+
+apply_force_platform()
 
 import numpy as np
 

@@ -5,6 +5,15 @@ between configs; one config crashing cannot take down the rest). The JSON
 lines every config prints via ``benchmarks.common.report`` are collected
 into a single artifact.
 
+Wedge resilience (the TPU relay drops unpredictably mid-session):
+- the output doc is rewritten after every config, so an outer timeout
+  killing the aggregator keeps everything that completed;
+- a re-run against the same --out resumes: configs already present with
+  rc=0 and metrics are kept as-is and skipped;
+- device metadata comes from a timeout-bounded subprocess *after* the
+  configs (metadata must never spend chip-window time before config 1,
+  nor hang the aggregator when the relay is wedged).
+
 Usage:
   python scripts/run_baseline_configs.py --out BENCH_CONFIGS_r03.json [--full]
   # CPU smoke:
@@ -32,32 +41,36 @@ CONFIGS = [
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_config(name: str, full: bool, timeout_s: float, platform: str | None) -> dict:
+def json_lines(text: str) -> list[dict]:
+    """Every parseable JSON-object line in ``text`` (non-JSON lines skipped)."""
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return out
+
+
+def run_config(name: str, full: bool, timeout_s: float) -> dict:
+    # Platform forcing reaches the subprocess via inherited env: main() sets
+    # GRAPHDYN_FORCE_PLATFORM in os.environ before the first call, and
+    # benchmarks.common applies it before first jax use (survives plugins
+    # that pin jax_platforms at interpreter startup).
     cmd = [sys.executable, os.path.join(ROOT, "benchmarks", f"{name}.py")]
     if full:
         cmd.append("--full")
-    env = dict(os.environ)
-    if platform:
-        # benchmarks.common applies this before first jax use — survives
-        # environment plugins that pin jax_platforms at interpreter startup
-        env["GRAPHDYN_FORCE_PLATFORM"] = platform
     t0 = time.time()
     try:
         proc = subprocess.run(
             cmd, capture_output=True, text=True, timeout=timeout_s, cwd=ROOT,
-            env=env,
         )
         rc, out, err = proc.returncode, proc.stdout, proc.stderr
     except subprocess.TimeoutExpired as e:
         rc, out, err = -1, (e.stdout or ""), f"TIMEOUT after {timeout_s}s"
-    metrics = []
-    for line in out.splitlines():
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                metrics.append(json.loads(line))
-            except json.JSONDecodeError:
-                pass
+    metrics = json_lines(out)
     entry = {
         "config": name,
         "rc": rc,
@@ -69,6 +82,23 @@ def run_config(name: str, full: bool, timeout_s: float, platform: str | None) ->
     return entry
 
 
+def probe_device_info(timeout_s: float = 180.0) -> tuple[str, list[str]]:
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import benchmarks.common, jax, json;"
+             "print(json.dumps({'backend': jax.default_backend(),"
+             " 'devices': [str(d) for d in jax.devices()]}))"],
+            capture_output=True, text=True, timeout=timeout_s, cwd=ROOT,
+        )
+    except subprocess.TimeoutExpired:
+        return "unknown", []
+    for info in json_lines(probe.stdout):
+        if "backend" in info and "devices" in info:
+            return info["backend"], info["devices"]
+    return "unknown", []
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="BENCH_CONFIGS.json")
@@ -76,37 +106,111 @@ def main():
     ap.add_argument("--timeout", type=float, default=3600.0, help="per-config seconds")
     ap.add_argument("--only", nargs="*", help="subset of config names")
     ap.add_argument(
-        "--platform", choices=["cpu", "tpu"], default=None,
-        help="force the JAX platform in each config subprocess",
+        "--platform", choices=["cpu", "tpu", "axon"], default=None,
+        help="force the JAX platform in each config subprocess ('axon' is "
+        "the tunneled-TPU plugin name: chip-or-hang, never a silent CPU "
+        "fallback; 'tpu' means a locally attached chip)",
+    )
+    ap.add_argument(
+        "--fresh", action="store_true",
+        help="ignore completed configs in an existing --out file (default: resume)",
     )
     args = ap.parse_args()
 
-    sys.path.insert(0, ROOT)
     if args.platform:
         os.environ["GRAPHDYN_FORCE_PLATFORM"] = args.platform
-    import benchmarks.common  # noqa: F401 — applies the platform force
-    import jax
+
+    mode = "full" if args.full else "smoke"
+    # What actually selects the backend in every subprocess — resumed
+    # results are only comparable when ALL of these match the prior run's
+    # (JAX_PLATFORMS matters too: the documented CPU smoke uses it, not
+    # --platform, and its numbers must never resume into a chip run).
+    platform_key = {
+        "mode": mode,
+        "platform_forced": os.environ.get("GRAPHDYN_FORCE_PLATFORM", ""),
+        "jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+        "xla_flags": os.environ.get("XLA_FLAGS", ""),
+    }
+
+    # Resume: a previous (wedge-killed) run's completed configs are kept,
+    # not re-measured and never clobbered by the startup flush. A prior
+    # file whose platform key mismatches (or that doesn't parse) is moved
+    # aside, never silently overwritten — it may hold scarce chip results.
+    cached: dict[str, dict] = {}
+    prior_backend, prior_devices = "unknown", []
+    if os.path.exists(args.out):
+        resumable = False
+        try:
+            with open(args.out) as f:
+                prior = json.load(f)
+            # Every key field must be PRESENT and equal: a legacy-format doc
+            # (no platform fields) records nothing about the env that made
+            # it, so it must never resume into any run.
+            resumable = (not args.fresh) and isinstance(prior, dict) and all(
+                k in prior and prior[k] == v for k, v in platform_key.items())
+        except (json.JSONDecodeError, OSError):
+            prior = None
+        if resumable:
+            for entry in prior.get("configs", []):
+                if entry.get("rc") == 0 and entry.get("metrics"):
+                    cached[entry["config"]] = entry
+            prior_backend = prior.get("backend", "unknown")
+            prior_devices = prior.get("devices", [])
+        else:
+            backup = f"{args.out}.prior-{time.strftime('%Y%m%dT%H%M%S')}"
+            os.replace(args.out, backup)
+            print(f"prior {args.out} not resumable (platform/mode mismatch, "
+                  f"--fresh, or unparseable); moved to {backup}", flush=True)
 
     doc = {
-        "backend": jax.default_backend(),
-        "devices": [str(d) for d in jax.devices()],
-        "mode": "full" if args.full else "smoke",
+        "backend": prior_backend,
+        "devices": prior_devices,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "configs": [],
+        "ok": False,
+        **platform_key,
     }
     names = args.only or CONFIGS
+    # The doc always carries every known result — the requested names plus
+    # any cached configs outside --only — so a partial re-run can never
+    # drop a completed entry from the file.
+    all_names = CONFIGS + [n for n in names if n not in CONFIGS]
+    all_names += [n for n in cached if n not in all_names]
+    # Cached (resumed) entries are part of the doc from the very first
+    # flush — a kill at ANY point of this run must not lose them.
+    results: dict[str, dict] = dict(cached)
+
+    def flush_doc():
+        # Rewrite after every config: an outer timeout killing the
+        # aggregator must not discard the configs that already finished.
+        doc["configs"] = [results[n] for n in all_names if n in results]
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, args.out)
+
+    flush_doc()
     for name in names:
-        print(f"=== {name} ({doc['mode']}) ===", flush=True)
-        entry = run_config(name, args.full, args.timeout, args.platform)
-        doc["configs"].append(entry)
+        if name in cached:
+            print(f"=== {name} ({mode}) === cached from previous run", flush=True)
+            continue
+        print(f"=== {name} ({mode}) ===", flush=True)
+        entry = run_config(name, args.full, args.timeout)
+        results[name] = entry
+        flush_doc()
         for m in entry["metrics"]:
             print("  ", json.dumps(m), flush=True)
         if entry["rc"] != 0:
             print("  rc=%s\n%s" % (entry["rc"], entry.get("stderr_tail", "")), flush=True)
-    ok = all(c["rc"] == 0 and c["metrics"] for c in doc["configs"])
+
+    if doc["backend"] == "unknown":
+        # Metadata probe runs last (never spends chip-window time before
+        # config 1) and only when the resumed doc didn't already have it.
+        doc["backend"], doc["devices"] = probe_device_info()
+    ok = all(results.get(n, {}).get("rc") == 0 and results.get(n, {}).get("metrics")
+             for n in names)
     doc["ok"] = ok
-    with open(args.out, "w") as f:
-        json.dump(doc, f, indent=1)
+    flush_doc()
     print(f"WROTE {args.out} ok={ok}")
     sys.exit(0 if ok else 1)
 

@@ -37,8 +37,11 @@ cp -f PALLAS_TPU.json "$OUT/PALLAS_TPU.json" 2>/dev/null
 echo "[tpu-session] five BASELINE configs (full) ..." >&2
 # per-config budget x5 must fit inside the outer budget, or the aggregator
 # dies before writing --out and every completed config's result is lost
+# --platform axon (the tunneled-TPU plugin): chip-or-hang, never a silent
+# CPU fallback; same resume key as the remainder session so a wedged run's
+# completed configs carry over to the next firing
 timeout 9000 python scripts/run_baseline_configs.py \
-    --out "$OUT/configs_tpu.json" --full --timeout 1500 >&2
+    --out "$OUT/configs_tpu.json" --full --timeout 1500 --platform axon >&2
 echo "[tpu-session] configs rc=$?" >&2
 
 echo "[tpu-session] physics on chip (HPr at reference constants) ..." >&2

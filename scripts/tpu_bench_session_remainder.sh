@@ -1,0 +1,49 @@
+#!/bin/bash
+# Remainder chip session: the stages the first round-4 window did NOT get
+# before the relay wedged at 10:19 UTC (headline bench + gather A/B + DMA
+# probe are already captured in tpu_session_r04/). Ordered by evidence
+# value so a second wedge mid-session still leaves the most important
+# artifact behind:
+#   1. five BASELINE configs at full scale (the VERDICT item-1 "done" bar)
+#   2. on-chip HPr physics at reference constants
+#   3. Pallas on-chip validation refresh (round-3 chip data already exists)
+# SHORT=1 trims per-stage budgets for a late recovery (cannot collide with
+# the driver's own round-end bench).  Usage:
+#   bash scripts/tpu_bench_session_remainder.sh [outdir]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-tpu_session_r04}"
+mkdir -p "$OUT"
+
+if [ "${SHORT:-0}" = "1" ]; then
+    CFG_OUTER=3600; CFG_PER=650; PHYS=600; VALIDATE=0
+else
+    CFG_OUTER=9000; CFG_PER=1500; PHYS=1200; VALIDATE=1500
+fi
+
+# 'axon' = the tunneled-TPU plugin: chip-or-hang in every stage, so a
+# relay that half-recovers can never let JAX fall back to CPU and write
+# CPU rates into the chip artifacts (per-config/outer timeouts bound the
+# hang; the aggregator resumes whatever completed on the next firing).
+echo "[tpu-remainder] five BASELINE configs (full, per-config ${CFG_PER}s) ..." >&2
+timeout "$CFG_OUTER" python scripts/run_baseline_configs.py \
+    --out "$OUT/configs_tpu.json" --full --timeout "$CFG_PER" --platform axon >&2
+echo "[tpu-remainder] configs rc=$?" >&2
+
+echo "[tpu-remainder] physics on chip (HPr at reference constants) ..." >&2
+GRAPHDYN_FORCE_PLATFORM=axon timeout "$PHYS" \
+    python scripts/physics_r04.py hpr "$OUT/physics_tpu.json" \
+    > "$OUT/physics_tpu.log" 2>&1
+echo "[tpu-remainder] physics rc=$?" >&2
+
+if [ "$VALIDATE" -gt 0 ]; then
+    echo "[tpu-remainder] pallas on-chip validation ..." >&2
+    GRAPHDYN_FORCE_PLATFORM=axon timeout "$VALIDATE" \
+        python scripts/pallas_tpu_validate.py \
+        > "$OUT/pallas_validate.log" 2>&1
+    rc=$?
+    echo "[tpu-remainder] pallas validate rc=$rc" >&2
+    [ $rc -eq 0 ] && cp -f PALLAS_TPU.json "$OUT/PALLAS_TPU.json"
+fi
+
+echo "[tpu-remainder] done; artifacts in $OUT" >&2

@@ -20,13 +20,25 @@ while true; do
         exit 0
     fi
     if tail -n 1 "$LOG" 2>/dev/null | grep -q " UP "; then
+        SESSION="${SESSION_SCRIPT:-scripts/tpu_bench_session.sh}"
+        if [ "$FULL_UNTIL" -gt 0 ] && [ "$(date +%s)" -gt "$FULL_UNTIL" ]; then
+            # default the late session to the short variant of the MAIN
+            # session (<name>_short.sh); if none exists, keep the main
+            # session rather than fall back to an unrelated script
+            DERIVED="${SESSION%.sh}_short.sh"
+            [ -f "$DERIVED" ] || DERIVED="$SESSION"
+            SESSION="${SESSION_SCRIPT_LATE:-$DERIVED}"
+        fi
+        if [ ! -f "$SESSION" ]; then
+            # validate BEFORE burning the one-shot flag: a mistyped
+            # SESSION_SCRIPT must not consume the recovery window
+            echo "[fire-when-up] session script $SESSION missing; NOT firing" \
+                >> "$OUT/session.log"
+            exit 1
+        fi
         date -u > "$FLAG"
         trap 'rm -f /tmp/tpu_canary.pause' EXIT   # unpause even if killed
         touch /tmp/tpu_canary.pause      # the session owns the chip now
-        SESSION=scripts/tpu_bench_session.sh
-        if [ "$FULL_UNTIL" -gt 0 ] && [ "$(date +%s)" -gt "$FULL_UNTIL" ]; then
-            SESSION=scripts/tpu_bench_session_short.sh
-        fi
         echo "[fire-when-up] canary UP at $(date -u +%H:%M:%S); launching $SESSION" \
             >> "$OUT/session.log"
         bash "$SESSION" "$OUT" >> "$OUT/session.log" 2>&1
