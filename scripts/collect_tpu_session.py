@@ -12,24 +12,28 @@ Usage: python scripts/collect_tpu_session.py SESSION_DIR [BENCH_CONFIGS_JSON]
 - Prints a one-screen summary for the commit message.
 """
 
+import importlib.util
 import json
 import os
 import sys
 
+# One JSON-lines parser shared with the aggregator (both scripts must agree
+# on which stdout lines count as metrics); loaded by path because scripts/
+# is not a package and this tool stays stdlib-pure otherwise.
+_spec = importlib.util.spec_from_file_location(
+    "run_baseline_configs",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "run_baseline_configs.py"))
+_rbc = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_rbc)
+json_lines = _rbc.json_lines
+
 
 def read_json_lines(path):
-    rows = []
     if not os.path.exists(path):
-        return rows
+        return []
     with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line.startswith("{"):
-                try:
-                    rows.append(json.loads(line))
-                except json.JSONDecodeError:
-                    pass
-    return rows
+        return json_lines(f.read())
 
 
 def main(session_dir, bench_configs="BENCH_CONFIGS_r04.json"):

@@ -157,7 +157,10 @@ def main():
             prior_backend = prior.get("backend", "unknown")
             prior_devices = prior.get("devices", [])
         else:
-            backup = f"{args.out}.prior-{time.strftime('%Y%m%dT%H%M%S')}"
+            # pid suffix: two same-second move-asides must not clobber
+            # each other's backup
+            backup = (f"{args.out}.prior-{time.strftime('%Y%m%dT%H%M%S')}"
+                      f"-{os.getpid()}")
             os.replace(args.out, backup)
             print(f"prior {args.out} not resumable (platform/mode mismatch, "
                   f"--fresh, or unparseable); moved to {backup}", flush=True)

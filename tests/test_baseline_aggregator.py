@@ -1,0 +1,150 @@
+"""Wedge-resume contract of scripts/run_baseline_configs.py.
+
+The aggregator is how full-scale chip configs get captured across TPU-relay
+wedges (the relay drops unpredictably mid-session): completed configs must
+survive any kill, re-runs must resume rather than re-measure, and results
+from a differently-configured environment must never be mixed in or
+silently destroyed. Subprocess spawning and device probing are stubbed —
+this pins the aggregation/resume logic itself.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "run_baseline_configs", os.path.join(ROOT, "scripts", "run_baseline_configs.py"))
+rbc = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(rbc)
+
+
+def _entry(name, rc=0):
+    metrics = [{"metric": f"m_{name}", "value": 1.0}] if rc == 0 else []
+    return {"config": name, "rc": rc, "elapsed_s": 0.1, "metrics": metrics}
+
+
+@pytest.fixture
+def run(monkeypatch, tmp_path):
+    """Run the aggregator main() with stubbed subprocess stages.
+
+    ``fail`` names configs whose (stubbed) run should report rc=-1; returns
+    (exit_code, parsed_doc, calls) where ``calls`` lists the configs that
+    were actually (re)measured rather than resumed.
+    """
+    out = str(tmp_path / "configs.json")
+
+    def _run(argv=(), fail=(), env=()):
+        calls = []
+
+        def fake_run_config(name, full, timeout_s):
+            calls.append(name)
+            return _entry(name, rc=-1 if name in fail else 0)
+
+        monkeypatch.setattr(rbc, "run_config", fake_run_config)
+        monkeypatch.setattr(rbc, "probe_device_info", lambda *a, **k: ("stub", ["dev0"]))
+        for k in ("GRAPHDYN_FORCE_PLATFORM", "JAX_PLATFORMS", "XLA_FLAGS"):
+            monkeypatch.delenv(k, raising=False)
+        for k, v in dict(env).items():
+            monkeypatch.setenv(k, v)
+        monkeypatch.setattr(sys, "argv",
+                            ["run_baseline_configs.py", "--out", out, *argv])
+        with pytest.raises(SystemExit) as exc:
+            rbc.main()
+        with open(out) as f:
+            doc = json.load(f)
+        return exc.value.code, doc, calls
+
+    _run.out = out
+    return _run
+
+
+def test_fresh_run_writes_complete_doc(run):
+    code, doc, calls = run()
+    assert code == 0 and doc["ok"] is True
+    assert [c["config"] for c in doc["configs"]] == rbc.CONFIGS
+    assert calls == rbc.CONFIGS
+    assert doc["backend"] == "stub"
+    for k in ("mode", "platform_forced", "jax_platforms", "xla_flags"):
+        assert k in doc
+
+
+def test_resume_skips_completed_and_retries_failed(run):
+    code, doc, _ = run(fail=("config2_hpr",))
+    assert code == 1 and doc["ok"] is False
+    # second run: the failed config is re-measured, the others resumed
+    code, doc, calls = run()
+    assert calls == ["config2_hpr"]
+    assert code == 0 and doc["ok"] is True
+    assert all(c["rc"] == 0 for c in doc["configs"])
+
+
+def test_only_subset_preserves_other_cached_entries(run):
+    run(argv=["--only", "config3_er_majority"])
+    code, doc, calls = run(argv=["--only", "config1_sa_rrg"])
+    assert calls == ["config1_sa_rrg"]
+    got = {c["config"] for c in doc["configs"]}
+    # the config3 result from the first run must survive the config1 rerun
+    assert got == {"config3_er_majority", "config1_sa_rrg"}
+    assert code == 0
+
+
+def test_platform_key_mismatch_backs_up_never_resumes(run):
+    run(env={"GRAPHDYN_FORCE_PLATFORM": "cpu"})
+    code, doc, calls = run(env={"GRAPHDYN_FORCE_PLATFORM": "axon"})
+    # every config re-measured; the cpu doc moved aside, not destroyed
+    assert calls == rbc.CONFIGS
+    assert doc["platform_forced"] == "axon"
+    backups = [p for p in os.listdir(os.path.dirname(run.out))
+               if os.path.basename(p).startswith("configs.json.prior-")]
+    assert backups, "mismatched prior doc must be backed up"
+    with open(os.path.join(os.path.dirname(run.out), backups[0])) as f:
+        prior = json.load(f)
+    assert prior["platform_forced"] == "cpu" and prior["ok"] is True
+
+
+def test_legacy_doc_without_key_fields_never_resumes(run):
+    code, doc, _ = run()
+    with open(run.out) as f:
+        legacy = json.load(f)
+    for k in ("platform_forced", "jax_platforms", "xla_flags"):
+        legacy.pop(k)
+    with open(run.out, "w") as f:
+        json.dump(legacy, f)
+    _, _, calls = run()
+    assert calls == rbc.CONFIGS  # nothing resumed from the legacy doc
+
+
+def test_fresh_flag_remeasures_everything(run):
+    run()
+    _, _, calls = run(argv=["--fresh"])
+    assert calls == rbc.CONFIGS
+
+
+def test_doc_on_disk_keeps_cached_entries_from_first_flush(run, monkeypatch):
+    """Kill-at-any-point safety: with a cached entry present, the file on
+    disk must contain it from the very first flush, before any config of
+    the second run executes."""
+    run(argv=["--only", "config3_er_majority"])
+
+    seen = {}
+
+    def exploding_run_config(name, full, timeout_s):
+        with open(run.out) as f:
+            seen["doc"] = json.load(f)
+        raise KeyboardInterrupt  # simulate the wedge kill mid-config-1
+
+    monkeypatch.setattr(rbc, "run_config", exploding_run_config)
+    monkeypatch.setattr(sys, "argv",
+                        ["run_baseline_configs.py", "--out", run.out])
+    with pytest.raises(KeyboardInterrupt):
+        rbc.main()
+    cfgs = {c["config"] for c in seen["doc"]["configs"]}
+    assert "config3_er_majority" in cfgs
+    # and the on-disk doc still holds it after the crash
+    with open(run.out) as f:
+        assert {c["config"] for c in json.load(f)["configs"]} == cfgs
