@@ -234,6 +234,9 @@ def main():
         _mark(f"wide R={R_try} rate {r:.3e}")
         if r > rate_wide:
             rate_wide, R_wide = r, R_try
+            # keep the failure emission's best-rate max() current: a later
+            # rung dying must not discard this rung's measured rate
+            partial["packed_rate_wide"] = rate_wide
         elif r < rate_wide:
             break  # rolled over — wider words no longer amortize
     partial["packed_rate_wide"] = rate_wide

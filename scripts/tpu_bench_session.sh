@@ -49,4 +49,11 @@ timeout 1200 python scripts/physics_r04.py hpr "$OUT/physics_tpu.json" \
     > "$OUT/physics_tpu.log" 2>&1
 echo "[tpu-session] physics rc=$?" >&2
 
+# Merge into the round doc immediately — a session fired by the watcher
+# near round end gets committed by the driver as-is, with nobody around
+# to run the collector by hand.
+echo "[tpu-session] merging artifacts into the round doc ..." >&2
+python scripts/collect_tpu_session.py "$OUT" BENCH_CONFIGS_r04.json >&2
+echo "[tpu-session] collect rc=$?" >&2
+
 echo "[tpu-session] done; artifacts in $OUT" >&2

@@ -46,4 +46,11 @@ if [ "$VALIDATE" -gt 0 ]; then
     [ $rc -eq 0 ] && cp -f PALLAS_TPU.json "$OUT/PALLAS_TPU.json"
 fi
 
+# Merge what this session captured into the round doc immediately: if the
+# watcher fired near round end, the driver commits the working tree as-is
+# and nobody may be around to run the collector by hand.
+echo "[tpu-remainder] merging artifacts into the round doc ..." >&2
+python scripts/collect_tpu_session.py "$OUT" BENCH_CONFIGS_r04.json >&2
+echo "[tpu-remainder] collect rc=$?" >&2
+
 echo "[tpu-remainder] done; artifacts in $OUT" >&2

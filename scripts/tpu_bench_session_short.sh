@@ -22,4 +22,13 @@ timeout 900 python scripts/pallas_gather_probe.py \
     > "$OUT/pallas_gather_probe.jsonl" 2> "$OUT/pallas_gather_probe.err"
 echo "[tpu-short] probe rc=$?" >&2
 
+# Merge into the round doc (the watcher may fire near round end with
+# nobody around to collect by hand), and self-report completion: this
+# session produces neither configs_tpu.json nor physics_tpu.json, so the
+# watcher's default done-check needs the marker to stop refiring.
+echo "[tpu-short] merging artifacts into the round doc ..." >&2
+python scripts/collect_tpu_session.py "$OUT" BENCH_CONFIGS_r04.json >&2
+echo "[tpu-short] collect rc=$?" >&2
+touch "$OUT/.short_session_done"
+
 echo "[tpu-short] done; artifacts in $OUT" >&2

@@ -1,18 +1,39 @@
 #!/bin/bash
-# Watch the TPU canary log; the first time an UP line appears, fire the
-# one-shot chip session into the given outdir (exactly once) and exit.
+# Watch the TPU canary log; whenever an UP line appears, fire the chip
+# session into the given outdir. The session may be cut short by a relay
+# re-wedge, so the watcher re-arms and fires again on the next recovery —
+# the config aggregator resumes completed configs, making refires cheap —
+# until the session reports done or MAX_FIRES firings are spent (a
+# flapping relay must not burn chip time in a loop forever).
 # After FULL_UNTIL (epoch seconds; 0 = always full) the abbreviated
 # session runs instead — a multi-hour full session fired late would
 # still be holding the chip when the driver's own round-end bench runs.
 #   nohup bash scripts/tpu_fire_when_up.sh tpu_session_r04 [log] [full_until] &
+# Env: SESSION_SCRIPT / SESSION_SCRIPT_LATE override the session scripts;
+#      MAX_FIRES caps firings (default 3);
+#      DONE_CHECK is a shell command returning 0 when no refire is needed
+#      (default: configs_tpu.json in the outdir reports ok=true).
 cd "$(dirname "$0")/.."
 OUT="${1:-tpu_session_r04}"
 LOG="${2:-/tmp/tpu_canary.log}"
 FULL_UNTIL="${3:-0}"
 FLAG="$OUT/.fired"
+MAX_FIRES="${MAX_FIRES:-3}"
+# Done = configs suite ok AND physics artifact parses (a timeout-truncated
+# physics file must keep a refire available), OR a session that produces
+# neither (the abbreviated bench-only one) self-reported completion.
+DONE_CHECK="${DONE_CHECK:-[ -f '$OUT/.short_session_done' ] || python -c \"import json; d=json.load(open('$OUT/configs_tpu.json')); json.load(open('$OUT/physics_tpu.json')); exit(0 if d.get('ok') else 1)\" 2>/dev/null}"
 mkdir -p "$OUT"
 while true; do
-    if [ -f "$FLAG" ]; then exit 0; fi
+    FIRES=$( [ -f "$FLAG" ] && wc -l < "$FLAG" || echo 0 )
+    if [ "$FIRES" -ge "$MAX_FIRES" ]; then
+        echo "[fire-when-up] $FIRES firings spent; exiting" >> "$OUT/session.log"
+        exit 0
+    fi
+    if eval "$DONE_CHECK"; then
+        echo "[fire-when-up] done-check passed; exiting" >> "$OUT/session.log"
+        exit 0
+    fi
     if tail -n 1 "$LOG" 2>/dev/null | grep -q "EXPIRED"; then
         # the canary stopped probing — nothing will ever flip the log to UP,
         # so waiting on it is pointless; exit rather than poll a dead file
@@ -30,20 +51,25 @@ while true; do
             SESSION="${SESSION_SCRIPT_LATE:-$DERIVED}"
         fi
         if [ ! -f "$SESSION" ]; then
-            # validate BEFORE burning the one-shot flag: a mistyped
-            # SESSION_SCRIPT must not consume the recovery window
+            # validate BEFORE burning a firing: a mistyped SESSION_SCRIPT
+            # must not consume the recovery window
             echo "[fire-when-up] session script $SESSION missing; NOT firing" \
                 >> "$OUT/session.log"
             exit 1
         fi
-        date -u > "$FLAG"
+        date -u >> "$FLAG"
         trap 'rm -f /tmp/tpu_canary.pause' EXIT   # unpause even if killed
         touch /tmp/tpu_canary.pause      # the session owns the chip now
         echo "[fire-when-up] canary UP at $(date -u +%H:%M:%S); launching $SESSION" \
-            >> "$OUT/session.log"
+            "(firing $((FIRES + 1))/$MAX_FIRES)" >> "$OUT/session.log"
         bash "$SESSION" "$OUT" >> "$OUT/session.log" 2>&1
         rm -f /tmp/tpu_canary.pause
-        exit 0
+        # loop (don't exit): the done/max-fires checks at the top decide
+        # whether another recovery window should refire. Wait out a FULL
+        # canary cycle (120s interval + 90s probe timeout) so a stale UP
+        # line from before a fast-failing session can't refire into a
+        # relay that wedged during it.
+        sleep 240
     fi
     sleep 30
 done
