@@ -1,0 +1,41 @@
+# Shared helpers for the chip session scripts (sourced, not executed).
+#
+# Refires reuse the same outdir, so every stage must be idempotent: skip
+# when the artifact it would produce already holds good data, and never
+# truncate a good artifact just to re-measure it. The config aggregator
+# resumes natively; these helpers give the other stages the same property.
+
+# ROUND_DOC: the benchmark doc all sessions merge into (one place to bump
+# per round instead of editing every session script).
+ROUND_DOC="${ROUND_DOC:-BENCH_CONFIGS_r04.json}"
+
+# json_ok FILE — file exists and parses as JSON
+json_ok() {
+    python - "$1" >/dev/null 2>&1 <<'EOF'
+import json, sys
+json.load(open(sys.argv[1]))
+EOF
+}
+
+# headline_ok FILE — bench headline parses AND carries a real rate (a
+# failed bench emits an error JSON with value 0.0, which a refire should
+# replace)
+headline_ok() {
+    python - "$1" >/dev/null 2>&1 <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+assert d.get("value", 0) > 0
+EOF
+}
+
+# rows_ok FILE — a JSONL artifact with at least one row
+rows_ok() { [ -s "$1" ]; }
+
+# collect_round OUTDIR TAG — merge the session dir into the round doc
+# (idempotent; fired near round end the driver commits the tree as-is,
+# with nobody around to run the collector by hand)
+collect_round() {
+    echo "[$2] merging artifacts into $ROUND_DOC ..." >&2
+    python scripts/collect_tpu_session.py "$1" "$ROUND_DOC" >&2
+    echo "[$2] collect rc=$?" >&2
+}

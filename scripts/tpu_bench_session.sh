@@ -9,51 +9,71 @@
 #   bench_headline.json      — bench.py (packed kernel, natural vs BFS order)
 #   gather_experiment.jsonl  — fused vs per-slot vs slot-sorted A/B/C
 #   configs_tpu.json         — all five BASELINE configs, full scale
+#
+# Idempotent per stage: a refire into the same outdir skips stages whose
+# artifact already holds good data (never truncates good chip data to
+# re-measure it) and re-runs only what is missing or failed.
 set -u
 cd "$(dirname "$0")/.."
+. scripts/_session_lib.sh
 OUT="${1:-/tmp/tpu_session}"
 mkdir -p "$OUT"
 
-echo "[tpu-session] headline bench ..." >&2
-timeout 1800 python bench.py > "$OUT/bench_headline.json" 2> "$OUT/bench_headline.err"
-echo "[tpu-session] bench rc=$? $(tail -c 300 "$OUT/bench_headline.json")" >&2
+if headline_ok "$OUT/bench_headline.json"; then
+    echo "[tpu-session] headline bench already captured; skipping" >&2
+else
+    echo "[tpu-session] headline bench ..." >&2
+    timeout 1800 python bench.py > "$OUT/bench_headline.json" 2> "$OUT/bench_headline.err"
+    echo "[tpu-session] bench rc=$? $(tail -c 300 "$OUT/bench_headline.json")" >&2
+fi
 
-echo "[tpu-session] gather experiment ..." >&2
-timeout 1800 python scripts/packed_gather_experiment.py \
-    > "$OUT/gather_experiment.jsonl" 2> "$OUT/gather_experiment.err"
-echo "[tpu-session] gather rc=$?" >&2
+if rows_ok "$OUT/gather_experiment.jsonl"; then
+    echo "[tpu-session] gather experiment already captured; skipping" >&2
+else
+    echo "[tpu-session] gather experiment ..." >&2
+    timeout 1800 python scripts/packed_gather_experiment.py \
+        > "$OUT/gather_experiment.jsonl" 2> "$OUT/gather_experiment.err"
+    echo "[tpu-session] gather rc=$?" >&2
+fi
 
-echo "[tpu-session] pallas random-row gather probe ..." >&2
-timeout 1800 python scripts/pallas_gather_probe.py \
-    > "$OUT/pallas_gather_probe.jsonl" 2> "$OUT/pallas_gather_probe.err"
-echo "[tpu-session] probe rc=$?" >&2
+if rows_ok "$OUT/pallas_gather_probe.jsonl"; then
+    echo "[tpu-session] pallas gather probe already captured; skipping" >&2
+else
+    echo "[tpu-session] pallas random-row gather probe ..." >&2
+    timeout 1800 python scripts/pallas_gather_probe.py \
+        > "$OUT/pallas_gather_probe.jsonl" 2> "$OUT/pallas_gather_probe.err"
+    echo "[tpu-session] probe rc=$?" >&2
+fi
 
-echo "[tpu-session] pallas on-chip validation (BDCM + packed kernels) ..." >&2
-timeout 1800 python scripts/pallas_tpu_validate.py \
-    > "$OUT/pallas_validate.log" 2>&1
-echo "[tpu-session] pallas validate rc=$?" >&2
-cp -f PALLAS_TPU.json "$OUT/PALLAS_TPU.json" 2>/dev/null
+if json_ok "$OUT/PALLAS_TPU.json"; then
+    echo "[tpu-session] pallas validation already captured; skipping" >&2
+else
+    echo "[tpu-session] pallas on-chip validation (BDCM + packed kernels) ..." >&2
+    timeout 1800 python scripts/pallas_tpu_validate.py \
+        > "$OUT/pallas_validate.log" 2>&1
+    rc=$?
+    echo "[tpu-session] pallas validate rc=$rc" >&2
+    [ $rc -eq 0 ] && cp -f PALLAS_TPU.json "$OUT/PALLAS_TPU.json"
+fi
 
 echo "[tpu-session] five BASELINE configs (full) ..." >&2
 # per-config budget x5 must fit inside the outer budget, or the aggregator
-# dies before writing --out and every completed config's result is lost
+# dies before writing --out and every completed config's result is lost.
 # --platform axon (the tunneled-TPU plugin): chip-or-hang, never a silent
-# CPU fallback; same resume key as the remainder session so a wedged run's
-# completed configs carry over to the next firing
+# CPU fallback. The aggregator resumes completed configs natively.
 timeout 9000 python scripts/run_baseline_configs.py \
     --out "$OUT/configs_tpu.json" --full --timeout 1500 --platform axon >&2
 echo "[tpu-session] configs rc=$?" >&2
 
-echo "[tpu-session] physics on chip (HPr at reference constants) ..." >&2
-timeout 1200 python scripts/physics_r04.py hpr "$OUT/physics_tpu.json" \
-    > "$OUT/physics_tpu.log" 2>&1
-echo "[tpu-session] physics rc=$?" >&2
+if json_ok "$OUT/physics_tpu.json"; then
+    echo "[tpu-session] physics already captured; skipping" >&2
+else
+    echo "[tpu-session] physics on chip (HPr at reference constants) ..." >&2
+    GRAPHDYN_FORCE_PLATFORM=axon timeout 1200 \
+        python scripts/physics_r04.py hpr "$OUT/physics_tpu.json" \
+        > "$OUT/physics_tpu.log" 2>&1
+    echo "[tpu-session] physics rc=$?" >&2
+fi
 
-# Merge into the round doc immediately — a session fired by the watcher
-# near round end gets committed by the driver as-is, with nobody around
-# to run the collector by hand.
-echo "[tpu-session] merging artifacts into the round doc ..." >&2
-python scripts/collect_tpu_session.py "$OUT" BENCH_CONFIGS_r04.json >&2
-echo "[tpu-session] collect rc=$?" >&2
-
+collect_round "$OUT" tpu-session
 echo "[tpu-session] done; artifacts in $OUT" >&2

@@ -7,11 +7,14 @@
 #   1. five BASELINE configs at full scale (the VERDICT item-1 "done" bar)
 #   2. on-chip HPr physics at reference constants
 #   3. Pallas on-chip validation refresh (round-3 chip data already exists)
+# Idempotent per stage (see _session_lib.sh): refires skip captured
+# artifacts and re-run only what is missing.
 # SHORT=1 trims per-stage budgets for a late recovery (cannot collide with
 # the driver's own round-end bench).  Usage:
 #   bash scripts/tpu_bench_session_remainder.sh [outdir]
 set -u
 cd "$(dirname "$0")/.."
+. scripts/_session_lib.sh
 OUT="${1:-tpu_session_r04}"
 mkdir -p "$OUT"
 
@@ -30,27 +33,29 @@ timeout "$CFG_OUTER" python scripts/run_baseline_configs.py \
     --out "$OUT/configs_tpu.json" --full --timeout "$CFG_PER" --platform axon >&2
 echo "[tpu-remainder] configs rc=$?" >&2
 
-echo "[tpu-remainder] physics on chip (HPr at reference constants) ..." >&2
-GRAPHDYN_FORCE_PLATFORM=axon timeout "$PHYS" \
-    python scripts/physics_r04.py hpr "$OUT/physics_tpu.json" \
-    > "$OUT/physics_tpu.log" 2>&1
-echo "[tpu-remainder] physics rc=$?" >&2
-
-if [ "$VALIDATE" -gt 0 ]; then
-    echo "[tpu-remainder] pallas on-chip validation ..." >&2
-    GRAPHDYN_FORCE_PLATFORM=axon timeout "$VALIDATE" \
-        python scripts/pallas_tpu_validate.py \
-        > "$OUT/pallas_validate.log" 2>&1
-    rc=$?
-    echo "[tpu-remainder] pallas validate rc=$rc" >&2
-    [ $rc -eq 0 ] && cp -f PALLAS_TPU.json "$OUT/PALLAS_TPU.json"
+if json_ok "$OUT/physics_tpu.json"; then
+    echo "[tpu-remainder] physics already captured; skipping" >&2
+else
+    echo "[tpu-remainder] physics on chip (HPr at reference constants) ..." >&2
+    GRAPHDYN_FORCE_PLATFORM=axon timeout "$PHYS" \
+        python scripts/physics_r04.py hpr "$OUT/physics_tpu.json" \
+        > "$OUT/physics_tpu.log" 2>&1
+    echo "[tpu-remainder] physics rc=$?" >&2
 fi
 
-# Merge what this session captured into the round doc immediately: if the
-# watcher fired near round end, the driver commits the working tree as-is
-# and nobody may be around to run the collector by hand.
-echo "[tpu-remainder] merging artifacts into the round doc ..." >&2
-python scripts/collect_tpu_session.py "$OUT" BENCH_CONFIGS_r04.json >&2
-echo "[tpu-remainder] collect rc=$?" >&2
+if [ "$VALIDATE" -gt 0 ]; then
+    if json_ok "$OUT/PALLAS_TPU.json"; then
+        echo "[tpu-remainder] pallas validation already captured; skipping" >&2
+    else
+        echo "[tpu-remainder] pallas on-chip validation ..." >&2
+        GRAPHDYN_FORCE_PLATFORM=axon timeout "$VALIDATE" \
+            python scripts/pallas_tpu_validate.py \
+            > "$OUT/pallas_validate.log" 2>&1
+        rc=$?
+        echo "[tpu-remainder] pallas validate rc=$rc" >&2
+        [ $rc -eq 0 ] && cp -f PALLAS_TPU.json "$OUT/PALLAS_TPU.json"
+    fi
+fi
 
+collect_round "$OUT" tpu-remainder
 echo "[tpu-remainder] done; artifacts in $OUT" >&2

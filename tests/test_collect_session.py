@@ -1,0 +1,102 @@
+"""Merge contract of scripts/collect_tpu_session.py.
+
+The collector folds a chip-session output directory into the round's
+benchmark doc; it is the last hop between scarce chip measurements and the
+committed artifact, so its guards are pinned: never stamp 'captured' over
+an empty session, never let fallback-backend rates masquerade as chip
+numbers, and tolerate the partial files a wedge-killed session leaves.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "collect_tpu_session", os.path.join(ROOT, "scripts", "collect_tpu_session.py"))
+cts = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(cts)
+
+HEADLINE = {"metric": "spin_updates_per_sec_per_chip_d3_rrg_n1000000",
+            "value": 3.0e11, "unit": "spin-updates/s", "backend": "tpu"}
+
+
+@pytest.fixture
+def session(tmp_path):
+    sdir = tmp_path / "session"
+    sdir.mkdir()
+    doc_path = tmp_path / "bench_configs.json"
+    doc_path.write_text(json.dumps({"round": 4, "status": "smoke captured"}))
+    return sdir, str(doc_path)
+
+
+def _write_headline(sdir, row=HEADLINE):
+    (sdir / "bench_headline.json").write_text(json.dumps(row) + "\n")
+
+
+def test_merges_headline_and_stamps_idempotently(session):
+    sdir, doc_path = session
+    _write_headline(sdir)
+    assert cts.main(str(sdir), doc_path) == 0
+    doc = json.loads(open(doc_path).read())
+    assert doc["tpu_full"]["headline"]["value"] == 3.0e11
+    assert "tpu_full captured from session" in doc["status"]
+    # second merge must not duplicate the stamp
+    assert cts.main(str(sdir), doc_path) == 0
+    doc2 = json.loads(open(doc_path).read())
+    assert doc2["status"].count("tpu_full captured from session") == 1
+
+
+def test_refuses_empty_session(session):
+    sdir, doc_path = session
+    before = open(doc_path).read()
+    assert cts.main(str(sdir), doc_path) == 1
+    assert open(doc_path).read() == before
+
+
+def test_refuses_startup_flush_only_configs_doc(session):
+    """The aggregator writes a valid-but-empty doc before config 1 runs; a
+    session killed right there must not count as captured."""
+    sdir, doc_path = session
+    (sdir / "configs_tpu.json").write_text(json.dumps(
+        {"backend": "unknown", "mode": "full", "configs": [], "ok": False}))
+    before = open(doc_path).read()
+    assert cts.main(str(sdir), doc_path) == 1
+    assert open(doc_path).read() == before
+
+
+def test_warns_on_fallback_backend_headline_and_configs(session):
+    sdir, doc_path = session
+    _write_headline(sdir, {**HEADLINE, "backend": "cpu"})
+    (sdir / "configs_tpu.json").write_text(json.dumps(
+        {"backend": "cpu", "mode": "full", "ok": True,
+         "configs": [{"config": "config1_sa_rrg", "rc": 0, "metrics": [{}]}]}))
+    assert cts.main(str(sdir), doc_path) == 0
+    doc = json.loads(open(doc_path).read())
+    assert "NOT chip numbers" in doc["tpu_full"]["warning"]
+    assert "NOT chip numbers" in doc["tpu_full"]["configs_warning"]
+
+
+def test_chip_backends_do_not_warn(session):
+    sdir, doc_path = session
+    _write_headline(sdir)
+    (sdir / "configs_tpu.json").write_text(json.dumps(
+        {"backend": "axon", "mode": "full", "ok": True,
+         "configs": [{"config": "config1_sa_rrg", "rc": 0, "metrics": [{}]}]}))
+    assert cts.main(str(sdir), doc_path) == 0
+    doc = json.loads(open(doc_path).read())
+    assert "warning" not in doc["tpu_full"]
+    assert "configs_warning" not in doc["tpu_full"]
+
+
+def test_truncated_physics_recorded_without_killing_merge(session):
+    sdir, doc_path = session
+    _write_headline(sdir)
+    (sdir / "physics_tpu.json").write_text('{"m_final": 1.0, "sw')  # cut mid-dump
+    assert cts.main(str(sdir), doc_path) == 0
+    doc = json.loads(open(doc_path).read())
+    assert "unparseable physics_tpu.json" in doc["tpu_full"]["physics_error"]
+    assert doc["tpu_full"]["headline"]["value"] == 3.0e11
