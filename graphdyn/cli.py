@@ -138,6 +138,12 @@ def build_parser() -> argparse.ArgumentParser:
     ent.add_argument("--damp", type=float, default=0.1)
     ent.add_argument("--max-sweeps", type=int, default=1300)
     ent.add_argument("--ent-floor", type=float, default=-0.05)
+    ent.add_argument(
+        "--plateau-eps", type=float, default=0.0,
+        help="stop the ladder when (m_init, ent1) move less than this for "
+        "--plateau-patience consecutive lambda (0 = off, reference behavior; "
+        "useful at p+c>=3 where the curve floors at positive ent1)")
+    ent.add_argument("--plateau-patience", type=int, default=3)
     ent.add_argument("--num-rep", type=int, default=3)
     ent.add_argument("--seed", type=int, default=0)
     ent.add_argument("--verbose", action="store_true")
@@ -317,6 +323,8 @@ def main(argv=None) -> int:
             lmbd_max=args.lmbd_max, lmbd_step=args.lmbd_step,
             eps=args.eps, damp=args.damp, max_sweeps=args.max_sweeps,
             ent_floor=args.ent_floor, num_rep=args.num_rep,
+            plateau_eps=args.plateau_eps,
+            plateau_patience=args.plateau_patience,
             dtype=args.dtype,
         )
         if args.union is not None:

@@ -87,6 +87,12 @@ class EntropyConfig:
     eps_clamp: float = 0.0      # epsilon floor for Z and chi (`ipynb:473`)
     max_sweeps: int = 1300      # T_max (`ipynb:478`)
     ent_floor: float = -0.05    # early-exit threshold (`ipynb:446`)
+    plateau_eps: float = 0.0    # opt-in: stop the ladder when (m_init, ent1)
+                                # change less than this for plateau_patience
+                                # consecutive λ (0 = off, reference behavior;
+                                # T>=3 curves floor at positive ent1 where the
+                                # reference's ent_floor exit never fires)
+    plateau_patience: int = 3
     num_rep: int = 3
     seed: int = 0
     dtype: str = "float32"      # 'float64' matches the reference's precision

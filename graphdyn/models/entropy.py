@@ -123,15 +123,38 @@ def _run_ladder(
     checkpoint_meta: dict | None = None,
     checkpoint_extra_arrays: dict | None = None,
     verbose: bool = False,
+    plateau_eps: float = 0.0,
+    plateau_patience: int = 3,
+    prev_rows=None,
 ):
     """The shared λ-ladder loop (`ipynb:394-451` semantics) used by every
     entropy solver: leaf write → warm-started fixed point → observables →
     Legendre transform → checkpoint → early exits. ``observe(chi, lm)``
     returns (φ, m_init) as scalars or per-member arrays; ``stop_fn(e1)``
-    decides the entropy-floor exit. Returns
-    ``(visited, ents, m_inits, ent1s, sweeps, nonconverged, chi)``."""
+    decides the entropy-floor exit. ``plateau_eps > 0`` adds an opt-in
+    exit: stop when every member's (m_init, ent1) moved less than
+    plateau_eps for plateau_patience consecutive λ — T>=3 curves floor at
+    positive ent1, where the reference's ent_floor exit never fires and
+    the remaining ladder re-converges an unchanged fixed point.
+    ``prev_rows = (m_init_rows, ent1_rows)`` is the already-visited prefix
+    when resuming a λ subset: the plateau streak is reconstructed from it
+    so a resumed run exits at exactly the λ an uninterrupted run would.
+    Returns ``(visited, ents, m_inits, ent1s, sweeps, nonconverged, chi)``."""
     ents, m_inits, ent1s, sweeps, visited = [], [], [], [], []
     nonconverged = 0.0
+    plateau_patience = max(1, int(plateau_patience))  # 0/negative would
+    plateau_streak = 0                                # exit unconditionally
+    prev_m = prev_e = None
+    if plateau_eps > 0 and prev_rows is not None and len(prev_rows[0]) > 0:
+        pm, pe = (np.asarray(r) for r in prev_rows)
+        for i in range(1, len(pm)):
+            moved = max(float(np.max(np.abs(pm[i] - pm[i - 1]))),
+                        float(np.max(np.abs(pe[i] - pe[i - 1]))))
+            plateau_streak = plateau_streak + 1 if moved < plateau_eps else 0
+        prev_m, prev_e = pm[-1], pe[-1]
+        if plateau_streak >= plateau_patience:
+            # the uninterrupted run had already exited inside the prefix
+            return visited, ents, m_inits, ent1s, sweeps, nonconverged, chi
     for lmbd in lambdas:
         lm = jnp.asarray(lmbd, dtype)
         chi = set_leaves(chi, lm)
@@ -168,6 +191,22 @@ def _run_ladder(
             )
         if stop_fn(e1) or failed:
             break
+        if plateau_eps > 0:
+            if prev_m is not None:
+                moved = max(
+                    float(np.max(np.abs(m0 - prev_m))),
+                    float(np.max(np.abs(e1 - prev_e))),
+                )
+                plateau_streak = (
+                    plateau_streak + 1 if moved < plateau_eps else 0
+                )
+                if plateau_streak >= plateau_patience:
+                    if verbose:
+                        print(f"plateau exit at lambda={lmbd:.2f} "
+                              f"(<{plateau_eps:g} movement for "
+                              f"{plateau_patience} consecutive lambda)")
+                    break
+            prev_m, prev_e = m0, e1
     return visited, ents, m_inits, ent1s, sweeps, nonconverged, chi
 
 
@@ -182,6 +221,7 @@ def entropy_sweep(
     verbose: bool = False,
     checkpointer=None,
     class_bucket: int | None = None,
+    prev_rows=None,
 ) -> EntropyResult:
     """Run the λ ladder on one graph instance.
 
@@ -199,7 +239,10 @@ def entropy_sweep(
     — the notebook's time-triggered intermediate-save sketch
     (`ipynb:439-445,475-476`) made live: after each λ the warm-start state
     (chi) and the results so far are offered for saving; resume by passing the
-    restored ``chi`` as ``chi0`` and the remaining ladder as ``lambdas``.
+    restored ``chi`` as ``chi0`` and the remaining ladder as ``lambdas``
+    (plus, when ``config.plateau_eps > 0``, the visited prefix's
+    ``(m_init, ent1)`` rows as ``prev_rows`` so the plateau streak resumes
+    where it left off).
     """
     config = config or EntropyConfig()
     dyn = config.dynamics
@@ -240,6 +283,9 @@ def entropy_sweep(
         checkpointer=checkpointer,
         checkpoint_meta={"seed": seed},
         verbose=verbose,
+        plateau_eps=config.plateau_eps,
+        plateau_patience=config.plateau_patience,
+        prev_rows=prev_rows,
     )
     return EntropyResult(
         lambdas=np.array(visited),
@@ -289,10 +335,13 @@ def _run_managed_ladder(
     (snapshots carry the already-stitched earlier segments as ``prev_*``),
     and removal on completion.
 
-    ``ladder_fn(lambdas_rest, chi, checkpointer, meta, extra_arrays)`` runs
-    the solver-specific :func:`_run_ladder` call and returns its 7-tuple;
-    ``chi_init()`` builds the cold-start messages. Returns ``(rows dict,
-    nonconverged, chi)`` with rows keyed by :data:`_LADDER_ROW_KEYS`.
+    ``ladder_fn(lambdas_rest, chi, checkpointer, meta, extra_arrays,
+    prev_rows)`` runs the solver-specific :func:`_run_ladder` call and
+    returns its 7-tuple — ``prev_rows`` is ``(m_init_rows, ent1_rows)`` of
+    the restored prefix (None on a cold start) so the plateau streak
+    survives the resume boundary; ``chi_init()`` builds the cold-start
+    messages. Returns ``(rows dict, nonconverged, chi)`` with rows keyed
+    by :data:`_LADDER_ROW_KEYS`.
     """
     from graphdyn.utils.io import PeriodicCheckpointer, load_validated
 
@@ -330,12 +379,19 @@ def _run_managed_ladder(
             **(extra_arrays or {}),
             **({f"prev_{k}": v for k, v in pre.items()} if pre is not None else {}),
         },
+        (pre["m_init"], pre["ent1"]) if pre is not None else None,
     )
     checkpointer.remove()
 
     rows, nonconverged, chi = _ladder_rows(out)
     if pre is not None:
-        rows = {k: np.concatenate([pre[k], rows[k]]) for k in rows}
+        if rows["lambdas"].size == 0:
+            # resumed past the run's own exit (e.g. a plateau streak that
+            # completed inside the prefix): nothing new to stitch, and the
+            # empty 1-D segment must not be concatenated onto 2-D prefix rows
+            rows = pre
+        else:
+            rows = {k: np.concatenate([pre[k], rows[k]]) for k in rows}
     return rows, nonconverged, chi
 
 
@@ -450,7 +506,7 @@ def entropy_ensemble(
                 f"{shards} shards) — pad the ensemble or shrink the mesh"
             )
 
-    def ladder_fn(lam, chi, ck, meta, xtra):
+    def ladder_fn(lam, chi, ck, meta, xtra, prev_rows=None):
         if mesh is not None:
             # placed here (not in chi_init) so a checkpoint-restored warm
             # start is re-placed on the mesh too
@@ -469,6 +525,9 @@ def entropy_ensemble(
             checkpointer=ck,
             checkpoint_meta=meta,
             checkpoint_extra_arrays=xtra,
+            plateau_eps=config.plateau_eps,
+            plateau_patience=config.plateau_patience,
+            prev_rows=prev_rows,
         )
 
     if checkpoint_path is not None:
@@ -695,7 +754,7 @@ def entropy_ensemble_union(
             else jnp.asarray(chi0, data.dtype)
         )
 
-    def ladder_fn(lam, chi, ck, meta, xtra):
+    def ladder_fn(lam, chi, ck, meta, xtra, prev_rows=None):
         return _run_ladder(
             lam, chi, data.dtype,
             set_leaves=set_leaves,
@@ -707,6 +766,9 @@ def entropy_ensemble_union(
             checkpoint_meta=meta,
             checkpoint_extra_arrays=xtra,
             verbose=verbose,
+            plateau_eps=config.plateau_eps,
+            plateau_patience=config.plateau_patience,
+            prev_rows=prev_rows,
         )
 
     if managed:
@@ -909,6 +971,10 @@ def entropy_grid(
             res = entropy_sweep(
                 g, config, seed=gseed, lambdas=lambdas[k0:], chi0=chi0,
                 verbose=verbose, checkpointer=ck, class_bucket=class_bucket,
+                # restored prefix rows keep the plateau streak (if enabled)
+                # identical to an uninterrupted run's
+                prev_rows=(m_init[di, rep, :k0], ent1[di, rep, :k0])
+                if k0 > 0 else None,
             )
             k = res.lambdas.size
             sl = slice(k0, k0 + k)

@@ -21,19 +21,46 @@ from typing import Any
 import numpy as np
 
 
+def _fingerprint_repr(p) -> str:
+    """``repr`` for fingerprinting. For config dataclasses, fields named in
+    the class's ``_fingerprint_optional`` tuple are omitted when they hold
+    their default value — so ADDING an opt-in field (at a
+    semantics-preserving default) does not invalidate checkpoints written
+    before the field existed. At the defaults the produced string is
+    byte-identical to the pre-field dataclass repr."""
+    import dataclasses
+
+    if dataclasses.is_dataclass(p) and not isinstance(p, type):
+        skip = getattr(p, "_fingerprint_optional", ())
+        parts = []
+        for f in dataclasses.fields(p):
+            v = getattr(p, f.name)
+            if f.name in skip:
+                if f.default is not dataclasses.MISSING and v == f.default:
+                    continue
+                if (f.default_factory is not dataclasses.MISSING
+                        and v == f.default_factory()):
+                    continue
+            parts.append(f"{f.name}={_fingerprint_repr(v)}")
+        return f"{type(p).__name__}({', '.join(parts)})"
+    return repr(p)
+
+
 def run_fingerprint(*parts) -> str:
     """Stable hex digest identifying a solver run's full identity — graph
     arrays hash by bytes+shape, everything else by ``repr`` (config
-    dataclasses have stable field reprs). Stored in chain-checkpoint
-    metadata so a resume under a different graph, config, dtype, or budget
-    is refused instead of silently producing a chimera chain."""
+    dataclasses have stable field reprs; opt-in fields at their defaults
+    are excluded, see :func:`_fingerprint_repr`). Stored in
+    chain-checkpoint metadata so a resume under a different graph, config,
+    dtype, or budget is refused instead of silently producing a chimera
+    chain."""
     h = hashlib.sha1()
     for p in parts:
         if isinstance(p, np.ndarray):
             h.update(str(p.shape).encode())
             h.update(np.ascontiguousarray(p).tobytes())
         else:
-            h.update(repr(p).encode())
+            h.update(_fingerprint_repr(p).encode())
         h.update(b"|")
     return h.hexdigest()
 

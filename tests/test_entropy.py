@@ -457,3 +457,58 @@ def test_golden_f64_artifact_reproducible():
         jax.config.update("jax_enable_x64", False)
     np.testing.assert_allclose(res.m_init, row["m_init"][:10], rtol=0, atol=1e-9)
     np.testing.assert_allclose(res.ent1, row["ent1"][:10], rtol=0, atol=1e-9)
+
+
+def test_plateau_exit_opt_in():
+    """plateau_eps > 0 stops the ladder after `patience` consecutive
+    unmoved lambda points; the visited prefix is bit-identical to the
+    reference-behavior (plateau_eps=0) run. Motivation: T>=3 curves floor
+    at positive ent1, where the reference's ent_floor exit never fires."""
+    edges = np.array([[0, 1], [1, 2], [2, 3], [3, 0], [0, 4]])
+    g = graph_from_edges(5, edges)
+    base = EntropyConfig(lmbd_max=5.0, lmbd_step=0.5, ent_floor=-1e9)
+    full = entropy_sweep(g, base, seed=0)
+    # an "everything counts as a plateau" tolerance: exits after the first
+    # ladder point with two consecutive unmoved successors
+    cfg = EntropyConfig(lmbd_max=5.0, lmbd_step=0.5, ent_floor=-1e9,
+                        plateau_eps=1e9, plateau_patience=2)
+    res = entropy_sweep(g, cfg, seed=0)
+    assert res.lambdas.size == 3  # lambda 0 + 2 plateau-streak points
+    np.testing.assert_array_equal(res.lambdas, full.lambdas[:3])
+    np.testing.assert_array_equal(res.m_init, full.m_init[:3])
+    np.testing.assert_array_equal(res.ent1, full.ent1[:3])
+    # default config keeps the reference behavior: the full ladder is
+    # visited (11 points for lmbd_max=5, step=0.5) unless a fixed point
+    # failed first
+    assert base.plateau_eps == 0.0
+    assert full.lambdas.size == 11 or full.nonconverged > 0
+
+
+def test_plateau_streak_resume_invariant():
+    """Splitting the ladder (chi + prev_rows handoff, the checkpoint-resume
+    shape) visits exactly the same λ set as the uninterrupted run — the
+    plateau streak must not reset at the resume boundary."""
+    edges = np.array([[0, 1], [1, 2], [2, 3], [3, 0], [0, 4]])
+    g = graph_from_edges(5, edges)
+    cfg = EntropyConfig(lmbd_max=5.0, lmbd_step=0.5, ent_floor=-1e9,
+                        plateau_eps=1e9, plateau_patience=2)
+    full = entropy_sweep(g, cfg, seed=0)
+    assert full.lambdas.size == 3  # plateau exit fired
+
+    lambdas = np.linspace(0.0, 5.0, 11)
+    # interrupt after 2 points (streak = 1), resume the rest
+    first = entropy_sweep(g, cfg, seed=0, lambdas=lambdas[:2])
+    rest = entropy_sweep(
+        g, cfg, seed=0, lambdas=lambdas[2:], chi0=first.chi,
+        prev_rows=(first.m_init, first.ent1),
+    )
+    stitched = np.concatenate([first.lambdas, rest.lambdas])
+    np.testing.assert_array_equal(stitched, full.lambdas)
+    # interrupt INSIDE a completed streak: the resumed call must visit
+    # nothing (the uninterrupted run had already exited)
+    first3 = entropy_sweep(g, cfg, seed=0, lambdas=lambdas[:3])
+    rest3 = entropy_sweep(
+        g, cfg, seed=0, lambdas=lambdas[3:], chi0=first3.chi,
+        prev_rows=(first3.m_init, first3.ent1),
+    )
+    assert rest3.lambdas.size == 0
