@@ -107,11 +107,19 @@ def torch_cpu_rate(g, steps=3):
     return g.n * steps / (time.perf_counter() - t0)
 
 
-def _init_watchdog(metric: str, timeout_s: float = 300.0):
-    """Fail loudly if device initialization hangs (e.g. an unreachable TPU
-    tunnel blocks `import jax` indefinitely): after ``timeout_s`` without the
-    armed flag being cleared, print a one-line error JSON and hard-exit so
-    the driver records a diagnosable value instead of a timeout."""
+def _init_watchdog(timeout_s: float = 300.0, allow_cpu_fallback: bool = True):
+    """Backstop for a relay that wedges *between* the successful probe and
+    the in-process init: after ``timeout_s`` without the armed flag being
+    cleared, re-exec this process with the platform forced to CPU so the
+    driver still records a real (fallback-labeled) number instead of a
+    timeout. A second wedge with the CPU force already applied cannot
+    happen (CPU init does not touch the tunnel), but the re-exec guard
+    below keeps even that path loop-free.
+
+    ``allow_cpu_fallback=False`` (caller explicitly forced a platform, e.g.
+    the chip session's GRAPHDYN_FORCE_PLATFORM=axon chip-or-hang contract):
+    on timeout, emit an error row and exit 2 instead of silently producing
+    CPU rates the caller asked to never get."""
     import os
     import threading
 
@@ -119,17 +127,23 @@ def _init_watchdog(metric: str, timeout_s: float = 300.0):
 
     def watch():
         if not done.wait(timeout_s):
+            if allow_cpu_fallback and not os.environ.get("BENCH_CPU_REEXEC"):
+                _mark(f"in-process device init hung {timeout_s:.0f}s after a "
+                      "successful probe; re-exec with CPU fallback")
+                os.environ["BENCH_CPU_REEXEC"] = "1"
+                os.environ["GRAPHDYN_FORCE_PLATFORM"] = "cpu"
+                os.execv(sys.executable, [sys.executable] + sys.argv)
             print(
-                json.dumps(
-                    {
-                        "metric": metric,
-                        "value": 0.0,
-                        "unit": "spin-updates/s",
-                        "vs_baseline": 0.0,
-                        "error": "device initialization timed out "
-                                 f"after {timeout_s:.0f}s (TPU unreachable?)",
-                    }
-                ),
+                json.dumps({
+                    "metric": "spin_updates_per_sec_per_chip_d3_rrg",
+                    "value": 0.0,
+                    "unit": "spin-updates/s",
+                    "vs_baseline": 0.0,
+                    "error": ("device init hung even under CPU force"
+                              if allow_cpu_fallback else
+                              f"device init hung {timeout_s:.0f}s under an "
+                              "explicitly forced platform (chip-or-hang)"),
+                }),
                 flush=True,
             )
             os._exit(2)
@@ -144,7 +158,30 @@ def main():
     ap.add_argument("--smoke", action="store_true", help="small shapes, fast")
     args = ap.parse_args()
 
-    init_done = _init_watchdog("spin_updates_per_sec_per_chip_d3_rrg")
+    import os
+
+    # Probe-before-init: a single long wait on a wedged relay loses the
+    # capture (BENCH_r01/r03/r04 all recorded 0.0 that way) while the relay
+    # demonstrably recovers in minutes-long windows. Probe in subprocesses
+    # until the budget is spent; if the relay never answers, fall back to
+    # CPU so a real, honestly-labeled number lands instead of an error row.
+    # An explicit GRAPHDYN_FORCE_PLATFORM skips the probe: 'cpu' cannot
+    # hang, and 'axon' means the caller (the chip-session watcher, which
+    # fires only on a canary UP) wants chip-or-hang semantics.
+    relay_note = None
+    explicit_force = bool(os.environ.get("GRAPHDYN_FORCE_PLATFORM"))
+    if os.environ.get("BENCH_CPU_REEXEC"):
+        # we are the post-wedge re-exec: the force var was set by the
+        # watchdog, not the caller
+        explicit_force = False
+        relay_note = ("relay wedged between probe and init; "
+                      "rates below are a CPU fallback, NOT chip numbers")
+    else:
+        from benchmarks.common import probe_or_cpu_fallback
+
+        relay_note = probe_or_cpu_fallback()   # no-op under an explicit force
+
+    init_done = _init_watchdog(allow_cpu_fallback=not explicit_force)
     import benchmarks.common  # noqa: F401 — applies GRAPHDYN_FORCE_PLATFORM
     import jax
 
@@ -189,6 +226,7 @@ def main():
             **partial,
             "packed_rate_wide_by_R": wide_by_R,
             "backend": jax.default_backend(),
+            **({"relay": relay_note} if relay_note else {}),
         }))
         return 0 if best > 0 else 2
 
@@ -218,10 +256,14 @@ def main():
     # amortizing with row size. So keep widening until OOM or the rate
     # rolls over: R = 4x and 8x the base (2 GB and 4 GB spin state at
     # n=1e6; each rung skipped on OOM rather than guessed).
-    rate_wide, R_wide = 0.0, 4 * R_packed
+    rate_wide, R_wide = 0.0, 0   # R_wide tracks only *measured* rungs
     from benchmarks.common import is_oom
 
-    for mult in (4, 8):
+    on_chip = jax.default_backend() == "tpu"
+    # Widening is an HBM per-row-amortization lever; on the CPU fallback it
+    # only burns minutes on host caches — chip-only. The 16x rung (W=2048,
+    # 8 GB spin state) probes past the r04-measured W=512 point; OOM skips.
+    for mult in (4, 8, 16) if on_chip else ():
         R_try = mult * R_packed
         try:
             r = packed_rate(g_bfs, R_try, max(steps // mult, 2))
@@ -245,7 +287,7 @@ def main():
     # even if the session watcher never fires. Chip-only (interpret mode is
     # not a rate); failure here must not cost the XLA rows
     rate_pallas = 0.0
-    if jax.default_backend() == "tpu":
+    if on_chip:
         try:
             rate_pallas = packed_rate(g_bfs, R_packed, steps, kernel="pallas")
         except Exception as e:  # noqa: BLE001 — optional row
@@ -278,7 +320,9 @@ def main():
                 "packed_rate_wide": rate_wide,
                 "packed_rate_wide_by_R": wide_by_R,
                 "packed_rate_pallas": rate_pallas,
-                "packed_replicas_wide": R_wide,
+                # only when a rung actually ran — R_wide=0 otherwise (a
+                # never-measured configuration must not report a count)
+                **({"packed_replicas_wide": R_wide} if wide_by_R else {}),
                 "int8_rate": v8,
                 "torch_cpu_rate": base,
                 "packed_replicas": R_packed,
@@ -292,9 +336,10 @@ def main():
                 # working set is partly cache-resident, not HBM-streaming
                 **(
                     {"roofline_fraction_v5e": value / 1.6e12}
-                    if not args.smoke else {}
+                    if not args.smoke and on_chip else {}
                 ),
                 "backend": jax.default_backend(),
+                **({"relay": relay_note} if relay_note else {}),
             }
         )
     )

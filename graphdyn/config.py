@@ -95,6 +95,11 @@ class EntropyConfig:
     plateau_patience: int = 3
     num_rep: int = 3
     seed: int = 0
+    # checkpoint-fingerprint opt-in fields (graphdyn.utils.io._fingerprint_repr):
+    # omitted from the fingerprint at their defaults, so checkpoints written
+    # before these fields existed still resume; declared here because the
+    # mechanism keys off THIS attribute — without it the skip is dead code
+    _fingerprint_optional = ("plateau_eps", "plateau_patience")
     dtype: str = "float32"      # 'float64' matches the reference's precision
                                 # (numpy default / `HPR_pytorch_RRG.py:11`);
                                 # requires jax_enable_x64

@@ -204,6 +204,99 @@ def packed_consensus_fraction(sp, n_replicas: int, target: int = 1) -> float:
     return float(bits.reshape(-1)[:n_replicas].sum()) / n_replicas
 
 
+def draw_packed_biased(seed: int, n: int, W: int, m0: float) -> jnp.ndarray:
+    """uint32[n, W] packed spins drawn ON DEVICE with initial magnetization
+    bias: each bit is +1 (set) independently with probability (1+m0)/2, so
+    E[m(0)] = m0 per replica — the biased-initialization axis of the thesis
+    question (`ER_BDCM_entropy.ipynb:113-123`: which m(0) flow to consensus).
+    Device-resident for the same reason as ``benchmarks.common.draw_u32``:
+    host→device state uploads are what the tunneled TPU link cannot sustain.
+    """
+    def f():
+        bits = jax.random.bernoulli(
+            jax.random.key(seed), (1.0 + m0) / 2.0, (n, W, WORD)
+        )
+        shifts = jnp.arange(WORD, dtype=jnp.uint32)
+        return (bits.astype(jnp.uint32) << shifts).sum(axis=2).astype(jnp.uint32)
+
+    return jax.jit(f)()
+
+
+def _consensus_bits(sp: jnp.ndarray, R: int) -> jnp.ndarray:
+    """bool[R]: replica at EITHER homogeneous state (+1 all-ones column or
+    −1 all-zeros column), straight from the packed domain."""
+    up = lax.reduce(sp, np.uint32(_FULL), lax.bitwise_and, dimensions=(0,))
+    down = ~lax.reduce(sp, np.uint32(0), lax.bitwise_or, dimensions=(0,))
+    flags = up | down                                   # uint32[W]
+    bits = (flags[:, None] >> jnp.arange(WORD, dtype=jnp.uint32)) & 1
+    return bits.reshape(-1)[:R].astype(bool)
+
+
+def _replica_magnetization(sp: jnp.ndarray, R: int) -> jnp.ndarray:
+    """float32[R]: per-replica magnetization m_r = (2·cnt_r − n)/n where
+    cnt_r counts +1 spins down replica r's bit column. The [n, W, 32]
+    bit expansion fuses into the sum — no unpacked state in HBM."""
+    n = sp.shape[0]
+    shifts = jnp.arange(WORD, dtype=jnp.uint32)
+    cnt = ((sp[:, :, None] >> shifts) & 1).astype(jnp.int32).sum(axis=0)
+    cnt = cnt.reshape(-1)[:R]
+    return (2.0 * cnt - n) / n
+
+
+@partial(jax.jit, static_argnames=(
+    "R", "max_steps", "chunk", "near_eps", "rule", "tie"))
+def packed_consensus_scan(nbr, deg, sp, R: int, max_steps: int,
+                          chunk: int = 10, near_eps: float = 0.01,
+                          rule: str = "majority", tie: str = "stay"):
+    """Roll packed replicas until every one has (near-)reached consensus or
+    ``max_steps`` is spent, recording per-replica first-passage steps — the
+    opinion-consensus observable (`SURVEY.md` §0.3: which initializations
+    flow to consensus) in one device program, no host round-trips.
+
+    Runs in ``chunk``-step slabs (first-passage resolution = chunk); after
+    each slab two per-replica flags update:
+
+    - ``strict``: bit column homogeneous (AND/OR word reductions), i.e. the
+      absorbing all-+1/all-−1 state;
+    - ``near``: |m_r| ≥ 1 − near_eps — robust to the O(1) frozen/blinking
+      small components of a sparse ER graph, which block strict consensus
+      at a rate set by component statistics rather than by the dynamics
+      under study.
+
+    The loop exits early once every replica is near-consensus (strict
+    implies near). Returns a dict of final state and per-replica
+    ``(strict, strict_step, near, near_step, m_final)``; unreached
+    first-passage steps are −1.
+    """
+    def slab(carry):
+        sp, t, strict, strict_t, near, near_t = carry
+        sp = packed_rollout(nbr, deg, sp, chunk, rule, tie)
+        t = t + chunk
+        s_now = _consensus_bits(sp, R)
+        m = _replica_magnetization(sp, R)
+        n_now = jnp.abs(m) >= 1.0 - near_eps
+        strict_t = jnp.where(s_now & ~strict, t, strict_t)
+        near_t = jnp.where(n_now & ~near, t, near_t)
+        return sp, t, strict | s_now, strict_t, near | n_now, near_t
+
+    def cond(carry):
+        _, t, _, _, near, _ = carry
+        return (t < max_steps) & ~jnp.all(near)
+
+    init = (
+        sp, jnp.int32(0),
+        jnp.zeros((R,), bool), jnp.full((R,), -1, jnp.int32),
+        jnp.zeros((R,), bool), jnp.full((R,), -1, jnp.int32),
+    )
+    sp, t, strict, strict_t, near, near_t = lax.while_loop(cond, slab, init)
+    return {
+        "sp": sp, "steps_run": t,
+        "strict": strict, "strict_step": strict_t,
+        "near": near, "near_step": near_t,
+        "m_final": _replica_magnetization(sp, R),
+    }
+
+
 def packed_end_state(graph, s, steps, rule="majority", tie="stay"):
     """Convenience wrapper: int8[R, n] in/out through the packed kernel."""
     sp = pack_spins(s)

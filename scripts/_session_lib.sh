@@ -7,7 +7,7 @@
 
 # ROUND_DOC: the benchmark doc all sessions merge into (one place to bump
 # per round instead of editing every session script).
-ROUND_DOC="${ROUND_DOC:-BENCH_CONFIGS_r04.json}"
+ROUND_DOC="${ROUND_DOC:-BENCH_CONFIGS_r05.json}"
 
 # json_ok FILE — file exists and parses as JSON
 json_ok() {
@@ -17,14 +17,17 @@ json.load(open(sys.argv[1]))
 EOF
 }
 
-# headline_ok FILE — bench headline parses AND carries a real rate (a
-# failed bench emits an error JSON with value 0.0, which a refire should
-# replace)
+# headline_ok FILE — bench headline parses, carries a real rate, AND is a
+# CHIP measurement (a failed bench emits an error JSON with value 0.0; a
+# wedged-relay bench may emit a nonzero CPU-fallback row with
+# backend != tpu — a refire into a recovered relay must replace both)
 headline_ok() {
     python - "$1" >/dev/null 2>&1 <<'EOF'
 import json, sys
 d = json.load(open(sys.argv[1]))
 assert d.get("value", 0) > 0
+assert d.get("backend") in ("tpu", "axon")
+assert "relay" not in d
 EOF
 }
 

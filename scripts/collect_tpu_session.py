@@ -28,6 +28,14 @@ _rbc = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(_rbc)
 json_lines = _rbc.json_lines
 
+# One shared backend allowlist for BOTH the headline and the configs guards
+# (ADVICE r04: the two guards had drifted apart). 'axon' is the tunneled-TPU
+# plugin; jax reports its backend as 'tpu', but configs docs written by the
+# aggregator may record either name. Unknown/missing metadata is a soft note,
+# never the fallback warning — a failed probe is not evidence of a fallback.
+CHIP_BACKENDS = ("tpu", "axon")
+UNKNOWN_BACKENDS = (None, "unknown")
+
 
 def read_json_lines(path):
     if not os.path.exists(path):
@@ -36,7 +44,7 @@ def read_json_lines(path):
         return json_lines(f.read())
 
 
-def main(session_dir, bench_configs="BENCH_CONFIGS_r04.json"):
+def main(session_dir, bench_configs="BENCH_CONFIGS_r05.json"):
     session_dir = os.path.normpath(session_dir)
     out = {}
 
@@ -44,11 +52,15 @@ def main(session_dir, bench_configs="BENCH_CONFIGS_r04.json"):
     if head:
         out["headline"] = head[-1]
         backend = out["headline"].get("backend")
-        if backend and backend != "tpu":
+        if backend in UNKNOWN_BACKENDS:
+            # metadata missing/probe failed: not a fallback, but say so
+            out["headline_note"] = "headline backend unknown (no metadata)"
+        elif backend not in CHIP_BACKENDS:
             # a wedged-relay CPU fallback must not masquerade as chip data
             out["warning"] = (
-                f"headline backend is {backend!r}, not 'tpu' — the session "
-                "ran on a fallback backend; rates are NOT chip numbers"
+                f"headline backend is {backend!r}, not the chip — the "
+                "session ran on a fallback backend; rates are NOT chip "
+                "numbers"
             )
 
     cfg_path = os.path.join(session_dir, "configs_tpu.json")
@@ -58,7 +70,12 @@ def main(session_dir, bench_configs="BENCH_CONFIGS_r04.json"):
                 out["configs"] = json.load(f)
             if isinstance(out["configs"], dict):
                 cfg_backend = out["configs"].get("backend")
-                if cfg_backend not in (None, "unknown", "tpu", "axon"):
+                if cfg_backend in UNKNOWN_BACKENDS:
+                    # metadata probe failed — keep that visible without the
+                    # fallback warning (the rates may well be chip numbers)
+                    out["configs_note"] = ("configs backend unknown "
+                                           "(metadata probe failed)")
+                elif cfg_backend not in CHIP_BACKENDS:
                     # same guard as the headline: a fallback backend's config
                     # rates must not merge into the round doc as chip numbers
                     out["configs_warning"] = (

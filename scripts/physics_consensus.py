@@ -1,0 +1,111 @@
+#!/usr/bin/env python3
+"""ER-majority opinion-consensus physics: consensus fraction and
+first-passage time vs initial magnetization m(0).
+
+The thesis objective (SURVEY.md §0.3) is finding initializations that flow
+to opinion consensus; the reference's entropy curves (`ER_BDCM_entropy.ipynb`)
+quantify the attractor landscape those initializations must escape. This
+script measures the forward-dynamics side of that story on the BASELINE
+config-3 ensemble — ER G(N, 6/N), majority rule, packed replicas — and
+writes a json + png artifact (VERDICT r04 next-step 5).
+
+Usage:
+  python scripts/physics_consensus.py OUT_JSON [OUT_PNG] [--full]
+
+CPU smoke by default shapes; --full is the BASELINE N=1e5, R=512 shape
+(chip-sized but CPU-feasible). Platform selection via GRAPHDYN_FORCE_PLATFORM
+(applied by benchmarks.common import).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+import benchmarks.common  # noqa: F401 — repo root + platform forcing
+
+M0_GRID = (0.0, 0.01, 0.02, 0.03, 0.05, 0.07, 0.1, 0.15, 0.2, 0.3)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("out_json")
+    ap.add_argument("out_png", nargs="?", default=None)
+    ap.add_argument("--full", action="store_true")
+    a = ap.parse_args()
+
+    # the same wedge protection as bench.py: an unforced run on a wedged
+    # relay would otherwise hang forever in jax init and write NO artifact
+    from benchmarks.common import probe_or_cpu_fallback
+
+    relay_note = probe_or_cpu_fallback()
+
+    import jax
+
+    from benchmarks.config3_er_majority import consensus_curve, consensus_ensemble
+
+    n, R, max_steps = (100_000, 512, 2000) if a.full else (20_000, 128, 500)
+    g, n_iso, nbr_dev, deg_dev = consensus_ensemble(n)
+    t0 = time.time()
+
+    def progress(pt):
+        print(f"m0={pt['m0']:g}: consensus={pt['consensus_fraction']:.3f} "
+              f"strict={pt['strict_fraction']:.3f} "
+              f"steps={pt['mean_steps_to_consensus']} "
+              f"|m_f|={pt['mean_abs_m_final']:.3f}", flush=True)
+
+    rows = consensus_curve(g, R, M0_GRID, max_steps, chunk=10,
+                           nbr_dev=nbr_dev, deg_dev=deg_dev,
+                           progress=progress)
+
+    doc = {
+        "what": "ER-majority consensus fraction & first-passage vs m(0)",
+        "graph": {"kind": "erdos_renyi", "n": g.n, "c": 6.0,
+                  "isolates_removed": n_iso, "seed": 0},
+        "dynamics": {"rule": "majority", "tie": "stay",
+                     "update": "parallel/synchronous"},
+        "near_consensus_def": "|m_final| >= 0.99",
+        "backend": jax.default_backend(),
+        "elapsed_s": round(time.time() - t0, 1),
+        "rows": rows,
+        **({"relay": relay_note} if relay_note else {}),
+    }
+    with open(a.out_json, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"wrote {a.out_json} (backend={doc['backend']})")
+
+    if a.out_png:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        m0s = [r["m0"] for r in rows]
+        fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(9.2, 3.6))
+        ax1.plot(m0s, [r["consensus_fraction"] for r in rows],
+                 "o-", label="near (|m|≥0.99)")
+        ax1.plot(m0s, [r["strict_fraction"] for r in rows],
+                 "s--", label="strict (all equal)")
+        ax1.set_xlabel("initial magnetization m(0)")
+        ax1.set_ylabel("consensus fraction")
+        ax1.set_ylim(-0.05, 1.05)
+        ax1.legend(frameon=False)
+        ax1.set_title(f"ER c=6, N={g.n}, R={R}, majority")
+        steps = [r["mean_steps_to_consensus"] for r in rows]
+        ax2.plot([m for m, s in zip(m0s, steps) if s is not None],
+                 [s for s in steps if s is not None], "o-")
+        ax2.set_xlabel("initial magnetization m(0)")
+        ax2.set_ylabel("mean steps to consensus")
+        ax2.set_title("first-passage time")
+        fig.tight_layout()
+        fig.savefig(a.out_png, dpi=120)
+        print(f"wrote {a.out_png}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,3 +62,45 @@ def test_checkpoint_load_metaless_npz(tmp_path):
     arrays, meta = Checkpoint(str(path)).load()
     assert meta == {}
     np.testing.assert_array_equal(arrays["s"], np.arange(4))
+
+
+def test_fingerprint_omits_optional_fields_at_defaults():
+    """Checkpoints written before EntropyConfig grew plateau_eps /
+    plateau_patience must still resume: at their defaults the opt-in fields
+    are omitted from the fingerprint, reproducing the pre-field digest
+    byte-for-byte (ADVICE r04: the skip mechanism was dead code because no
+    config declared `_fingerprint_optional`)."""
+    import dataclasses
+
+    from graphdyn.config import DynamicsConfig, EntropyConfig
+    from graphdyn.utils.io import _fingerprint_repr, run_fingerprint
+
+    cfg = EntropyConfig()
+    r = _fingerprint_repr(cfg)
+    assert "plateau" not in r
+
+    # reconstruct the pre-field dataclass (same name, same fields minus the
+    # opt-in ones) and check digest equality, nested config included
+    pre_fields = [
+        (f.name, f.type, f)
+        for f in dataclasses.fields(cfg)
+        if f.name not in EntropyConfig._fingerprint_optional
+    ]
+    Pre = dataclasses.make_dataclass("EntropyConfig", pre_fields)
+    pre = Pre(**{
+        f.name: getattr(cfg, f.name)
+        for f in dataclasses.fields(cfg)
+        if f.name not in EntropyConfig._fingerprint_optional
+    })
+    assert _fingerprint_repr(pre) == r
+    assert run_fingerprint(pre) == run_fingerprint(cfg)
+
+    # a NON-default opt-in value must change the fingerprint (it changes
+    # ladder semantics, so resuming across it would be a chimera)
+    tuned = EntropyConfig(plateau_eps=1e-4)
+    assert run_fingerprint(tuned) != run_fingerprint(cfg)
+    assert "plateau_eps" in _fingerprint_repr(tuned)
+
+    # nested dynamics config still participates in the digest
+    other = EntropyConfig(dynamics=DynamicsConfig(rule="minority"))
+    assert run_fingerprint(other) != run_fingerprint(cfg)

@@ -102,3 +102,58 @@ def test_packed_many_words_matches_int8(rng):
     for r in (0, 31, 32, 63, 100, 223):      # word boundaries + interior
         want = run_dynamics(g, s[r], 5, "majority", "stay", backend="cpu")
         np.testing.assert_array_equal(got[r], want)
+
+
+def test_draw_packed_biased_mean_bias():
+    """Device-resident biased draw: bit density matches (1+m0)/2 and the
+    per-replica magnetization estimator agrees with the unpacked mean."""
+    from graphdyn.ops.packed import _replica_magnetization, draw_packed_biased
+
+    n, W = 4000, 4
+    for m0 in (0.0, 0.2, -0.3):
+        sp = np.asarray(draw_packed_biased(5, n, W, m0))
+        s = unpack_spins(sp, W * 32)                   # int8[R, n]
+        assert abs(float(s.mean()) - m0) < 0.02
+        m = np.asarray(_replica_magnetization(sp, W * 32))
+        np.testing.assert_allclose(m, s.mean(axis=1), atol=1e-6)
+
+
+def test_packed_consensus_scan_matches_unpacked_oracle(rng):
+    """First-passage bookkeeping vs a step-by-step unpacked oracle: strict
+    flags, chunk-resolution first-passage steps, and m_final all agree."""
+    import jax.numpy as jnp
+
+    from graphdyn.ops.packed import packed_consensus_scan
+
+    g = erdos_renyi_graph(120, 6.0 / 120, seed=3)
+    R, chunk, max_steps = 64, 5, 60
+    s0 = (2 * rng.integers(0, 2, size=(R, g.n)) - 1).astype(np.int8)
+    # bias half the replicas so both converged and unconverged cases occur
+    s0[: R // 2] = np.where(
+        rng.random((R // 2, g.n)) < 0.65, np.int8(1), np.int8(-1)
+    )
+
+    out = packed_consensus_scan(
+        jnp.asarray(g.nbr), jnp.asarray(g.deg), jnp.asarray(pack_spins(s0)),
+        R=R, max_steps=max_steps, chunk=chunk,
+    )
+
+    # oracle: roll the int8 kernel chunk by chunk, flag all-equal states
+    s = s0.copy()
+    strict_step = np.full(R, -1)
+    for t in range(chunk, max_steps + 1, chunk):
+        s = packed_end_state(g, s, chunk)
+        cons = np.all(s == s[:, :1], axis=1)
+        strict_step = np.where((strict_step < 0) & cons, t, strict_step)
+        if int(out["steps_run"]) == t:
+            break                                     # scan early-exited here
+
+    np.testing.assert_array_equal(
+        np.asarray(out["strict_step"]), strict_step
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out["strict"]), strict_step >= 0
+    )
+    np.testing.assert_allclose(
+        np.asarray(out["m_final"]), s.mean(axis=1), atol=1e-6
+    )
