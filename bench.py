@@ -107,52 +107,6 @@ def torch_cpu_rate(g, steps=3):
     return g.n * steps / (time.perf_counter() - t0)
 
 
-def _init_watchdog(timeout_s: float = 300.0, allow_cpu_fallback: bool = True):
-    """Backstop for a relay that wedges *between* the successful probe and
-    the in-process init: after ``timeout_s`` without the armed flag being
-    cleared, re-exec this process with the platform forced to CPU so the
-    driver still records a real (fallback-labeled) number instead of a
-    timeout. A second wedge with the CPU force already applied cannot
-    happen (CPU init does not touch the tunnel), but the re-exec guard
-    below keeps even that path loop-free.
-
-    ``allow_cpu_fallback=False`` (caller explicitly forced a platform, e.g.
-    the chip session's GRAPHDYN_FORCE_PLATFORM=axon chip-or-hang contract):
-    on timeout, emit an error row and exit 2 instead of silently producing
-    CPU rates the caller asked to never get."""
-    import os
-    import threading
-
-    done = threading.Event()
-
-    def watch():
-        if not done.wait(timeout_s):
-            if allow_cpu_fallback and not os.environ.get("BENCH_CPU_REEXEC"):
-                _mark(f"in-process device init hung {timeout_s:.0f}s after a "
-                      "successful probe; re-exec with CPU fallback")
-                os.environ["BENCH_CPU_REEXEC"] = "1"
-                os.environ["GRAPHDYN_FORCE_PLATFORM"] = "cpu"
-                os.execv(sys.executable, [sys.executable] + sys.argv)
-            print(
-                json.dumps({
-                    "metric": "spin_updates_per_sec_per_chip_d3_rrg",
-                    "value": 0.0,
-                    "unit": "spin-updates/s",
-                    "vs_baseline": 0.0,
-                    "error": ("device init hung even under CPU force"
-                              if allow_cpu_fallback else
-                              f"device init hung {timeout_s:.0f}s under an "
-                              "explicitly forced platform (chip-or-hang)"),
-                }),
-                flush=True,
-            )
-            os._exit(2)
-
-    t = threading.Thread(target=watch, daemon=True)
-    t.start()
-    return done
-
-
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="small shapes, fast")
@@ -168,20 +122,25 @@ def main():
     # An explicit GRAPHDYN_FORCE_PLATFORM skips the probe: 'cpu' cannot
     # hang, and 'axon' means the caller (the chip-session watcher, which
     # fires only on a canary UP) wants chip-or-hang semantics.
-    relay_note = None
-    explicit_force = bool(os.environ.get("GRAPHDYN_FORCE_PLATFORM"))
-    if os.environ.get("BENCH_CPU_REEXEC"):
-        # we are the post-wedge re-exec: the force var was set by the
-        # watchdog, not the caller
-        explicit_force = False
-        relay_note = ("relay wedged between probe and init; "
-                      "rates below are a CPU fallback, NOT chip numbers")
-    else:
-        from benchmarks.common import probe_or_cpu_fallback
+    # the force var counts as the CALLER's only when the watchdog re-exec
+    # didn't set it
+    explicit_force = (bool(os.environ.get("GRAPHDYN_FORCE_PLATFORM"))
+                      and not os.environ.get("BENCH_CPU_REEXEC"))
+    from benchmarks.common import init_watchdog, probe_or_cpu_fallback
 
-        relay_note = probe_or_cpu_fallback()   # no-op under an explicit force
-
-    init_done = _init_watchdog(allow_cpu_fallback=not explicit_force)
+    relay_note = probe_or_cpu_fallback()   # probe; no-op under explicit force
+    init_done = init_watchdog(
+        allow_cpu_fallback=not explicit_force,
+        fail_row={
+            "metric": "spin_updates_per_sec_per_chip_d3_rrg",
+            "value": 0.0,
+            "unit": "spin-updates/s",
+            "vs_baseline": 0.0,
+            "error": ("device init hung under an explicitly forced platform "
+                      "(chip-or-hang)" if explicit_force
+                      else "device init hung even under CPU force"),
+        },
+    )
     import benchmarks.common  # noqa: F401 — applies GRAPHDYN_FORCE_PLATFORM
     import jax
 

@@ -46,12 +46,13 @@ def probe_relay(budget_s: float, probe_timeout: float = 75.0) -> bool:
         "print('PROBE_OK', jax.default_backend())"
     )
     deadline = time.monotonic() + budget_s
-    attempt = 0
+    attempt = fast_fails = 0
     while True:
         left = deadline - time.monotonic()
         if left <= 0:
             return False
         attempt += 1
+        t0 = time.monotonic()
         try:
             p = subprocess.run(
                 [sys.executable, "-c", code],
@@ -69,20 +70,41 @@ def probe_relay(budget_s: float, probe_timeout: float = 75.0) -> bool:
                       "backend — no chip in this environment, not retrying",
                       file=sys.stderr, flush=True)
                 return False
+            # completed-but-failed (rc != 0): could be a transient relay
+            # error OR deterministic breakage (broken install, plugin that
+            # raises). Three consecutive FAST failures = deterministic —
+            # stop burning the budget on them; a wedge manifests as a
+            # hang/timeout, never as a quick clean exit.
+            if time.monotonic() - t0 < 10.0:
+                fast_fails += 1
+                if fast_fails >= 3:
+                    print(f"[probe] attempt {attempt}: third consecutive "
+                          "fast failure — deterministic, not retrying; "
+                          f"last stderr: {p.stderr.strip()[-200:]}",
+                          file=sys.stderr, flush=True)
+                    return False
+            else:
+                fast_fails = 0
         except subprocess.TimeoutExpired:
-            pass
+            fast_fails = 0
         print(f"[probe] attempt {attempt}: down "
               f"({max(deadline - time.monotonic(), 0):.0f}s budget left)",
               file=sys.stderr, flush=True)
-        if deadline - time.monotonic() > 20:
-            time.sleep(20)
+        time.sleep(min(20.0, max(deadline - time.monotonic(), 0.0)))
 
 
 def probe_or_cpu_fallback(budget_s: float | None = None) -> str | None:
     """Entry-point guard for capture scripts: when no platform is forced,
     probe the relay and force CPU if it never answers, returning a
     fallback-label note (None when the chip is up or a force was already
-    set). Must run BEFORE first in-process jax backend use."""
+    set). Must run BEFORE first in-process jax backend use. Pair with
+    :func:`init_watchdog` around the first jax call — the relay can wedge
+    in the window between a successful probe and the in-process init."""
+    if os.environ.get("BENCH_CPU_REEXEC"):
+        # we are the post-wedge re-exec of init_watchdog: the CPU force was
+        # set by the watchdog, not the caller — label the capture
+        return ("relay wedged between probe and init; "
+                "this capture is a CPU fallback, NOT chip numbers")
     if os.environ.get("GRAPHDYN_FORCE_PLATFORM"):
         return None
     budget = (float(os.environ.get("BENCH_INIT_BUDGET_S", "600"))
@@ -95,6 +117,45 @@ def probe_or_cpu_fallback(budget_s: float | None = None) -> str | None:
     apply_force_platform()
     return (f"TPU relay unreachable for {budget:.0f}s of probing; "
             "this capture is a CPU fallback, NOT chip numbers")
+
+
+def init_watchdog(timeout_s: float = 300.0, allow_cpu_fallback: bool = True,
+                  fail_row: dict | None = None):
+    """Backstop for a relay that wedges *between* a successful probe and the
+    in-process jax init (which then hangs unrecoverably): after ``timeout_s``
+    without the returned event being set, re-exec the process with the
+    platform forced to CPU so the capture still lands as a real,
+    fallback-labeled artifact (``probe_or_cpu_fallback`` detects the re-exec
+    and returns the label). With ``allow_cpu_fallback=False`` (the caller
+    explicitly forced a platform — chip-or-hang semantics), or when the
+    CPU re-exec itself hangs (cannot happen: CPU init never touches the
+    tunnel), print ``fail_row`` as JSON if given and exit 2.
+
+    Call ``.set()`` on the returned event as soon as the first jax device
+    call completes."""
+    import threading
+
+    done = threading.Event()
+
+    def watch():
+        if not done.wait(timeout_s):
+            if allow_cpu_fallback and not os.environ.get("BENCH_CPU_REEXEC"):
+                print(f"[init-watchdog] device init hung {timeout_s:.0f}s "
+                      "after a successful probe; re-exec with CPU fallback",
+                      file=sys.stderr, flush=True)
+                os.environ["BENCH_CPU_REEXEC"] = "1"
+                os.environ["GRAPHDYN_FORCE_PLATFORM"] = "cpu"
+                os.execv(sys.executable, [sys.executable] + sys.argv)
+            if fail_row is not None:
+                print(json.dumps(fail_row), flush=True)
+            else:
+                print("[init-watchdog] device init hung "
+                      f"{timeout_s:.0f}s; exiting", file=sys.stderr,
+                      flush=True)
+            os._exit(2)
+
+    threading.Thread(target=watch, daemon=True).start()
+    return done
 
 
 def _sync(out):

@@ -21,12 +21,23 @@ from graphdyn.graphs import random_regular_graph
 from graphdyn.models.sa import simulated_annealing
 
 
-def _setup(n, R, steps):
-    """Shared graph + config + injected-stream setup (seed 0)."""
+def _setup(n, R, steps, device_s0=False):
+    """Shared graph + config + injected-stream setup (seed 0).
+
+    ``device_s0`` draws the spin state on device (`benchmarks.common
+    .draw_pm1_int8`) instead of host-side — required at n=1e6 where a
+    host draw means a 32 MB upload over the tunneled TPU link; the
+    proposal/uniform streams stay host-drawn (KB-sized, and they keep
+    chains reproducible against the injected-stream tests)."""
     g = random_regular_graph(n, 3, seed=0)
     cfg = SAConfig(dynamics=DynamicsConfig(p=3, c=1))
     rng = np.random.default_rng(0)
-    s0 = (2 * rng.integers(0, 2, size=(R, n)) - 1).astype(np.int8)
+    if device_s0:
+        from benchmarks.common import draw_pm1_int8
+
+        s0 = draw_pm1_int8(0, (R, g.n))
+    else:
+        s0 = (2 * rng.integers(0, 2, size=(R, n)) - 1).astype(np.int8)
     proposals = rng.integers(0, n, size=(R, steps)).astype(np.int32)
     uniforms = rng.random(size=(R, steps))
     return g, cfg, s0, proposals, uniforms
@@ -92,23 +103,34 @@ def run(n, R, steps):
 
 
 def run_lightcone_scaling(n, R, steps):
-    """One extra shape at 10× the BASELINE n, light-cone only: per-step work
-    is O(ball), so the rate should hold roughly flat while the full rollout
+    """Light-cone-only rungs at 10×/100× the BASELINE n: per-step work is
+    O(ball), so the rate should hold roughly flat while the full rollout
     scales O(n) — the measured form of the scaling claim (see the known
-    CPU-backend accept-scatter ceiling in graphdyn/ops/lightcone.py)."""
-    from graphdyn.ops.lightcone import build_lightcone_tables
+    CPU-backend accept-scatter ceiling in graphdyn/ops/lightcone.py;
+    whether XLA:TPU aliases the accept-scatter is exactly what the chip
+    rungs answer — `SA_RRG.py:32-37` is the O(n·d) cost being killed).
 
-    g, cfg, s0, proposals, uniforms = _setup(n, R, steps)
-    tables = build_lightcone_tables(g, cfg.dynamics.p + cfg.dynamics.c - 1)
+    Tables are built ON DEVICE (`build_lightcone_tables_device`) and the
+    spin state drawn on device: at n=1e6 the host path is ~100 s of Python
+    BFS plus ~600 MB of table upload, which the tunneled TPU link cannot
+    sustain. The metric name carries a ``_scaling`` tag so run()'s
+    host-tables lightcone row at the same (n, R) never collides."""
+    from graphdyn.ops.lightcone import build_lightcone_tables_device
+
+    g, cfg, s0, proposals, uniforms = _setup(n, R, steps, device_s0=True)
+    tables = build_lightcone_tables_device(
+        g, cfg.dynamics.p + cfg.dynamics.c - 1
+    )
     lc = _timed_steady(
         g, cfg, s0, proposals, uniforms, steps,
         rollout_mode="lightcone", lc_tables=tables,
     )
     report(
-        "sa_mcmc_steps_per_sec_lightcone_n%d_r%d" % (n, R),
+        "sa_mcmc_steps_per_sec_lightcone_scaling_n%d_r%d" % (n, R),
         R * steps / lc,
         "mcmc-steps/s",
         timing="steady_state",
+        tables="device_built",
     )
 
 
@@ -117,6 +139,11 @@ if __name__ == "__main__":
     ap.add_argument("--full", action="store_true")
     a = ap.parse_args()
     run(10_000 if a.full else 2000, 32, 2000 if a.full else 400)
-    run_lightcone_scaling(
-        100_000 if a.full else 20_000, 32, 1000 if a.full else 200
-    )
+    # the O(ball) scaling claim, measured: steps/s across decades of n
+    # (flat = the accept-scatter aliases; falling = it copies — diagnose)
+    run_lightcone_scaling(10_000 if a.full else 2000, 32,
+                          1000 if a.full else 200)
+    run_lightcone_scaling(100_000 if a.full else 20_000, 32,
+                          1000 if a.full else 200)
+    if a.full:
+        run_lightcone_scaling(1_000_000, 32, 500)

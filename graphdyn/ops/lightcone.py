@@ -57,22 +57,42 @@ class LightconeTables(NamedTuple):
     ball_max: int
 
 
+def _adjacency_checksums(nbr) -> tuple[int, int]:
+    """Two independent position-weighted 32-bit checksums of a neighbor
+    table, computed WHERE THE ARRAY LIVES (numpy on host, XLA on device —
+    only two scalars ever cross the link). Weights are a fixed odd-multiplier
+    mix of the flat position, so swapped/permuted/mismatched adjacencies
+    collide only with ~2^-64 probability."""
+    xp = jnp if isinstance(nbr, jnp.ndarray) else np
+    flat = xp.asarray(nbr, dtype=xp.uint32).reshape(-1)
+    pos = xp.arange(flat.shape[0], dtype=xp.uint32)
+    w1 = pos * xp.uint32(2654435761) + xp.uint32(0x9E3779B9)
+    w2 = (pos ^ xp.uint32(0x85EBCA6B)) * xp.uint32(2246822519) + xp.uint32(1)
+    c1 = ((flat + xp.uint32(1)) * w1).sum(dtype=xp.uint32)
+    c2 = ((flat + xp.uint32(1)) * w2).sum(dtype=xp.uint32)
+    return int(c1), int(c2)
+
+
 def resolve_lightcone_tables(graph, radius: int, lc_tables=None) -> LightconeTables:
     """Build tables for ``graph``/``radius``, or validate caller-supplied
     ones. Slot 0 of every ball is the node itself, so ``nbr_glob[:, 0, :]``
     IS the adjacency the tables were built from — a full graph identity
     check, not just a shape check. A mismatched table would make the chain
     silently diverge (JAX gathers clamp instead of erroring), so refuse up
-    front. One guard shared by the unsharded and mesh SA solvers."""
+    front. One guard shared by the unsharded and mesh SA solvers.
+
+    The identity check compares position-weighted checksums rather than the
+    raw arrays: device-built tables at n=1e6 would otherwise pull 12 MB to
+    the host on EVERY solver call — tens of seconds over the tunneled TPU
+    link, inside callers' timed regions."""
     if lc_tables is None:
         return build_lightcone_tables(graph, radius)
     if (
         lc_tables.radius != radius
         or lc_tables.ball.shape[0] != graph.n
         or lc_tables.nbr_glob.shape[2] != graph.nbr.shape[1]
-        or not np.array_equal(
-            np.asarray(lc_tables.nbr_glob[:, 0, :]), np.asarray(graph.nbr)
-        )
+        or _adjacency_checksums(lc_tables.nbr_glob[:, 0, :])
+        != _adjacency_checksums(graph.nbr)
     ):
         raise ValueError(
             f"lc_tables were built for a different graph or radius "
@@ -132,6 +152,91 @@ def build_lightcone_tables(graph, radius: int) -> LightconeTables:
         nbr_glob=jnp.asarray(nbr_glob),
         radius=radius,
         ball_max=B,
+    )
+
+
+def ball_bound(dmax: int, radius: int) -> int:
+    """Tree upper bound on the radius-``radius`` ball size at max degree
+    ``dmax``: 1 + Σ_{k=1..r} dmax·(dmax−1)^{k−1}. Exact on trees; an
+    overestimate wherever short cycles merge branches (padding absorbs)."""
+    return 1 + sum(dmax * max(dmax - 1, 1) ** (k - 1)
+                   for k in range(1, radius + 1))
+
+
+def build_lightcone_tables_device(graph, radius: int) -> LightconeTables:
+    """The ball tables built ON DEVICE — gathers, sorts and searchsorted
+    instead of the host BFS of :func:`build_lightcone_tables`.
+
+    Motivation: at n=1e6 the host builder spends ~100 s of Python BFS and
+    then uploads ~600 MB of tables over the tunneled TPU link (the r04
+    session measured ~0.3 MB/s host→device — half an hour of transfer for
+    one benchmark rung). Here only the [n, dmax] neighbor table crosses the
+    link; everything else is computed where it will be used.
+
+    Construction per node i (vectorized over all nodes at once):
+
+    1. candidate list = radius-fold repeated neighbor gather starting from
+       [i] (ghost id n maps to itself, so padding propagates inertly);
+    2. self-occurrences masked to ghost, then sort + first-occurrence
+       compaction → the ball as {i} followed by the remaining members in
+       ascending id order, ghost-padded to the static tree bound B;
+    3. ``nbr_glob = nbr_ext[ball]``; ``nbr_slot`` by binary search of each
+       global neighbor id in the sorted tail (slot 0 = self handled
+       separately, ghost/out-of-ball → −1).
+
+    Slot ORDER differs from the host builder (BFS level order there,
+    sorted-id here), but the kernel contract only requires membership,
+    self-at-slot-0, and table self-consistency — the per-slot DP is
+    order-independent, so chains stay bit-identical (tested against the
+    host tables and the full rollout).
+    """
+    n = graph.n
+    nbr = jnp.asarray(graph.nbr)
+    dmax = int(nbr.shape[1])
+    B = ball_bound(dmax, radius)
+
+    @jax.jit
+    def build(nbr):
+        nbr_ext = jnp.concatenate(
+            [nbr, jnp.full((1, dmax), n, nbr.dtype)], axis=0
+        )
+        ids = jnp.arange(n, dtype=jnp.int32)
+        cand = ids[:, None]                           # [n, 1]
+        frontier = cand
+        for _ in range(radius):
+            frontier = jnp.take(
+                nbr_ext, frontier, axis=0
+            ).reshape(n, -1)                          # [n, d^k]
+            cand = jnp.concatenate([cand, frontier], axis=1)
+        # self never re-enters (cycles through i) — mask to ghost, re-add
+        # as slot 0 below
+        cand = jnp.where(cand == ids[:, None], n, cand)
+        srt = jnp.sort(cand, axis=1)                  # ghosts (n) sort last
+        first = jnp.concatenate(
+            [jnp.ones((n, 1), bool), srt[:, 1:] != srt[:, :-1]], axis=1
+        )
+        uniq = jnp.sort(jnp.where(first & (srt < n), srt, n), axis=1)
+        tail = uniq[:, : B - 1]                       # ascending, ghost-padded
+        ball = jnp.concatenate([ids[:, None], tail], axis=1)     # [n, B]
+        nbr_glob = jnp.take(nbr_ext, ball, axis=0)               # [n, B, d]
+        # ghost ball slots must gather ghost neighbors (the host builder
+        # leaves them at the ghost fill): nbr_ext[n] = n already does.
+        q = nbr_glob.reshape(n, -1)                   # [n, B*d]
+        pos = jax.vmap(
+            lambda t, qr: jnp.searchsorted(t, qr)
+        )(tail, q)                                    # [n, B*d]
+        hit = (q < n) & (pos < B - 1) & (
+            jnp.take_along_axis(tail, jnp.minimum(pos, B - 2), axis=1) == q
+        )
+        slot = jnp.where(hit, pos + 1, -1)            # tail slots start at 1
+        slot = jnp.where(q == ids[:, None], 0, slot)  # self -> slot 0
+        nbr_slot = slot.reshape(n, B, dmax).astype(jnp.int32)
+        return ball, nbr_slot, nbr_glob
+
+    ball, nbr_slot, nbr_glob = build(nbr)
+    return LightconeTables(
+        ball=ball, nbr_slot=nbr_slot, nbr_glob=nbr_glob,
+        radius=radius, ball_max=B,
     )
 
 

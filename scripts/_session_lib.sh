@@ -34,6 +34,18 @@ EOF
 # rows_ok FILE — a JSONL artifact with at least one row
 rows_ok() { [ -s "$1" ]; }
 
+# chip_doc_ok FILE — a JSON artifact that parses AND records a chip backend
+# with no fallback label (a CPU-fallback capture must not block a refire
+# from replacing it with chip data — same contract as headline_ok)
+chip_doc_ok() {
+    python - "$1" >/dev/null 2>&1 <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+assert d.get("backend") in ("tpu", "axon")
+assert "relay" not in d
+EOF
+}
+
 # collect_round OUTDIR TAG — merge the session dir into the round doc
 # (idempotent; fired near round end the driver commits the tree as-is,
 # with nobody around to run the collector by hand)

@@ -114,6 +114,25 @@ def main(session_dir, bench_configs="BENCH_CONFIGS_r05.json"):
             # other artifacts (same tolerance as read_json_lines)
             out["physics_error"] = f"unparseable physics_tpu.json: {e}"
 
+    cons_path = os.path.join(session_dir, "consensus_tpu.json")
+    if os.path.exists(cons_path):
+        try:
+            with open(cons_path) as f:
+                out["consensus_physics"] = json.load(f)
+            cons_backend = out["consensus_physics"].get("backend")
+            if cons_backend in UNKNOWN_BACKENDS:
+                out["consensus_physics_note"] = (
+                    "consensus backend unknown (no metadata)")
+            elif cons_backend not in CHIP_BACKENDS:
+                # same guard as headline/configs: fallback data stays
+                # labeled (consensus *physics* is backend-independent, but
+                # the chip-evidence claim is not)
+                out["consensus_physics_warning"] = (
+                    f"consensus backend is {cons_backend!r}, not the chip")
+        except json.JSONDecodeError as e:
+            out["consensus_physics_error"] = (
+                f"unparseable consensus_tpu.json: {e}")
+
     cfgs_present = out.get("configs")
     if isinstance(cfgs_present, dict):
         # the aggregator writes a valid-but-empty doc at startup; an empty
@@ -149,6 +168,12 @@ def main(session_dir, bench_configs="BENCH_CONFIGS_r05.json"):
         print(f"  WARNING: {out['warning']}")
     for row in out.get("pallas_gather_probe", []):
         print(f"  probe: {row}")
+    cons = out.get("consensus_physics")
+    if isinstance(cons, dict):
+        pts = [(r.get("m0"), r.get("consensus_fraction"))
+               for r in cons.get("rows", [])]
+        print(f"  consensus physics: backend={cons.get('backend')} "
+              f"{len(pts)} m0 points {pts[:4]}...")
     cfgs = out.get("configs")
     if isinstance(cfgs, dict):
         cfgs = cfgs.get("configs", [])

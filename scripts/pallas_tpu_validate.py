@@ -180,6 +180,43 @@ def packed_equivalence():
     return rows
 
 
+def packed_general_equivalence():
+    """Compiled-mode bit-parity of the GENERAL-shapes per-row-DMA kernel
+    (ragged/even degrees — `pallas_packed_rollout_general`): the variant the
+    tunnel's remote-compile helper returned HTTP 500 on in the r04 window
+    (helper-subprocess crash, not a Mosaic lowering error). Each case runs
+    independently with the error text captured, so a recurring 500 leaves a
+    pinned repro in PALLAS_TPU.json instead of killing the validate run."""
+    from graphdyn.ops.packed import pack_spins, packed_rollout
+    from graphdyn.ops.pallas_packed import pallas_packed_rollout_general
+
+    rows = []
+    for tag, g, rule, tie in [
+        ("even_uniform_d4", random_regular_graph(2048, 4, seed=3),
+         "majority", "stay"),
+        ("ragged_er", erdos_renyi_graph(2048, 6.0 / 2048, seed=5),
+         "majority", "change"),
+        ("ragged_er_minority", erdos_renyi_graph(1024, 4.0 / 1024, seed=6),
+         "minority", "stay"),
+    ]:
+        R = 64
+        rng = np.random.default_rng(9)
+        sp = jnp.asarray(pack_spins(
+            (2 * rng.integers(0, 2, size=(R, g.n)) - 1).astype(np.int8)
+        ))
+        row = {"case": tag, "n": g.n, "rule": rule, "tie": tie}
+        try:
+            ref = packed_rollout(
+                jnp.asarray(g.nbr), jnp.asarray(g.deg), sp, 5, rule, tie)
+            out = pallas_packed_rollout_general(
+                jnp.asarray(g.nbr), np.asarray(g.deg), sp, 5, rule, tie)
+            row["bit_equal"] = bool(jnp.array_equal(ref, out))
+        except Exception as e:  # noqa: BLE001 — pin the repro, keep going
+            row["error"] = str(e)[:500]
+        rows.append(row)
+    return rows
+
+
 def main():
     info = {
         "backend": jax.default_backend(),
@@ -191,6 +228,7 @@ def main():
         "equivalence": equivalence(),
         "sweep_equivalence": sweep_equivalence(),
         "packed_equivalence": packed_equivalence(),
+        "packed_general_equivalence": packed_general_equivalence(),
         "timing": timing(),
     }
     with open("PALLAS_TPU.json", "w") as f:

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -40,12 +41,22 @@ def main():
     a = ap.parse_args()
 
     # the same wedge protection as bench.py: an unforced run on a wedged
-    # relay would otherwise hang forever in jax init and write NO artifact
-    from benchmarks.common import probe_or_cpu_fallback
+    # relay would otherwise hang forever in jax init and write NO artifact.
+    # Probe first (a wedged relay hangs in-process init unrecoverably),
+    # then arm the watchdog for the probe→init wedge window: on a hang it
+    # re-execs this script with CPU forced, and probe_or_cpu_fallback in
+    # the re-exec returns the fallback label.
+    from benchmarks.common import init_watchdog, probe_or_cpu_fallback
 
     relay_note = probe_or_cpu_fallback()
+    init_done = init_watchdog(
+        allow_cpu_fallback=not (os.environ.get("GRAPHDYN_FORCE_PLATFORM")
+                                and not os.environ.get("BENCH_CPU_REEXEC")))
 
     import jax
+
+    jax.devices()
+    init_done.set()
 
     from benchmarks.config3_er_majority import consensus_curve, consensus_ensemble
 

@@ -1,7 +1,7 @@
 #!/bin/bash
 # Abbreviated chip session for a late relay recovery: headline bench +
-# gather A/B/C/D + DMA probe only (~30-60 min), so it cannot collide with
-# the driver's own round-end bench the way the multi-hour full session
+# Pallas validation + consensus physics (~30-50 min), so it cannot collide
+# with the driver's own round-end bench the way the multi-hour full session
 # would. Idempotent per stage (see _session_lib.sh).
 # Usage: bash scripts/tpu_bench_session_short.sh [outdir]
 set -u
@@ -14,34 +14,40 @@ if headline_ok "$OUT/bench_headline.json"; then
     echo "[tpu-short] headline bench already captured; skipping" >&2
 else
     echo "[tpu-short] headline bench ..." >&2
-    timeout 1500 python bench.py > "$OUT/bench_headline.json" 2> "$OUT/bench_headline.err"
+    BENCH_INIT_BUDGET_S=120 timeout 1500 \
+        python bench.py > "$OUT/bench_headline.json" 2> "$OUT/bench_headline.err"
     echo "[tpu-short] bench rc=$? $(tail -c 300 "$OUT/bench_headline.json")" >&2
 fi
 
-if rows_ok "$OUT/gather_experiment.jsonl"; then
-    echo "[tpu-short] gather experiment already captured; skipping" >&2
+if json_ok "$OUT/PALLAS_TPU.json"; then
+    echo "[tpu-short] pallas validation already captured; skipping" >&2
 else
-    echo "[tpu-short] gather experiment ..." >&2
-    timeout 1200 python scripts/packed_gather_experiment.py \
-        > "$OUT/gather_experiment.jsonl" 2> "$OUT/gather_experiment.err"
-    echo "[tpu-short] gather rc=$?" >&2
+    echo "[tpu-short] pallas on-chip validation ..." >&2
+    GRAPHDYN_FORCE_PLATFORM=axon timeout 1200 \
+        python scripts/pallas_tpu_validate.py \
+        > "$OUT/pallas_validate.log" 2>&1
+    rc=$?
+    echo "[tpu-short] pallas validate rc=$rc" >&2
+    [ $rc -eq 0 ] && cp -f PALLAS_TPU.json "$OUT/PALLAS_TPU.json"
 fi
 
-if rows_ok "$OUT/pallas_gather_probe.jsonl"; then
-    echo "[tpu-short] pallas gather probe already captured; skipping" >&2
+if chip_doc_ok "$OUT/consensus_tpu.json"; then
+    echo "[tpu-short] consensus physics already captured; skipping" >&2
 else
-    echo "[tpu-short] pallas random-row gather probe ..." >&2
-    timeout 900 python scripts/pallas_gather_probe.py \
-        > "$OUT/pallas_gather_probe.jsonl" 2> "$OUT/pallas_gather_probe.err"
-    echo "[tpu-short] probe rc=$?" >&2
+    echo "[tpu-short] ER-majority consensus physics (m0 sweep) ..." >&2
+    GRAPHDYN_FORCE_PLATFORM=axon timeout 1200 \
+        python scripts/physics_consensus.py \
+        "$OUT/consensus_tpu.json" "$OUT/consensus_tpu.png" --full \
+        > "$OUT/consensus_tpu.log" 2>&1
+    echo "[tpu-short] consensus rc=$?" >&2
 fi
 
 collect_round "$OUT" tpu-short
 
 # Self-report completion ONLY when the session's key artifact is really
-# in hand: this session produces neither configs_tpu.json nor
-# physics_tpu.json, so the watcher's done-check relies on this marker —
-# and a cut-short session must leave refires available.
+# in hand: this session produces no configs_tpu.json / physics_tpu.json,
+# so the watcher's done-check relies on this marker — and a cut-short
+# session must leave refires available.
 if headline_ok "$OUT/bench_headline.json"; then
     touch "$OUT/.short_session_done"
 fi

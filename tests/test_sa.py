@@ -307,3 +307,43 @@ def test_lightcone_checkpoint_resume(tmp_path, abort_after_save):
     np.testing.assert_array_equal(base.s, resumed.s)
     np.testing.assert_array_equal(base.num_steps, resumed.num_steps)
     np.testing.assert_array_equal(base.m_final, resumed.m_final)
+
+
+def test_lightcone_device_tables_bit_parity():
+    """Device-built ball tables (gather/sort/searchsorted — no host BFS, no
+    table upload) drive the light-cone solver to bit-identical chains vs the
+    host-BFS tables AND vs the full rollout, on RRG and ragged ER. Slot
+    order differs between the builders; the per-slot DP is order-independent
+    so the chains must not."""
+    from graphdyn.graphs import erdos_renyi_graph
+    from graphdyn.ops.lightcone import (
+        build_lightcone_tables,
+        build_lightcone_tables_device,
+    )
+
+    for gname, g in [
+        ("rrg", random_regular_graph(60, 3, seed=5)),
+        ("er", erdos_renyi_graph(70, 3.0 / 69, seed=8)),   # ragged + isolates
+    ]:
+        rng = np.random.default_rng(21)
+        R, L = 3, 2000
+        s0 = (2 * rng.integers(0, 2, size=(R, g.n)) - 1).astype(np.int8)
+        proposals = rng.integers(0, g.n, size=(R, L)).astype(np.int32)
+        uniforms = rng.random(size=(R, L))
+        for p, c in [(3, 1), (2, 2)]:
+            cfg = SAConfig(dynamics=DynamicsConfig(p=p, c=c))
+            radius = p + c - 1
+            kw = dict(s0=s0, proposals=proposals, uniforms=uniforms,
+                      backend="jax", rollout_mode="lightcone")
+            host = simulated_annealing(
+                g, cfg, lc_tables=build_lightcone_tables(g, radius), **kw
+            )
+            dev = simulated_annealing(
+                g, cfg, lc_tables=build_lightcone_tables_device(g, radius),
+                **kw
+            )
+            for f in ("s", "num_steps", "m_final", "mag_reached"):
+                np.testing.assert_array_equal(
+                    getattr(host, f), getattr(dev, f),
+                    err_msg=f"{gname} p={p} c={c} field={f}",
+                )
