@@ -87,10 +87,13 @@ def probe_relay(budget_s: float, probe_timeout: float = 75.0) -> bool:
                 fast_fails = 0
         except subprocess.TimeoutExpired:
             fast_fails = 0
-        print(f"[probe] attempt {attempt}: down "
-              f"({max(deadline - time.monotonic(), 0):.0f}s budget left)",
-              file=sys.stderr, flush=True)
-        time.sleep(min(20.0, max(deadline - time.monotonic(), 0.0)))
+        left = deadline - time.monotonic()
+        print(f"[probe] attempt {attempt}: down ({max(left, 0):.0f}s budget "
+              "left)", file=sys.stderr, flush=True)
+        # near the deadline, shorten the pause instead of sleeping the rest
+        # of the budget away — the final window still gets a probe attempt
+        # (the subprocess timeout floor of 15 s may overshoot slightly)
+        time.sleep(20.0 if left > 25.0 else min(2.0, max(left, 0.0)))
 
 
 def probe_or_cpu_fallback(budget_s: float | None = None) -> str | None:

@@ -194,6 +194,16 @@ def build_lightcone_tables_device(graph, radius: int) -> LightconeTables:
     nbr = jnp.asarray(graph.nbr)
     dmax = int(nbr.shape[1])
     B = ball_bound(dmax, radius)
+    if B > 16384:
+        # the static tree bound pads every row to the WORST-degree ball —
+        # fine for (near-)regular graphs (d=3, r=3 ⇒ B=22), hopeless for
+        # ragged ones (ER dmax≈20, r=3 ⇒ B=7621 ⇒ n·B·d tables). The host
+        # builder sizes B to the largest ACTUAL ball instead.
+        raise ValueError(
+            f"tree ball bound {B} at dmax={dmax}, radius={radius} is too "
+            "ragged for the device builder's static padding; use "
+            "build_lightcone_tables (host BFS, actual-ball-sized tables)"
+        )
 
     @jax.jit
     def build(nbr):

@@ -17,17 +17,14 @@ json.load(open(sys.argv[1]))
 EOF
 }
 
-# headline_ok FILE — bench headline parses, carries a real rate, AND is a
-# CHIP measurement (a failed bench emits an error JSON with value 0.0; a
-# wedged-relay bench may emit a nonzero CPU-fallback row with
-# backend != tpu — a refire into a recovered relay must replace both)
+# headline_ok FILE — chip_doc_ok AND carries a real rate (a failed bench
+# emits an error JSON with value 0.0; a wedged-relay bench may emit a
+# nonzero CPU-fallback row — a refire into a recovered relay must replace
+# both). One chip-contract (chip_doc_ok below) + the value check.
 headline_ok() {
-    python - "$1" >/dev/null 2>&1 <<'EOF'
+    chip_doc_ok "$1" && python - "$1" >/dev/null 2>&1 <<'EOF'
 import json, sys
-d = json.load(open(sys.argv[1]))
-assert d.get("value", 0) > 0
-assert d.get("backend") in ("tpu", "axon")
-assert "relay" not in d
+assert json.load(open(sys.argv[1])).get("value", 0) > 0
 EOF
 }
 

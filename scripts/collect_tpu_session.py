@@ -119,7 +119,11 @@ def main(session_dir, bench_configs="BENCH_CONFIGS_r05.json"):
         try:
             with open(cons_path) as f:
                 out["consensus_physics"] = json.load(f)
-            cons_backend = out["consensus_physics"].get("backend")
+            # same non-dict tolerance as the configs block: a truncated/
+            # rewritten file can parse as a list or string
+            cons_backend = (out["consensus_physics"].get("backend")
+                            if isinstance(out["consensus_physics"], dict)
+                            else None)
             if cons_backend in UNKNOWN_BACKENDS:
                 out["consensus_physics_note"] = (
                     "consensus backend unknown (no metadata)")

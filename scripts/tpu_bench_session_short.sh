@@ -44,11 +44,13 @@ fi
 
 collect_round "$OUT" tpu-short
 
-# Self-report completion ONLY when the session's key artifact is really
-# in hand: this session produces no configs_tpu.json / physics_tpu.json,
-# so the watcher's done-check relies on this marker — and a cut-short
-# session must leave refires available.
-if headline_ok "$OUT/bench_headline.json"; then
+# Self-report completion ONLY when ALL of this session's artifacts are
+# really in hand: this session produces no configs_tpu.json /
+# physics_tpu.json, so the watcher's done-check relies on this marker —
+# and a session cut short during ANY stage must leave refires available.
+if headline_ok "$OUT/bench_headline.json" \
+        && json_ok "$OUT/PALLAS_TPU.json" \
+        && chip_doc_ok "$OUT/consensus_tpu.json"; then
     touch "$OUT/.short_session_done"
 fi
 

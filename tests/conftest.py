@@ -66,3 +66,95 @@ def abort_after_save():
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running correctness anchors")
+
+
+# Two-tier suite (README "Running the tests"): the default developer/CI run
+# is `pytest tests/ -m "not slow"` (<5 min); the full run — every
+# correctness anchor, ~25 min on this host — is `pytest tests/` (what the
+# round judge executes). Tests measured ≥7 s on the shared 8-device CPU
+# mesh are marked slow HERE, centrally, so the tier boundary is one
+# reviewable list; regenerate with
+#   pytest tests/ -q --durations=0 2>&1 | awk '$1+0>=7 && $2=="call"'
+# Parametrized ids pin only the slow parameter combos; the rest stay fast.
+_SLOW = {
+    ("test_bdcm.py", "test_bucketed_partitions_match"),
+    ("test_bdcm.py", "test_bucketed_sweep_matches_unbucketed"),
+    ("test_bdcm.py", "test_entropy_sweep_bucketed_matches"),
+    ("test_bench_contract.py", "test_bench_smoke_emits_one_json_line"),
+    ("test_cli.py", "test_cli_entropy"),
+    ("test_cli.py", "test_cli_entropy_union"),
+    ("test_cli.py", "test_cli_hpr_batch_device_init"),
+    ("test_cli.py", "test_cli_sa_sharded"),
+    ("test_dynamics.py", "test_solvers_run_under_nondefault_rules"),
+    ("test_entropy.py", "test_congruent_ensemble_managed_resume_bit_exact"),
+    ("test_entropy.py", "test_entropy_checkpointer_and_counts"),
+    ("test_entropy.py", "test_entropy_ensemble_empty_attractor_no_nan"),
+    ("test_entropy.py", "test_entropy_grid_resume_bit_exact"),
+    ("test_entropy.py", "test_golden_f64_artifact_reproducible"),
+    ("test_entropy.py", "test_golden_triples_tight_f64"),
+    ("test_entropy.py", "test_golden_triples_tolerance"),
+    ("test_entropy.py", "test_grid_driver_shapes"),
+    ("test_entropy.py", "test_union_ensemble_all_isolate_member"),
+    ("test_entropy.py", "test_union_ensemble_checkpointing"),
+    ("test_entropy.py", "test_union_ensemble_managed_resume_bit_exact"),
+    ("test_entropy.py", "test_union_ensemble_matches_per_graph"),
+    ("test_entropy.py", "test_union_ensemble_resume_chi0"),
+    ("test_entropy.py", "test_warm_start_resume_state"),
+    ("test_hpr.py", "test_hpr_batch_checkpoint_resume_bit_exact"),
+    ("test_hpr.py", "test_hpr_batch_device_init"),
+    ("test_hpr.py", "test_hpr_batch_mesh_checkpoint_resume"),
+    ("test_hpr.py", "test_hpr_batch_sharded_bit_identical_to_unsharded[5]"),
+    ("test_hpr.py", "test_hpr_batch_sharded_bit_identical_to_unsharded[8]"),
+    ("test_hpr.py", "test_hpr_batch_sharded_replicas"),
+    ("test_hpr.py", "test_hpr_checkpoint_resume_bit_exact"),
+    ("test_hpr.py", "test_hpr_ensemble_driver"),
+    ("test_hpr.py", "test_hpr_ensemble_driver_resume"),
+    ("test_hpr.py", "test_hpr_float64_axis"),
+    ("test_hpr.py", "test_union_setup_device_bit_identical_to_host"),
+    ("test_hpr_oracle.py", "test_iterated_sweep_matches_oracle"),
+    ("test_hpr_oracle.py", "test_sweep_matches_bruteforce_oracle[14-3-2-1-2.0]"),
+    ("test_packed.py", "test_draw_packed_biased_mean_bias"),
+    ("test_pallas.py", "test_dp_contract_matches_xla[2-2-1e-10]"),
+    ("test_pallas.py", "test_dp_contract_matches_xla[3-2-0.0]"),
+    ("test_pallas.py", "test_dp_contract_matches_xla[3-3-0.0]"),
+    ("test_pallas.py", "test_dp_contract_matches_xla[4-2-0.0]"),
+    ("test_pallas.py", "test_sweep_pallas_vs_xla_er"),
+    ("test_pallas.py", "test_sweep_pallas_with_bias_rrg"),
+    ("test_pallas_packed.py", "test_pallas_packed_general_matches_xla[change-majority]"),
+    ("test_pallas_packed.py", "test_pallas_packed_general_matches_xla[change-minority]"),
+    ("test_pallas_packed.py", "test_pallas_packed_general_matches_xla[stay-majority]"),
+    ("test_pallas_packed.py", "test_pallas_packed_general_matches_xla[stay-minority]"),
+    ("test_parallel.py", "test_sharded_sweep_f64_matches_unsharded"),
+    ("test_parallel.py", "test_sharded_sweep_matches_unsharded[er]"),
+    ("test_parallel.py", "test_union_entropy_mesh_matches_unsharded"),
+    ("test_parallel.py", "test_vmapped_entropy_mesh_matches_unsharded"),
+    ("test_sa.py", "test_lightcone_bit_parity_with_full"),
+    ("test_sa.py", "test_lightcone_checkpoint_resume"),
+    ("test_sa.py", "test_lightcone_device_tables_bit_parity"),
+    ("test_sa.py", "test_sa_ensemble_driver_resume"),
+    ("test_sa_sharded.py", "test_lightcone_sharded_bit_parity_and_resume"),
+    ("test_sa_sharded.py", "test_prng_mode_bit_parity"),
+    ("test_sa_sharded.py", "test_sharded_checkpoint_resume_bit_exact"),
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    collected = set()
+    for item in items:
+        key = (item.fspath.basename, item.name)
+        collected.add(key)
+        if key in _SLOW:
+            item.add_marker(pytest.mark.slow)
+    # a renamed test (or changed parametrize id) must not silently fall out
+    # of the slow tier: flag _SLOW entries whose FILE was collected but
+    # whose test no longer matches. Warning, not error — a -k filtered run
+    # legitimately collects a subset.
+    files = {f for f, _ in collected}
+    stale = sorted(e for e in _SLOW if e[0] in files and e not in collected)
+    if stale and not config.getoption("-k"):
+        import warnings
+
+        warnings.warn(
+            f"conftest._SLOW entries match no collected test "
+            f"(renamed/reparametrized?): {stale}", stacklevel=1,
+        )
