@@ -33,68 +33,6 @@ def run(n, R, steps):
     )
 
 
-def consensus_point(g, R, m0, max_steps, chunk=10, seed=1000,
-                    nbr_dev=None, deg_dev=None):
-    """One m(0) point of the opinion-consensus curve on a prepared graph:
-    biased device-resident init, chunked consensus scan, per-replica
-    statistics reduced to a plain dict (shared by this config's sweep and
-    ``scripts/physics_consensus.py``). Callers sweeping many m(0) points
-    pass ``nbr_dev``/``deg_dev`` once — re-uploading the multi-MB neighbor
-    table per point is tunnel traffic the link cannot sustain."""
-    from graphdyn.ops.packed import draw_packed_biased, packed_consensus_scan
-
-    W = -(-R // 32)
-    sp = draw_packed_biased(seed, g.n, W, m0)
-    nbr_dev = jnp.asarray(g.nbr) if nbr_dev is None else nbr_dev
-    deg_dev = jnp.asarray(g.deg) if deg_dev is None else deg_dev
-    out = packed_consensus_scan(
-        nbr_dev, deg_dev, sp,
-        R=W * 32, max_steps=max_steps, chunk=chunk,
-    )
-    near = np.asarray(out["near"])[:R]
-    near_step = np.asarray(out["near_step"])[:R]
-    m_final = np.asarray(out["m_final"])[:R]
-    n_near = int(near.sum())
-    return {
-        "m0": float(m0),
-        "consensus_fraction": n_near / R,
-        "strict_fraction": float(np.asarray(out["strict"])[:R].mean()),
-        "mean_steps_to_consensus": (
-            float(near_step[near].mean()) if n_near else None
-        ),
-        "mean_abs_m_final": float(np.abs(m_final).mean()),
-        "max_steps": int(max_steps),
-        "step_resolution": int(chunk),
-        "replicas": int(R),
-    }
-
-
-def consensus_ensemble(n):
-    """The config-3 opinion-dynamics ensemble, defined ONCE for every
-    consumer (this config's sweep and ``scripts/physics_consensus.py``):
-    ER G(n, 6/n) seed 0 with isolates removed, mirroring the reference's
-    analytic isolate treatment (`ER_BDCM_entropy.ipynb:283-291`). Returns
-    (graph, n_isolates, nbr_device, deg_device) — tables uploaded once."""
-    from graphdyn.graphs import remove_isolates
-
-    g, n_iso = remove_isolates(erdos_renyi_graph(n, 6.0 / n, seed=0))
-    return g, n_iso, jnp.asarray(g.nbr), jnp.asarray(g.deg)
-
-
-def consensus_curve(g, R, m0_list, max_steps, chunk=10, nbr_dev=None,
-                    deg_dev=None, progress=None):
-    """The m(0)→consensus curve as a list of row dicts (one per m(0),
-    seed-offset 1000+k). ``progress`` is an optional per-row callback."""
-    rows = []
-    for k, m0 in enumerate(m0_list):
-        pt = consensus_point(g, R, m0, max_steps, chunk, seed=1000 + k,
-                             nbr_dev=nbr_dev, deg_dev=deg_dev)
-        rows.append(pt)
-        if progress is not None:
-            progress(pt)
-    return rows
-
-
 def run_consensus_sweep(n, R, m0_list, max_steps, chunk=10):
     """The config's PHYSICS, not just its GB/s: sweep the initial
     magnetization m(0) and record which initializations flow to opinion
@@ -105,8 +43,12 @@ def run_consensus_sweep(n, R, m0_list, max_steps, chunk=10):
     O(1) frozen/blinking small components of sparse ER that block strict
     all-equal consensus at a rate set by component statistics, not by the
     dynamics), strict-consensus fraction, mean steps to near-consensus
-    (resolution = ``chunk``), and mean |m_final|. One JSON line per m(0)."""
-    g, n_iso, nbr_dev, deg_dev = consensus_ensemble(n)
+    (resolution = ``chunk``), and mean |m_final|. One JSON line per m(0).
+    The experiment driver lives in `graphdyn.models.consensus`; this config
+    only reports its rows in the benchmark-JSON-line format."""
+    from graphdyn.models.consensus import consensus_curve, er_consensus_ensemble
+
+    g, n_iso, nbr_dev, deg_dev = er_consensus_ensemble(n)
     for pt in consensus_curve(g, R, m0_list, max_steps, chunk,
                               nbr_dev=nbr_dev, deg_dev=deg_dev):
         pt = dict(pt)

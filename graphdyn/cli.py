@@ -128,6 +128,38 @@ def build_parser() -> argparse.ArgumentParser:
              "incompatible with --checkpoint)",
     )
 
+    cons = sub.add_parser(
+        "consensus",
+        help="forward opinion-consensus m(0) sweep (the phenomenon the "
+             "entropy curves quantify — `ER_BDCM_entropy.ipynb:113-123`)",
+    )
+    cons.add_argument("--n", type=int, default=100_000)
+    cons.add_argument("--c", type=float, default=6.0, help="ER mean degree")
+    cons.add_argument("--rule", choices=["majority", "minority"],
+                      default="majority")
+    cons.add_argument("--tie", choices=["stay", "change"], default="stay")
+    cons.add_argument("--replicas", type=int, default=512)
+    cons.add_argument(
+        "--m0", type=float, nargs="+",
+        default=[0.0, 0.01, 0.02, 0.03, 0.05, 0.07, 0.1, 0.15, 0.2, 0.3],
+        help="initial-magnetization grid",
+    )
+    cons.add_argument("--max-steps", type=int, default=2000)
+    cons.add_argument(
+        "--chunk", type=int, default=10,
+        help="steps per consensus check (= first-passage resolution)",
+    )
+    cons.add_argument(
+        "--near-eps", type=float, default=0.01,
+        help="near-consensus threshold: |m_final| >= 1 - near_eps",
+    )
+    cons.add_argument("--seed", type=int, default=0, help="graph seed")
+    cons.add_argument("--out", default=None, help="json path for the curve")
+    cons.add_argument(
+        "--plot", default=None, metavar="PNG",
+        help="render consensus fraction + first-passage vs m(0)",
+    )
+
     ent = sub.add_parser("entropy", help="BDCM entropy λ-sweep (notebook)")
     ent.add_argument("--n", type=int, default=1000)
     ent.add_argument("--deg", type=float, nargs="+", default=[1.0, 1.5, 2.0])
@@ -306,6 +338,43 @@ def main(argv=None) -> int:
             "time": out.time.tolist(),
             "out": args.out,
         }))
+    elif args.cmd == "consensus":
+        from graphdyn.models.consensus import consensus_curve, er_consensus_ensemble
+
+        if args.plot:
+            import importlib.util
+
+            if importlib.util.find_spec("matplotlib") is None:
+                raise SystemExit(
+                    "--plot requires matplotlib, which is not installed"
+                )
+        g, n_iso, nbr_dev, deg_dev = er_consensus_ensemble(
+            args.n, c=args.c, seed=args.seed
+        )
+        rows = consensus_curve(
+            g, args.replicas, args.m0, args.max_steps, chunk=args.chunk,
+            nbr_dev=nbr_dev, deg_dev=deg_dev, rule=args.rule, tie=args.tie,
+            near_eps=args.near_eps,
+        )
+        from graphdyn.models.consensus import consensus_doc
+
+        doc = consensus_doc(
+            g, n_iso, rows, c=args.c, seed=args.seed, rule=args.rule,
+            tie=args.tie, near_eps=args.near_eps, solver="consensus",
+        )
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(doc, f, indent=1)
+        if args.plot:
+            from graphdyn.plotting import plot_consensus_curve
+
+            plot_consensus_curve(
+                rows,
+                title=f"ER c={args.c:g}, N={g.n}, R={args.replicas}, "
+                      f"{args.rule}",
+                save_path=args.plot,
+            )
+        print(json.dumps(doc))
     elif args.cmd == "entropy":
         from graphdyn.models.entropy import entropy_grid
 

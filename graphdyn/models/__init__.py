@@ -1,2 +1,2 @@
 """L5 solvers ("model families"): SA-MCMC initialization search, HPr
-reinforced BP, BDCM entropy λ-sweep."""
+reinforced BP, BDCM entropy λ-sweep, forward opinion-consensus sweep."""

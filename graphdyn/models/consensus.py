@@ -1,0 +1,124 @@
+"""Forward opinion-consensus experiment: which initial magnetizations m(0)
+flow to consensus, and how fast.
+
+This is the forward-dynamics side of the thesis question (SURVEY.md §0.3):
+the reference quantifies the attractor landscape via BDCM entropy curves
+(`ER_BDCM_entropy.ipynb:113-123` — the biased-initialization axis) and
+searches initializations with SA/HPr; this driver measures the phenomenon
+those curves predict, directly, with the bit-packed replica kernel — sweep
+m(0), record the fraction of replicas reaching consensus, the first-passage
+time, and the final magnetization.
+
+Everything device-resident: biased packed draw, chunked consensus scan in
+one jitted `lax.while_loop` (`graphdyn.ops.packed.packed_consensus_scan`),
+per-point host traffic limited to a handful of scalars per replica.
+
+Two consensus notions are tracked per replica (both returned):
+
+- ``strict``: the absorbing homogeneous state, all spins equal — blocked on
+  sparse ER at an O(1) rate by frozen/blinking small components (a pair of
+  degree-1 nodes locked opposite, say), i.e. by component statistics rather
+  than the dynamics under study;
+- ``near``: |m_final| ≥ 1 − near_eps (default 0.99) — the giant component
+  has consensed; robust to those small components.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+def er_consensus_ensemble(n: int, c: float = 6.0, seed: int = 0):
+    """The standard opinion-dynamics ensemble — ER G(n, c/n) with isolates
+    removed, mirroring the reference's analytic isolate treatment
+    (`ER_BDCM_entropy.ipynb:283-291`). Returns
+    ``(graph, n_isolates, nbr_device, deg_device)``; the device tables are
+    uploaded exactly once for a whole sweep."""
+    import jax.numpy as jnp
+
+    from graphdyn.graphs import erdos_renyi_graph, remove_isolates
+
+    g, n_iso = remove_isolates(erdos_renyi_graph(n, c / n, seed=seed))
+    return g, n_iso, jnp.asarray(g.nbr), jnp.asarray(g.deg)
+
+
+def consensus_point(g, R: int, m0: float, max_steps: int, chunk: int = 10,
+                    seed: int = 1000, nbr_dev=None, deg_dev=None,
+                    rule: str = "majority", tie: str = "stay",
+                    near_eps: float = 0.01) -> dict:
+    """One m(0) point: biased device-resident init, chunked consensus scan,
+    per-replica statistics reduced to a plain dict. Callers sweeping many
+    points pass ``nbr_dev``/``deg_dev`` once — re-uploading the multi-MB
+    neighbor table per point is tunnel traffic the TPU link cannot
+    sustain."""
+    import jax.numpy as jnp
+
+    from graphdyn.ops.packed import draw_packed_biased, packed_consensus_scan
+
+    W = -(-R // 32)
+    sp = draw_packed_biased(seed, g.n, W, m0)
+    nbr_dev = jnp.asarray(g.nbr) if nbr_dev is None else nbr_dev
+    deg_dev = jnp.asarray(g.deg) if deg_dev is None else deg_dev
+    out = packed_consensus_scan(
+        nbr_dev, deg_dev, sp, R=W * 32, max_steps=max_steps, chunk=chunk,
+        near_eps=near_eps, rule=rule, tie=tie,
+    )
+    near = np.asarray(out["near"])[:R]
+    near_step = np.asarray(out["near_step"])[:R]
+    m_final = np.asarray(out["m_final"])[:R]
+    n_near = int(near.sum())
+    return {
+        "m0": float(m0),
+        "consensus_fraction": n_near / R,
+        "strict_fraction": float(np.asarray(out["strict"])[:R].mean()),
+        "mean_steps_to_consensus": (
+            float(near_step[near].mean()) if n_near else None
+        ),
+        "mean_abs_m_final": float(np.abs(m_final).mean()),
+        "max_steps": int(max_steps),
+        "step_resolution": int(chunk),
+        "replicas": int(R),
+    }
+
+
+def consensus_doc(g, n_iso: int, rows: list[dict], *, c: float = 6.0,
+                  seed: int = 0, rule: str = "majority", tie: str = "stay",
+                  near_eps: float = 0.01, **extra) -> dict:
+    """The one artifact schema for a consensus sweep — shared by the CLI
+    and `scripts/physics_consensus.py` so the two writers cannot drift
+    (the session collector reads ``backend`` from this doc)."""
+    import jax
+
+    return {
+        "what": "ER-majority consensus fraction & first-passage vs m(0)",
+        "graph": {"kind": "erdos_renyi", "n": g.n, "c": c,
+                  "isolates_removed": n_iso, "seed": seed},
+        "dynamics": {"rule": rule, "tie": tie,
+                     "update": "parallel/synchronous"},
+        "near_consensus_def": f"|m_final| >= {1.0 - near_eps:g}",
+        "backend": jax.default_backend(),
+        "rows": rows,
+        **extra,
+    }
+
+
+def consensus_curve(g, R: int, m0_list: Sequence[float], max_steps: int,
+                    chunk: int = 10, nbr_dev=None, deg_dev=None,
+                    rule: str = "majority", tie: str = "stay",
+                    near_eps: float = 0.01, progress=None) -> list[dict]:
+    """The m(0)→consensus curve as a list of row dicts (one per m(0), seed
+    offset 1000+k so points are independent). ``progress`` is an optional
+    per-row callback (e.g. a print)."""
+    rows = []
+    for k, m0 in enumerate(m0_list):
+        pt = consensus_point(
+            g, R, m0, max_steps, chunk, seed=1000 + k,
+            nbr_dev=nbr_dev, deg_dev=deg_dev, rule=rule, tie=tie,
+            near_eps=near_eps,
+        )
+        rows.append(pt)
+        if progress is not None:
+            progress(pt)
+    return rows

@@ -194,14 +194,18 @@ def build_lightcone_tables_device(graph, radius: int) -> LightconeTables:
     nbr = jnp.asarray(graph.nbr)
     dmax = int(nbr.shape[1])
     B = ball_bound(dmax, radius)
-    if B > 16384:
-        # the static tree bound pads every row to the WORST-degree ball —
-        # fine for (near-)regular graphs (d=3, r=3 ⇒ B=22), hopeless for
-        # ragged ones (ER dmax≈20, r=3 ⇒ B=7621 ⇒ n·B·d tables). The host
-        # builder sizes B to the largest ACTUAL ball instead.
+    # the static tree bound pads every row to the WORST-degree ball — fine
+    # for (near-)regular graphs (d=3, r=3 ⇒ B=22 ⇒ ~620 MB of tables at
+    # n=1e6), hopeless for ragged ones (ER dmax≈20, r=3 ⇒ B=7621 ⇒
+    # tens of GB at n=1e5). Refuse on projected TABLE memory, not on B
+    # alone (a big B on a tiny graph is fine); the host builder sizes B to
+    # the largest ACTUAL ball instead.
+    table_bytes = 4 * n * B * (1 + 2 * dmax)     # ball + nbr_slot + nbr_glob
+    if table_bytes > 8e9:
         raise ValueError(
-            f"tree ball bound {B} at dmax={dmax}, radius={radius} is too "
-            "ragged for the device builder's static padding; use "
+            f"device ball tables would need ~{table_bytes / 1e9:.0f} GB "
+            f"(tree bound B={B} at dmax={dmax}, radius={radius}, n={n}) — "
+            "too ragged for the device builder's static padding; use "
             "build_lightcone_tables (host BFS, actual-ball-sized tables)"
         )
 

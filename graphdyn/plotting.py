@@ -110,3 +110,33 @@ def plot_entropy_grid(grid, *, rep: int | str = "mean", save_path=None):
         ax.figure.tight_layout()
         ax.figure.savefig(save_path)
     return ax
+
+def plot_consensus_curve(rows, *, title=None, save_path=None):
+    """Render the m(0)→consensus curve family from
+    :func:`graphdyn.models.consensus.consensus_curve` rows: consensus
+    fraction (near + strict) vs m(0) on the left, mean first-passage steps
+    on the right. Returns the (ax_fraction, ax_steps) pair."""
+    plt = _mpl()
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(9.2, 3.6), dpi=120)
+    m0s = [r["m0"] for r in rows]
+    ax1.plot(m0s, [r["consensus_fraction"] for r in rows],
+             "o-", ms=4, lw=1.2, label="near (|m| ≥ 1−ε)")
+    ax1.plot(m0s, [r["strict_fraction"] for r in rows],
+             "s--", ms=4, lw=1.0, label="strict (all equal)")
+    ax1.set_xlabel("initial magnetization m(0)")
+    ax1.set_ylabel("consensus fraction")
+    ax1.set_ylim(-0.05, 1.05)
+    ax1.legend(frameon=False, fontsize=8)
+    if title:
+        ax1.set_title(title, fontsize=9)
+    steps = [(r["m0"], r["mean_steps_to_consensus"]) for r in rows
+             if r["mean_steps_to_consensus"] is not None]
+    if steps:
+        ax2.plot(*zip(*steps), "o-", ms=4, lw=1.2)
+    ax2.set_xlabel("initial magnetization m(0)")
+    ax2.set_ylabel("mean steps to consensus")
+    ax2.set_title("first-passage time", fontsize=9)
+    fig.tight_layout()
+    if save_path:
+        fig.savefig(save_path)
+    return ax1, ax2

@@ -58,10 +58,10 @@ def main():
     jax.devices()
     init_done.set()
 
-    from benchmarks.config3_er_majority import consensus_curve, consensus_ensemble
+    from graphdyn.models.consensus import consensus_curve, er_consensus_ensemble
 
     n, R, max_steps = (100_000, 512, 2000) if a.full else (20_000, 128, 500)
-    g, n_iso, nbr_dev, deg_dev = consensus_ensemble(n)
+    g, n_iso, nbr_dev, deg_dev = er_consensus_ensemble(n)
     t0 = time.time()
 
     def progress(pt):
@@ -74,46 +74,24 @@ def main():
                            nbr_dev=nbr_dev, deg_dev=deg_dev,
                            progress=progress)
 
-    doc = {
-        "what": "ER-majority consensus fraction & first-passage vs m(0)",
-        "graph": {"kind": "erdos_renyi", "n": g.n, "c": 6.0,
-                  "isolates_removed": n_iso, "seed": 0},
-        "dynamics": {"rule": "majority", "tie": "stay",
-                     "update": "parallel/synchronous"},
-        "near_consensus_def": "|m_final| >= 0.99",
-        "backend": jax.default_backend(),
-        "elapsed_s": round(time.time() - t0, 1),
-        "rows": rows,
+    from graphdyn.models.consensus import consensus_doc
+
+    doc = consensus_doc(
+        g, n_iso, rows,
+        elapsed_s=round(time.time() - t0, 1),
         **({"relay": relay_note} if relay_note else {}),
-    }
+    )
     with open(a.out_json, "w") as f:
         json.dump(doc, f, indent=1)
     print(f"wrote {a.out_json} (backend={doc['backend']})")
 
     if a.out_png:
-        import matplotlib
-        matplotlib.use("Agg")
-        import matplotlib.pyplot as plt
+        from graphdyn.plotting import plot_consensus_curve
 
-        m0s = [r["m0"] for r in rows]
-        fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(9.2, 3.6))
-        ax1.plot(m0s, [r["consensus_fraction"] for r in rows],
-                 "o-", label="near (|m|≥0.99)")
-        ax1.plot(m0s, [r["strict_fraction"] for r in rows],
-                 "s--", label="strict (all equal)")
-        ax1.set_xlabel("initial magnetization m(0)")
-        ax1.set_ylabel("consensus fraction")
-        ax1.set_ylim(-0.05, 1.05)
-        ax1.legend(frameon=False)
-        ax1.set_title(f"ER c=6, N={g.n}, R={R}, majority")
-        steps = [r["mean_steps_to_consensus"] for r in rows]
-        ax2.plot([m for m, s in zip(m0s, steps) if s is not None],
-                 [s for s in steps if s is not None], "o-")
-        ax2.set_xlabel("initial magnetization m(0)")
-        ax2.set_ylabel("mean steps to consensus")
-        ax2.set_title("first-passage time")
-        fig.tight_layout()
-        fig.savefig(a.out_png, dpi=120)
+        plot_consensus_curve(
+            rows, title=f"ER c=6, N={g.n}, R={R}, majority",
+            save_path=a.out_png,
+        )
         print(f"wrote {a.out_png}")
     return 0
 

@@ -147,11 +147,14 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
     # a renamed test (or changed parametrize id) must not silently fall out
     # of the slow tier: flag _SLOW entries whose FILE was collected but
-    # whose test no longer matches. Warning, not error — a -k filtered run
-    # legitimately collects a subset.
+    # whose test no longer matches. Warning, not error — and only for
+    # whole-file/dir invocations: -k filters and `file.py::test` selections
+    # legitimately collect a subset.
+    if config.getoption("-k") or any("::" in a for a in config.args):
+        return
     files = {f for f, _ in collected}
     stale = sorted(e for e in _SLOW if e[0] in files and e not in collected)
-    if stale and not config.getoption("-k"):
+    if stale:
         import warnings
 
         warnings.warn(

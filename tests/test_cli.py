@@ -126,3 +126,26 @@ def test_cli_entropy_union(tmp_path, capsys):
     saved = load_results_npz(p)
     assert saved["ent1_deg0"].shape[1] == 3
     assert saved["ent1_deg1"].shape[1] == 3
+
+
+def test_cli_consensus(tmp_path, capsys):
+    """The forward opinion-consensus driver: m(0) sweep with json + plot
+    artifacts and monotone physics (more bias, no less consensus)."""
+    pytest.importorskip("matplotlib")
+    out = str(tmp_path / "cons.json")
+    png = str(tmp_path / "cons.png")
+    rc = main([
+        "consensus", "--n", "2000", "--replicas", "64",
+        "--m0", "0.0", "0.1", "0.3", "--max-steps", "200",
+        "--out", out, "--plot", png,
+    ])
+    assert rc == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["solver"] == "consensus"
+    fracs = [r["consensus_fraction"] for r in line["rows"]]
+    assert len(fracs) == 3 and fracs[1] <= fracs[2] and fracs[2] >= 0.9
+    with open(out) as f:
+        assert json.load(f)["rows"] == line["rows"]
+    import os
+
+    assert os.path.getsize(png) > 0
