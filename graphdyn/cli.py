@@ -154,6 +154,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="near-consensus threshold: |m_final| >= 1 - near_eps",
     )
     cons.add_argument("--seed", type=int, default=0, help="graph seed")
+    cons.add_argument(
+        "--sharded", action="store_true",
+        help="shard the packed word axis over all visible devices (zero "
+             "per-step collectives; bit-identical to unsharded)",
+    )
     cons.add_argument("--out", default=None, help="json path for the curve")
     cons.add_argument(
         "--plot", default=None, metavar="PNG",
@@ -339,7 +344,11 @@ def main(argv=None) -> int:
             "out": args.out,
         }))
     elif args.cmd == "consensus":
-        from graphdyn.models.consensus import consensus_curve, er_consensus_ensemble
+        from graphdyn.models.consensus import (
+            consensus_curve,
+            consensus_doc,
+            er_consensus_ensemble,
+        )
 
         if args.plot:
             import importlib.util
@@ -351,13 +360,18 @@ def main(argv=None) -> int:
         g, n_iso, nbr_dev, deg_dev = er_consensus_ensemble(
             args.n, c=args.c, seed=args.seed
         )
+        mesh = None
+        if args.sharded:
+            import jax
+
+            from graphdyn.parallel.mesh import make_mesh
+
+            mesh = make_mesh((len(jax.devices()),), ("replica",))
         rows = consensus_curve(
             g, args.replicas, args.m0, args.max_steps, chunk=args.chunk,
             nbr_dev=nbr_dev, deg_dev=deg_dev, rule=args.rule, tie=args.tie,
-            near_eps=args.near_eps,
+            near_eps=args.near_eps, mesh=mesh,
         )
-        from graphdyn.models.consensus import consensus_doc
-
         doc = consensus_doc(
             g, n_iso, rows, c=args.c, seed=args.seed, rule=args.rule,
             tie=args.tie, near_eps=args.near_eps, solver="consensus",

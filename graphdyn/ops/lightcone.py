@@ -200,12 +200,15 @@ def build_lightcone_tables_device(graph, radius: int) -> LightconeTables:
     # tens of GB at n=1e5). Refuse on projected TABLE memory, not on B
     # alone (a big B on a tiny graph is fine); the host builder sizes B to
     # the largest ACTUAL ball instead.
-    table_bytes = 4 * n * B * (1 + 2 * dmax)     # ball + nbr_slot + nbr_glob
-    if table_bytes > 8e9:
+    # peak BUILD memory, not just the three output tables: the jitted build
+    # also materializes q/pos/hit/slot, each [n, B·dmax] int32 — ~4 extra
+    # table-sized buffers. ≈ 4·n·B·(1+2·dmax) output + 16·n·B·dmax temps.
+    build_bytes = 4 * n * B * (1 + 6 * dmax)
+    if build_bytes > 8e9:
         raise ValueError(
-            f"device ball tables would need ~{table_bytes / 1e9:.0f} GB "
-            f"(tree bound B={B} at dmax={dmax}, radius={radius}, n={n}) — "
-            "too ragged for the device builder's static padding; use "
+            f"device ball-table build would peak at ~{build_bytes / 1e9:.0f}"
+            f" GB (tree bound B={B} at dmax={dmax}, radius={radius}, n={n})"
+            " — too ragged for the device builder's static padding; use "
             "build_lightcone_tables (host BFS, actual-ball-sized tables)"
         )
 

@@ -204,14 +204,17 @@ def packed_consensus_fraction(sp, n_replicas: int, target: int = 1) -> float:
     return float(bits.reshape(-1)[:n_replicas].sum()) / n_replicas
 
 
-def draw_packed_biased(seed: int, n: int, W: int, m0: float) -> jnp.ndarray:
+def draw_packed_biased(seed: int, n: int, W: int, m0: float,
+                       out_shardings=None) -> jnp.ndarray:
     """uint32[n, W] packed spins drawn ON DEVICE with initial magnetization
     bias: each bit is +1 (set) independently with probability (1+m0)/2, so
     E[m(0)] = m0 per replica — the biased-initialization axis of the thesis
     question (`ER_BDCM_entropy.ipynb:113-123`: which m(0) flow to consensus).
     Device-resident for the same reason as ``benchmarks.common.draw_u32``:
     host→device state uploads are what the tunneled TPU link cannot sustain.
-    """
+    ``out_shardings`` lands the state directly in a word-axis sharding for
+    the multi-device scan (the draw is deterministic in ``seed`` regardless,
+    so sharded and unsharded states are bit-identical)."""
     def f():
         bits = jax.random.bernoulli(
             jax.random.key(seed), (1.0 + m0) / 2.0, (n, W, WORD)
@@ -219,7 +222,7 @@ def draw_packed_biased(seed: int, n: int, W: int, m0: float) -> jnp.ndarray:
         shifts = jnp.arange(WORD, dtype=jnp.uint32)
         return (bits.astype(jnp.uint32) << shifts).sum(axis=2).astype(jnp.uint32)
 
-    return jax.jit(f)()
+    return jax.jit(f, out_shardings=out_shardings)()
 
 
 def _consensus_bits(sp: jnp.ndarray, R: int) -> jnp.ndarray:
