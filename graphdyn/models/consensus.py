@@ -103,6 +103,85 @@ def consensus_point(g, R: int, m0: float, max_steps: int, chunk: int = 10,
     }
 
 
+def consensus_curve_ensemble(n: int, R: int, m0_list: Sequence[float],
+                             max_steps: int, *, c: float = 6.0,
+                             graph_seeds: Sequence[int] = (0, 1, 2),
+                             chunk: int = 10, rule: str = "majority",
+                             tie: str = "stay", near_eps: float = 0.01,
+                             mesh=None, progress=None):
+    """The consensus curve over an ENSEMBLE of graph instances: one
+    :func:`consensus_curve` per graph seed, plus per-m(0) aggregates
+    (mean and instance spread) — the same instance-spread discipline as
+    the entropy golden anchors. Returns ``(per_seed, aggregate)`` where
+    ``per_seed`` is a list of {graph_seed, n, isolates_removed, rows} and
+    ``aggregate`` one row per m(0) with mean/std/min/max of the consensus
+    fraction and the mean first-passage over instances."""
+    per_seed = []
+    for s in graph_seeds:
+        g, n_iso, nbr_dev, deg_dev = er_consensus_ensemble(n, c=c, seed=s)
+        rows = consensus_curve(
+            g, R, m0_list, max_steps, chunk, nbr_dev=nbr_dev,
+            deg_dev=deg_dev, rule=rule, tie=tie, near_eps=near_eps,
+            mesh=mesh,
+            progress=(lambda pt, s=s: progress(s, pt)) if progress else None,
+        )
+        per_seed.append({"graph_seed": int(s), "n": g.n,
+                         "isolates_removed": n_iso, "rows": rows})
+    aggregate = []
+    for j, m0 in enumerate(m0_list):
+        fr = np.array([ps["rows"][j]["consensus_fraction"]
+                       for ps in per_seed])
+        steps = [ps["rows"][j]["mean_steps_to_consensus"]
+                 for ps in per_seed]
+        steps = [x for x in steps if x is not None]
+        aggregate.append({
+            "m0": float(m0),
+            "consensus_fraction_mean": float(fr.mean()),
+            # None (not 0.0) for a single instance: no spread was MEASURED,
+            # and the plotter keys its error-bar branch on this
+            "consensus_fraction_std": float(fr.std(ddof=1))
+            if len(fr) > 1 else None,
+            "consensus_fraction_min": float(fr.min()),
+            "consensus_fraction_max": float(fr.max()),
+            "mean_steps_to_consensus": (float(np.mean(steps))
+                                        if steps else None),
+            "instances": len(per_seed),
+            # alias for single-run consumers (collector, plotter)
+            "consensus_fraction": float(fr.mean()),
+        })
+    return per_seed, aggregate
+
+
+def consensus_ensemble_doc(n: int, per_seed: list[dict],
+                           aggregate: list[dict], *, c: float = 6.0,
+                           rule: str = "majority", tie: str = "stay",
+                           near_eps: float = 0.01, **extra) -> dict:
+    """Artifact schema for a multi-instance sweep: ``rows`` carries the
+    per-m(0) aggregates (with instance spread), ``per_seed`` the raw
+    curves. Same top-level keys the session collector reads."""
+    import jax
+
+    return {
+        "what": (f"ER-{rule} consensus fraction & first-passage vs m(0), "
+                 f"{len(per_seed)}-instance ensemble"),
+        # n = REQUESTED size; per-instance post-isolate sizes alongside so
+        # tooling never compares pre- vs post-isolate counts (the
+        # single-run doc records the post-isolate g.n)
+        "graph": {"kind": "erdos_renyi", "n": n, "c": c,
+                  "graph_seeds": [ps["graph_seed"] for ps in per_seed],
+                  "n_kept": [ps["n"] for ps in per_seed],
+                  "isolates_removed": [ps["isolates_removed"]
+                                       for ps in per_seed]},
+        "dynamics": {"rule": rule, "tie": tie,
+                     "update": "parallel/synchronous"},
+        "near_consensus_def": f"|m_final| >= {1.0 - near_eps:g}",
+        "backend": jax.default_backend(),
+        "rows": aggregate,
+        "per_seed": per_seed,
+        **extra,
+    }
+
+
 def consensus_doc(g, n_iso: int, rows: list[dict], *, c: float = 6.0,
                   seed: int = 0, rule: str = "majority", tie: str = "stay",
                   near_eps: float = 0.01, **extra) -> dict:

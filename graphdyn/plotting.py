@@ -119,10 +119,18 @@ def plot_consensus_curve(rows, *, title=None, save_path=None):
     plt = _mpl()
     fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(9.2, 3.6), dpi=120)
     m0s = [r["m0"] for r in rows]
-    ax1.plot(m0s, [r["consensus_fraction"] for r in rows],
-             "o-", ms=4, lw=1.2, label="near (|m| ≥ 1−ε)")
-    ax1.plot(m0s, [r["strict_fraction"] for r in rows],
-             "s--", ms=4, lw=1.0, label="strict (all equal)")
+    frac = [r["consensus_fraction"] for r in rows]
+    yerr = [r.get("consensus_fraction_std") for r in rows]
+    if any(e is not None for e in yerr):
+        # ensemble rows: instance spread as error bars
+        ax1.errorbar(m0s, frac, yerr=[e or 0.0 for e in yerr],
+                     fmt="o-", ms=4, lw=1.2, capsize=2.5,
+                     label="near (|m| ≥ 1−ε), ±σ over instances")
+    else:
+        ax1.plot(m0s, frac, "o-", ms=4, lw=1.2, label="near (|m| ≥ 1−ε)")
+    if "strict_fraction" in rows[0]:
+        ax1.plot(m0s, [r["strict_fraction"] for r in rows],
+                 "s--", ms=4, lw=1.0, label="strict (all equal)")
     ax1.set_xlabel("initial magnetization m(0)")
     ax1.set_ylabel("consensus fraction")
     ax1.set_ylim(-0.05, 1.05)

@@ -38,6 +38,12 @@ def main():
     ap.add_argument("out_json")
     ap.add_argument("out_png", nargs="?", default=None)
     ap.add_argument("--full", action="store_true")
+    ap.add_argument(
+        "--instances", type=int, default=None,
+        help="graph instances (default: 3 with --full, 1 smoke); lower it "
+             "when the stage budget is tight — there is no per-instance "
+             "resume, so a timeout loses the whole sweep",
+    )
     a = ap.parse_args()
 
     # the same wedge protection as bench.py: an unforced run on a wedged
@@ -58,38 +64,48 @@ def main():
     jax.devices()
     init_done.set()
 
-    from graphdyn.models.consensus import consensus_curve, er_consensus_ensemble
+    from graphdyn.models.consensus import (
+        consensus_curve_ensemble,
+        consensus_ensemble_doc,
+    )
 
-    n, R, max_steps = (100_000, 512, 2000) if a.full else (20_000, 128, 500)
-    g, n_iso, nbr_dev, deg_dev = er_consensus_ensemble(n)
+    # --full: three graph instances for error bars (the same instance-spread
+    # discipline as the entropy golden anchors); smoke: one
+    n, R, max_steps, seeds = ((100_000, 512, 2000, (0, 1, 2)) if a.full
+                              else (20_000, 128, 500, (0,)))
+    if a.instances is not None:
+        seeds = tuple(range(a.instances))
     t0 = time.time()
 
-    def progress(pt):
-        print(f"m0={pt['m0']:g}: consensus={pt['consensus_fraction']:.3f} "
+    def progress(seed, pt):
+        print(f"seed={seed} m0={pt['m0']:g}: "
+              f"consensus={pt['consensus_fraction']:.3f} "
               f"strict={pt['strict_fraction']:.3f} "
               f"steps={pt['mean_steps_to_consensus']} "
               f"|m_f|={pt['mean_abs_m_final']:.3f}", flush=True)
 
-    rows = consensus_curve(g, R, M0_GRID, max_steps, chunk=10,
-                           nbr_dev=nbr_dev, deg_dev=deg_dev,
-                           progress=progress)
+    per_seed, aggregate = consensus_curve_ensemble(
+        n, R, M0_GRID, max_steps, graph_seeds=seeds, chunk=10,
+        progress=progress,
+    )
 
-    from graphdyn.models.consensus import consensus_doc
-
-    doc = consensus_doc(
-        g, n_iso, rows,
+    doc = consensus_ensemble_doc(
+        n, per_seed, aggregate,
         elapsed_s=round(time.time() - t0, 1),
         **({"relay": relay_note} if relay_note else {}),
     )
     with open(a.out_json, "w") as f:
         json.dump(doc, f, indent=1)
-    print(f"wrote {a.out_json} (backend={doc['backend']})")
+    print(f"wrote {a.out_json} (backend={doc['backend']}, "
+          f"{len(per_seed)} instances)")
 
     if a.out_png:
         from graphdyn.plotting import plot_consensus_curve
 
         plot_consensus_curve(
-            rows, title=f"ER c=6, N={g.n}, R={R}, majority",
+            aggregate,
+            title=f"ER c=6, N={n}, R={R}, majority, "
+                  f"{len(per_seed)} instances",
             save_path=a.out_png,
         )
         print(f"wrote {a.out_png}")

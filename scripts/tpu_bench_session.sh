@@ -61,7 +61,10 @@ if chip_doc_ok "$OUT/consensus_tpu.json"; then
     echo "[tpu-session] consensus physics already captured; skipping" >&2
 else
     echo "[tpu-session] ER-majority consensus physics (m0 sweep) ..." >&2
-    GRAPHDYN_FORCE_PLATFORM=axon timeout 1500 \
+    # 2700 s: --full is a 3-instance sweep (~20 min measured on CPU; far
+    # faster on chip, but the budget must cover a slow tunnel — there is
+    # no per-instance resume, so a timeout loses the whole sweep)
+    GRAPHDYN_FORCE_PLATFORM=axon timeout 2700 \
         python scripts/physics_consensus.py \
         "$OUT/consensus_tpu.json" "$OUT/consensus_tpu.png" --full \
         > "$OUT/consensus_tpu.log" 2>&1

@@ -15,7 +15,7 @@
 set -u
 cd "$(dirname "$0")/.."
 . scripts/_session_lib.sh
-OUT="${1:-tpu_session_r04}"
+OUT="${1:-tpu_session_r05}"
 mkdir -p "$OUT"
 
 if [ "${SHORT:-0}" = "1" ]; then
@@ -41,6 +41,21 @@ else
         python scripts/physics_r04.py hpr "$OUT/physics_tpu.json" \
         > "$OUT/physics_tpu.log" 2>&1
     echo "[tpu-remainder] physics rc=$?" >&2
+fi
+
+if chip_doc_ok "$OUT/consensus_tpu.json"; then
+    echo "[tpu-remainder] consensus physics already captured; skipping" >&2
+else
+    echo "[tpu-remainder] ER-majority consensus physics (m0 sweep) ..." >&2
+    # instances scale with the budget; no per-instance resume, so a
+    # timeout loses the whole sweep — size it to fit
+    if [ "${SHORT:-0}" = "1" ]; then CONS_T=900; CONS_I=1; else CONS_T=2700; CONS_I=3; fi
+    GRAPHDYN_FORCE_PLATFORM=axon timeout "$CONS_T" \
+        python scripts/physics_consensus.py \
+        "$OUT/consensus_tpu.json" "$OUT/consensus_tpu.png" --full \
+        --instances "$CONS_I" \
+        > "$OUT/consensus_tpu.log" 2>&1
+    echo "[tpu-remainder] consensus rc=$?" >&2
 fi
 
 if [ "$VALIDATE" -gt 0 ]; then

@@ -35,9 +35,12 @@ if chip_doc_ok "$OUT/consensus_tpu.json"; then
     echo "[tpu-short] consensus physics already captured; skipping" >&2
 else
     echo "[tpu-short] ER-majority consensus physics (m0 sweep) ..." >&2
+    # single instance: the late-recovery session is time-boxed, and one
+    # chip-labeled instance beats three lost to the timeout (no resume)
     GRAPHDYN_FORCE_PLATFORM=axon timeout 1200 \
         python scripts/physics_consensus.py \
         "$OUT/consensus_tpu.json" "$OUT/consensus_tpu.png" --full \
+        --instances 1 \
         > "$OUT/consensus_tpu.log" 2>&1
     echo "[tpu-short] consensus rc=$?" >&2
 fi

@@ -20,9 +20,11 @@ FULL_UNTIL="${3:-0}"
 FLAG="$OUT/.fired"
 MAX_FIRES="${MAX_FIRES:-3}"
 # Done = configs suite ok AND physics artifact parses (a timeout-truncated
-# physics file must keep a refire available), OR a session that produces
-# neither (the abbreviated bench-only one) self-reported completion.
-DONE_CHECK="${DONE_CHECK:-[ -f '$OUT/.short_session_done' ] || python -c \"import json; d=json.load(open('$OUT/configs_tpu.json')); json.load(open('$OUT/physics_tpu.json')); exit(0 if d.get('ok') else 1)\" 2>/dev/null}"
+# physics file must keep a refire available) AND the consensus artifact is
+# chip-valid (backend tpu/axon, no fallback label), OR a session that
+# produces none of those (the abbreviated bench-only one) self-reported
+# completion.
+DONE_CHECK="${DONE_CHECK:-[ -f '$OUT/.short_session_done' ] || python -c \"import json; d=json.load(open('$OUT/configs_tpu.json')); json.load(open('$OUT/physics_tpu.json')); c=json.load(open('$OUT/consensus_tpu.json')); exit(0 if d.get('ok') and c.get('backend') in ('tpu','axon') and 'relay' not in c else 1)\" 2>/dev/null}"
 mkdir -p "$OUT"
 while true; do
     FIRES=$( [ -f "$FLAG" ] && wc -l < "$FLAG" || echo 0 )

@@ -81,10 +81,13 @@ _SLOW = {
     ("test_bdcm.py", "test_bucketed_sweep_matches_unbucketed"),
     ("test_bdcm.py", "test_entropy_sweep_bucketed_matches"),
     ("test_bench_contract.py", "test_bench_smoke_emits_one_json_line"),
+    ("test_cli.py", "test_cli_consensus"),
     ("test_cli.py", "test_cli_entropy"),
     ("test_cli.py", "test_cli_entropy_union"),
     ("test_cli.py", "test_cli_hpr_batch_device_init"),
     ("test_cli.py", "test_cli_sa_sharded"),
+    ("test_consensus.py", "test_ensemble_aggregate_matches_per_seed"),
+    ("test_consensus.py", "test_ensemble_doc_schema"),
     ("test_dynamics.py", "test_solvers_run_under_nondefault_rules"),
     ("test_entropy.py", "test_congruent_ensemble_managed_resume_bit_exact"),
     ("test_entropy.py", "test_entropy_checkpointer_and_counts"),
@@ -124,6 +127,7 @@ _SLOW = {
     ("test_pallas_packed.py", "test_pallas_packed_general_matches_xla[change-minority]"),
     ("test_pallas_packed.py", "test_pallas_packed_general_matches_xla[stay-majority]"),
     ("test_pallas_packed.py", "test_pallas_packed_general_matches_xla[stay-minority]"),
+    ("test_parallel.py", "test_consensus_scan_word_sharded_bit_parity"),
     ("test_parallel.py", "test_sharded_sweep_f64_matches_unsharded"),
     ("test_parallel.py", "test_sharded_sweep_matches_unsharded[er]"),
     ("test_parallel.py", "test_union_entropy_mesh_matches_unsharded"),

@@ -1,0 +1,55 @@
+"""Forward opinion-consensus driver (graphdyn.models.consensus): ensemble
+aggregation, artifact schema, and physics sanity (more bias ⇒ no less
+consensus). The packed-domain first-passage bookkeeping itself is
+oracle-tested in tests/test_packed.py."""
+
+import numpy as np
+
+from graphdyn.models.consensus import (
+    consensus_curve_ensemble,
+    consensus_doc,
+    consensus_ensemble_doc,
+    er_consensus_ensemble,
+)
+
+
+def test_ensemble_aggregate_matches_per_seed():
+    m0s = (0.0, 0.1, 0.3)
+    per_seed, agg = consensus_curve_ensemble(
+        1500, 64, m0s, max_steps=200, graph_seeds=(0, 1, 2),
+    )
+    assert [ps["graph_seed"] for ps in per_seed] == [0, 1, 2]
+    assert len(agg) == len(m0s)
+    for j, row in enumerate(agg):
+        fr = np.array([ps["rows"][j]["consensus_fraction"]
+                       for ps in per_seed])
+        assert row["m0"] == m0s[j]
+        assert row["consensus_fraction_mean"] == float(fr.mean())
+        assert row["consensus_fraction"] == row["consensus_fraction_mean"]
+        np.testing.assert_allclose(
+            row["consensus_fraction_std"], float(fr.std(ddof=1)), atol=1e-12
+        )
+        assert (row["consensus_fraction_min"]
+                <= row["consensus_fraction_mean"]
+                <= row["consensus_fraction_max"])
+        assert row["instances"] == 3
+    # physics: strong bias consenses essentially always, on every instance
+    assert agg[-1]["consensus_fraction_min"] >= 0.95
+
+
+def test_ensemble_doc_schema():
+    per_seed, agg = consensus_curve_ensemble(
+        800, 32, (0.2,), max_steps=100, graph_seeds=(4, 5),
+    )
+    doc = consensus_ensemble_doc(800, per_seed, agg, elapsed_s=1.0)
+    assert doc["graph"]["graph_seeds"] == [4, 5]
+    assert doc["rows"] is agg and doc["per_seed"] is per_seed
+    assert "majority" in doc["what"]
+    assert doc["backend"] == "cpu"
+    assert doc["elapsed_s"] == 1.0
+    # the single-run doc shares the same reader-facing keys
+    g, n_iso, _, _ = er_consensus_ensemble(800, seed=4)
+    single = consensus_doc(g, n_iso, per_seed[0]["rows"])
+    for key in ("what", "graph", "dynamics", "near_consensus_def",
+                "backend", "rows"):
+        assert key in single and key in doc
