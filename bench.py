@@ -122,30 +122,17 @@ def main():
     # An explicit GRAPHDYN_FORCE_PLATFORM skips the probe: 'cpu' cannot
     # hang, and 'axon' means the caller (the chip-session watcher, which
     # fires only on a canary UP) wants chip-or-hang semantics.
-    # the force var counts as the CALLER's only when the watchdog re-exec
-    # didn't set it
-    explicit_force = (bool(os.environ.get("GRAPHDYN_FORCE_PLATFORM"))
-                      and not os.environ.get("BENCH_CPU_REEXEC"))
-    from benchmarks.common import init_watchdog, probe_or_cpu_fallback
+    from benchmarks.common import guarded_capture_init
 
-    relay_note = probe_or_cpu_fallback()   # probe; no-op under explicit force
-    init_done = init_watchdog(
-        allow_cpu_fallback=not explicit_force,
-        fail_row={
-            "metric": "spin_updates_per_sec_per_chip_d3_rrg",
-            "value": 0.0,
-            "unit": "spin-updates/s",
-            "vs_baseline": 0.0,
-            "error": ("device init hung under an explicitly forced platform "
-                      "(chip-or-hang)" if explicit_force
-                      else "device init hung even under CPU force"),
-        },
-    )
-    import benchmarks.common  # noqa: F401 — applies GRAPHDYN_FORCE_PLATFORM
+    # probe-or-fallback + init watchdog + first device touch, shared with
+    # the physics capture scripts (one chip-or-hang preamble everywhere)
+    relay_note = guarded_capture_init(fail_row={
+        "metric": "spin_updates_per_sec_per_chip_d3_rrg",
+        "value": 0.0,
+        "unit": "spin-updates/s",
+        "vs_baseline": 0.0,
+    })
     import jax
-
-    jax.devices()
-    init_done.set()
 
     from graphdyn.graphs import random_regular_graph
 
@@ -218,7 +205,9 @@ def main():
     rate_wide, R_wide = 0.0, 0   # R_wide tracks only *measured* rungs
     from benchmarks.common import is_oom
 
-    on_chip = jax.default_backend() == "tpu"
+    # the tunneled plugin reports "tpu"; hedge "axon" like every other
+    # chip-backend allowlist in the repo (chip_doc_ok, CHIP_BACKENDS)
+    on_chip = jax.default_backend() in ("tpu", "axon")
     # Widening is an HBM per-row-amortization lever; on the CPU fallback it
     # only burns minutes on host caches — chip-only. The 16x rung (W=2048,
     # 8 GB spin state) probes past the r04-measured W=512 point; OOM skips.

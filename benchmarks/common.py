@@ -112,14 +112,20 @@ def probe_or_cpu_fallback(budget_s: float | None = None) -> str | None:
         return None
     budget = (float(os.environ.get("BENCH_INIT_BUDGET_S", "600"))
               if budget_s is None else budget_s)
+    t0 = time.monotonic()
     if probe_relay(budget):
         return None
+    elapsed = time.monotonic() - t0
     os.environ["GRAPHDYN_FORCE_PLATFORM"] = "cpu"
     from graphdyn.utils.platform import apply_force_platform
 
     apply_force_platform()
-    return (f"TPU relay unreachable for {budget:.0f}s of probing; "
-            "this capture is a CPU fallback, NOT chip numbers")
+    # elapsed, not budget: a deterministic give-up (no chip plugin, fast
+    # failures) happens in seconds — the label must not claim minutes of
+    # relay unreachability that never elapsed
+    return (f"no chip backend after {elapsed:.0f}s of probing "
+            f"(budget {budget:.0f}s); this capture is a CPU fallback, "
+            "NOT chip numbers")
 
 
 def init_watchdog(timeout_s: float = 300.0, allow_cpu_fallback: bool = True,
@@ -159,6 +165,35 @@ def init_watchdog(timeout_s: float = 300.0, allow_cpu_fallback: bool = True,
 
     threading.Thread(target=watch, daemon=True).start()
     return done
+
+
+def guarded_capture_init(fail_row: dict | None = None,
+                         timeout_s: float = 300.0) -> str | None:
+    """The one chip-or-hang entry preamble for every capture script
+    (bench.py, scripts/physics_consensus*.py): probe-or-fallback, arm the
+    init watchdog, touch the first device, disarm. Returns the fallback
+    label note (None when on chip / explicitly forced). One implementation
+    so the force/re-exec interaction cannot drift between scripts.
+
+    ``fail_row`` (optional JSON row printed if even the watchdog path
+    hangs) gets an ``error`` text filled in based on whether the caller
+    explicitly forced a platform (chip-or-hang) or not."""
+    explicit = (bool(os.environ.get("GRAPHDYN_FORCE_PLATFORM"))
+                and not os.environ.get("BENCH_CPU_REEXEC"))
+    note = probe_or_cpu_fallback()
+    if fail_row is not None and "error" not in fail_row:
+        fail_row = dict(fail_row)
+        fail_row["error"] = (
+            "device init hung under an explicitly forced platform "
+            "(chip-or-hang)" if explicit
+            else "device init hung even under CPU force")
+    done = init_watchdog(timeout_s, allow_cpu_fallback=not explicit,
+                         fail_row=fail_row)
+    import jax
+
+    jax.devices()
+    done.set()
+    return note
 
 
 def _sync(out):

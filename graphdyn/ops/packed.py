@@ -270,7 +270,18 @@ def packed_consensus_scan(nbr, deg, sp, R: int, max_steps: int,
     implies near). Returns a dict of final state and per-replica
     ``(strict, strict_step, near, near_step, m_final)``; unreached
     first-passage steps are −1.
+
+    ``chunk`` must divide ``max_steps``: the loop advances in whole slabs,
+    so a non-dividing pair would silently run past the budget while
+    downstream artifacts record the requested ``max_steps`` — refused here
+    instead.
     """
+    if max_steps % chunk:
+        raise ValueError(
+            f"chunk={chunk} must divide max_steps={max_steps} (the scan "
+            "advances in whole chunks; a remainder would overshoot the "
+            "recorded budget)"
+        )
     def slab(carry):
         sp, t, strict, strict_t, near, near_t = carry
         sp = packed_rollout(nbr, deg, sp, chunk, rule, tie)

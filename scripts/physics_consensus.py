@@ -46,23 +46,12 @@ def main():
     )
     a = ap.parse_args()
 
-    # the same wedge protection as bench.py: an unforced run on a wedged
-    # relay would otherwise hang forever in jax init and write NO artifact.
-    # Probe first (a wedged relay hangs in-process init unrecoverably),
-    # then arm the watchdog for the probe→init wedge window: on a hang it
-    # re-execs this script with CPU forced, and probe_or_cpu_fallback in
-    # the re-exec returns the fallback label.
-    from benchmarks.common import init_watchdog, probe_or_cpu_fallback
+    # the shared wedge protection (benchmarks.common.guarded_capture_init):
+    # an unforced run on a wedged relay would otherwise hang forever in jax
+    # init and write NO artifact
+    from benchmarks.common import guarded_capture_init
 
-    relay_note = probe_or_cpu_fallback()
-    init_done = init_watchdog(
-        allow_cpu_fallback=not (os.environ.get("GRAPHDYN_FORCE_PLATFORM")
-                                and not os.environ.get("BENCH_CPU_REEXEC")))
-
-    import jax
-
-    jax.devices()
-    init_done.set()
+    relay_note = guarded_capture_init()
 
     from graphdyn.models.consensus import (
         consensus_curve_ensemble,
