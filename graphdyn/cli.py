@@ -134,7 +134,14 @@ def build_parser() -> argparse.ArgumentParser:
              "entropy curves quantify — `ER_BDCM_entropy.ipynb:113-123`)",
     )
     cons.add_argument("--n", type=int, default=100_000)
+    cons.add_argument(
+        "--graph", choices=["er", "rrg"], default="er",
+        help="ensemble: ER G(n, c/n) (config-3) or random d-regular "
+             "(the SA search's ensemble — random-init threshold there is "
+             "~10x the SA-constructed m(0), see rrg_threshold_r05.json)",
+    )
     cons.add_argument("--c", type=float, default=6.0, help="ER mean degree")
+    cons.add_argument("--d", type=int, default=4, help="RRG degree")
     cons.add_argument("--rule", choices=["majority", "minority"],
                       default="majority")
     cons.add_argument("--tie", choices=["stay", "change"], default="stay")
@@ -357,9 +364,16 @@ def main(argv=None) -> int:
                 raise SystemExit(
                     "--plot requires matplotlib, which is not installed"
                 )
-        g, n_iso, nbr_dev, deg_dev = er_consensus_ensemble(
-            args.n, c=args.c, seed=args.seed
-        )
+        if args.graph == "rrg":
+            from graphdyn.models.consensus import rrg_consensus_ensemble
+
+            g, n_iso, nbr_dev, deg_dev = rrg_consensus_ensemble(
+                args.n, d=args.d, seed=args.seed
+            )
+        else:
+            g, n_iso, nbr_dev, deg_dev = er_consensus_ensemble(
+                args.n, c=args.c, seed=args.seed
+            )
         mesh = None
         if args.sharded:
             import jax
@@ -375,6 +389,9 @@ def main(argv=None) -> int:
         doc = consensus_doc(
             g, n_iso, rows, c=args.c, seed=args.seed, rule=args.rule,
             tie=args.tie, near_eps=args.near_eps, solver="consensus",
+            kind=("random_regular" if args.graph == "rrg"
+                  else "erdos_renyi"),
+            d=args.d,
         )
         if args.out:
             with open(args.out, "w") as f:
@@ -384,8 +401,9 @@ def main(argv=None) -> int:
 
             plot_consensus_curve(
                 rows,
-                title=f"ER c={args.c:g}, N={g.n}, R={args.replicas}, "
-                      f"{args.rule}",
+                title=(f"RRG d={args.d}" if args.graph == "rrg"
+                       else f"ER c={args.c:g}")
+                + f", N={g.n}, R={args.replicas}, {args.rule}",
                 save_path=args.plot,
             )
         print(json.dumps(doc))

@@ -44,6 +44,20 @@ def er_consensus_ensemble(n: int, c: float = 6.0, seed: int = 0):
     return g, n_iso, jnp.asarray(g.nbr), jnp.asarray(g.deg)
 
 
+def rrg_consensus_ensemble(n: int, d: int = 4, seed: int = 0):
+    """RRG variant of :func:`er_consensus_ensemble` — the SA search's own
+    graph ensemble (`SA_RRG.py:45-46`: random d-regular), for measuring the
+    RANDOM-initialization consensus threshold that the SA/HPr-constructed
+    initializations beat. No isolates by construction. Returns the same
+    ``(graph, 0, nbr_device, deg_device)`` tuple shape."""
+    import jax.numpy as jnp
+
+    from graphdyn.graphs import random_regular_graph
+
+    g = random_regular_graph(n, d, seed=seed)
+    return g, 0, jnp.asarray(g.nbr), jnp.asarray(g.deg)
+
+
 def consensus_point(g, R: int, m0: float, max_steps: int, chunk: int = 10,
                     seed: int = 1000, nbr_dev=None, deg_dev=None,
                     rule: str = "majority", tie: str = "stay",
@@ -105,6 +119,7 @@ def consensus_point(g, R: int, m0: float, max_steps: int, chunk: int = 10,
 
 def consensus_curve_ensemble(n: int, R: int, m0_list: Sequence[float],
                              max_steps: int, *, c: float = 6.0,
+                             graph: str = "er", d: int = 4,
                              graph_seeds: Sequence[int] = (0, 1, 2),
                              chunk: int = 10, rule: str = "majority",
                              tie: str = "stay", near_eps: float = 0.01,
@@ -112,13 +127,20 @@ def consensus_curve_ensemble(n: int, R: int, m0_list: Sequence[float],
     """The consensus curve over an ENSEMBLE of graph instances: one
     :func:`consensus_curve` per graph seed, plus per-m(0) aggregates
     (mean and instance spread) — the same instance-spread discipline as
-    the entropy golden anchors. Returns ``(per_seed, aggregate)`` where
+    the entropy golden anchors. ``graph`` picks the ensemble: ``"er"``
+    (G(n, c/n), isolates removed) or ``"rrg"`` (d-regular — the SA
+    search's ensemble). Returns ``(per_seed, aggregate)`` where
     ``per_seed`` is a list of {graph_seed, n, isolates_removed, rows} and
     ``aggregate`` one row per m(0) with mean/std/min/max of the consensus
     fraction and the mean first-passage over instances."""
     per_seed = []
     for s in graph_seeds:
-        g, n_iso, nbr_dev, deg_dev = er_consensus_ensemble(n, c=c, seed=s)
+        if graph == "er":
+            g, n_iso, nbr_dev, deg_dev = er_consensus_ensemble(n, c=c, seed=s)
+        elif graph == "rrg":
+            g, n_iso, nbr_dev, deg_dev = rrg_consensus_ensemble(n, d=d, seed=s)
+        else:
+            raise ValueError(f"graph must be 'er' or 'rrg', got {graph!r}")
         rows = consensus_curve(
             g, R, m0_list, max_steps, chunk, nbr_dev=nbr_dev,
             deg_dev=deg_dev, rule=rule, tie=tie, near_eps=near_eps,
@@ -155,19 +177,24 @@ def consensus_curve_ensemble(n: int, R: int, m0_list: Sequence[float],
 def consensus_ensemble_doc(n: int, per_seed: list[dict],
                            aggregate: list[dict], *, c: float = 6.0,
                            rule: str = "majority", tie: str = "stay",
-                           near_eps: float = 0.01, **extra) -> dict:
+                           near_eps: float = 0.01,
+                           kind: str = "erdos_renyi", d: int | None = None,
+                           **extra) -> dict:
     """Artifact schema for a multi-instance sweep: ``rows`` carries the
     per-m(0) aggregates (with instance spread), ``per_seed`` the raw
-    curves. Same top-level keys the session collector reads."""
+    curves. Same top-level keys the session collector reads; same
+    kind/d provenance axis as :func:`consensus_doc`."""
     import jax
 
+    ens = "ER" if kind == "erdos_renyi" else f"RRG-d{d}"
     return {
-        "what": (f"ER-{rule} consensus fraction & first-passage vs m(0), "
-                 f"{len(per_seed)}-instance ensemble"),
+        "what": (f"{ens}-{rule} consensus fraction & first-passage vs "
+                 f"m(0), {len(per_seed)}-instance ensemble"),
         # n = REQUESTED size; per-instance post-isolate sizes alongside so
         # tooling never compares pre- vs post-isolate counts (the
         # single-run doc records the post-isolate g.n)
-        "graph": {"kind": "erdos_renyi", "n": n, "c": c,
+        "graph": {"kind": kind, "n": n,
+                  **({"c": c} if kind == "erdos_renyi" else {"d": d}),
                   "graph_seeds": [ps["graph_seed"] for ps in per_seed],
                   "n_kept": [ps["n"] for ps in per_seed],
                   "isolates_removed": [ps["isolates_removed"]
@@ -184,15 +211,18 @@ def consensus_ensemble_doc(n: int, per_seed: list[dict],
 
 def consensus_doc(g, n_iso: int, rows: list[dict], *, c: float = 6.0,
                   seed: int = 0, rule: str = "majority", tie: str = "stay",
-                  near_eps: float = 0.01, **extra) -> dict:
+                  near_eps: float = 0.01, kind: str = "erdos_renyi",
+                  d: int | None = None, **extra) -> dict:
     """The one artifact schema for a consensus sweep — shared by the CLI
     and `scripts/physics_consensus.py` so the two writers cannot drift
     (the session collector reads ``backend`` from this doc)."""
     import jax
 
+    ens = "ER" if kind == "erdos_renyi" else f"RRG-d{d}"
     return {
-        "what": f"ER-{rule} consensus fraction & first-passage vs m(0)",
-        "graph": {"kind": "erdos_renyi", "n": g.n, "c": c,
+        "what": f"{ens}-{rule} consensus fraction & first-passage vs m(0)",
+        "graph": {"kind": kind, "n": g.n,
+                  **({"c": c} if kind == "erdos_renyi" else {"d": d}),
                   "isolates_removed": n_iso, "seed": seed},
         "dynamics": {"rule": rule, "tie": tie,
                      "update": "parallel/synchronous"},

@@ -1,0 +1,130 @@
+#!/usr/bin/env python3
+"""Random-initialization consensus threshold on the SA search's own
+ensemble (random d-regular graphs, majority/stay — `SA_RRG.py:45-46`).
+
+Closes the loop on the thesis narrative: the SA solver CONSTRUCTS
+initializations at m(0) ≈ 3.7–4.6% that reach all-+1 consensus within the
+(p, c) = (3, 1) transient — three synchronous steps (RESULTS_r04.md). This
+script measures what a RANDOM biased initialization needs on the same
+graphs under the same dynamics, with a generous 2000-step budget (free
+dynamics, not the 3-step funnel): the eventual-consensus threshold
+m_c^rand. The gap between m_c^rand and SA's 4% — and the fact that SA's
+configurations consense in 3 steps rather than hundreds — is the measured
+form of "optimized initializations are atypical".
+
+Usage:
+  python scripts/physics_consensus_rrg.py OUT_JSON [OUT_PNG] [--full]
+
+Same wedge protection as the other capture scripts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+import benchmarks.common  # noqa: F401 — repo root + platform forcing
+
+# bracketing grid: smoke showed the random-init transition sits at
+# m(0) ≈ 0.4–0.6 on RRG (vs 0.01 on ER c=6 — degree homogeneity freezes
+# domains), so sample densely there while keeping low-m0 anchors
+M0_GRID = (0.0, 0.05, 0.1, 0.2, 0.3, 0.4, 0.45, 0.5, 0.55, 0.6, 0.7)
+D_GRID = (3, 4)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("out_json")
+    ap.add_argument("out_png", nargs="?", default=None)
+    ap.add_argument("--full", action="store_true")
+    a = ap.parse_args()
+
+    from benchmarks.common import guarded_capture_init
+
+    relay_note = guarded_capture_init()
+
+    import jax  # noqa: F401 — backend recorded via the shared doc writer
+
+    from graphdyn.models.consensus import (
+        consensus_curve_ensemble,
+        consensus_ensemble_doc,
+    )
+
+    n, R, max_steps, seeds = ((10_000, 256, 2000, (0, 1, 2)) if a.full
+                              else (3000, 128, 500, (0,)))
+    t0 = time.time()
+    curves = []
+    for d in D_GRID:
+        per_seed, agg = consensus_curve_ensemble(
+            n, R, M0_GRID, max_steps, graph="rrg", d=d,
+            graph_seeds=seeds,
+        )
+        # each d-curve is one shared-schema ensemble doc (same writer as
+        # the CLI and physics_consensus.py — no third schema to drift)
+        curves.append({
+            "d": d,
+            **consensus_ensemble_doc(n, per_seed, agg,
+                                     kind="random_regular", d=d),
+        })
+        print(f"d={d}: " + " ".join(
+            f"m0={r['m0']:g}:{r['consensus_fraction_mean']:.2f}"
+            for r in agg), flush=True)
+
+    doc = {
+        "what": ("random-initialization consensus threshold on RRG "
+                 "(the SA ensemble, `SA_RRG.py:45-46`): consensus "
+                 "fraction vs m(0) under free majority dynamics"),
+        "d_grid": list(D_GRID),
+        "replicas": R,
+        "max_steps": max_steps,
+        "backend": curves[0]["backend"],
+        "elapsed_s": round(time.time() - t0, 1),
+        "curves": curves,
+        **({"relay": relay_note} if relay_note else {}),
+    }
+    with open(a.out_json, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"wrote {a.out_json} (backend={doc['backend']})")
+
+    if a.out_png:
+        import matplotlib
+
+        matplotlib.use("Agg", force=False)
+        import matplotlib.pyplot as plt
+
+        fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(9.6, 3.8), dpi=120)
+        for cv in curves:
+            agg = cv["rows"]
+            fr = [r["consensus_fraction_mean"] for r in agg]
+            err = [r["consensus_fraction_std"] or 0.0 for r in agg]
+            ax1.errorbar([r["m0"] for r in agg], fr, yerr=err, fmt="o-",
+                         ms=3.5, lw=1.1, capsize=2, label=f"RRG d={cv['d']}")
+            steps = [(r["m0"], r["mean_steps_to_consensus"]) for r in agg
+                     if r["mean_steps_to_consensus"] is not None]
+            if steps:
+                ax2.plot(*zip(*steps), "o-", ms=3.5, lw=1.1,
+                         label=f"RRG d={cv['d']}")
+        ax1.axvspan(0.037, 0.046, color="tab:red", alpha=0.18,
+                    label="SA-constructed m(0) (3-step consensus)")
+        ax1.set_xlabel("initial magnetization m(0)")
+        ax1.set_ylabel("consensus fraction")
+        ax1.set_title(f"random inits, N={n:,}, budget {max_steps} steps",
+                      fontsize=9)
+        ax1.legend(frameon=False, fontsize=7)
+        ax2.set_xlabel("initial magnetization m(0)")
+        ax2.set_ylabel("mean steps to consensus")
+        ax2.set_title("first-passage (where consensus occurs)", fontsize=9)
+        ax2.legend(frameon=False, fontsize=7)
+        fig.tight_layout()
+        fig.savefig(a.out_png)
+        print(f"wrote {a.out_png}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,3 +53,32 @@ def test_ensemble_doc_schema():
     for key in ("what", "graph", "dynamics", "near_consensus_def",
                 "backend", "rows"):
         assert key in single and key in doc
+
+
+def test_rrg_ensemble_dispatch_and_doc_provenance():
+    """graph='rrg' routes to the d-regular ensemble and the shared doc
+    writers record the right provenance for both kinds; unknown kinds are
+    refused."""
+    import pytest
+
+    from graphdyn.models.consensus import rrg_consensus_ensemble
+
+    g, n_iso, nbr, deg = rrg_consensus_ensemble(300, d=3, seed=1)
+    assert (g.n, n_iso) == (300, 0)
+    assert nbr.shape == (300, 3)
+
+    per_seed, agg = consensus_curve_ensemble(
+        300, 32, (0.6,), max_steps=100, graph="rrg", d=3, graph_seeds=(0,),
+    )
+    doc = consensus_ensemble_doc(300, per_seed, agg,
+                                 kind="random_regular", d=3)
+    assert doc["what"].startswith("RRG-d3-majority")
+    assert doc["graph"]["kind"] == "random_regular"
+    assert doc["graph"]["d"] == 3 and "c" not in doc["graph"]
+    er_doc = consensus_ensemble_doc(300, per_seed, agg)
+    assert er_doc["what"].startswith("ER-majority")
+    assert er_doc["graph"]["c"] == 6.0 and "d" not in er_doc["graph"]
+
+    with pytest.raises(ValueError, match="'er' or 'rrg'"):
+        consensus_curve_ensemble(300, 32, (0.1,), max_steps=100,
+                                 graph="cycle")
