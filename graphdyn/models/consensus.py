@@ -209,6 +209,24 @@ def consensus_ensemble_doc(n: int, per_seed: list[dict],
     }
 
 
+def m_half(aggregate: Sequence[dict]):
+    """The half-consensus bias: first upward 0.5-crossing of the mean
+    consensus fraction over an aggregate curve (linear interpolation in
+    m0). None when the curve starts at/above 0.5 (the crossing is below
+    the grid — e.g. a fluctuation baseline) or never crosses. The ONE
+    definition of the m_c observable, shared by the FSS and phase-sweep
+    capture scripts."""
+    m0s = [r["m0"] for r in aggregate]
+    fr = [r["consensus_fraction_mean"] for r in aggregate]
+    if fr and fr[0] >= 0.5:
+        return None
+    for j in range(1, len(fr)):
+        if fr[j - 1] < 0.5 <= fr[j]:
+            t = (0.5 - fr[j - 1]) / (fr[j] - fr[j - 1])
+            return m0s[j - 1] + t * (m0s[j] - m0s[j - 1])
+    return None
+
+
 def consensus_doc(g, n_iso: int, rows: list[dict], *, c: float = 6.0,
                   seed: int = 0, rule: str = "majority", tie: str = "stay",
                   near_eps: float = 0.01, kind: str = "erdos_renyi",

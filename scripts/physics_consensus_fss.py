@@ -59,6 +59,8 @@ def main():
     a = ap.parse_args()
 
     if a.replot:
+        if not a.out_png:
+            ap.error("--replot requires OUT_PNG (it only renders)")
         with open(a.out_json) as f:
             doc = json.load(f)
     else:
@@ -99,28 +101,22 @@ def main():
             **({"relay": relay_note} if relay_note else {}),
         }
 
-    # half-consensus point per N (linear interpolation in raw m0, FIRST
-    # upward crossing) — the measured m_c(N); its N-independence is the
-    # headline finding. None when the curve starts at/above 0.5 (m_c below
-    # the grid — e.g. a small-N finite-time tail) — reported, not guessed.
-    def m_half(agg):
-        m0s = [r["m0"] for r in agg]
-        fr = [r["consensus_fraction_mean"] for r in agg]
-        if fr and fr[0] >= 0.5:
-            return None
-        for j in range(1, len(fr)):
-            if fr[j - 1] < 0.5 <= fr[j]:
-                t = (0.5 - fr[j - 1]) / (fr[j] - fr[j - 1])
-                return m0s[j - 1] + t * (m0s[j] - m0s[j - 1])
-        return None
+    # half-consensus point per N — the measured m_c(N); its N-independence
+    # is the headline finding (one shared crossing definition:
+    # graphdyn.models.consensus.m_half)
+    from graphdyn.models.consensus import m_half
 
     doc["m_half_by_n"] = {
         str(cv["n"]): m_half(cv["aggregate"]) for cv in doc["curves"]
     }
-    with open(a.out_json, "w") as f:
-        json.dump(doc, f, indent=1)
-    print(f"wrote {a.out_json} (backend={doc['backend']}, "
-          f"m_half={doc['m_half_by_n']})")
+    if not a.replot:
+        # atomic, and --replot never rewrites the measured artifact at all
+        tmp = a.out_json + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, a.out_json)
+        print(f"wrote {a.out_json} (backend={doc['backend']}, "
+              f"m_half={doc['m_half_by_n']})")
 
     if a.out_png:
         import matplotlib

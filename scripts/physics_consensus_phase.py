@@ -1,0 +1,166 @@
+#!/usr/bin/env python3
+"""Degree dependence of the consensus threshold: m_c(c) on ER.
+
+Context (RESULTS_r05.md): ER c=6 tips to consensus at m_c ≈ 0.010 while
+random-regular graphs freeze until m(0) ≈ 0.44–0.54 — the difference is
+degree HETEROGENEITY (hubs break local voting deadlocks), not density.
+This script maps the interpolation — and finds the threshold is a
+SPARSITY phenomenon (measured, N=1e5, 2000-step budget): at c=6 a clean
+m_c ≈ 0.0099; by c=8 the unbiased (m0=0) baseline already orders
+spontaneously 47% of the time, and at c ≥ 11 essentially always — there
+is no threshold left to cross. The meaningful pair of observables is
+therefore the curve family plus the spontaneous-ordering probability
+P(consensus | m0=0) vs c; m_c is reported only where the 0.5-crossing is
+meaningfully above the baseline (a crossing computed through a ≈0.5
+baseline, as at c=8, is an artifact of the definition, flagged not
+plotted).
+
+Restricted to c ≥ 6 so the near-consensus criterion (|m_final| ≥ 0.99,
+whole-graph) stays meaningful — at smaller c the non-giant component
+fraction alone approaches 1% (a c=5 probe measured the criterion
+saturating near zero for exactly this reason). Needs the large-N tier: at
+n=5000 the unbiased fluctuation baseline exceeds 0.5 even at c=6.
+
+Usage:
+  python scripts/physics_consensus_phase.py OUT_JSON [OUT_PNG] [--full]
+
+Same wedge protection as the other capture scripts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+import benchmarks.common  # noqa: F401 — repo root + platform forcing
+
+C_GRID = (6.0, 8.0, 11.0, 16.0, 22.0)
+M0_GRID = (0.0, 0.001, 0.002, 0.003, 0.005, 0.008, 0.012, 0.018, 0.026)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("out_json")
+    ap.add_argument("out_png", nargs="?", default=None)
+    ap.add_argument("--full", action="store_true")
+    ap.add_argument("--replot", action="store_true",
+                    help="render OUT_PNG from an existing OUT_JSON")
+    a = ap.parse_args()
+
+    if a.replot:
+        if not a.out_png:
+            ap.error("--replot requires OUT_PNG (it only renders)")
+        with open(a.out_json) as f:
+            doc = json.load(f)
+        curves = doc["curves"]
+    else:
+        from benchmarks.common import guarded_capture_init
+
+        relay_note = guarded_capture_init()
+
+        from graphdyn.models.consensus import (
+            consensus_curve_ensemble,
+            consensus_ensemble_doc,
+            m_half,
+        )
+
+        n, R, max_steps, seeds = ((100_000, 256, 2000, (0, 1)) if a.full
+                                  else (20_000, 128, 500, (0,)))
+        t0 = time.time()
+        curves = []
+        for c in C_GRID:
+            per_seed, agg = consensus_curve_ensemble(
+                n, R, M0_GRID, max_steps, c=c, graph_seeds=seeds,
+            )
+            mc = m_half(agg)
+            curves.append({
+                "c": c, "m_c": mc,
+                **consensus_ensemble_doc(n, per_seed, agg, c=c),
+            })
+            print(f"c={c:g}: m_c={mc} | " + " ".join(
+                f"{r['m0']:g}:{r['consensus_fraction_mean']:.2f}"
+                for r in agg), flush=True)
+
+        doc = {
+            "what": ("consensus threshold vs ER mean degree at fixed N: "
+                     "the threshold is a SPARSITY phenomenon — clean "
+                     "m_c at c=6, spontaneous unbiased ordering by c>=8"),
+            "c_grid": list(C_GRID),
+            "n": n, "replicas": R, "max_steps": max_steps,
+            "backend": curves[0]["backend"],
+            "elapsed_s": round(time.time() - t0, 1),
+            "curves": curves,
+            **({"relay": relay_note} if relay_note else {}),
+        }
+
+    # m_c is only a threshold when it clears the unbiased baseline: a
+    # 0.5-crossing computed through a ~0.5 baseline (c=8) is an artifact
+    # of the definition, not a barrier — keep it out of m_c_by_c and
+    # record it separately
+    doc["m_c_by_c"] = {}
+    doc["baseline_m0_0_by_c"] = {}
+    for cv in curves:
+        base = cv["rows"][0]["consensus_fraction_mean"]
+        doc["baseline_m0_0_by_c"][str(cv["c"])] = base
+        doc["m_c_by_c"][str(cv["c"])] = (
+            cv["m_c"] if cv["m_c"] is not None and base < 0.25 else None
+        )
+    if not a.replot:
+        # atomic: the measured sweep is expensive — a crash mid-dump must
+        # not destroy it (and --replot never rewrites its source at all)
+        import os as _os
+
+        tmp = a.out_json + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        _os.replace(tmp, a.out_json)
+        print(f"wrote {a.out_json} (m_c_by_c={doc['m_c_by_c']}, "
+              f"baseline={doc['baseline_m0_0_by_c']})")
+
+    if a.out_png:
+        import matplotlib
+
+        matplotlib.use("Agg", force=False)
+        import matplotlib.pyplot as plt
+
+        fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(9.6, 3.8), dpi=120)
+        for cv in curves:
+            agg = cv["rows"]
+            ax1.errorbar(
+                [r["m0"] for r in agg],
+                [r["consensus_fraction_mean"] for r in agg],
+                yerr=[r["consensus_fraction_std"] or 0.0 for r in agg],
+                fmt="o-", ms=3.5, lw=1.1, capsize=2, label=f"c={cv['c']:g}",
+            )
+        ax1.set_xlabel("initial magnetization m(0)")
+        ax1.set_ylabel("consensus fraction")
+        ax1.set_title(f"ER, N={doc['n']:,}, R={doc['replicas']}", fontsize=9)
+        ax1.legend(frameon=False, fontsize=7)
+        cs = [cv["c"] for cv in curves]
+        ax2.plot(cs, [doc["baseline_m0_0_by_c"][str(c)] for c in cs],
+                 "s-", ms=5, lw=1.2, color="tab:orange",
+                 label="P(consensus | m(0)=0) — spontaneous ordering")
+        for c in cs:
+            mc = doc["m_c_by_c"][str(c)]
+            if mc is not None:
+                ax2.annotate(f"$m_c$={mc:.4f}", (c, 0.05),
+                             fontsize=7, ha="center")
+        ax2.set_xlabel("ER mean degree c")
+        ax2.set_ylabel("fraction")
+        ax2.set_ylim(-0.05, 1.05)
+        ax2.set_title("the threshold melts with density:\n"
+                      "by c≥11 unbiased inits order anyway", fontsize=9)
+        ax2.legend(frameon=False, fontsize=7)
+        fig.tight_layout()
+        fig.savefig(a.out_png)
+        print(f"wrote {a.out_png}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
