@@ -153,11 +153,11 @@ def run_replicas(n, R, sweeps):
         if use_mesh:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            from graphdyn.parallel.mesh import make_mesh
+            from graphdyn.parallel.mesh import make_mesh, shard_map
 
             mesh = make_mesh((n_dev,), ("replica",))
             rep = P("replica")
-            body = jax.jit(jax.shard_map(
+            body = jax.jit(shard_map(
                 body_local, mesh=mesh, in_specs=(rep,), out_specs=(rep, rep),
                 check_vma=False,
             ))

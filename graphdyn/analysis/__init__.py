@@ -1,0 +1,24 @@
+"""graphdyn.analysis — static analysis + trace-time contracts.
+
+Two enforcement layers for the invariants the framework's throughput rests
+on (ARCHITECTURE.md "Static analysis & contracts"):
+
+- :mod:`graphdyn.analysis.graftlint` — an AST linter (stdlib-only) with
+  JAX/TPU-specific rules GD001–GD006.  Run as
+  ``python -m graphdyn.analysis graphdyn/ --format=text|json``; the exit
+  code is the number of undisabled findings, so it slots straight into
+  ``scripts/lint.sh`` and the tier-1 lint-gate test.
+- :mod:`graphdyn.analysis.contracts` — the ``@contract`` decorator checking
+  shapes/dtypes of jitted-function inputs/outputs at trace time (zero cost
+  post-compile), applied to the public kernels in ``ops/`` and
+  ``parallel/``.
+"""
+
+from graphdyn.analysis.contracts import ContractError, contract  # noqa: F401
+from graphdyn.analysis.graftlint import (  # noqa: F401
+    Finding,
+    RULES,
+    lint_paths,
+    lint_sources,
+    main,
+)

@@ -1,0 +1,8 @@
+"""``python -m graphdyn.analysis`` — run graftlint from the command line."""
+
+import sys
+
+from graphdyn.analysis.graftlint import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,548 @@
+"""graftlint — an AST linter for the JAX/TPU invariants this framework's
+performance story rests on (ARCHITECTURE.md "Static analysis & contracts").
+
+The hot kernels must stay inside one fused XLA program: a stray host-NumPy
+call, a Python branch on a traced value, or an implicit float64 promotion
+inside a jitted body regresses throughput *silently* — results stay correct
+while the program gains host round-trips or doubles its HBM traffic. ruff and
+mypy cannot see any of this (it is all well-typed Python); these rules can.
+
+Rules (stable codes; each can be silenced per line with
+``# graftlint: disable=GDxxx`` plus a reason):
+
+- **GD001** host-NumPy call (``np.*``/``numpy.*``) inside a jit context — a
+  function decorated/wrapped with ``jax.jit`` (directly or via
+  ``partial(jax.jit, ...)``) or passed as a body to ``lax.while_loop`` /
+  ``lax.scan`` / ``lax.fori_loop``.  NumPy executes on the host at trace
+  time; on traced values it either crashes or silently constant-folds.
+  Dtype scalar constructors (``np.int32(…)`` etc.) are exempt — they are
+  trace-time constants by construction.
+- **GD002** Python ``if``/``while``/``for`` branching on a traced value
+  (heuristic: the condition references a jit-function parameter that is not
+  in ``static_argnums``/``static_argnames``).  Python control flow runs at
+  trace time; on traced operands it raises ``TracerBoolConversionError`` —
+  or worse, specializes the program to one branch.
+- **GD003** host sync inside a hot path: ``.item()``, ``float(…)``,
+  ``int(…)``, ``np.asarray(…)`` on device arrays inside jitted/loop bodies —
+  each one is a device→host transfer that serializes the step loop.
+- **GD004** dtype-contract violation: literal ``jnp.float64``/``np.float64``
+  anywhere, and dtype-less ``jnp.ones``/``jnp.zeros``/``jnp.arange`` inside
+  ``graphdyn/ops/`` and ``graphdyn/parallel/`` where the int8-spin /
+  int32-sum / f32-message contract (ARCHITECTURE.md dtype table) is
+  normative and the float default would double message HBM traffic.
+- **GD005** jit hygiene: a string/enum/config-typed parameter of a jitted
+  function not declared static (every distinct value retraces — or fails to
+  hash), or a static parameter with an unhashable (list/dict/set) default.
+- **GD006** a rollout-shaped jitted entry point (name matches
+  ``rollout``/``scan``, or the body carries a ``lax`` loop) without
+  ``donate_argnums``/``donate_argnames``: the large state buffer is
+  double-buffered in HBM instead of updated in place.
+
+Escape hatches, all requiring an explicit code list (``all`` allowed):
+
+- same line:      ``# graftlint: disable=GD001,GD003  <reason>``
+- line before:    ``# graftlint: disable-next-line=GD004  <reason>``
+- whole file:     ``# graftlint: disable-file=GD006  <reason>``
+
+The linter is stdlib-only (``ast`` + ``tokenize``-free line scanning) so the
+lint gate needs no third-party installs.  Heuristic by design: it resolves
+names syntactically, not semantically — the escape hatch (with a written
+reason) is the intended pressure valve, and every use of it documents a real
+exception to the contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Iterable, NamedTuple
+
+RULES = {
+    "GD001": "host-NumPy call inside a jitted/loop body",
+    "GD002": "Python control flow on a traced value",
+    "GD003": "host sync (.item()/float()/int()/np.asarray) inside a hot path",
+    "GD004": "dtype-contract violation (float64 literal / dtype-less creation)",
+    "GD005": "jit hygiene (non-static string/enum/config param, unhashable static default)",
+    "GD006": "rollout-shaped jitted entry point without donate_argnums",
+}
+
+# np dtype scalar constructors: trace-time constants, exempt from GD001
+_NP_DTYPE_CTORS = {
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32", "uint64",
+    "float16", "float32", "bool_", "dtype",
+}
+# jnp array creators that default to a float dtype (GD004 scope)
+_DTYPE_DEFAULT_FLOAT = {"ones", "zeros", "arange"}
+_LAX_LOOPS = {"while_loop", "fori_loop", "scan"}
+_ROLLOUT_NAME = re.compile(r"rollout|scan")
+
+_DISABLE_RE = re.compile(
+    r"#\s*graftlint:\s*(disable|disable-next-line|disable-file)=(.*)$"
+)
+_CODE_TOKEN = re.compile(r"(?i)^(gd\d{3}|all)$")
+
+
+class Finding(NamedTuple):
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+
+
+def _parse_codes(blob: str) -> set:
+    """Code list from everything after ``disable=``: comma-separated, each
+    piece's first whitespace token must be a GDxxx/all code — so a free-text
+    reason after a single space never corrupts the list (``disable=GD004
+    host staging`` still disables GD004)."""
+    codes = set()
+    for piece in blob.split(","):
+        tok = piece.split()[0] if piece.split() else ""
+        if _CODE_TOKEN.match(tok):
+            codes.add(tok.upper())
+    return codes
+
+
+def _parse_disables(src: str):
+    """(same_line: {lineno: set}, next_line: {lineno: set}, file: set)."""
+    same, nxt, whole = {}, {}, set()
+    for i, text in enumerate(src.splitlines(), start=1):
+        m = _DISABLE_RE.search(text)
+        if not m:
+            continue
+        kind = m.group(1)
+        codes = _parse_codes(m.group(2))
+        if kind == "disable":
+            same.setdefault(i, set()).update(codes)
+        elif kind == "disable-next-line":
+            nxt.setdefault(i + 1, set()).update(codes)
+        else:
+            whole.update(codes)
+    return same, nxt, whole
+
+
+def _dotted(node: ast.AST) -> str:
+    """'a.b.c' for a Name/Attribute chain, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """Does this expression denote jax.jit (Name `jit` or `*.jit`)?"""
+    d = _dotted(node)
+    return d == "jit" or d.endswith(".jit")
+
+
+def _param_names(fn) -> list[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+class _JitInfo(NamedTuple):
+    static: frozenset       # static parameter names
+    has_donate: bool
+    decorated: bool         # jit via decorator (vs loop body / jit(f) call)
+
+
+def _jit_kwargs(call: ast.Call) -> dict:
+    return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+
+
+def _static_names(fn, kwargs: dict) -> frozenset:
+    """Resolve static_argnames/static_argnums decorator kwargs to names."""
+    names = set()
+    params = _param_names(fn)
+    v = kwargs.get("static_argnames")
+    if isinstance(v, ast.Constant) and isinstance(v.value, str):
+        names.add(v.value)
+    elif isinstance(v, (ast.Tuple, ast.List)):
+        names.update(
+            e.value for e in v.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        )
+    v = kwargs.get("static_argnums")
+    idxs = []
+    if isinstance(v, ast.Constant) and isinstance(v.value, int):
+        idxs = [v.value]
+    elif isinstance(v, (ast.Tuple, ast.List)):
+        idxs = [e.value for e in v.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    for i in idxs:
+        if 0 <= i < len(params):
+            names.add(params[i])
+    return frozenset(names)
+
+
+def _jit_decorator_info(fn) -> _JitInfo | None:
+    """_JitInfo if ``fn`` carries a jit decorator (plain, called, or via
+    functools.partial)."""
+    for dec in fn.decorator_list:
+        if _is_jit_expr(dec):
+            return _JitInfo(frozenset(), False, True)
+        if isinstance(dec, ast.Call):
+            kwargs = _jit_kwargs(dec)
+            if _is_jit_expr(dec.func):
+                return _JitInfo(
+                    _static_names(fn, kwargs),
+                    "donate_argnums" in kwargs or "donate_argnames" in kwargs,
+                    True,
+                )
+            d = _dotted(dec.func)
+            if (d == "partial" or d.endswith(".partial")) and any(
+                _is_jit_expr(a) for a in dec.args
+            ):
+                return _JitInfo(
+                    _static_names(fn, kwargs),
+                    "donate_argnums" in kwargs or "donate_argnames" in kwargs,
+                    True,
+                )
+    return None
+
+
+class _FileLinter:
+    def __init__(self, path: str, src: str, enum_names: frozenset):
+        self.path = path
+        self.src = src
+        self.enum_names = enum_names
+        self.findings: list[Finding] = []
+        norm = path.replace("\\", "/")
+        self.dtype_strict = "/ops/" in norm or "/parallel/" in norm
+
+    def emit(self, node: ast.AST, code: str, message: str) -> None:
+        self.findings.append(
+            Finding(self.path, node.lineno, node.col_offset, code, message)
+        )
+
+    # -- jit-context discovery ------------------------------------------
+
+    def _collect(self, tree: ast.Module):
+        """(all function nodes by name, jit entries, loop-body names)."""
+        by_name: dict[str, list] = {}
+        entries: dict[int, _JitInfo] = {}       # id(node) -> info
+        nodes: dict[int, ast.AST] = {}
+        loop_body_names: set[str] = set()
+        loop_body_lambdas: list[ast.Lambda] = []
+
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name.setdefault(node.name, []).append(node)
+                info = _jit_decorator_info(node)
+                if info:
+                    entries[id(node)] = info
+                    nodes[id(node)] = node
+            elif isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                base = d.rsplit(".", 1)[-1]
+                if base in _LAX_LOOPS:
+                    # while_loop(cond, body, init) / fori_loop(lo, hi, body,
+                    # init) / scan(f, ...): every function-typed positional
+                    # arg is traced
+                    for arg in node.args:
+                        if isinstance(arg, ast.Name):
+                            loop_body_names.add(arg.id)
+                        elif isinstance(arg, ast.Lambda):
+                            loop_body_lambdas.append(arg)
+                elif _is_jit_expr(node.func) and node.args:
+                    # jit(f, ...) call form
+                    if isinstance(arg := node.args[0], ast.Name):
+                        loop_body_names.add(arg.id)  # treated as jit context
+
+        for name in loop_body_names:
+            for fn in by_name.get(name, []):
+                if id(fn) not in entries:
+                    entries[id(fn)] = _JitInfo(frozenset(), False, False)
+                    nodes[id(fn)] = fn
+        return nodes, entries, loop_body_lambdas
+
+    # -- checks ---------------------------------------------------------
+
+    def run(self) -> list[Finding]:
+        try:
+            tree = ast.parse(self.src, filename=self.path)
+        except SyntaxError as e:
+            self.findings.append(
+                Finding(self.path, e.lineno or 1, 0, "GD000",
+                        f"syntax error: {e.msg}")
+            )
+            return self.findings
+
+        nodes, entries, lambdas = self._collect(tree)
+        seen: set[int] = set()
+        for key, fn in nodes.items():
+            info = entries[key]
+            if info.decorated:
+                self._check_jit_signature(fn, info)
+                self._check_donation(fn, info)
+            traced = frozenset(_param_names(fn)) - info.static
+            self._check_body(fn, traced, info.static, seen)
+        for lam in lambdas:
+            self._check_body(lam, frozenset(_param_names(lam)), frozenset(),
+                             seen)
+        self._check_dtypes(tree)
+        self.findings.sort(key=lambda f: (f.line, f.col, f.code))
+        return self.findings
+
+    def _check_body(self, fn, traced: frozenset, static: frozenset,
+                    seen: set):
+        """GD001/GD002/GD003 inside one jit-context function, recursing into
+        nested function definitions (their bodies trace too; their params
+        join the traced set *for their own subtree only* — they never leak
+        to sibling statements; closures keep the outer static set)."""
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            self._visit(stmt, traced, static, seen)
+
+    def _visit(self, node, traced: frozenset, static: frozenset, seen: set):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            inner = traced | (frozenset(_param_names(node)) - static)
+            for child in ast.iter_child_nodes(node):
+                self._visit(child, inner, static, seen)
+            return
+        if isinstance(node, ast.Call):
+            self._check_call(node, static)
+        elif isinstance(node, (ast.If, ast.While)):
+            self._check_branch(node, node.test, traced)
+        elif isinstance(node, ast.For):
+            if isinstance(node.iter, ast.Name) and node.iter.id in traced:
+                self.emit(
+                    node, "GD002",
+                    f"Python for-loop iterates over traced value "
+                    f"{node.iter.id!r} (use lax.fori_loop/scan, or "
+                    f"declare it static)",
+                )
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, traced, static, seen)
+
+    def _check_call(self, node: ast.Call, static: frozenset = frozenset()):
+        d = _dotted(node.func)
+        if d.startswith(("np.", "numpy.")):
+            attr = d.split(".", 1)[1]
+            if attr == "asarray":
+                self.emit(node, "GD003",
+                          "np.asarray inside a jitted/loop body forces a "
+                          "device->host transfer")
+            elif attr.split(".")[0] not in _NP_DTYPE_CTORS:
+                self.emit(node, "GD001",
+                          f"host-NumPy call {d}(...) inside a jitted/loop "
+                          f"body (runs on host at trace time)")
+        elif isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+            self.emit(node, "GD003",
+                      ".item() inside a jitted/loop body blocks on a "
+                      "device->host transfer")
+        elif (isinstance(node.func, ast.Name)
+              and node.func.id in ("float", "int")
+              and node.args and not isinstance(node.args[0], ast.Constant)
+              # float()/int() of a *static* parameter is trace-time by
+              # construction — no device value involved
+              and not (isinstance(node.args[0], ast.Name)
+                       and node.args[0].id in static)):
+            self.emit(node, "GD003",
+                      f"{node.func.id}(...) inside a jitted/loop body "
+                      f"materializes a host scalar")
+
+    def _check_branch(self, node, test: ast.expr, traced: frozenset):
+        hits = sorted(
+            n.id for n in ast.walk(test)
+            if isinstance(n, ast.Name) and n.id in traced
+        )
+        if hits:
+            kw = "if" if isinstance(node, ast.If) else "while"
+            self.emit(
+                node, "GD002",
+                f"Python `{kw}` on traced value(s) {', '.join(hits)} (use "
+                f"lax.cond/lax.select, or declare them static)",
+            )
+
+    def _check_jit_signature(self, fn, info: _JitInfo):
+        a = fn.args
+        params = a.posonlyargs + a.args + a.kwonlyargs
+        pos_defaults = dict(
+            zip([p.arg for p in (a.posonlyargs + a.args)[-len(a.defaults):]],
+                a.defaults)
+        ) if a.defaults else {}
+        kw_defaults = {
+            p.arg: d for p, d in zip(a.kwonlyargs, a.kw_defaults) if d
+        }
+        defaults = {**pos_defaults, **kw_defaults}
+        for p in params:
+            default = defaults.get(p.arg)
+            ann = _dotted(p.annotation).rsplit(".", 1)[-1] if p.annotation \
+                else ""
+            # `Rule | str`-style unions: look at every referenced name
+            ann_names = {ann} | {
+                n.id for n in ast.walk(p.annotation)
+                if isinstance(n, ast.Name)
+            } if p.annotation else {ann}
+            stringy = (
+                isinstance(default, ast.Constant)
+                and isinstance(default.value, str)
+            ) or "str" in ann_names or bool(
+                ann_names & self.enum_names
+            ) or any(n.endswith("Config") for n in ann_names if n)
+            if stringy and p.arg not in info.static:
+                self.emit(
+                    p, "GD005",
+                    f"string/enum/config parameter {p.arg!r} of jitted "
+                    f"function {fn.name!r} is not in static_argnames "
+                    f"(each value retraces, unhashable values fail)",
+                )
+            if p.arg in info.static and isinstance(
+                default, (ast.List, ast.Dict, ast.Set)
+            ):
+                self.emit(
+                    p, "GD005",
+                    f"static parameter {p.arg!r} of jitted function "
+                    f"{fn.name!r} has an unhashable default",
+                )
+
+    def _check_donation(self, fn, info: _JitInfo):
+        if info.has_donate:
+            return
+        has_loop = any(
+            isinstance(n, ast.Call)
+            and _dotted(n.func).rsplit(".", 1)[-1] in _LAX_LOOPS
+            for n in ast.walk(fn)
+        )
+        if has_loop or _ROLLOUT_NAME.search(fn.name):
+            self.emit(
+                fn, "GD006",
+                f"rollout-shaped jitted entry point {fn.name!r} has no "
+                f"donate_argnums/donate_argnames — the state buffer is "
+                f"double-buffered in HBM",
+            )
+
+    def _check_dtypes(self, tree: ast.Module):
+        """GD004: float64 literals (everywhere), dtype-less float-defaulting
+        creators (ops/ + parallel/ only)."""
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute):
+                d = _dotted(node)
+                if d in ("np.float64", "numpy.float64", "jnp.float64",
+                         "jax.numpy.float64"):
+                    self.emit(
+                        node, "GD004",
+                        f"{d} literal: the device dtype contract is "
+                        f"int8 spins / int32 sums / f32 messages "
+                        f"(ARCHITECTURE.md dtype table)",
+                    )
+            elif self.dtype_strict and isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d.startswith(("jnp.", "jax.numpy.")):
+                    attr = d.rsplit(".", 1)[-1]
+                    if attr in _DTYPE_DEFAULT_FLOAT:
+                        has_dtype = any(
+                            kw.arg == "dtype" for kw in node.keywords
+                        ) or len(node.args) >= (4 if attr == "arange" else 2)
+                        if not has_dtype:
+                            self.emit(
+                                node, "GD004",
+                                f"dtype-less {d}(...) takes an ambient-"
+                                f"dependent dtype (f32, or int64 under "
+                                f"x64) — pass the contract dtype "
+                                f"explicitly (int8/int32/f32)",
+                            )
+
+
+def _collect_enum_names(sources: list[tuple[str, str]]) -> frozenset:
+    """Names of Enum-derived classes across every linted file (so GD005
+    recognizes `rule: Rule` without semantic imports)."""
+    names = set()
+    for _, src in sources:
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and any(
+                "Enum" in _dotted(b) for b in node.bases
+            ):
+                names.add(node.name)
+    return frozenset(names)
+
+
+def lint_sources(sources: list[tuple[str, str]]) -> list[Finding]:
+    """Lint (path, source) pairs; disable comments already honored."""
+    enum_names = _collect_enum_names(sources)
+    out = []
+    for path, src in sources:
+        same, nxt, whole = _parse_disables(src)
+        for f in _FileLinter(path, src, enum_names).run():
+            disabled = (
+                f.code in whole or "ALL" in whole
+                or f.code in same.get(f.line, ())
+                or "ALL" in same.get(f.line, ())
+                or f.code in nxt.get(f.line, ())
+                or "ALL" in nxt.get(f.line, ())
+            )
+            if not disabled:
+                out.append(f)
+    return out
+
+
+def iter_python_files(paths: Iterable[str]):
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            yield from sorted(path.rglob("*.py"))
+        elif path.suffix == ".py":
+            yield path
+
+
+def lint_paths(paths: Iterable[str]) -> list[Finding]:
+    sources = []
+    unreadable = []
+    for f in iter_python_files(paths):
+        try:
+            sources.append((str(f), f.read_text()))
+        except OSError as e:
+            # fail CLOSED: a file the gate could not inspect is a finding,
+            # not a skip — otherwise a permission-broken checkout passes
+            unreadable.append(
+                Finding(str(f), 1, 0, "GD000", f"cannot read file: {e}")
+            )
+    return unreadable + lint_sources(sources)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m graphdyn.analysis",
+        description="graftlint: JAX/TPU-invariant linter "
+                    "(exit code = number of findings)",
+    )
+    ap.add_argument("paths", nargs="+", help="files or directories to lint")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    findings = lint_paths(args.paths)
+    if args.format == "json":
+        print(json.dumps([f._asdict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f"{f.path}:{f.line}:{f.col}: {f.code} {f.message}")
+        if findings:
+            print(f"graftlint: {len(findings)} finding(s)", file=sys.stderr)
+    # exit code = findings, clamped to the 8-bit exit-status range
+    return min(len(findings), 125)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

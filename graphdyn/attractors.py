@@ -141,7 +141,9 @@ def edge_factor_tensor(
     T = p + c
     atr, traj = condition_tensors(n_msgs, p, c, include_xj=True, rule=rule, tie=tie)
     fix = attr_mask(T, attr_value)
-    return (atr & traj & fix[:, None, None]).astype(np.float64)
+    # host-built factor tensors stay f64 like the reference; BDCMData
+    # casts to the message dtype at transfer time
+    return (atr & traj & fix[:, None, None]).astype(np.float64)  # graftlint: disable=GD004  host staging
 
 
 def node_factor_tensor(
@@ -157,6 +159,7 @@ def node_factor_tensor(
     T = p + c
     atr, traj = condition_tensors(n_msgs, p, c, include_xj=False, rule=rule, tie=tie)
     fix = attr_mask(T, attr_value)
+    # graftlint: disable-next-line=GD004  host staging (cast at transfer)
     return (atr & traj & fix[:, None]).astype(np.float64)
 
 

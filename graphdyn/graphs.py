@@ -317,7 +317,9 @@ def random_regular_graph(
 
 def _decode_triu(code: np.ndarray, n: int):
     """Decode linear upper-triangle index k -> (i, j), i < j (vectorized)."""
-    code = code.astype(np.float64)
+    # f64 host math is load-bearing: sqrt on f32 loses the exact integer
+    # decode above ~2^24 edges — never crosses the device link
+    code = code.astype(np.float64)  # graftlint: disable=GD004  exact host decode
     nn = 2 * n - 1
     i = np.floor((nn - np.sqrt(nn * nn - 8.0 * code)) / 2.0).astype(np.int64)
     # float guard: correct i by at most one in either direction

@@ -57,6 +57,10 @@ class EntropyResult(NamedTuple):
 
 
 @partial(jax.jit, static_argnames=("spec", "eps", "t_max"))
+# warm-start ladders and the sharded-vs-unsharded parity tests replay the
+# same chi through multiple fixed-point variants; donation would
+# invalidate their input buffer
+# graftlint: disable-next-line=GD006  callers reuse chi across variants
 def _fixed_point_exec(chi, lmbd, valid, x0, tables, spec, eps: float, t_max: int):
     """Module-level fixed-point executor: graphs whose sweep shapes coincide
     (same degree-class signature, e.g. via ``BDCMData(class_bucket=...)``)
@@ -473,6 +477,7 @@ def entropy_ensemble(
     eps, T_max = config.eps, config.max_sweeps
 
     @jax.jit
+    # graftlint: disable-next-line=GD006  callers reuse chi across variants
     def fixed_point(chi, lmbd):
         def cond(st):
             _, delta, t = st

@@ -38,6 +38,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from graphdyn.analysis.contracts import contract
 from graphdyn.attractors import (
     attr_mask,
     edge_factor_tensor,
@@ -106,6 +107,7 @@ class BDCMData:
         # threads through messages, factor casts, and observables. float64
         # requires jax_enable_x64 (and disables the f32 Pallas kernel).
         self.dtype = jnp.dtype(dtype)
+        # graftlint: disable-next-line=GD004  dtype *guard*, no f64 created
         if self.dtype == jnp.float64 and not jax.config.jax_enable_x64:
             raise ValueError(
                 "BDCMData(dtype=float64) requires jax.config.update"
@@ -368,11 +370,14 @@ def _sweep_core(chi, lmbd, bias_edge, valid, x0, tables, spec: _SweepSpec):
 
 
 @partial(jax.jit, static_argnames=("spec",))
+@contract(chi="float32|float64[e,k,k]", lmbd="float32|float64[]",
+          ret="float32|float64[e,k,k]")
 def _sweep_exec(chi, lmbd, bias_edge, valid, x0, tables, spec: _SweepSpec):
     return _sweep_core(chi, lmbd, bias_edge, valid, x0, tables, spec)
 
 
 def _resolve_pallas_modes(data: BDCMData, use_pallas) -> tuple:
+    # graftlint: disable-next-line=GD004  dtype *guard*, no f64 created
     if data.dtype == jnp.float64:
         # the fused kernel is f32-only; f64 runs always take the XLA path.
         # Refuse an explicit force rather than silently comparing XLA to

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from graphdyn.analysis.contracts import contract
+
 
 class Rule(str, enum.Enum):
     MAJORITY = "majority"
@@ -134,6 +136,10 @@ def batched_rollout_impl(nbr, s, steps: int, R_coef: int, C_coef: int,
 
 
 @partial(jax.jit, static_argnames=("steps", "rule", "tie", "gather"))
+@contract(nbr="int32[n,d]", s="int8[r,n]", ret="int8[r,n]")
+# the fused/per_slot A/B path and the numpy-parity tests roll the SAME s
+# through multiple calls; donating s would invalidate their input buffer
+# graftlint: disable-next-line=GD006  A/B callers reuse the input state
 def batched_rollout(nbr, s, steps: int, rule: str = "majority",
                     tie: str = "stay", gather: str = "fused"):
     R_coef, C_coef = rule_coefficients(rule, tie)
@@ -141,6 +147,10 @@ def batched_rollout(nbr, s, steps: int, rule: str = "majority",
 
 
 @partial(jax.jit, static_argnames=("steps", "rule", "tie"))
+@contract(nbr="int32[n,d]", s0="int8[n]", ret="int8[n]")
+# run_dynamics passes the caller's (asarray-identity) spins; oracles then
+# replay the same buffer — donation would invalidate it under them
+# graftlint: disable-next-line=GD006  callers replay the input spins
 def _run_jax(nbr, s0, steps: int, rule: str, tie: str):
     if steps <= 0:
         return s0

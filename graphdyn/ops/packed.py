@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from graphdyn.analysis.contracts import contract
 from graphdyn.ops.dynamics import Rule, TieBreak
 
 WORD = 32
@@ -99,6 +100,11 @@ def _compare_planes(planes, thr_bits):
 
 
 @partial(jax.jit, static_argnames=("rule", "tie", "steps", "gather"))
+@contract(nbr="int32[n,d]", deg="int32[n]", sp="uint32[n,w]",
+          ret="uint32[n,w]")
+# the per_slot/fused A/B tests and benchmarks roll the SAME sp through both
+# schedules; donating it would invalidate their input buffer
+# graftlint: disable-next-line=GD006  A/B callers reuse the input state
 def packed_rollout(nbr, deg, sp, steps: int, rule: str = "majority",
                    tie: str = "stay", gather: str = "per_slot"):
     """Roll packed spins ``sp: uint32[n, W]`` for ``steps`` synchronous
@@ -122,7 +128,9 @@ def packed_rollout(nbr, deg, sp, steps: int, rule: str = "majority",
     n, dmax = nbr.shape
     if steps <= 0:
         return sp
-    n_planes = max(int(np.ceil(np.log2(dmax + 1))), 1)
+    # bits needed to count up to dmax: bit_length(dmax) == ceil(log2(dmax+1))
+    # exactly, in integer arithmetic (no host float math at trace time)
+    n_planes = max(dmax.bit_length(), 1)
 
     # the ghost row rides IN the loop carry: re-building the ghost-extended
     # state with a concatenate inside the body costs a full extra read+write
@@ -247,7 +255,9 @@ def _replica_magnetization(sp: jnp.ndarray, R: int) -> jnp.ndarray:
 
 
 @partial(jax.jit, static_argnames=(
-    "R", "max_steps", "chunk", "near_eps", "rule", "tie"))
+    "R", "max_steps", "chunk", "near_eps", "rule", "tie"),
+         donate_argnames=("sp",))
+@contract(nbr="int32[n,d]", deg="int32[n]", sp="uint32[n,w]")
 def packed_consensus_scan(nbr, deg, sp, R: int, max_steps: int,
                           chunk: int = 10, near_eps: float = 0.01,
                           rule: str = "majority", tie: str = "stay"):

@@ -155,6 +155,8 @@ def dp_contract(
     K = 2**T
     M = (d + 1) ** T
     Ed = chi_in.shape[0]
+    # trace-time kernel constants from static (d, T) — no device value
+    # graftlint: disable-next-line=GD003  static ints for the kernel spec
     offsets = tuple(int(o) for o in _flat_offsets(d, T))
 
     budget_eb = vmem_block_edges(d, T)

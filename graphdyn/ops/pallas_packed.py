@@ -255,6 +255,9 @@ def _general_step_ext(nbr_pad, deg_pad, sp_ext, *, rule, tie, n_real,
     jax.jit,
     static_argnames=("steps", "rule", "tie", "block", "depth", "interpret"),
 )
+# bit-parity tests roll the SAME sp through this kernel and packed_rollout;
+# donating sp would invalidate their input buffer
+# graftlint: disable-next-line=GD006  parity callers reuse the input state
 def pallas_packed_rollout_general(nbr, deg, sp, steps: int,
                                   rule: str = "majority", tie: str = "stay",
                                   *, block: int = 256, depth: int = 8,
@@ -290,6 +293,7 @@ def pallas_packed_rollout_general(nbr, deg, sp, steps: int,
 @partial(
     jax.jit, static_argnames=("steps", "rule", "block", "depth", "interpret")
 )
+# graftlint: disable-next-line=GD006  parity callers reuse the input state
 def _rollout_jit(nbr, sp, *, steps, rule, block, depth, interpret):
     step = partial(
         pallas_packed_step, rule=rule, block=block, depth=depth,

@@ -13,6 +13,27 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:                                  # jax ≥ 0.6: top-level export, check_vma
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:                   # jax 0.4.x: experimental, check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """Version-portable ``shard_map``: the new-API surface (``check_vma``)
+    mapped onto whichever implementation this jax ships (the 0.4.x
+    experimental one calls the same flag ``check_rep``). Every shard_map in
+    graphdyn goes through here so an API move breaks one line, not five
+    call sites."""
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_CHECK_KW: check_vma},
+    )
+
 
 def make_mesh(
     shape: tuple[int, ...] | None = None,
@@ -52,7 +73,17 @@ def init_multihost(**kwargs) -> int:
 
     import os
 
-    if not jax.distributed.is_initialized():
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is None:
+        # jax 0.4.x has no public probe; the live client sits on the
+        # private distributed state (None until initialize() succeeds)
+        from jax._src import distributed as _dist
+
+        def is_init():
+            state = getattr(_dist, "global_state", None)
+            return getattr(state, "client", None) is not None
+
+    if not is_init():
         try:
             jax.distributed.initialize(**kwargs)
         except (ValueError, RuntimeError):

@@ -28,9 +28,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
 from graphdyn.config import SAConfig
+from graphdyn.parallel.mesh import shard_map
 from graphdyn.models.sa import (
     SAResult,
     draw_sa_proposal,
@@ -156,7 +156,7 @@ def make_sharded_sa_solver(
             local_i = i - node_idx * n_block
             owned = (local_i >= 0) & (local_i < n_block)
             li = jnp.clip(local_i, 0, n_block - 1)
-            ridx = jnp.arange(Rl)
+            ridx = jnp.arange(Rl, dtype=jnp.int32)
             s_i_local = st.s[ridx, li].astype(jnp.int32)
             flipped = st.s.at[ridx, li].set((-s_i_local).astype(jnp.int8))
             s_flip = jnp.where(owned[:, None], flipped, st.s)
@@ -273,7 +273,7 @@ def _make_lightcone_solver(
                 key, t, proposals, uniforms,
                 injected=injected, stream_len=stream_len, n=n_real, dt=dt,
             )
-            ridx = jnp.arange(Rl)
+            ridx = jnp.arange(Rl, dtype=jnp.int32)
             # current spins live in traj[:, 0] (the carried cache); see
             # models.sa._sa_loop — identical step arithmetic
             s_i = traj[ridx, 0, i].astype(jnp.int32)
@@ -391,7 +391,7 @@ def sa_sharded(
 
     rep_shards = int(mesh.shape[replica_axis])
     node_shards = int(mesh.shape[node_axis])
-    np_dt = np.float32 if dtype == jnp.float32 else np.float64
+    np_dt = np.float32 if dtype == jnp.float32 else np.float64  # graftlint: disable=GD004  dtype mirror for host results
     t_dt = np.int64 if jax.config.jax_enable_x64 else np.int32
 
     if rollout_mode not in ("full", "lightcone"):
@@ -585,6 +585,7 @@ def sa_sharded(
 
     s_final = extract_s(state[0])
     # same arithmetic as the unsharded solver's mag_reached
+    # graftlint: disable-next-line=GD004  host observable, exact sum
     mag = (s_final.astype(np.float64).sum(axis=1) / n).astype(np_dt)
     return SAResult(
         s=s_final,

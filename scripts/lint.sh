@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# The repo's lint/type/invariant gate (ARCHITECTURE.md "Static analysis &
+# contracts"). Three layers, strictest last:
+#
+#   1. ruff   — style/bug-pattern lint (config in pyproject.toml)
+#   2. mypy   — types on the layers with annotations worth checking
+#   3. graftlint — the JAX/TPU-invariant linter (python -m graphdyn.analysis);
+#                  ALWAYS runs (stdlib-only) and always gates
+#
+# ruff/mypy are optional dependencies (pyproject [dev] extra): when absent
+# from the environment they are SKIPPED WITH A NOTICE, not silently — the
+# container that runs the tier-1 gate does not ship them, and the gate must
+# not demand installs. graftlint is the layer that can never be absent.
+#
+# Usage: scripts/lint.sh            # whole package
+#        scripts/lint.sh PATH...    # specific files/dirs (graftlint only
+#                                   # narrows; ruff/mypy keep their scope)
+set -u
+cd "$(dirname "$0")/.."
+
+fail=0
+
+if command -v ruff >/dev/null 2>&1 || python -c 'import ruff' 2>/dev/null; then
+    echo "== ruff =="
+    if command -v ruff >/dev/null 2>&1; then
+        ruff check graphdyn/ benchmarks/ tests/ scripts/*.py __graft_entry__.py bench.py || fail=1
+    else
+        python -m ruff check graphdyn/ benchmarks/ tests/ scripts/*.py __graft_entry__.py bench.py || fail=1
+    fi
+else
+    echo "== ruff: not installed — SKIPPED (pip install ruff to enable) =="
+fi
+
+if python -c 'import mypy' 2>/dev/null; then
+    echo "== mypy (graphdyn/analysis, graphdyn/ops) =="
+    python -m mypy graphdyn/analysis/ graphdyn/ops/ || fail=1
+elif command -v mypy >/dev/null 2>&1; then
+    echo "== mypy (graphdyn/analysis, graphdyn/ops) =="
+    mypy graphdyn/analysis/ graphdyn/ops/ || fail=1
+else
+    echo "== mypy: not installed — SKIPPED (pip install mypy to enable) =="
+fi
+
+echo "== graftlint =="
+python -m graphdyn.analysis "${@:-graphdyn/}" --format=text || fail=1
+
+if [ "$fail" -ne 0 ]; then
+    echo "lint gate: FAILED" >&2
+    exit 1
+fi
+echo "lint gate: OK"

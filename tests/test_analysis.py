@@ -1,0 +1,552 @@
+"""graphdyn.analysis regression tests.
+
+Per acceptance criteria: every GD rule must (a) fire on a minimal bad
+example and (b) stay silent on the matching good example; the @contract
+decorator must catch shape/dtype violations at trace time and cost nothing
+on conforming calls.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from graphdyn.analysis import ContractError, contract, lint_sources
+from graphdyn.analysis.graftlint import RULES
+
+
+def _codes(src, path="x.py"):
+    return [f.code for f in lint_sources([(path, src)])]
+
+
+# ---------------------------------------------------------------------------
+# graftlint rules: minimal bad example fires, matching good example doesn't
+# ---------------------------------------------------------------------------
+
+
+class TestGD001HostNumpy:
+    def test_bad_np_call_in_jitted_fn(self):
+        src = (
+            "import jax, numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return np.tanh(x)\n"
+        )
+        assert "GD001" in _codes(src)
+
+    def test_bad_np_call_in_loop_body(self):
+        src = (
+            "import numpy as np\n"
+            "from jax import lax\n"
+            "def body(i, s):\n"
+            "    return np.roll(s, 1)\n"
+            "def run(s):\n"
+            "    return lax.fori_loop(0, 10, body, s)\n"
+        )
+        assert "GD001" in _codes(src)
+
+    def test_good_jnp_call(self):
+        src = (
+            "import jax, jax.numpy as jnp\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return jnp.tanh(x)\n"
+        )
+        assert _codes(src) == []
+
+    def test_good_np_outside_jit(self):
+        src = (
+            "import numpy as np\n"
+            "def f(x):\n"
+            "    return np.tanh(x)\n"
+        )
+        assert _codes(src) == []
+
+    def test_good_np_dtype_ctor_is_exempt(self):
+        src = (
+            "import jax, numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x + np.int32(3)\n"
+        )
+        assert _codes(src) == []
+
+
+class TestGD002TracedBranch:
+    BAD = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x, n):\n"
+        "    if n > 0:\n"
+        "        return x\n"
+        "    return -x\n"
+    )
+
+    def test_bad_if_on_traced_param(self):
+        assert "GD002" in _codes(self.BAD)
+
+    def test_good_if_on_static_param(self):
+        src = (
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, static_argnames=('n',))\n"
+            "def f(x, n):\n"
+            "    if n > 0:\n"
+            "        return x\n"
+            "    return -x\n"
+        )
+        assert _codes(src) == []
+
+    def test_good_static_argnums(self):
+        src = (
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, static_argnums=(1,))\n"
+            "def f(x, n):\n"
+            "    while n > 0:\n"
+            "        n -= 1\n"
+            "    return x\n"
+        )
+        assert _codes(src) == []
+
+    def test_bad_for_over_traced(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(xs):\n"
+            "    acc = 0\n"
+            "    for x in xs:\n"
+            "        acc = acc + x\n"
+            "    return acc\n"
+        )
+        assert "GD002" in _codes(src)
+
+
+class TestGD003HostSync:
+    def test_bad_item(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x.sum().item()\n"
+        )
+        assert "GD003" in _codes(src)
+
+    def test_bad_float_cast(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return float(x)\n"
+        )
+        assert "GD003" in _codes(src)
+
+    def test_bad_np_asarray(self):
+        src = (
+            "import jax, numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return np.asarray(x)\n"
+        )
+        assert "GD003" in _codes(src)
+
+    def test_good_float_of_static(self):
+        src = (
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, static_argnames=('damp',))\n"
+            "def f(x, damp):\n"
+            "    return x * float(damp)\n"
+        )
+        assert _codes(src) == []
+
+    def test_good_outside_jit(self):
+        src = "def f(x):\n    return float(x)\n"
+        assert _codes(src) == []
+
+
+class TestGD004DtypeContract:
+    def test_bad_float64_literal_anywhere(self):
+        src = "import numpy as np\nA = np.zeros(3, np.float64)\n"
+        assert "GD004" in _codes(src, "graphdyn/models/foo.py")
+
+    def test_bad_dtypeless_zeros_in_ops(self):
+        src = "import jax.numpy as jnp\ndef f(n):\n    return jnp.zeros(n)\n"
+        assert "GD004" in _codes(src, "graphdyn/ops/foo.py")
+
+    def test_good_dtypeless_zeros_outside_ops(self):
+        src = "import jax.numpy as jnp\ndef f(n):\n    return jnp.zeros(n)\n"
+        assert _codes(src, "graphdyn/models/foo.py") == []
+
+    def test_good_explicit_dtype_in_ops(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def f(n):\n"
+            "    return jnp.zeros(n, jnp.int32) + jnp.arange(n, dtype=jnp.int8)\n"
+        )
+        assert _codes(src, "graphdyn/ops/foo.py") == []
+
+    def test_good_positional_dtype(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def f(n):\n"
+            "    return jnp.ones((n, 2), jnp.float32)\n"
+        )
+        assert _codes(src, "graphdyn/parallel/foo.py") == []
+
+
+class TestGD005JitHygiene:
+    def test_bad_string_param_not_static(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x, rule='majority'):\n"
+            "    return x\n"
+        )
+        assert "GD005" in _codes(src)
+
+    def test_bad_enum_annotation_not_static(self):
+        src = (
+            "import enum, jax\n"
+            "class Rule(str, enum.Enum):\n"
+            "    A = 'a'\n"
+            "@jax.jit\n"
+            "def f(x, rule: Rule):\n"
+            "    return x\n"
+        )
+        assert "GD005" in _codes(src)
+
+    def test_enum_names_shared_across_files(self):
+        """The enum may be defined in a sibling module of the lint run."""
+        enum_src = (
+            "import enum\n"
+            "class Rule(str, enum.Enum):\n"
+            "    A = 'a'\n"
+        )
+        use_src = (
+            "import jax\nfrom other import Rule\n"
+            "@jax.jit\n"
+            "def f(x, rule: Rule):\n"
+            "    return x\n"
+        )
+        codes = [
+            f.code
+            for f in lint_sources([("other.py", enum_src), ("use.py", use_src)])
+        ]
+        assert "GD005" in codes
+
+    def test_good_string_param_static(self):
+        src = (
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, static_argnames=('rule',))\n"
+            "def f(x, rule='majority'):\n"
+            "    return x\n"
+        )
+        assert _codes(src) == []
+
+    def test_bad_unhashable_static_default(self):
+        src = (
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, static_argnames=('shape',))\n"
+            "def f(x, shape=[3, 4]):\n"
+            "    return x\n"
+        )
+        assert "GD005" in _codes(src)
+
+
+class TestGD006Donation:
+    BAD = (
+        "import jax\nfrom jax import lax\n"
+        "@jax.jit\n"
+        "def rollout(s):\n"
+        "    return lax.fori_loop(0, 8, lambda i, x: -x, s)\n"
+    )
+
+    def test_bad_rollout_without_donate(self):
+        assert "GD006" in _codes(self.BAD)
+
+    def test_good_rollout_with_donate(self):
+        src = (
+            "import jax\nfrom jax import lax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, donate_argnums=(0,))\n"
+            "def rollout(s):\n"
+            "    return lax.fori_loop(0, 8, lambda i, x: -x, s)\n"
+        )
+        assert _codes(src) == []
+
+    def test_good_non_rollout_jit(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x + 1\n"
+        )
+        assert _codes(src) == []
+
+
+class TestDisableComments:
+    BAD_LINE = "    return np.tanh(x)"
+
+    def _src(self, line):
+        return (
+            "import jax, numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            f"{line}\n"
+        )
+
+    def test_same_line_disable(self):
+        src = self._src(
+            self.BAD_LINE + "  # graftlint: disable=GD001  parity oracle"
+        )
+        assert _codes(src) == []
+
+    def test_next_line_disable(self):
+        src = self._src(
+            "    # graftlint: disable-next-line=GD001  parity oracle\n"
+            + self.BAD_LINE
+        )
+        assert _codes(src) == []
+
+    def test_file_disable(self):
+        src = "# graftlint: disable-file=GD001  oracle module\n" + self._src(
+            self.BAD_LINE
+        )
+        assert _codes(src) == []
+
+    def test_disable_wrong_code_does_not_silence(self):
+        src = self._src(self.BAD_LINE + "  # graftlint: disable=GD004  nope")
+        assert "GD001" in _codes(src)
+
+    def test_disable_list(self):
+        src = self._src(
+            "    return int(np.ceil(x))"
+            "  # graftlint: disable=GD001,GD003  trace-time"
+        )
+        assert _codes(src) == []
+
+    def test_single_space_before_reason_still_disables(self):
+        """A one-space separator between code and reason must not corrupt
+        the code list (regression: the old parser needed two spaces)."""
+        src = self._src(
+            self.BAD_LINE + "  # graftlint: disable=GD001 parity oracle"
+        )
+        assert _codes(src) == []
+
+    def test_reason_words_are_not_parsed_as_codes(self):
+        src = self._src(
+            self.BAD_LINE + "  # graftlint: disable=GD004 host, staging"
+        )
+        assert "GD001" in _codes(src)  # only GD004 disabled, not GD001
+
+
+class TestScoping:
+    def test_nested_fn_params_do_not_leak_to_siblings(self):
+        """Params of a nested loop body must not poison GD002 checks on
+        plain-Python sibling statements reusing the same names."""
+        src = (
+            "import jax\nfrom jax import lax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, donate_argnums=(0,))\n"
+            "def f(x):\n"
+            "    def body(i, s):\n"
+            "        return s + 1\n"
+            "    y = lax.fori_loop(0, 8, body, x)\n"
+            "    i = 0\n"
+            "    while i < 3:\n"       # plain host loop on a local int
+            "        i += 1\n"
+            "    return y\n"
+        )
+        assert _codes(src) == []
+
+    def test_nested_fn_branch_on_own_param_still_fires(self):
+        src = (
+            "import jax\nfrom jax import lax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, donate_argnums=(0,))\n"
+            "def f(x):\n"
+            "    def body(i, s):\n"
+            "        if s > 0:\n"      # traced loop-carry
+            "            return s\n"
+            "        return -s\n"
+            "    return lax.fori_loop(0, 8, body, x)\n"
+        )
+        assert "GD002" in _codes(src)
+
+
+def test_unreadable_file_is_a_finding(tmp_path):
+    """The gate fails closed: a .py path that cannot be read counts as a
+    finding instead of silently passing."""
+    from graphdyn.analysis import lint_paths
+
+    bad = tmp_path / "broken.py"
+    bad.symlink_to(tmp_path / "does-not-exist.py")
+    findings = lint_paths([str(tmp_path)])
+    assert [f.code for f in findings] == ["GD000"]
+    assert "cannot read" in findings[0].message
+
+
+def test_rules_registry_complete():
+    assert set(RULES) == {f"GD00{i}" for i in range(1, 7)}
+
+
+def test_repo_package_is_clean():
+    """The smoke test from the issue: graftlint over graphdyn/ reports zero
+    undisabled findings (in-process — the subprocess variant lives in
+    test_lint_gate.py)."""
+    from pathlib import Path
+
+    from graphdyn.analysis import lint_paths
+
+    pkg = Path(__file__).resolve().parent.parent / "graphdyn"
+    findings = lint_paths([str(pkg)])
+    assert findings == [], findings
+
+
+# ---------------------------------------------------------------------------
+# @contract
+# ---------------------------------------------------------------------------
+
+
+class TestContract:
+    def test_pass_and_symbol_binding(self):
+        @contract(a="int8[r,n]", b="int32[n]", ret="int32[r]")
+        def f(a, b):
+            return (a.astype(jnp.int32) * b[None, :]).sum(axis=1)
+
+        out = f(jnp.ones((4, 7), jnp.int8), jnp.ones((7,), jnp.int32))
+        assert out.shape == (4,)
+
+    def test_dtype_mismatch(self):
+        @contract(a="int8[n]")
+        def f(a):
+            return a
+
+        with pytest.raises(ContractError, match="dtype"):
+            f(jnp.ones((3,), jnp.int32))
+
+    def test_rank_mismatch(self):
+        @contract(a="int8[r,n]")
+        def f(a):
+            return a
+
+        with pytest.raises(ContractError, match="rank"):
+            f(jnp.ones((3,), jnp.int8))
+
+    def test_symbol_conflict_across_args(self):
+        @contract(a="int32[n]", b="int32[n]")
+        def f(a, b):
+            return a + b
+
+        with pytest.raises(ContractError, match="bound"):
+            f(jnp.ones((3,), jnp.int32), jnp.ones((4,), jnp.int32))
+
+    def test_return_checked_against_bound_symbols(self):
+        @contract(a="int32[n]", ret="int32[n]")
+        def f(a):
+            return jnp.concatenate([a, a])
+
+        with pytest.raises(ContractError, match="bound"):
+            f(jnp.ones((3,), jnp.int32))
+
+    def test_union_dtypes(self):
+        @contract(a="float32|float64[n]")
+        def f(a):
+            return a
+
+        f(jnp.ones((3,), jnp.float32))
+        with pytest.raises(ContractError, match="dtype"):
+            f(jnp.ones((3,), jnp.int32))
+
+    def test_wildcards(self):
+        @contract(a="*[_,n]", b="int32[n]")
+        def f(a, b):
+            return b
+
+        f(jnp.ones((9, 5)), jnp.ones((5,), jnp.int32))
+
+    def test_python_scalar_kind(self):
+        @contract(lmbd="float32|float64[]")
+        def f(x, lmbd):
+            return x * lmbd
+
+        f(jnp.ones(3), 0.5)                       # weak Python float OK
+        f(jnp.ones(3), jnp.float32(0.5))
+        with pytest.raises(ContractError):
+            f(jnp.ones(3), jnp.ones((2,)))        # rank 1, wants scalar
+
+    def test_checks_run_at_trace_time_only(self):
+        """Under jit the wrapper runs per *trace*, not per call: conforming
+        repeated calls hit the compile cache without re-entering it."""
+        calls = {"n": 0}
+
+        def spy(a):
+            calls["n"] += 1
+            return a * 2
+
+        f = jax.jit(contract(a="int32[n]")(spy))
+        x = jnp.ones((5,), jnp.int32)
+        np.testing.assert_array_equal(f(x), 2 * np.ones(5))
+        f(x)
+        f(x)
+        assert calls["n"] == 1  # traced once; checks cost nothing after
+
+    def test_trace_time_rejection_under_jit(self):
+        f = jax.jit(contract(a="int8[n]")(lambda a: a))
+        with pytest.raises(ContractError):
+            f(jnp.ones((3,), jnp.float32))
+
+    def test_unknown_param_rejected_at_decoration(self):
+        with pytest.raises(ValueError, match="unknown"):
+            contract(nope="int8[n]")(lambda a: a)
+
+    def test_tuple_return_spec(self):
+        @contract(a="int32[n]", ret=("int32[n]", None))
+        def f(a):
+            return a, "aux"
+
+        f(jnp.ones((3,), jnp.int32))
+
+    def test_malformed_spec_rejected(self):
+        with pytest.raises(ValueError):
+            contract(a="int8[n")(lambda a: a)
+        with pytest.raises(ValueError):
+            contract(a="int8[n,,m]")(lambda a: a)
+
+
+class TestContractedEntryPoints:
+    """The shipped kernels carry their contracts."""
+
+    def test_batched_rollout_rejects_wrong_spin_dtype(self):
+        from graphdyn.graphs import random_regular_graph
+        from graphdyn.ops.dynamics import batched_rollout
+
+        g = random_regular_graph(32, 3, seed=0)
+        s = np.ones((2, 32), np.int32)            # should be int8
+        with pytest.raises(ContractError, match="int8"):
+            batched_rollout(jnp.asarray(g.nbr), jnp.asarray(s), 2)
+
+    def test_packed_rollout_rejects_mismatched_rows(self):
+        from graphdyn.graphs import random_regular_graph
+        from graphdyn.ops.packed import packed_rollout
+
+        g = random_regular_graph(32, 3, seed=0)
+        sp = jnp.zeros((31, 1), jnp.uint32)       # n mismatch vs nbr rows
+        with pytest.raises(ContractError, match="bound"):
+            packed_rollout(jnp.asarray(g.nbr), jnp.asarray(g.deg), sp, 2)
+
+    def test_sweep_exec_rejects_nonsquare_chi(self):
+        from graphdyn.graphs import random_regular_graph
+        from graphdyn.ops.bdcm import BDCMData, make_sweep
+
+        g = random_regular_graph(24, 3, seed=0)
+        data = BDCMData(g, p=1, c=1)
+        sweep = make_sweep(data, damp=0.3, use_pallas=False)
+        chi = data.init_messages(seed=0)
+        bad = jnp.concatenate([chi, chi], axis=2)  # [2E, K, 2K]
+        with pytest.raises(ContractError):
+            sweep(bad, jnp.float32(0.1))

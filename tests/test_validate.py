@@ -37,6 +37,52 @@ def test_debug_nans_restores_config():
     assert jax.config.jax_debug_nans == prev
 
 
+def test_debug_nans_restores_config_on_exception():
+    """The finally-branch contract: an exception escaping the body must not
+    leave the (expensive, re-run-every-op) debug mode enabled."""
+    prev = jax.config.jax_debug_nans
+
+    class Boom(RuntimeError):
+        pass
+
+    with pytest.raises(Boom):
+        with debug_nans():
+            assert jax.config.jax_debug_nans is True
+            raise Boom()
+    assert jax.config.jax_debug_nans == prev
+
+    # and the nested/disable form restores too
+    with pytest.raises(Boom):
+        with debug_nans(False):
+            raise Boom()
+    assert jax.config.jax_debug_nans == prev
+
+
+def test_checked_flags_seeded_nan_inside_jitted_loop_body():
+    """checkify compiles the float checks INTO the program: a NaN produced
+    inside a jitted lax.fori_loop body — where a Python-level assert can
+    never run — must surface as a raised error, and the same loop without
+    the seed must pass."""
+    from jax import lax
+
+    def roll(x, seed_nan: bool):
+        def body(i, s):
+            s = s * 0.5 + 1.0
+            if seed_nan:
+                # inject inf - inf = nan at iteration 3 only
+                s = jnp.where(i == 3, s + jnp.inf - jnp.inf, s)
+            return s
+
+        return lax.fori_loop(0, 8, body, x).sum()
+
+    clean = checked(jax.jit(lambda x: roll(x, False)))
+    assert np.isfinite(float(clean(jnp.ones(16))))
+
+    seeded = checked(jax.jit(lambda x: roll(x, True)))
+    with pytest.raises(Exception, match="nan"):
+        seeded(jnp.ones(16))
+
+
 def test_sweep_values_finite_under_checkify():
     """The BDCM sweep's safe-denominator normalization admits no NaNs even
     from an all-zero message row."""
