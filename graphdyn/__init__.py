@@ -19,6 +19,10 @@ History-Passing reinforcement, BDCM entropy curves — see SURVEY.md):
 - ``graphdyn.parallel``    — device-mesh sharding, psum ensemble reductions,
   node-sharded dynamics for giant graphs.
 - ``graphdyn.utils``       — PRNG, IO (npz + orbax checkpoints), profiling.
+- ``graphdyn.analysis``    — static guarantees: the graftlint AST linter
+  (GD001–GD007) and trace-time shape/dtype contracts.
+- ``graphdyn.resilience``  — runtime guarantees: deterministic fault
+  injection, retry/degrade policies, preemption-safe shutdown (exit 75).
 """
 
 from graphdyn.graphs import (  # noqa: F401

@@ -37,6 +37,13 @@ Rules (stable codes; each can be silenced per line with
   ``rollout``/``scan``, or the body carries a ``lax`` loop) without
   ``donate_argnums``/``donate_argnames``: the large state buffer is
   double-buffered in HBM instead of updated in place.
+- **GD007** non-atomic persistence: a direct ``np.savez``/
+  ``np.savez_compressed`` or ``open(..., "w")`` write to a non-temp path
+  anywhere except ``utils/io.py``.  A preemption mid-write leaves a torn
+  file that poisons the next resume; every durable write must go through
+  the atomic writers in :mod:`graphdyn.utils.io` (temp file +
+  ``os.replace``).  Paths whose expression mentions ``tmp``/``temp`` are
+  exempt — writing the temp half of the discipline is the point.
 
 Escape hatches, all requiring an explicit code list (``all`` allowed):
 
@@ -68,6 +75,7 @@ RULES = {
     "GD004": "dtype-contract violation (float64 literal / dtype-less creation)",
     "GD005": "jit hygiene (non-static string/enum/config param, unhashable static default)",
     "GD006": "rollout-shaped jitted entry point without donate_argnums",
+    "GD007": "non-atomic persistence (direct np.savez / open-for-write outside utils/io.py)",
 }
 
 # np dtype scalar constructors: trace-time constants, exempt from GD001
@@ -222,6 +230,9 @@ class _FileLinter:
         self.findings: list[Finding] = []
         norm = path.replace("\\", "/")
         self.dtype_strict = "/ops/" in norm or "/parallel/" in norm
+        # utils/io.py is the one module allowed to touch raw write APIs —
+        # it IS the atomic-write implementation
+        self.persist_strict = not norm.endswith("utils/io.py")
 
     def emit(self, node: ast.AST, code: str, message: str) -> None:
         self.findings.append(
@@ -294,6 +305,7 @@ class _FileLinter:
             self._check_body(lam, frozenset(_param_names(lam)), frozenset(),
                              seen)
         self._check_dtypes(tree)
+        self._check_persistence(tree)
         self.findings.sort(key=lambda f: (f.line, f.col, f.code))
         return self.findings
 
@@ -460,6 +472,79 @@ class _FileLinter:
                                 f"x64) — pass the contract dtype "
                                 f"explicitly (int8/int32/f32)",
                             )
+
+
+    def _check_persistence(self, tree: ast.Module):
+        """GD007: direct durable writes outside utils/io.py. A torn npz/json
+        from a preemption mid-write poisons the next resume; the atomic
+        writers (temp + ``os.replace``) exist so this can never happen."""
+        if not self.persist_strict:
+            return
+
+        def is_temp_token(blob: str) -> bool:
+            # token-boundary match, not substring: 'attempt_path' and
+            # 'template' contain 'temp' but are NOT temp paths. A token is
+            # temp-ish when it is exactly tmp/temp/temporary/tempfile or
+            # starts with tmp (tmpfile, tmp2) / mkstemp-style names.
+            for tok in re.split(r"[^a-z0-9]+", blob.lower()):
+                if tok in ("temp", "temporary", "tempfile") or tok.startswith(
+                    ("tmp", "mkstemp", "mkdtemp")
+                ):
+                    return True
+            return False
+
+        def looks_temp(node: ast.expr | None) -> bool:
+            # a temp-ish token anywhere in the path expression (a literal
+            # fragment, a variable named tmp_path, tempfile.* calls):
+            # writing the temp half of the atomic discipline is the point
+            if node is None:
+                return False
+            for n in ast.walk(node):
+                blob = ""
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    blob = n.value
+                elif isinstance(n, ast.Name):
+                    blob = n.id
+                elif isinstance(n, ast.Attribute):
+                    blob = n.attr
+                if blob and is_temp_token(blob):
+                    return True
+            return False
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d in ("np.savez", "numpy.savez", "np.savez_compressed",
+                     "numpy.savez_compressed", "np.save", "numpy.save"):
+                if node.args and looks_temp(node.args[0]):
+                    continue
+                self.emit(
+                    node, "GD007",
+                    f"direct {d}(...) to a non-temp path: a preemption "
+                    f"mid-write leaves a torn file — use graphdyn.utils.io "
+                    f"(save_results_npz/Checkpoint: temp + os.replace)",
+                )
+            elif d == "open":
+                mode = None
+                if len(node.args) >= 2:
+                    mode = node.args[1]
+                for kw in node.keywords:
+                    if kw.arg == "mode":
+                        mode = kw.value
+                if (
+                    isinstance(mode, ast.Constant)
+                    and isinstance(mode.value, str)
+                    and mode.value.startswith(("w", "a", "x"))
+                    and not (node.args and looks_temp(node.args[0]))
+                ):
+                    self.emit(
+                        node, "GD007",
+                        "open(..., for write) to a non-temp path: persist "
+                        "through graphdyn.utils.io (write_json_atomic / "
+                        "temp file + os.replace) so a preemption cannot "
+                        "tear the file",
+                    )
 
 
 def _collect_enum_names(sources: list[tuple[str, str]]) -> frozenset:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import sys
 
 import numpy as np
 
@@ -50,8 +51,25 @@ def _add_dtype_flag(ap, help_text: str) -> None:
     )
 
 
+def _add_resilience_flags(ap: argparse.ArgumentParser) -> None:
+    """The shared runtime-resilience knobs of the long-running commands
+    (ARCHITECTURE.md "Resilience")."""
+    ap.add_argument(
+        "--max-save-retries", type=int, default=None, metavar="N",
+        help="retry a failed checkpoint save up to N times (exponential "
+             "backoff) before DEGRADING to skip-save with a logged warning "
+             "— the run keeps computing either way (default: 2)",
+    )
+
+
 def build_parser() -> argparse.ArgumentParser:
-    ap = argparse.ArgumentParser(prog="graphdyn")
+    ap = argparse.ArgumentParser(
+        prog="graphdyn",
+        epilog="Exit codes: 0 success; 75 (EX_TEMPFAIL) graceful preemption "
+               "shutdown — SIGTERM/SIGINT checkpointed at the next chunk "
+               "boundary, safe for a scheduler to requeue; anything else is "
+               "a real failure. See ARCHITECTURE.md 'Resilience'.",
+    )
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     sa = sub.add_parser("sa", help="SA initialization search (`SA_RRG.py`)")
@@ -71,9 +89,12 @@ def build_parser() -> argparse.ArgumentParser:
     sa.add_argument("--out", default=None, help="npz path (`SA_RRG.py:92` keys)")
     sa.add_argument(
         "--checkpoint", default=None,
-        help="path prefix for preemption-safe exact resume (driver + chain)",
+        help="path prefix for preemption-safe exact resume (driver + chain); "
+             "SIGTERM then checkpoints at the next chunk boundary and exits "
+             "75 (EX_TEMPFAIL)",
     )
     sa.add_argument("--checkpoint-interval", type=float, default=30.0)
+    _add_resilience_flags(sa)
     sa.add_argument(
         "--rollout-mode", choices=["full", "lightcone"], default="full",
         help="candidate evaluation: full graph re-roll (reference cost "
@@ -110,9 +131,12 @@ def build_parser() -> argparse.ArgumentParser:
     hpr.add_argument("--out", default=None, help="npz path (`HPR:377` keys)")
     hpr.add_argument(
         "--checkpoint", default=None,
-        help="path prefix for preemption-safe exact resume (driver + chain)",
+        help="path prefix for preemption-safe exact resume (driver + chain); "
+             "SIGTERM then checkpoints at the next chunk boundary and exits "
+             "75 (EX_TEMPFAIL)",
     )
     hpr.add_argument("--checkpoint-interval", type=float, default=30.0)
+    _add_resilience_flags(hpr)
     _add_dtype_flag(hpr, "float64 matches the reference's solver precision "
                           "(`HPR_pytorch_RRG.py:11`; enables x64)")
     hpr.add_argument(
@@ -194,9 +218,12 @@ def build_parser() -> argparse.ArgumentParser:
     ent.add_argument("--out", default=None, help="npz path (`ipynb:515` keys)")
     ent.add_argument(
         "--checkpoint", default=None,
-        help="path prefix for time-triggered saves + exact λ-granular resume",
+        help="path prefix for time-triggered saves + exact λ-granular "
+             "resume; SIGTERM then checkpoints at the next λ and exits 75 "
+             "(EX_TEMPFAIL)",
     )
     ent.add_argument("--checkpoint-interval", type=float, default=30.0)
+    _add_resilience_flags(ent)
     _add_dtype_flag(ent, "float64 matches the reference's precision "
                           "(enables x64)")
     ent.add_argument(
@@ -215,13 +242,34 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    """Parse flags and run the matching experiment driver under the
+    graceful-shutdown protocol: SIGTERM/SIGINT checkpoints at the next
+    chunk/rep/λ boundary (when ``--checkpoint`` is set) and exits
+    ``EX_TEMPFAIL`` (75) so schedulers can requeue a preempted run instead
+    of marking it failed."""
+    from graphdyn.resilience import (
+        EX_TEMPFAIL, ShutdownRequested, graceful_shutdown, set_save_retry,
+    )
+
     args = build_parser().parse_args(argv)
 
     if getattr(args, "dtype", None) == "float64":
         import jax
 
         jax.config.update("jax_enable_x64", True)
+    if getattr(args, "max_save_retries", None) is not None:
+        set_save_retry(args.max_save_retries)
 
+    try:
+        with graceful_shutdown():
+            return _run(args)
+    except ShutdownRequested as e:
+        print(f"graphdyn: {e} — exiting {EX_TEMPFAIL} (requeue me)",
+              file=sys.stderr)
+        return EX_TEMPFAIL
+
+
+def _run(args) -> int:
     if args.cmd == "sa":
         cfg = SAConfig(
             dynamics=_dynamics(args),
@@ -394,8 +442,9 @@ def main(argv=None) -> int:
             d=args.d,
         )
         if args.out:
-            with open(args.out, "w") as f:
-                json.dump(doc, f, indent=1)
+            from graphdyn.utils.io import write_json_atomic
+
+            write_json_atomic(args.out, doc, indent=1)
         if args.plot:
             from graphdyn.plotting import plot_consensus_curve
 

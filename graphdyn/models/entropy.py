@@ -18,6 +18,7 @@ early-exit logic remain in Python.
 
 from __future__ import annotations
 
+import logging
 from functools import partial
 from typing import NamedTuple
 
@@ -28,6 +29,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from graphdyn.config import EntropyConfig
+from graphdyn.resilience import faults as _faults
+from graphdyn.resilience.shutdown import raise_if_requested, shutdown_requested
 from graphdyn.graphs import Graph, erdos_renyi_graph, remove_isolates
 from graphdyn.ops.bdcm import (
     BDCMData,
@@ -36,6 +39,8 @@ from graphdyn.ops.bdcm import (
     make_mean_m_init,
     make_sweep,
 )
+
+log = logging.getLogger("graphdyn.models")
 
 
 def lambda_ladder(config: EntropyConfig) -> np.ndarray:
@@ -85,17 +90,28 @@ def _fixed_point_exec(chi, lmbd, valid, x0, tables, spec, eps: float, t_max: int
 def make_fixed_point(data: BDCMData, config: EntropyConfig):
     """``(chi, lmbd) -> (chi*, sweeps, delta)``: iterate the sweep until
     ``max|Δchi| < eps`` or ``max_sweeps`` (`ipynb:420-432`), via the shared
-    executor."""
-    from graphdyn.ops.bdcm import _sweep_args
+    executor. A Pallas lowering/compile failure degrades the program to the
+    XLA path (logged, results unchanged) instead of aborting the ladder;
+    fault site ``sweep.nan`` poisons the carry for NaN-path tests."""
+    from graphdyn.ops.bdcm import _sweep_args, poison_nan, resilient_exec
 
     valid, x0, tables, spec = _sweep_args(
         data, damp=config.damp, eps_clamp=config.eps_clamp,
         mask_invalid_src=True, with_bias=False, use_pallas="auto",
     )
-    return lambda chi, lmbd: _fixed_point_exec(
-        chi, lmbd, valid, x0, tables, spec,
-        float(config.eps), int(config.max_sweeps),
-    )
+    eps_f, t_max = float(config.eps), int(config.max_sweeps)
+    state = {"spec": spec}
+
+    def fixed_point(chi, lmbd):
+        out = resilient_exec(state, lambda sp: _fixed_point_exec(
+            chi, lmbd, valid, x0, tables, sp, eps_f, t_max
+        ))
+        if _faults.transform_spec("sweep.nan", "nan") is not None:
+            chi_out, t, _ = out
+            out = (poison_nan(chi_out), t, jnp.asarray(jnp.nan, chi_out.dtype))
+        return out
+
+    return fixed_point
 
 
 def _ensemble_stop_fn(config: EntropyConfig, ent_floor_mode: str):
@@ -173,26 +189,48 @@ def _run_ladder(
         ent1s.append(e1)
         sweeps.append(t)
         failed = float(delta) > eps
+        # NaN anywhere in the carry/observables is poison, not a value
+        # (−inf is a legitimate degraded φ — empty attractor set — and
+        # flows through): degrade explicitly to the reference's
+        # non-convergence sentinel and stop, never emit NaN rows silently.
+        # NB a NaN delta makes `delta > eps` FALSE — without this check a
+        # poisoned fixed point would read as converged.
+        poisoned = bool(
+            np.isnan(float(delta)) or np.isnan(phi).any() or np.isnan(m0).any()
+        )
+        if poisoned and not failed:
+            failed = True
+        if poisoned:
+            log.warning(
+                "non-finite sweep state at lambda=%g (delta=%r) — recording "
+                "non-convergence and stopping the ladder", float(lmbd), delta,
+            )
         if failed:
             nonconverged = float(lmbd)
         if verbose:
             m_s = f"{m0:.5f}" if np.ndim(m0) == 0 else f"{np.mean(m0):.5f}(mean)"
             e_s = f"{e1:.5f}" if np.ndim(e1) == 0 else f"{np.mean(e1):.5f}(mean)"
             print(f"lambda={lmbd:.2f} t={t} m_init={m_s} ent1={e_s}")
-        if checkpointer is not None and checkpointer.due():
-            checkpointer.maybe_save(
-                {
-                    "chi": np.asarray(chi),
-                    "ent": np.array(ents),
-                    "m_init": np.array(m_inits),
-                    "ent1": np.array(ent1s),
-                    "sweeps": np.array(sweeps),
-                    "lambdas": np.array(visited),
-                    **(checkpoint_extra_arrays or {}),
-                },
-                {"lmbd": float(lmbd), "failed": bool(failed),
-                 **(checkpoint_meta or {})},
-            )
+        stopping = shutdown_requested()
+        if checkpointer is not None and (stopping or checkpointer.due()):
+            payload = {
+                "chi": np.asarray(chi),
+                "ent": np.array(ents),
+                "m_init": np.array(m_inits),
+                "ent1": np.array(ent1s),
+                "sweeps": np.array(sweeps),
+                "lambdas": np.array(visited),
+                **(checkpoint_extra_arrays or {}),
+            }
+            meta = {"lmbd": float(lmbd), "failed": bool(failed),
+                    **(checkpoint_meta or {})}
+            if stopping:
+                checkpointer.save_now(payload, meta)  # bypass interval gate
+            else:
+                checkpointer.maybe_save(payload, meta)
+        if stopping:
+            raise_if_requested()
+        _faults.maybe_fail("lambda.boundary", key=f"lmbd={float(lmbd):g}")
         if stop_fn(e1) or failed:
             break
         if plateau_eps > 0:
@@ -816,6 +854,13 @@ class _GridCheckpointAdapter:
 
     def maybe_save(self, arrays, meta) -> bool:
         return self._ck.maybe_save(
+            {**arrays, **self._extra_arrays}, {**meta, **self._extra}
+        )
+
+    def save_now(self, arrays, meta) -> bool:
+        """Shutdown snapshot: same coordinate/grid injection, no interval
+        gate — the restored cell must know which (deg, rep, λ) it was."""
+        return self._ck.save_now(
             {**arrays, **self._extra_arrays}, {**meta, **self._extra}
         )
 

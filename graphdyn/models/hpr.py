@@ -698,8 +698,15 @@ def hpr_ensemble(
     ``checkpoint_path`` makes the driver preemption-safe, exactly as in
     :func:`graphdyn.models.sa.sa_ensemble`: completed repetitions snapshot
     with the next repetition index, the in-flight chain checkpoints at
-    ``<path>_chain<k>`` (exact resume), graphs re-derive from ``seed + k``."""
+    ``<path>_chain<k>`` (exact resume), graphs re-derive from ``seed + k``;
+    graceful shutdown snapshots the completed-rep prefix before propagating
+    :class:`~graphdyn.resilience.ShutdownRequested`, and fault site
+    ``rep.boundary`` simulates a hard preemption between repetitions."""
     from graphdyn.graphs import random_regular_graph
+    from graphdyn.resilience import faults as _faults
+    from graphdyn.resilience.shutdown import (
+        ShutdownRequested, raise_if_requested, shutdown_requested,
+    )
     from graphdyn.utils.io import (
         Checkpoint, PeriodicCheckpointer, load_resume_prefix, save_results_npz,
     )
@@ -730,25 +737,38 @@ def hpr_ensemble(
 
     for k in range(start_k, n_rep):
         g = random_regular_graph(n, d, seed=seed + k, method=graph_method)
-        res = hpr_solve(
-            g, config, seed=seed + k,
-            # per-rep chain path — see sa_ensemble: interval-gated driver
-            # snapshots can lag the in-flight rep, and a shared chain file
-            # from a later rep would wedge the earlier rep's resume
-            checkpoint_path=(checkpoint_path + f"_chain{k}") if checkpoint_path else None,
-            checkpoint_interval_s=checkpoint_interval_s,
-        )
+
+        def driver_payload():
+            return {"mag_reached": mag, "conf": conf, "num_steps": steps,
+                    "time": times}
+
+        try:
+            res = hpr_solve(
+                g, config, seed=seed + k,
+                # per-rep chain path — see sa_ensemble: interval-gated driver
+                # snapshots can lag the in-flight rep, and a shared chain file
+                # from a later rep would wedge the earlier rep's resume
+                checkpoint_path=(checkpoint_path + f"_chain{k}") if checkpoint_path else None,
+                checkpoint_interval_s=checkpoint_interval_s,
+            )
+        except ShutdownRequested:
+            # the in-flight chain checkpointed itself; persist the
+            # completed-rep prefix before the CLI exits 75
+            if pc is not None:
+                pc.save_now(driver_payload(), {**run_id, "next_rep": k})
+            raise
         mag[k] = float(res.mag_reached)
         conf[k] = res.s
         steps[k] = res.num_steps
         graphs[k] = g.nbr
         times[k] = res.elapsed_s
         if pc is not None:
-            pc.maybe_save(
-                {"mag_reached": mag, "conf": conf, "num_steps": steps,
-                 "time": times},
-                {**run_id, "next_rep": k + 1},
-            )
+            pc.maybe_save(driver_payload(), {**run_id, "next_rep": k + 1})
+        _faults.maybe_fail("rep.boundary", key=f"rep={k}")
+        if shutdown_requested():
+            if pc is not None:
+                pc.save_now(driver_payload(), {**run_id, "next_rep": k + 1})
+            raise_if_requested()
     for k in range(start_k):
         graphs[k] = random_regular_graph(
             n, d, seed=seed + k, method=graph_method

@@ -588,8 +588,16 @@ def sa_ensemble(
     repetitions are snapshotted (with the next repetition index), and the
     in-flight chain checkpoints its own state at ``<path>_chain<k>`` (exact
     resume — see :func:`simulated_annealing`). Graphs re-derive from
-    ``seed + k``, so a resumed run records identical graphs."""
+    ``seed + k``, so a resumed run records identical graphs. A graceful
+    shutdown (SIGTERM under :func:`graphdyn.resilience.graceful_shutdown`)
+    snapshots the completed-rep prefix before propagating
+    :class:`~graphdyn.resilience.ShutdownRequested`; fault site
+    ``rep.boundary`` simulates a hard preemption between repetitions."""
     from graphdyn.graphs import random_regular_graph
+    from graphdyn.resilience import faults as _faults
+    from graphdyn.resilience.shutdown import (
+        ShutdownRequested, raise_if_requested, shutdown_requested,
+    )
     from graphdyn.utils.io import (
         Checkpoint, PeriodicCheckpointer, load_resume_prefix, save_results_npz,
     )
@@ -633,26 +641,39 @@ def sa_ensemble(
         # earlier rep's fingerprint check refuses (resume permanently
         # wedged). Per-rep files are either resumed when their rep re-runs
         # or removed on that rep's completion.
-        res = simulated_annealing(
-            g, config, n_replicas=1, seed=seed + k,
-            max_steps=max_steps, backend=backend,
-            checkpoint_path=chain_ckpt,
-            checkpoint_interval_s=checkpoint_interval_s,
-            rollout_mode=rollout_mode,  # cpu+lightcone raises there, loudly
-        )
+        def driver_payload():
+            return {
+                "mag_reached": mag, "num_steps": steps,
+                "conf": conf, "m_final": m_final,
+            }
+
+        try:
+            res = simulated_annealing(
+                g, config, n_replicas=1, seed=seed + k,
+                max_steps=max_steps, backend=backend,
+                checkpoint_path=chain_ckpt,
+                checkpoint_interval_s=checkpoint_interval_s,
+                rollout_mode=rollout_mode,  # cpu+lightcone raises there, loudly
+            )
+        except ShutdownRequested:
+            # the in-flight chain already checkpointed itself at its chunk
+            # boundary; persist the completed-rep prefix too (the periodic
+            # driver snapshot may lag), then let the CLI exit 75
+            if pc is not None:
+                pc.save_now(driver_payload(), {**run_id, "next_rep": k})
+            raise
         mag[k] = res.mag_reached[0]
         steps[k] = res.num_steps[0]
         conf[k] = res.s[0]
         graphs[k] = g.nbr
         m_final[k] = res.m_final[0]
         if pc is not None:
-            pc.maybe_save(
-                {
-                    "mag_reached": mag, "num_steps": steps,
-                    "conf": conf, "m_final": m_final,
-                },
-                {**run_id, "next_rep": k + 1},
-            )
+            pc.maybe_save(driver_payload(), {**run_id, "next_rep": k + 1})
+        _faults.maybe_fail("rep.boundary", key=f"rep={k}")
+        if shutdown_requested():
+            if pc is not None:
+                pc.save_now(driver_payload(), {**run_id, "next_rep": k + 1})
+            raise_if_requested()
     # graphs for reps completed before a resume re-derive from seed + k
     for k in range(start_k):
         graphs[k] = random_regular_graph(

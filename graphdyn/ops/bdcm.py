@@ -30,6 +30,7 @@ masked out of the DP (HPr relies on those chi entries decaying under damping);
 
 from __future__ import annotations
 
+import logging
 from functools import partial
 from typing import NamedTuple
 
@@ -39,6 +40,7 @@ import jax
 import jax.numpy as jnp
 
 from graphdyn.analysis.contracts import contract
+from graphdyn.resilience import faults as _faults
 from graphdyn.attractors import (
     attr_mask,
     edge_factor_tensor,
@@ -49,6 +51,8 @@ from graphdyn.attractors import (
     x0_pm,
 )
 from graphdyn.graphs import EdgeTables, Graph, build_edge_tables, degree_classes
+
+log = logging.getLogger("graphdyn.ops")
 
 
 class _EdgeClass(NamedTuple):
@@ -350,6 +354,9 @@ def _sweep_core(chi, lmbd, bias_edge, valid, x0, tables, spec: _SweepSpec):
         if mode:
             from graphdyn.ops.pallas_bdcm import dp_contract
 
+            # trace-time site: a firing plan here stands in for a real
+            # kernel lowering/compile failure on this backend
+            _faults.maybe_fail("pallas.lower", key=f"d={d}")
             upd = dp_contract(
                 chi_in,
                 A * tilt[:, None, None],
@@ -407,6 +414,46 @@ def _resolve_pallas_modes(data: BDCMData, use_pallas) -> tuple:
     return tuple(modes)
 
 
+def pallas_fallback_spec(spec: _SweepSpec, exc: BaseException) -> _SweepSpec:
+    """Runtime Pallas→lax degradation: when a sweep program with active
+    Pallas modes dies in kernel lowering/compilation, return the same spec
+    with every class forced onto the XLA path (bit-parity is tested, so the
+    fallback changes throughput, not results); any other failure — or a
+    failure with no Pallas mode to blame — re-raises. Callers swap their
+    spec for the returned one, so the rebuild happens once per program
+    (``_resolve_pallas_modes`` alone only makes the *static* dtype/backend
+    choice and cannot see a lowering failure)."""
+    if not any(spec.pallas) or not _faults.is_lowering_failure(exc):
+        raise exc
+    log.warning(
+        "Pallas kernel failed to lower/compile on backend %r — rebuilding "
+        "the sweep with use_pallas=False and continuing: %s",
+        jax.default_backend(), exc,
+    )
+    return spec._replace(pallas=("",) * len(spec.pallas))
+
+
+def poison_nan(x: jnp.ndarray) -> jnp.ndarray:
+    """Seed one NaN into a float carry (the ``sweep.nan`` fault payload)."""
+    return x.at[(0,) * x.ndim].set(jnp.nan)
+
+
+def resilient_exec(state: dict, run):
+    """Execute ``run(spec)`` with the runtime Pallas→lax fallback — the ONE
+    implementation shared by :func:`make_sweep` and
+    :func:`graphdyn.models.entropy.make_fixed_point`, so the fallback
+    protocol cannot drift between them. ``state`` is a mutable
+    ``{"spec": _SweepSpec}`` holder: a lowering failure swaps in the XLA
+    spec (via :func:`pallas_fallback_spec`, which re-raises anything it
+    cannot blame on Pallas) and the rebuilt program sticks for all later
+    calls."""
+    try:
+        return run(state["spec"])
+    except Exception as e:
+        state["spec"] = pallas_fallback_spec(state["spec"], e)
+        return run(state["spec"])
+
+
 def _sweep_args(data: BDCMData, *, damp, eps_clamp, mask_invalid_src, with_bias, use_pallas):
     valid = jnp.asarray(data.valid)
     x0 = jnp.asarray(data.x0, data.dtype)
@@ -455,17 +502,30 @@ def make_sweep(
     The returned callable dispatches to a module-level jitted executor —
     graphs with identical class-table shapes share its compile cache (see
     ``BDCMData(class_bucket=...)`` for arranging that on ER ensembles).
+
+    Resilience: a Pallas lowering/compile failure at first execution
+    degrades the program to the pure-XLA path (:func:`pallas_fallback_spec`
+    — logged, results unchanged) instead of aborting the run; fault site
+    ``sweep.nan`` can poison the returned messages for NaN-path tests.
     """
     valid, x0, tables, spec = _sweep_args(
         data, damp=damp, eps_clamp=eps_clamp,
         mask_invalid_src=mask_invalid_src, with_bias=with_bias,
         use_pallas=use_pallas,
     )
+    state = {"spec": spec}
+
+    def call(chi, lmbd, bias_edge):
+        out = resilient_exec(state, lambda sp: _sweep_exec(
+            chi, lmbd, bias_edge, valid, x0, tables, sp
+        ))
+        if _faults.transform_spec("sweep.nan", "nan") is not None:
+            out = poison_nan(out)
+        return out
+
     if with_bias:
-        return lambda chi, lmbd, bias_edge: _sweep_exec(
-            chi, lmbd, bias_edge, valid, x0, tables, spec
-        )
-    return lambda chi, lmbd: _sweep_exec(chi, lmbd, None, valid, x0, tables, spec)
+        return lambda chi, lmbd, bias_edge: call(chi, lmbd, bias_edge)
+    return lambda chi, lmbd: call(chi, lmbd, None)
 
 
 class EnsembleBDCM:

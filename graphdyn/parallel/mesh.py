@@ -49,7 +49,7 @@ def make_mesh(
     return Mesh(devices[:need].reshape(shape), axis_names)
 
 
-def init_multihost(**kwargs) -> int:
+def init_multihost(retry_deadline_s: float = 60.0, **kwargs) -> int:
     """Initialize JAX's multi-host runtime (one controller process per host)
     and return ``jax.process_count()``.
 
@@ -68,10 +68,19 @@ def init_multihost(**kwargs) -> int:
     communication-free in the solvers (replica-major unions, per-device SA
     chains), so DCN only ever carries the scalar per-sweep stop-test psum.
     :func:`make_hybrid_mesh` builds exactly that layout.
+
+    Resilience: with multi-host intent (explicit kwargs, or a coordinator
+    detectable in the environment), a coordinator that is not up yet is a
+    *race*, not an error — the connection retries with exponential backoff
+    until ``retry_deadline_s`` (fault site ``multihost.init`` simulates the
+    not-yet-up coordinator) before the failure surfaces.
     """
     import jax.distributed
 
     import os
+
+    from graphdyn.resilience import RetryPolicy, retry
+    from graphdyn.resilience import faults as _faults
 
     is_init = getattr(jax.distributed, "is_initialized", None)
     if is_init is None:
@@ -84,27 +93,59 @@ def init_multihost(**kwargs) -> int:
             return getattr(state, "client", None) is not None
 
     if not is_init():
-        try:
+        # Benign single-process cases: no coordinator config to form a
+        # world from (ValueError), or the XLA backend is already up —
+        # e.g. a driver that used jax before opting into multi-host
+        # (RuntimeError). Swallowing either on a REAL pod would make N
+        # hosts silently run N duplicate single-host jobs, so surface
+        # the failure whenever multi-host intent is stated (kwargs) or
+        # a multi-host environment is detectable.
+        detected = any(
+            os.environ.get(v)
+            for v in (
+                "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+                "MEGASCALE_COORDINATOR_ADDRESS",
+            )
+        # single-host TPU VMs also set TPU_WORKER_HOSTNAMES (one
+        # entry); only a multi-worker list signals a pod
+        ) or ("," in os.environ.get("TPU_WORKER_HOSTNAMES", ""))
+
+        def connect():
+            _faults.maybe_fail("multihost.init")
             jax.distributed.initialize(**kwargs)
-        except (ValueError, RuntimeError):
-            # Benign single-process cases: no coordinator config to form a
-            # world from (ValueError), or the XLA backend is already up —
-            # e.g. a driver that used jax before opting into multi-host
-            # (RuntimeError). Swallowing either on a REAL pod would make N
-            # hosts silently run N duplicate single-host jobs, so surface
-            # the failure whenever multi-host intent is stated (kwargs) or
-            # a multi-host environment is detectable.
-            detected = any(
-                os.environ.get(v)
-                for v in (
-                    "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
-                    "MEGASCALE_COORDINATOR_ADDRESS",
-                )
-            # single-host TPU VMs also set TPU_WORKER_HOSTNAMES (one
-            # entry); only a multi-worker list signals a pod
-            ) or ("," in os.environ.get("TPU_WORKER_HOSTNAMES", ""))
-            if kwargs or detected:
-                raise
+
+        def transient(e: BaseException) -> bool:
+            # only unavailability is worth waiting out; a deterministic
+            # RuntimeError (e.g. "initialize must be called before any JAX
+            # computations") must surface on the FIRST attempt
+            if isinstance(e, _faults.InjectedUnavailable):
+                return True
+            msg = str(e).lower()
+            return any(t in msg for t in (
+                "unavailable", "connection refused", "failed to connect",
+                "deadline", "timed out", "timeout",
+            ))
+
+        if kwargs or detected:
+            # multi-host intent: a not-yet-listening coordinator at job
+            # start is the common race on preemptible slices — retry with
+            # a deadline instead of crashing the whole pod job at t=0.
+            # tries=64 is a non-binding ceiling; retry()'s deadline_s stops
+            # as soon as the next backoff sleep would cross the deadline,
+            # so retry_deadline_s is the single binding limit.
+            retry(
+                connect,
+                policy=RetryPolicy(tries=64, base_delay_s=0.5, max_delay_s=8.0),
+                retry_on=(RuntimeError,),
+                retry_if=transient,
+                what="jax.distributed.initialize",
+                deadline_s=retry_deadline_s,
+            )
+        else:
+            try:
+                connect()
+            except (ValueError, RuntimeError):
+                pass
     return jax.process_count()
 
 

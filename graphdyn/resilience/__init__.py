@@ -1,0 +1,52 @@
+"""graphdyn.resilience — runtime fault tolerance for long-running solvers.
+
+The runtime counterpart to :mod:`graphdyn.analysis` (which gives *static*
+guarantees): this package makes the hours-long SA chains, HPr runs, and
+λ-sweep grids survive the faults that preemptible TPU slices actually
+deliver. Three cooperating pieces (ARCHITECTURE.md "Resilience"):
+
+- :mod:`graphdyn.resilience.faults` — deterministic, seedable fault
+  injection (:class:`FaultPlan`) at named sites instrumented through the
+  io/solver/ops layers, plus the ``GRAPHDYN_FAULT_PLAN`` env hook for
+  CLI-level tests. Every recovery path below ships with an injection test.
+- :mod:`graphdyn.resilience.retry` — bounded exponential-backoff
+  :func:`retry` and the process-wide checkpoint-save policy
+  (:data:`SAVE_RETRY`, CLI ``--max-save-retries``): transient save failures
+  retry, exhausted retries degrade to skip-save with a logged warning —
+  the chain keeps computing.
+- :mod:`graphdyn.resilience.shutdown` — :func:`graceful_shutdown` turns
+  SIGTERM/SIGINT into "checkpoint at next chunk boundary, exit
+  :data:`EX_TEMPFAIL` (75)", so schedulers can tell preemption from
+  failure.
+"""
+
+from graphdyn.resilience.faults import (  # noqa: F401
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    InjectedLoweringError,
+    InjectedPreemption,
+    InjectedUnavailable,
+    InjectedWriteError,
+    check_fault,
+    current_plan,
+    is_lowering_failure,
+    maybe_fail,
+    transform_spec,
+    truncate_file,
+)
+from graphdyn.resilience.retry import (  # noqa: F401
+    SAVE_RETRY,
+    RetryPolicy,
+    retry,
+    set_save_retry,
+)
+from graphdyn.resilience.shutdown import (  # noqa: F401
+    EX_TEMPFAIL,
+    ShutdownRequested,
+    clear_shutdown,
+    graceful_shutdown,
+    raise_if_requested,
+    request_shutdown,
+    shutdown_requested,
+)

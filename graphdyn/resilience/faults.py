@@ -1,0 +1,307 @@
+"""Deterministic fault injection — the test harness for every recovery path.
+
+The recovery code in this framework (checkpoint quarantine, retry/degrade
+saves, preemption resume, the Pallas→lax fallback) is only trustworthy if it
+can be *exercised*: a recovery path without a fault that triggers it is dead
+code with a comforting name. This module provides the trigger.
+
+A :class:`FaultPlan` is a context manager holding a list of
+:class:`FaultSpec` entries, each naming an **injection site** (a stable
+string like ``"checkpoint.write"``), an action, and a deterministic firing
+schedule (the ``at``-th hit of that site, optionally ``count`` consecutive
+hits, optionally a seeded probability). Production code calls
+:func:`maybe_fail`/:func:`check_fault` at the instrumented sites; with no
+active plan both are near-zero-cost no-ops (one global check), so the sites
+cost nothing in real runs.
+
+Site catalogue (kept in ARCHITECTURE.md "Resilience" in sync with the
+instrumented code):
+
+===================  =====================================================
+site                 instrumented in
+===================  =====================================================
+``checkpoint.write`` ``utils.io.Checkpoint.save`` — ``raise`` (ENOSPC) or
+                     ``torn`` (partial temp file left behind, then raise)
+``checkpoint.read``  ``utils.io.Checkpoint.load`` — ``truncate`` corrupts
+                     the on-disk npz before the real loader reads it
+``chunk.boundary``   ``utils.io.ChainCheckpointer.drive`` — ``preempt``
+                     raises at the ``at``-th chunk boundary
+``rep.boundary``     ``models.sa.sa_ensemble`` / ``models.hpr.hpr_ensemble``
+                     — ``preempt`` raises after the ``at``-th repetition
+``lambda.boundary``  ``models.entropy._run_ladder`` — ``preempt`` raises
+                     after the ``at``-th visited λ
+``pallas.lower``     ``ops.bdcm._sweep_core`` (Pallas branch, trace time) —
+                     ``raise`` simulates a kernel lowering/compile failure
+``sweep.nan``        ``ops.bdcm.make_sweep`` / ``models.entropy
+                     .make_fixed_point`` wrappers — ``nan`` poisons the
+                     returned carry
+``multihost.init``   ``parallel.mesh.init_multihost`` — ``raise`` simulates
+                     a coordinator that is not up yet
+===================  =====================================================
+
+CLI-level tests inject through the ``GRAPHDYN_FAULT_PLAN`` environment
+variable (a JSON list of spec dicts); it is consulted only when no
+in-process plan is active, so programmatic plans always win.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import logging
+import os
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+log = logging.getLogger("graphdyn.resilience")
+
+ENV_VAR = "GRAPHDYN_FAULT_PLAN"
+
+
+class InjectedFault(Exception):
+    """Base class of every injected failure (so tests and recovery code can
+    tell injected faults from organic ones)."""
+
+
+class InjectedWriteError(InjectedFault, OSError):
+    """Simulated persistent-storage write failure (defaults to ENOSPC)."""
+
+    def __init__(self, path: str = ""):
+        OSError.__init__(self, errno.ENOSPC, "injected: no space left on device", path)
+
+
+class InjectedPreemption(InjectedFault):
+    """Simulated hard preemption: the process dies *here*, no cleanup."""
+
+
+class InjectedLoweringError(InjectedFault):
+    """Simulated Pallas kernel lowering/compile failure."""
+
+
+class InjectedUnavailable(InjectedFault, RuntimeError):
+    """Simulated transient service unavailability (e.g. coordinator not up)."""
+
+
+@dataclass
+class FaultSpec:
+    """One fault: fire ``count`` times starting at the ``at``-th hit of
+    ``site`` (1-based, counted per plan activation). ``p`` < 1 makes each
+    eligible hit fire with that probability from the plan's seeded stream —
+    deterministic given the plan seed. ``match`` restricts firing to hits
+    whose ``key`` context value contains it (e.g. a checkpoint path).
+
+    Actions: ``raise`` (site-specific exception), ``preempt`` (hard kill —
+    :class:`InjectedPreemption`), ``torn``/``truncate``/``nan`` (data
+    transformations applied by the site), and ``signal`` (deliver a
+    graceful-shutdown request exactly as a SIGTERM handler would — the
+    deterministic, race-free way to test the preemption protocol)."""
+
+    site: str
+    action: str = "raise"   # raise | preempt | torn | truncate | nan | signal
+    at: int = 1
+    count: int = 1
+    p: float = 1.0
+    match: str | None = None
+    hits: int = field(default=0, init=False)    # per-plan-activation counter
+    fired: int = field(default=0, init=False)
+
+
+class FaultPlan:
+    """A deterministic, seedable schedule of injected faults.
+
+    Use as a context manager::
+
+        with FaultPlan([FaultSpec("chunk.boundary", "preempt", at=2)]):
+            solver(...)        # raises InjectedPreemption at chunk 2
+
+    Plans nest (a stack); the innermost active plan is consulted. Entering
+    the same plan twice resets its hit counters, so one plan object can
+    drive several independent runs in a test.
+    """
+
+    def __init__(self, specs, *, seed: int = 0):
+        self.specs = [
+            FaultSpec(**s) if isinstance(s, dict) else s for s in specs
+        ]
+        self.seed = seed
+        self._rng = np.random.default_rng(seed)
+
+    @classmethod
+    def from_env(cls, env: str | None = None) -> "FaultPlan | None":
+        """Plan from the ``GRAPHDYN_FAULT_PLAN`` JSON (or ``env`` override);
+        None when unset/empty. Malformed JSON raises — a CLI test with a
+        typo'd plan must fail loudly, not run fault-free and pass."""
+        blob = os.environ.get(ENV_VAR, "") if env is None else env
+        if not blob.strip():
+            return None
+        doc = json.loads(blob)
+        specs = doc.get("specs", doc) if isinstance(doc, dict) else doc
+        seed = doc.get("seed", 0) if isinstance(doc, dict) else 0
+        return cls([FaultSpec(**s) for s in specs], seed=seed)
+
+    def __enter__(self) -> "FaultPlan":
+        for s in self.specs:
+            s.hits = s.fired = 0
+        self._rng = np.random.default_rng(self.seed)
+        _stack().append(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _stack().remove(self)
+        if any(s.action == "signal" and s.fired for s in self.specs):
+            # a fired 'signal' spec set the process-global shutdown flag;
+            # clear it on plan exit so the injected request cannot outlive
+            # the plan and poison every later solver call in this process
+            # (inside a graceful_shutdown scope the request has already
+            # been consumed as ShutdownRequested by the time we get here)
+            from graphdyn.resilience.shutdown import clear_shutdown
+
+            clear_shutdown()
+
+    def poll(self, site: str, key: str = "") -> FaultSpec | None:
+        """The spec that fires on this hit of ``site``, or None. Counts the
+        hit on every matching spec regardless of firing."""
+        for s in self.specs:
+            if s.site != site:
+                continue
+            if s.match is not None and s.match not in key:
+                continue
+            s.hits += 1
+            in_window = s.at <= s.hits < s.at + s.count
+            if in_window and s.fired < s.count:
+                if s.p >= 1.0 or self._rng.random() < s.p:
+                    s.fired += 1
+                    return s
+        return None
+
+
+_local = threading.local()
+
+
+def _stack() -> list:
+    if not hasattr(_local, "plans"):
+        _local.plans = []
+    return _local.plans
+
+
+_env_plan_cache: list = []      # [] = unparsed, [None] or [FaultPlan] = parsed
+
+
+def _env_plan() -> FaultPlan | None:
+    if not _env_plan_cache:
+        # env plans live for the process (never on the with-stack); their
+        # hit counters run from the first consulted site onward
+        _env_plan_cache.append(FaultPlan.from_env())
+    return _env_plan_cache[0]
+
+
+def current_plan() -> FaultPlan | None:
+    """Innermost active plan: an explicit ``with FaultPlan(...)`` wins over
+    the process-level ``GRAPHDYN_FAULT_PLAN`` env plan."""
+    stack = _stack()
+    if stack:
+        return stack[-1]
+    return _env_plan()
+
+
+def check_fault(site: str, key: str = "") -> FaultSpec | None:
+    """Poll ``site``: the firing :class:`FaultSpec` (for sites that apply a
+    data transformation themselves — ``truncate``, ``torn``, ``nan``), or
+    None. Near-free with no active plan."""
+    plan = current_plan()
+    if plan is None:
+        return None
+    spec = plan.poll(site, key)
+    if spec is not None:
+        log.warning("fault injected at %s: %s (hit %d)", site, spec.action,
+                    spec.hits)
+        if spec.action == "signal":
+            import signal as _signal
+
+            from graphdyn.resilience.shutdown import request_shutdown
+
+            request_shutdown(_signal.SIGTERM)
+    return spec
+
+
+def maybe_fail(site: str, key: str = "") -> None:
+    """Poll ``site`` and raise the configured exception when a spec fires:
+    ``preempt`` → :class:`InjectedPreemption` (a hard kill is a hard kill at
+    EVERY site — never downgraded to a site-specific retryable error),
+    ``raise`` → the site's specialized exception. Transform-type actions at
+    a raise-only site also raise (a misconfigured plan must not silently
+    no-op); ``signal``'s side effect already happened in
+    :func:`check_fault`."""
+    spec = check_fault(site, key)
+    if spec is None or spec.action == "signal":
+        return
+    if spec.action == "preempt":
+        raise InjectedPreemption(
+            f"injected preempt at {site} (hit {spec.hits})"
+        )
+    if spec.action == "raise":
+        if site == "checkpoint.write":
+            raise InjectedWriteError(key)
+        if site == "pallas.lower":
+            raise InjectedLoweringError(
+                f"injected lowering failure at {key or site}"
+            )
+        if site == "multihost.init":
+            raise InjectedUnavailable("injected: coordinator unavailable")
+    raise InjectedFault(f"injected {spec.action} at {site} (hit {spec.hits})")
+
+
+def transform_spec(site: str, expected: str, key: str = "") -> FaultSpec | None:
+    """:func:`check_fault` for sites whose firing spec applies a data
+    transformation (``truncate``, ``torn``, ``nan``): returns the spec only
+    when its action is ``expected``. ``preempt`` raises
+    :class:`InjectedPreemption`, any other mismatched action raises
+    :class:`InjectedFault` — a plan that names a site must never silently
+    no-op; ``signal`` returns None (its side effect already happened)."""
+    spec = check_fault(site, key)
+    if spec is None or spec.action == "signal":
+        return None
+    if spec.action == expected:
+        return spec
+    if spec.action == "preempt":
+        raise InjectedPreemption(f"injected preempt at {site} (hit {spec.hits})")
+    raise InjectedFault(
+        f"injected {spec.action} at {site} (hit {spec.hits}) — this site "
+        f"only applies {expected!r}"
+    )
+
+
+def truncate_file(path: str, frac: float = 0.5) -> None:
+    """Corrupt an on-disk file by truncating it to ``frac`` of its size —
+    the ``checkpoint.read`` fault's payload (a torn download / partial
+    flush). A 0-byte result is valid too (frac=0)."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(0, int(size * frac)))
+
+
+def is_lowering_failure(exc: BaseException) -> bool:
+    """Heuristic: does this exception (or its cause/context chain) look like
+    a Pallas/Mosaic kernel lowering or compile failure — the class of error
+    the runtime lax fallback is allowed to swallow? Injected lowering faults
+    count by construction."""
+    seen: set[int] = set()
+    e: BaseException | None = exc
+    while e is not None and id(e) not in seen:
+        seen.add(id(e))
+        if isinstance(e, InjectedLoweringError):
+            return True
+        if isinstance(e, InjectedFault):
+            # any OTHER injected fault is by construction not a lowering
+            # failure — an InjectedPreemption at the pallas.lower site must
+            # kill the run, not trigger the fallback (its message contains
+            # "pallas", so the substring scan below would misfire)
+            return False
+        blob = f"{type(e).__module__}.{type(e).__name__}: {e}".lower()
+        if any(tok in blob for tok in
+               ("pallas", "mosaic", "triton", "lowering", "unimplemented")):
+            return True
+        e = e.__cause__ or e.__context__
+    return False

@@ -1,0 +1,92 @@
+"""Bounded exponential-backoff retry + graceful degradation policy.
+
+The headline workloads are hours-long; the failure economics are asymmetric.
+A checkpoint save that hits a transient ENOSPC/EIO must not kill the chain —
+the chain IS the value, the snapshot is insurance. Conversely
+``init_multihost`` racing a coordinator that is still booting should wait
+out the race instead of crashing the whole pod job at t=0. Both are the
+same primitive: :func:`retry` with a small bounded budget, then an explicit
+policy decision (give up loudly, or degrade and keep computing).
+
+The checkpoint-save budget is process-global (:data:`SAVE_RETRY`) so the CLI
+``--max-save-retries`` flag reaches every solver without threading a
+parameter through ten signatures.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+
+log = logging.getLogger("graphdyn.resilience")
+
+
+@dataclass
+class RetryPolicy:
+    """``tries`` total attempts (1 = no retry), exponential backoff
+    ``base_delay_s * 2**k`` capped at ``max_delay_s``."""
+
+    tries: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+
+    def delays(self):
+        d = self.base_delay_s
+        for _ in range(max(0, self.tries - 1)):
+            yield min(d, self.max_delay_s)
+            d *= 2.0
+
+
+# the process-wide checkpoint-save budget (CLI: --max-save-retries). A
+# mutable singleton, updated in place — importers hold the object, not a
+# snapshot of it.
+SAVE_RETRY = RetryPolicy()
+
+
+def set_save_retry(tries: int) -> None:
+    """Set the checkpoint-save retry budget (``tries`` retries after the
+    first attempt): the ``--max-save-retries`` knob."""
+    SAVE_RETRY.tries = max(1, int(tries) + 1)
+
+
+def retry(
+    fn,
+    *,
+    policy: RetryPolicy | None = None,
+    retry_on: tuple = (OSError,),
+    retry_if=None,
+    what: str = "operation",
+    deadline_s: float | None = None,
+    sleep=time.sleep,
+):
+    """Call ``fn()`` with bounded exponential backoff.
+
+    Retries on ``retry_on`` exceptions only — further narrowed by
+    ``retry_if(exc) -> bool`` when given (a deterministic failure dressed
+    in a retryable class must surface immediately, not after the whole
+    backoff budget); the last failure re-raises. ``deadline_s`` caps the
+    total time spent waiting (attempts stop early when the next sleep
+    would cross it) — the ``init_multihost`` "retry with deadline"
+    contract. Each retry logs a warning with the failure, so a run that
+    survived transient trouble says so in its log."""
+    policy = policy or RetryPolicy()
+    t0 = time.monotonic()
+    delays = list(policy.delays()) + [None]     # None = no sleep after last
+    for attempt, delay in enumerate(delays, start=1):
+        try:
+            return fn()
+        except retry_on as e:
+            if retry_if is not None and not retry_if(e):
+                raise
+            out_of_time = deadline_s is not None and delay is not None and (
+                time.monotonic() - t0 + delay > deadline_s
+            )
+            if delay is None or out_of_time:
+                raise
+            log.warning(
+                "%s failed (attempt %d/%d): %s — retrying in %.2gs",
+                what, attempt, len(delays), e, delay,
+            )
+            sleep(delay)
+    raise AssertionError("unreachable")         # pragma: no cover

@@ -14,11 +14,20 @@ from __future__ import annotations
 
 import hashlib
 import json
+import logging
 import os
 import time
+import zipfile
+import zlib
 from typing import Any
 
 import numpy as np
+
+from graphdyn.resilience import faults as _faults
+from graphdyn.resilience.retry import SAVE_RETRY, retry as _retry_call
+from graphdyn.resilience.shutdown import raise_if_requested, shutdown_requested
+
+log = logging.getLogger("graphdyn.io")
 
 
 def _fingerprint_repr(p) -> str:
@@ -65,15 +74,41 @@ def run_fingerprint(*parts) -> str:
     return h.hexdigest()
 
 
+def _atomic_savez(path: str, payload: dict) -> str:
+    """``np.savez`` with the temp-file + ``os.replace`` discipline: a reader
+    (or a preemption mid-write) sees either the old file or the new one,
+    never a torn npz. Preserves ``np.savez``'s append-``.npz`` semantics;
+    returns the final path. The one savez both :func:`save_results_npz` and
+    :class:`Checkpoint` go through (graftlint GD007 flags any other write
+    path in the package)."""
+    final = path if path.endswith(".npz") else path + ".npz"
+    tmp = final[:-len(".npz")] + ".tmp.npz"
+    np.savez(tmp, **payload)
+    os.replace(tmp, final)
+    return final
+
+
 def save_results_npz(path: str, **arrays) -> None:
     """Reference-compatible result file (e.g. ``mag_reached=..., conf=...,
-    num_steps=..., graphs=..., time=...`` as in `HPR_pytorch_RRG.py:377`)."""
-    np.savez(path, **{k: np.asarray(v) for k, v in arrays.items()})
+    num_steps=..., graphs=..., time=...`` as in `HPR_pytorch_RRG.py:377`),
+    written atomically — a preemption during the end-of-run save cannot
+    leave a torn results file."""
+    _atomic_savez(path, {k: np.asarray(v) for k, v in arrays.items()})
 
 
 def load_results_npz(path: str) -> dict[str, np.ndarray]:
     with np.load(path) as f:
         return {k: f[k] for k in f.files}
+
+
+def write_json_atomic(path: str, doc, **dump_kwargs) -> None:
+    """JSON result file via temp + ``os.replace`` — same torn-write
+    discipline as the npz writers (GD007 flags direct ``open(…, "w")``
+    persistence elsewhere in the package)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, **dump_kwargs)
+    os.replace(tmp, path)
 
 
 class Checkpoint:
@@ -94,13 +129,34 @@ class Checkpoint:
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         if self._META_KEY in arrays:
             raise ValueError(f"array key {self._META_KEY!r} is reserved")
-        tmp = self.path + ".tmp.npz"
         payload = {k: np.asarray(v) for k, v in arrays.items()}
+        for k, v in payload.items():
+            if v.dtype == object:
+                # savez would pickle it and SUCCEED, but the default
+                # allow_pickle=False load then raises ValueError — which the
+                # corruption handler would read as a corrupt file and
+                # quarantine on every resume. Fail at write time instead.
+                raise TypeError(
+                    f"checkpoint array {k!r} has dtype=object (ragged or "
+                    f"mixed) — not loadable without pickle; use a "
+                    f"fixed-width dtype"
+                )
         payload[self._META_KEY] = np.frombuffer(
             json.dumps(meta).encode(), dtype=np.uint8
         )
-        np.savez(tmp, **payload)
-        os.replace(tmp, self.path + ".npz")
+        spec = _faults.check_fault("checkpoint.write", key=self.path)
+        if spec is not None and spec.action != "signal":
+            if spec.action == "preempt":
+                raise _faults.InjectedPreemption(
+                    f"injected preempt during checkpoint write ({self.path})"
+                )
+            if spec.action == "torn":
+                # what a real preemption mid-savez leaves behind: a partial
+                # temp file (never the published .npz — os.replace is atomic)
+                with open(self.path + ".tmp.npz", "wb") as f:
+                    f.write(b"PK\x03\x04 torn by injected preemption")
+            raise _faults.InjectedWriteError(self.path)
+        _atomic_savez(self.path + ".npz", payload)
 
     def remove(self) -> None:
         """Delete the checkpoint file if present (end-of-run cleanup), plus
@@ -113,16 +169,40 @@ class Checkpoint:
                 pass
 
     def load(self) -> tuple[dict[str, np.ndarray], dict[str, Any]] | None:
-        if not os.path.exists(self.path + ".npz"):
+        path = self.path + ".npz"
+        if not os.path.exists(path):
             return None
-        with np.load(self.path + ".npz") as f:
-            arrays = {k: f[k] for k in f.files if k != self._META_KEY}
-            if self._META_KEY in f.files:
-                meta = json.loads(f[self._META_KEY].tobytes().decode())
-            else:
-                # foreign/legacy npz (e.g. a reference-style results file):
-                # still loadable, just with empty metadata
-                meta = {}
+        spec = _faults.transform_spec("checkpoint.read", "truncate",
+                                      key=self.path)
+        if spec is not None:
+            _faults.truncate_file(path)          # torn flush / partial copy
+        try:
+            with np.load(path) as f:
+                arrays = {k: f[k] for k in f.files if k != self._META_KEY}
+                if self._META_KEY in f.files:
+                    meta = json.loads(f[self._META_KEY].tobytes().decode())
+                else:
+                    # foreign/legacy npz (e.g. a reference-style results
+                    # file): still loadable, just with empty metadata
+                    meta = {}
+        # structural corruption ONLY — a transient read error (plain
+        # OSError: EIO, EACCES, network blip) must propagate, not destroy a
+        # perfectly good checkpoint by quarantining it
+        except (zipfile.BadZipFile, zlib.error, EOFError, ValueError) as e:
+            # a corrupted/truncated checkpoint is a first-class condition
+            # (torn write on a dying node, partial object-store copy), not
+            # a crash: quarantine it for post-mortem and start fresh. The
+            # quarantine file is deliberately NOT cleaned by remove().
+            quarantine = self.path + ".corrupt.npz"
+            try:
+                os.replace(path, quarantine)
+            except OSError:
+                quarantine = "<unquarantined: rename failed>"
+            log.warning(
+                "checkpoint at %s is corrupt (%s: %s) — quarantined to %s, "
+                "starting fresh", path, type(e).__name__, e, quarantine,
+            )
+            return None
         return arrays, meta
 
 
@@ -209,6 +289,11 @@ class ChainCheckpointer:
     def maybe_save(self, arrays: dict) -> bool:
         return self._pc.maybe_save(arrays, self._meta)
 
+    def save_now(self, arrays: dict) -> bool:
+        """Immediate save bypassing the interval gate — the shutdown
+        snapshot. Same retry/degrade policy as periodic saves."""
+        return self._pc.save_now(arrays, self._meta)
+
     def remove(self) -> None:
         self._pc.remove()
 
@@ -218,19 +303,64 @@ class ChainCheckpointer:
         never of a finished state, so an abort in the final window cannot
         leave a stale done-snapshot — then remove the file. ``payload`` is
         only called when a save is actually due (snapshots can be large
-        device-to-host copies). Returns the final state."""
+        device-to-host copies). Returns the final state.
+
+        Preemption-safe: when a graceful shutdown is pending (SIGTERM under
+        :func:`graphdyn.resilience.graceful_shutdown`), the chunk boundary
+        forces an immediate snapshot and raises
+        :class:`~graphdyn.resilience.ShutdownRequested` — so the on-disk
+        checkpoint is never older than one chunk when the CLI exits 75.
+        Fault site ``chunk.boundary`` simulates a hard preemption here."""
+        k = 0
         while active(state):
             state = advance(state)
-            if active(state) and self.due():
-                self.maybe_save(payload(state))
+            k += 1
+            _faults.maybe_fail("chunk.boundary", key=f"{self.path}#{k}")
+            if active(state):
+                if shutdown_requested():
+                    if not self.save_now(payload(state)):
+                        log.warning(
+                            "shutdown snapshot for %s could not be written "
+                            "— resume will fall back to the last periodic "
+                            "checkpoint (if any)", self.path,
+                        )
+                    raise_if_requested()
+                elif self.due():
+                    self.maybe_save(payload(state))
         self.remove()
         return state
+
+
+def save_with_retry(ckpt: Checkpoint, arrays: dict, meta: dict) -> bool:
+    """``ckpt.save`` under the process-wide retry budget
+    (:data:`graphdyn.resilience.retry.SAVE_RETRY`, CLI
+    ``--max-save-retries``), degrading to **skip-save** when retries are
+    exhausted: a transient (or even persistent) write failure must not kill
+    an hours-long chain — the snapshot is insurance, the chain is the
+    value. Returns False (with a logged warning) on the degrade path."""
+    try:
+        _retry_call(
+            lambda: ckpt.save(arrays, meta),
+            policy=SAVE_RETRY,
+            retry_on=(OSError,),
+            what=f"checkpoint save ({ckpt.path})",
+        )
+        return True
+    except OSError as e:
+        log.warning(
+            "checkpoint save to %s failed after %d attempt(s) — SKIPPING "
+            "this snapshot and continuing the run: %s",
+            ckpt.path, SAVE_RETRY.tries, e,
+        )
+        return False
 
 
 class PeriodicCheckpointer:
     """Time-triggered checkpointing (the notebook's ``saving_time`` sketch,
     `ipynb:439-445`): call ``maybe_save`` inside the solver loop; it writes at
-    most every ``interval_s`` seconds."""
+    most every ``interval_s`` seconds. Writes go through
+    :func:`save_with_retry` — after the retry budget, the snapshot is
+    skipped (logged) and the next one is attempted an interval later."""
 
     def __init__(self, path: str, interval_s: float = 30.0, max_saves: int | None = None):
         self.ckpt = Checkpoint(path)
@@ -250,10 +380,18 @@ class PeriodicCheckpointer:
     def maybe_save(self, arrays: dict[str, Any], meta: dict[str, Any]) -> bool:
         if not self.due():
             return False
-        self.ckpt.save(arrays, meta)
+        return self.save_now(arrays, meta)
+
+    def save_now(self, arrays: dict[str, Any], meta: dict[str, Any]) -> bool:
+        """Immediate save bypassing the interval gate — the graceful-
+        shutdown snapshot (same retry/degrade policy). On the degrade path
+        the clock still resets: retry next interval, don't hammer a full
+        disk on every chunk."""
+        ok = save_with_retry(self.ckpt, arrays, meta)
         self._last = time.monotonic()
-        self._count += 1
-        return True
+        if ok:
+            self._count += 1
+        return ok
 
     def remove(self) -> None:
         self.ckpt.remove()

@@ -44,6 +44,21 @@ fi
 echo "== graftlint =="
 python -m graphdyn.analysis "${@:-graphdyn/}" --format=text || fail=1
 
+# 4. faultcheck — the fault-injection test subset standalone (pytest -m
+#    faultinject): every recovery path in graphdyn/resilience must survive
+#    its injected fault. Skipped with a notice when pytest is absent, or
+#    when GRAPHDYN_SKIP_FAULTCHECK=1 (set by the tier-1 lint-gate test:
+#    the same subset already runs in the suite proper — no double work).
+if [ "${GRAPHDYN_SKIP_FAULTCHECK:-0}" = "1" ]; then
+    echo "== faultcheck: GRAPHDYN_SKIP_FAULTCHECK=1 — SKIPPED (subset runs in tier-1) =="
+elif python -c 'import pytest' 2>/dev/null; then
+    echo "== faultcheck (pytest -m faultinject) =="
+    JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faultinject \
+        -p no:cacheprovider || fail=1
+else
+    echo "== faultcheck: pytest not installed — SKIPPED (pip install pytest to enable) =="
+fi
+
 if [ "$fail" -ne 0 ]; then
     echo "lint gate: FAILED" >&2
     exit 1

@@ -288,6 +288,79 @@ class TestGD006Donation:
         assert _codes(src) == []
 
 
+class TestGD007AtomicPersistence:
+    BAD_SAVEZ = (
+        "import numpy as np\n"
+        "def persist(path, arr):\n"
+        "    np.savez(path, arr=arr)\n"
+    )
+    BAD_OPEN = (
+        "import json\n"
+        "def persist(path, doc):\n"
+        "    with open(path, 'w') as f:\n"
+        "        json.dump(doc, f)\n"
+    )
+
+    def test_bad_direct_savez(self):
+        assert "GD007" in _codes(self.BAD_SAVEZ)
+
+    def test_bad_open_for_write(self):
+        assert "GD007" in _codes(self.BAD_OPEN)
+
+    def test_good_temp_then_replace(self):
+        src = (
+            "import os\nimport numpy as np\n"
+            "def persist(path, arr):\n"
+            "    tmp = path + '.tmp.npz'\n"
+            "    np.savez(tmp, arr=arr)\n"
+            "    os.replace(tmp, path + '.npz')\n"
+        )
+        assert _codes(src) == []
+
+    def test_good_open_for_read(self):
+        src = (
+            "def read(path):\n"
+            "    with open(path) as f:\n"
+            "        return f.read()\n"
+        )
+        assert _codes(src) == []
+
+    def test_utils_io_exempt(self):
+        # the atomic-write implementation itself may touch raw write APIs
+        assert _codes(self.BAD_SAVEZ, path="graphdyn/utils/io.py") == []
+
+    def test_temp_is_a_token_not_a_substring(self):
+        # 'attempt_path'/'template' contain 'temp' but are not temp paths
+        src = (
+            "import numpy as np\n"
+            "def persist(attempt_path, template, arr):\n"
+            "    np.savez(attempt_path, arr=arr)\n"
+            "    with open(template, 'w') as f:\n"
+            "        f.write('x')\n"
+        )
+        assert _codes(src) == ["GD007", "GD007"]
+
+    def test_tempfile_module_is_exempt(self):
+        src = (
+            "import tempfile\n"
+            "def scratch(doc):\n"
+            "    with open(tempfile.mktemp(), 'w') as f:\n"
+            "        f.write(doc)\n"
+        )
+        # tempfile.mktemp: 'tempfile' token → exempt. (mktemp ends in
+        # 'temp' as a substring only, but the module name already exempts.)
+        assert _codes(src) == []
+
+    def test_disable_escape_hatch(self):
+        src = (
+            "import numpy as np\n"
+            "def persist(path, arr):\n"
+            "    np.savez(path, arr=arr)  # graftlint: disable=GD007  "
+            "scratch file, never resumed\n"
+        )
+        assert _codes(src) == []
+
+
 class TestDisableComments:
     BAD_LINE = "    return np.tanh(x)"
 
@@ -391,7 +464,7 @@ def test_unreadable_file_is_a_finding(tmp_path):
 
 
 def test_rules_registry_complete():
-    assert set(RULES) == {f"GD00{i}" for i in range(1, 7)}
+    assert set(RULES) == {f"GD00{i}" for i in range(1, 8)}
 
 
 def test_repo_package_is_clean():

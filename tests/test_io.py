@@ -35,6 +35,17 @@ def test_checkpoint_roundtrip_single_file(tmp_path):
     np.testing.assert_array_equal(arrs["s"], arrays["s"])
 
 
+def test_checkpoint_rejects_object_dtype_at_save(tmp_path):
+    """np.savez would pickle an object array and succeed, but the default
+    allow_pickle=False load then fails — which the corruption handler would
+    quarantine as 'corrupt' on every resume. Fail at write time instead."""
+    ck = Checkpoint(str(tmp_path / "state"))
+    ragged = np.asarray([np.arange(2), np.arange(3)], dtype=object)
+    with np.testing.assert_raises(TypeError):
+        ck.save({"bad": ragged}, {})
+    assert ck.load() is None                     # nothing was written
+
+
 def test_checkpoint_reserved_key(tmp_path):
     ck = Checkpoint(str(tmp_path / "state"))
     try:
@@ -52,6 +63,29 @@ def test_periodic_checkpointer_throttles(tmp_path):
     assert pc.maybe_save({"x": np.zeros(1)}, {"t": 1})
     arrs, meta = pc.ckpt.load()
     assert meta == {"t": 1}
+
+
+def test_results_npz_write_is_atomic(tmp_path):
+    """save_results_npz goes through temp + os.replace (same discipline as
+    Checkpoint.save): np.savez's .npz-appending semantics are preserved and
+    no temp file survives the write."""
+    p = str(tmp_path / "res")                    # extensionless, like np.savez
+    save_results_npz(p, x=np.arange(3))
+    assert (tmp_path / "res.npz").exists()
+    assert list(tmp_path.glob("*.tmp.npz")) == []
+    np.testing.assert_array_equal(load_results_npz(p + ".npz")["x"], np.arange(3))
+
+
+def test_write_json_atomic_roundtrip(tmp_path):
+    from graphdyn.utils.io import write_json_atomic
+
+    p = str(tmp_path / "doc.json")
+    write_json_atomic(p, {"a": [1, 2]}, indent=1)
+    import json
+
+    with open(p) as f:
+        assert json.load(f) == {"a": [1, 2]}
+    assert list(tmp_path.glob("*.tmp")) == []
 
 
 def test_checkpoint_load_metaless_npz(tmp_path):

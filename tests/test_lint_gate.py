@@ -7,6 +7,7 @@ and the one that catches perf-invariant regressions nothing else can.
 """
 
 import json
+import os
 import subprocess
 import sys
 from pathlib import Path
@@ -16,15 +17,19 @@ REPO = Path(__file__).resolve().parent.parent
 
 def test_lint_sh_gate_passes():
     """scripts/lint.sh exits 0 on the repo (ruff/mypy skip gracefully when
-    absent; graftlint always gates)."""
+    absent; graftlint always gates). The faultcheck step is skipped here —
+    the faultinject subset already runs in this very suite; re-running it
+    nested would double the gate's cost for no extra coverage."""
     proc = subprocess.run(
         ["bash", str(REPO / "scripts" / "lint.sh")],
         cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "GRAPHDYN_SKIP_FAULTCHECK": "1"},
     )
     assert proc.returncode == 0, (
         f"lint gate failed:\n{proc.stdout}\n{proc.stderr}"
     )
     assert "lint gate: OK" in proc.stdout
+    assert "faultcheck" in proc.stdout    # the step exists and announced itself
 
 
 def test_graftlint_clean_on_package_json():
@@ -39,6 +44,25 @@ def test_graftlint_clean_on_package_json():
     findings = json.loads(proc.stdout)
     assert proc.returncode == 0, f"undisabled findings: {findings}"
     assert findings == []
+
+
+def test_gd007_active_in_gate(tmp_path):
+    """GD007 (non-atomic persistence) is live in the gating linter: a
+    direct np.savez to a non-temp path is a finding."""
+    bad = tmp_path / "writer.py"
+    bad.write_text(
+        "import numpy as np\n\n"
+        "def persist(path, arr):\n"
+        "    np.savez(path, arr=arr)\n"   # GD007
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "graphdyn.analysis", str(bad),
+         "--format=json"],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    findings = json.loads(proc.stdout)
+    assert proc.returncode == 1, findings
+    assert [f["code"] for f in findings] == ["GD007"]
 
 
 def test_graftlint_exit_code_counts_findings(tmp_path):
