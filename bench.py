@@ -8,7 +8,11 @@ The headline value is the bit-packed replica kernel
 counting) at N=1e6 × 4096 replicas — the framework's ensemble-dynamics hot
 path. ``vs_baseline`` is the speedup over the reference-style torch-CPU
 dynamics kernel (`HPR_pytorch_RRG.py:169-171` semantics) measured on this
-host. The int8 batched-rollout rate is reported alongside.
+host. The int8 batched-rollout rate is reported alongside, plus the
+``ensemble_rate`` row: end-to-end DRIVER throughput (grouped pipeline vs
+legacy serial loop on the same workload, ``ensemble_speedup`` = their
+wall-clock ratio). Rows skipped on the current backend (wide-replica,
+Pallas) emit ``null`` + ``<row>_skipped_reason``, never 0.0.
 
 Usage: python bench.py [--smoke]
 """
@@ -60,10 +64,16 @@ def packed_rate(g, R, steps, iters=3, kernel="xla"):
         deg_h = np.asarray(g.deg)
         # the rollout is jitted internally (host-side support gate outside)
         f = lambda sp: pallas_packed_rollout(nbr, deg_h, sp, steps)  # noqa: E731
+        _sync(f(sp))
     else:
         deg = jnp.asarray(g.deg)
-        f = jax.jit(lambda sp: packed_rollout(nbr, deg, sp, steps))
-    _sync(f(sp))
+        # donate the chained state: the timing loop feeds each call's output
+        # into the next, so without donation the 512 MB state at the full
+        # shape is double-buffered for the whole loop
+        f = jax.jit(lambda sp: packed_rollout(nbr, deg, sp, steps),
+                    donate_argnums=0)
+        sp = f(sp)                      # warmup consumes the drawn state
+        _sync(sp)
     _mark("packed_rate: warm; timing")
     t0 = time.perf_counter()
     for _ in range(iters):
@@ -83,13 +93,54 @@ def int8_rate(g, R, steps, iters=3):
     R_coef, C_coef = rule_coefficients("majority", "stay")
     nbr = jnp.asarray(g.nbr)
     s = draw_pm1_int8(0, (R, g.n))
-    f = jax.jit(lambda s: batched_rollout_impl(nbr, s, steps, R_coef, C_coef))
-    _sync(f(s))
+    # chained timing loop — donate so the [R, n] state updates in place
+    f = jax.jit(lambda s: batched_rollout_impl(nbr, s, steps, R_coef, C_coef),
+                donate_argnums=0)
+    s = f(s)
+    _sync(s)
     t0 = time.perf_counter()
     for _ in range(iters):
         s = f(s)
     _sync(s)
     return g.n * R * steps * iters / (time.perf_counter() - t0)
+
+
+def ensemble_rate(smoke: bool):
+    """End-to-end DRIVER throughput (spin-updates/s through ``sa_ensemble``,
+    host graph sampling included) — the number the pipeline changes, where
+    the kernel rows above cannot see driver overhead. Runs the same
+    workload twice per path (grouped pipeline vs legacy serial loop; the
+    first run pays the XLA compile, the second is measured) and reports the
+    warm rates plus their wall-clock ratio. Results are element-wise
+    identical between the paths (tested), so this is a pure execution-
+    schedule A/B."""
+    from graphdyn.config import DynamicsConfig, SAConfig
+    from graphdyn.models.sa import sa_ensemble
+
+    if smoke:
+        n, n_stat, max_steps, group = 512, 16, 300, 16
+    else:
+        n, n_stat, max_steps, group = 8192, 32, 500, 32
+    cfg = SAConfig(dynamics=DynamicsConfig(p=1, c=1))
+    kw = dict(n_stat=n_stat, seed=0, max_steps=max_steps)
+
+    walls = {}
+    updates = {}
+    for label, gs in (("serial", 0), ("grouped", group)):
+        _mark(f"ensemble_rate {label}: warmup (compile)")
+        sa_ensemble(n, 3, cfg, group_size=gs, **kw)
+        _mark(f"ensemble_rate {label}: timing")
+        t0 = time.perf_counter()
+        res = sa_ensemble(n, 3, cfg, group_size=gs, **kw)
+        walls[label] = time.perf_counter() - t0
+        updates[label] = n * int(np.sum(res.num_steps))
+    return {
+        "ensemble_rate": updates["grouped"] / walls["grouped"],
+        "ensemble_rate_serial": updates["serial"] / walls["serial"],
+        "ensemble_speedup": walls["serial"] / walls["grouped"],
+        "ensemble_workload": {"n": n, "d": 3, "n_stat": n_stat,
+                              "max_steps": max_steps, "group_size": group},
+    }
 
 
 def torch_cpu_rate(g, steps=3):
@@ -156,10 +207,25 @@ def main():
     partial = {"packed_rate_natural_order": 0.0, "packed_rate_bfs_order": 0.0,
                "packed_rate_wide": 0.0, "packed_rate_pallas": 0.0,
                "int8_rate": 0.0}
+    # rows that were SKIPPED (backend unsupported / optional row failed)
+    # emit null + a reason, never 0.0: a skip must be unmistakable from a
+    # measured collapse, or the benchmark trajectory reads it as a
+    # regression (BENCH_r05 recorded packed_rate_wide/pallas 0.0 that way)
+    skipped = {}
+    # driver-throughput rates ride along in both emissions but stay outside
+    # `partial` (whose values feed the headline max() over kernel rates)
+    extra = {}
     # per-rung widening rates: measured in scarce chip time, so they ride
-    # along in the failure emission too (kept outside `partial`, whose
-    # values feed a max() over scalars)
+    # along in the failure emission too
     wide_by_R = {}
+
+    def _rows():
+        out = dict(partial)
+        for key, reason in skipped.items():
+            if not out.get(key):
+                out[key] = None
+                out[key + "_skipped_reason"] = reason
+        return out
 
     def _fail(e, stage="device"):
         best = max(v for v in partial.values())
@@ -169,7 +235,8 @@ def main():
             "unit": "spin-updates/s",
             "vs_baseline": 0.0,
             "error": f"{stage} failed mid-run: {str(e)[:200]}",
-            **partial,
+            **_rows(),
+            **extra,
             "packed_rate_wide_by_R": wide_by_R,
             "backend": jax.default_backend(),
             **({"relay": relay_note} if relay_note else {}),
@@ -211,6 +278,11 @@ def main():
     # Widening is an HBM per-row-amortization lever; on the CPU fallback it
     # only burns minutes on host caches — chip-only. The 16x rung (W=2048,
     # 8 GB spin state) probes past the r04-measured W=512 point; OOM skips.
+    if not on_chip:
+        skipped["packed_rate_wide"] = (
+            "wide-replica widening is chip-only (backend=%s)"
+            % jax.default_backend()
+        )
     for mult in (4, 8, 16) if on_chip else ():
         R_try = mult * R_packed
         try:
@@ -219,6 +291,10 @@ def main():
             if not is_oom(e):
                 return _fail(e)
             _mark(f"wide R={R_try} OOM; stopping the widening sweep")
+            if not wide_by_R:
+                skipped["packed_rate_wide"] = (
+                    f"first widening rung R={R_try} OOMed"
+                )
             break
         wide_by_R[str(R_try)] = r
         _mark(f"wide R={R_try} rate {r:.3e}")
@@ -240,8 +316,21 @@ def main():
             rate_pallas = packed_rate(g_bfs, R_packed, steps, kernel="pallas")
         except Exception as e:  # noqa: BLE001 — optional row
             _mark(f"pallas kernel row failed: {str(e)[:150]}")
+            skipped["packed_rate_pallas"] = (
+                f"pallas kernel row failed: {str(e)[:150]}"
+            )
+    else:
+        skipped["packed_rate_pallas"] = (
+            "pallas kernel row is chip-only (backend=%s)"
+            % jax.default_backend()
+        )
     partial["packed_rate_pallas"] = rate_pallas
     value = max(rate_natural, rate_bfs, rate_wide, rate_pallas)
+    _mark("ensemble driver A/B (grouped pipeline vs serial loop)")
+    try:
+        extra.update(ensemble_rate(args.smoke))
+    except Exception as e:  # noqa: BLE001 — emit partials, then bail
+        return _fail(e, stage="ensemble driver")
     _mark(f"wide rate {rate_wide:.3e}; pallas rate {rate_pallas:.3e}; int8 row")
     try:
         v8 = int8_rate(g, R_int8, steps)
@@ -263,15 +352,13 @@ def main():
                 # SINGLE-THREADED torch-CPU kernel on this host
                 "vs_baseline": value / base,
                 "baseline_kind": "torch_cpu_single_thread",
-                "packed_rate_natural_order": rate_natural,
-                "packed_rate_bfs_order": rate_bfs,
-                "packed_rate_wide": rate_wide,
+                # skipped rows emit null + <row>_skipped_reason, never 0.0
+                **_rows(),
+                **extra,
                 "packed_rate_wide_by_R": wide_by_R,
-                "packed_rate_pallas": rate_pallas,
                 # only when a rung actually ran — R_wide=0 otherwise (a
                 # never-measured configuration must not report a count)
                 **({"packed_replicas_wide": R_wide} if wide_by_R else {}),
-                "int8_rate": v8,
                 "torch_cpu_rate": base,
                 "packed_replicas": R_packed,
                 "packed_replicas_best": R_wide if value == rate_wide else R_packed,

@@ -44,6 +44,16 @@ Rules (stable codes; each can be silenced per line with
   the atomic writers in :mod:`graphdyn.utils.io` (temp file +
   ``os.replace``).  Paths whose expression mentions ``tmp``/``temp`` are
   exempt — writing the temp half of the discipline is the point.
+- **GD008** per-iteration host→device transfer: ``jnp.asarray``/
+  ``jnp.array``/``jax.device_put`` of host-built arrays inside a Python
+  ``for``-loop in a *driver module* (``graphdyn/models/``,
+  ``graphdyn/pipeline/``, ``cli.py``).  Each iteration re-ships a fresh
+  host buffer while the device idles — the serial-ensemble anti-pattern
+  the pipeline exists to remove (stack the per-iteration tables once and
+  run one vmapped program; overlap host builds with
+  :class:`graphdyn.pipeline.prefetch.HostPrefetcher`).  ``for``-loops
+  inside jit contexts are exempt (they unroll at trace time — no per-step
+  transfer exists).
 
 Escape hatches, all requiring an explicit code list (``all`` allowed):
 
@@ -76,6 +86,13 @@ RULES = {
     "GD005": "jit hygiene (non-static string/enum/config param, unhashable static default)",
     "GD006": "rollout-shaped jitted entry point without donate_argnums",
     "GD007": "non-atomic persistence (direct np.savez / open-for-write outside utils/io.py)",
+    "GD008": "per-iteration host->device transfer (jnp.asarray/device_put) in a driver-module for-loop",
+}
+
+# host->device transfer calls GD008 watches inside host for-loops
+_GD008_CALLS = {
+    "jnp.asarray", "jnp.array", "jax.numpy.asarray", "jax.numpy.array",
+    "jax.device_put", "device_put",
 }
 
 # np dtype scalar constructors: trace-time constants, exempt from GD001
@@ -233,6 +250,13 @@ class _FileLinter:
         # utils/io.py is the one module allowed to touch raw write APIs —
         # it IS the atomic-write implementation
         self.persist_strict = not norm.endswith("utils/io.py")
+        # GD008 scope: the experiment drivers — where a per-repetition host
+        # loop shipping arrays to the device is the throughput anti-pattern
+        # the ensemble pipeline removes
+        self.driver_mod = (
+            "/models/" in norm or "/pipeline/" in norm
+            or norm.endswith("cli.py")
+        )
 
     def emit(self, node: ast.AST, code: str, message: str) -> None:
         self.findings.append(
@@ -306,6 +330,7 @@ class _FileLinter:
                              seen)
         self._check_dtypes(tree)
         self._check_persistence(tree)
+        self._check_host_loop_transfers(tree, seen)
         self.findings.sort(key=lambda f: (f.line, f.col, f.code))
         return self.findings
 
@@ -473,6 +498,33 @@ class _FileLinter:
                                 f"explicitly (int8/int32/f32)",
                             )
 
+
+    def _check_host_loop_transfers(self, tree: ast.Module, jit_seen: set):
+        """GD008: host→device transfers inside host-side ``for``-loops of
+        driver modules — the serial-ensemble anti-pattern (one transfer per
+        repetition while the device idles). ``jit_seen`` holds every node
+        already visited inside a jit context: a ``for`` there unrolls at
+        trace time, so no per-iteration transfer exists and it is exempt."""
+        if not self.driver_mod:
+            return
+        flagged: set[int] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.For) or id(node) in jit_seen:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call) or id(sub) in flagged:
+                    continue
+                d = _dotted(sub.func)
+                if d in _GD008_CALLS:
+                    flagged.add(id(sub))
+                    self.emit(
+                        sub, "GD008",
+                        f"{d}(...) inside a host for-loop ships one buffer "
+                        f"per iteration while the device idles — stack the "
+                        f"per-iteration tables and run one batched program "
+                        f"(see graphdyn.pipeline), or hoist the transfer "
+                        f"out of the loop",
+                    )
 
     def _check_persistence(self, tree: ast.Module):
         """GD007: direct durable writes outside utils/io.py. A torn npz/json

@@ -62,6 +62,22 @@ def _add_resilience_flags(ap: argparse.ArgumentParser) -> None:
     )
 
 
+def _add_pipeline_flags(ap: argparse.ArgumentParser) -> None:
+    """The shared ensemble-pipeline knobs (ARCHITECTURE.md "Ensemble
+    pipeline")."""
+    ap.add_argument(
+        "--group-size", type=int, default=None, metavar="G",
+        help="run G repetitions at a time as ONE batched device program "
+             "(element-wise identical to the serial loop; default: auto, "
+             "min(reps, 8); 0 forces the legacy serial repetition loop)",
+    )
+    ap.add_argument(
+        "--prefetch", type=int, default=2, metavar="D",
+        help="build up to D upcoming graphs on a background thread while "
+             "the current group computes (deterministic; 0 disables)",
+    )
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="graphdyn",
@@ -69,6 +85,13 @@ def build_parser() -> argparse.ArgumentParser:
                "shutdown — SIGTERM/SIGINT checkpointed at the next chunk "
                "boundary, safe for a scheduler to requeue; anything else is "
                "a real failure. See ARCHITECTURE.md 'Resilience'.",
+    )
+    ap.add_argument(
+        "--compile-cache", default=None, metavar="DIR",
+        help="persistent XLA compile cache directory "
+             "(jax_compilation_cache_dir): re-runs and resumed jobs skip "
+             "the multi-second compile; also honored from the "
+             "GRAPHDYN_COMPILE_CACHE environment variable (this flag wins)",
     )
     sub = ap.add_subparsers(dest="cmd", required=True)
 
@@ -95,6 +118,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sa.add_argument("--checkpoint-interval", type=float, default=30.0)
     _add_resilience_flags(sa)
+    _add_pipeline_flags(sa)
     sa.add_argument(
         "--rollout-mode", choices=["full", "lightcone"], default="full",
         help="candidate evaluation: full graph re-roll (reference cost "
@@ -137,6 +161,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     hpr.add_argument("--checkpoint-interval", type=float, default=30.0)
     _add_resilience_flags(hpr)
+    _add_pipeline_flags(hpr)
     _add_dtype_flag(hpr, "float64 matches the reference's solver precision "
                           "(`HPR_pytorch_RRG.py:11`; enables x64)")
     hpr.add_argument(
@@ -224,6 +249,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ent.add_argument("--checkpoint-interval", type=float, default=30.0)
     _add_resilience_flags(ent)
+    ent.add_argument(
+        "--prefetch", type=int, default=2, metavar="D",
+        help="build up to D upcoming grid-cell ER graphs on a background "
+             "thread while the current cell sweeps (deterministic; 0 "
+             "disables)",
+    )
     _add_dtype_flag(ent, "float64 matches the reference's precision "
                           "(enables x64)")
     ent.add_argument(
@@ -252,6 +283,12 @@ def main(argv=None) -> int:
     )
 
     args = build_parser().parse_args(argv)
+
+    # opt-in persistent compile cache (flag wins over the env variable);
+    # must apply before anything traces
+    from graphdyn.utils.platform import apply_compile_cache
+
+    apply_compile_cache(args.compile_cache)
 
     if getattr(args, "dtype", None) == "float64":
         import jax
@@ -332,6 +369,7 @@ def _run(args) -> int:
             checkpoint_path=args.checkpoint,
             checkpoint_interval_s=args.checkpoint_interval,
             rollout_mode=args.rollout_mode,
+            group_size=args.group_size, prefetch=args.prefetch,
         )
         print(json.dumps({
             "solver": "sa",
@@ -390,6 +428,7 @@ def _run(args) -> int:
             save_path=args.out,
             checkpoint_path=args.checkpoint,
             checkpoint_interval_s=args.checkpoint_interval,
+            group_size=args.group_size, prefetch=args.prefetch,
         )
         print(json.dumps({
             "solver": "hpr",
@@ -547,6 +586,7 @@ def _run(args) -> int:
             verbose=args.verbose, save_path=args.out,
             checkpoint_path=args.checkpoint,
             checkpoint_interval_s=args.checkpoint_interval,
+            prefetch=args.prefetch,
         )
         if args.plot:
             from graphdyn.plotting import plot_entropy_grid

@@ -571,6 +571,56 @@ def replicate_edge_tables_device(tables: EdgeTables, R: int, n: int) -> EdgeTabl
     )
 
 
+class GraphStack(NamedTuple):
+    """``G`` same-size graphs as one batched table set (host numpy arrays) —
+    the ensemble-pipeline layout (ARCHITECTURE.md "Ensemble pipeline"):
+    member ``g``'s neighbor row block is ``nbr[g]``, ghost-padded to the
+    stack-wide ``dmax`` with each member's OWN ghost index ``n`` (ghost rows
+    contribute 0 to neighbor sums, so padding a member to a wider ``dmax``
+    cannot change its dynamics — the vmapped rollout is exact for every
+    member degree sequence)."""
+
+    nbr: np.ndarray   # int32[G, n, dmax]
+    deg: np.ndarray   # int32[G, n]
+
+    @property
+    def G(self) -> int:
+        return self.nbr.shape[0]
+
+    @property
+    def n(self) -> int:
+        return self.nbr.shape[1]
+
+    @property
+    def dmax(self) -> int:
+        return self.nbr.shape[2]
+
+
+def stack_graphs(graphs, dmax: int | None = None) -> GraphStack:
+    """Stack same-``n`` graphs into the batched ``nbr[G, n, dmax]`` layout
+    consumed by the vmapped multi-graph solvers (one device-resident table
+    set for a whole disorder ensemble, instead of one host→device transfer
+    per repetition). Members with a smaller ``dmax`` are re-padded with
+    their ghost index; a member wider than ``dmax`` is refused."""
+    if not graphs:
+        raise ValueError("empty graph stack")
+    ns = {g.n for g in graphs}
+    if len(ns) != 1:
+        raise ValueError(f"stacked graphs must share n, got {sorted(ns)}")
+    n = ns.pop()
+    width = max(g.dmax for g in graphs)
+    if dmax is None:
+        dmax = width
+    elif dmax < width:
+        raise ValueError(f"dmax={dmax} < stack max degree width {width}")
+    nbr = np.full((len(graphs), n, dmax), n, np.int32)
+    for k, g in enumerate(graphs):
+        nbr[k, :, : g.dmax] = g.nbr
+    return GraphStack(
+        nbr=nbr, deg=np.stack([g.deg for g in graphs]).astype(np.int32)
+    )
+
+
 def disjoint_union(graphs) -> tuple[Graph, np.ndarray, np.ndarray]:
     """Disjoint union of arbitrary graphs (graph k's nodes shifted by the
     cumulative node count).

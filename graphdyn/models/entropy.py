@@ -176,6 +176,7 @@ def _run_ladder(
             # the uninterrupted run had already exited inside the prefix
             return visited, ents, m_inits, ent1s, sweeps, nonconverged, chi
     for lmbd in lambdas:
+        # graftlint: disable-next-line=GD008  one SCALAR λ per ladder step — the warm-started ladder is inherently sequential, there is no table to stack
         lm = jnp.asarray(lmbd, dtype)
         chi = set_leaves(chi, lm)
         chi, t, delta = fixed_point(chi, lm)
@@ -900,10 +901,19 @@ def entropy_grid(
     checkpoint_path: str | None = None,
     checkpoint_interval_s: float = 30.0,
     class_bucket: int | None = 64,
+    prefetch: int = 2,
 ) -> EntropyGridResult:
     """The notebook's full experiment driver: deg-grid × repetitions × λ
     ladder on fresh ER instances (`ipynb:496-513`); ``save_path`` persists
     the result grids npz-style (the commented save at `ipynb:515`).
+
+    ``prefetch`` overlaps the host-side ER sampling of upcoming grid cells
+    with the current cell's device sweep (a bounded background thread —
+    ARCHITECTURE.md "Ensemble pipeline"; 0 disables the thread). Each
+    cell's graph depends only on its ``seed + 1000·di + rep``, so the
+    overlap cannot change results. Cell batching itself stays the λ-warm-
+    started sequential ladder; for device-batched ER ensembles use
+    :func:`entropy_ensemble_union` (the ``--union`` CLI path).
 
     ``checkpoint_path`` enables time-triggered intermediate saves every
     ``checkpoint_interval_s`` seconds (the notebook's ``saving_time=30``
@@ -985,12 +995,25 @@ def entropy_grid(
             checkpoint_path, interval_s=checkpoint_interval_s
         )
 
-    for di, deg in enumerate(deg_grid):
-        for rep in range(Rr):
-            if (di, rep) < (start_di, start_rep):
-                continue                        # completed cell, restored
+    from graphdyn.pipeline.prefetch import HostPrefetcher
+
+    pending = [
+        (di, rep)
+        for di in range(D) for rep in range(Rr)
+        if (di, rep) >= (start_di, start_rep)   # completed cells restored
+    ]
+
+    def build_cell(ci):
+        di, rep = pending[ci]
+        return erdos_renyi_graph(
+            n, deg_grid[di] / (n - 1), seed=seed + 1000 * di + rep,
+            method=graph_method,
+        )
+
+    with HostPrefetcher(build_cell, range(len(pending)), depth=prefetch) as pf:
+        for ci, (di, rep) in enumerate(pending):
             gseed = seed + 1000 * di + rep
-            g = erdos_renyi_graph(n, deg / (n - 1), seed=gseed, method=graph_method)
+            g = pf.get(ci)
             live = g.deg[g.deg > 0]
             nodes_isolated[di, rep] = g.n - live.size
             mean_degrees[di, rep] = live.mean() if live.size else 0.0

@@ -149,47 +149,32 @@ def hpr_solve(
     and the full chain state (chi, biases, s, PRNG key, t) is snapshotted
     atomically at most every ``checkpoint_interval_s`` seconds; a rerun
     pointing at the checkpoint continues bit-for-bit. Removed on completion.
+
+    The chain advances through the ensemble pipeline's shared group program
+    (:class:`graphdyn.pipeline.hpr_group.HPRGroupExec` with G=1;
+    ARCHITECTURE.md "Ensemble pipeline"): the grouped ``hpr_ensemble``
+    driver runs the SAME vmapped body at G=``group_size``, which is what
+    makes serial-vs-grouped driver results element-wise identical — two
+    *differently structured* loop programs (e.g. a fused while-loop vs its
+    own op-by-op restatement) differ at the ulp level under XLA fusion and
+    eventually flip a chain decision, so sharing one program family is the
+    only robust identity. (The chain body is the pure-XLA sweep core; the
+    Pallas sweep remains available to ``hpr_solve_batch``/``make_sweep``.)
     """
     t_start = time.perf_counter()
     config = config or HPRConfig()
-    setup = _prep(graph, config)
-    data, sweep, marginals = setup.data, setup.sweep, setup.marginals
-    bias_to_edge = setup.bias_to_edge
-    lmbd, pie, gamma, TT, n = setup.lmbd, setup.pie, setup.gamma, setup.TT, setup.n
+    from graphdyn.pipeline.hpr_group import HPRGroupExec
 
-    def m_of_end(s):
-        return setup.m_of_end_batch(s[None])[0]
-
-    @jax.jit
-    # the chunked exact-resume path snapshots the pre-chunk carry to the
-    # checkpoint — donating it would invalidate the buffer being saved
-    # graftlint: disable-next-line=GD006  checkpoint path reuses the carry
-    def run_chunk(chi, biases, s, key, t, m_final, t_end):
-        def cond(st):
-            _, _, _, _, t, m_final = st
-            return (m_final < 1.0) & (t < t_end)
-
-        def body(st):
-            chi, biases, s, key, t, _ = st
-            chi = sweep(chi, lmbd, bias_to_edge(biases))
-            marg = marginals(chi)
-            # reinforcement (`new_biases_i`, `HPR:137-145`)
-            minus_wins = marg[:, 1] >= marg[:, 0]
-            new_bias = jnp.where(
-                minus_wins[:, None],
-                jnp.stack([pie, 1 - pie]),
-                jnp.stack([1 - pie, pie]),
-            )
-            key, ku = jax.random.split(key)
-            u = jax.random.uniform(ku, (n,), setup.dtype)
-            update = u < 1.0 - (1.0 + t.astype(setup.dtype)) ** (-gamma)
-            biases = jnp.where(update[:, None], new_bias, biases)
-            s = jnp.where(biases[:, 0] > biases[:, 1], 1, -1).astype(jnp.int8)
-            t = t + 1
-            m_final = jnp.where(t > TT, 2.0, m_of_end(s))
-            return chi, biases, s, key, t, m_final
-
-        return lax.while_loop(cond, body, (chi, biases, s, key, t, m_final))
+    dyn = config.dynamics
+    n = graph.n
+    dtype = jnp.dtype(config.dtype)
+    tables = build_edge_tables(graph)
+    data = BDCMData(
+        graph, tables, p=dyn.p, c=dyn.c, attr_value=dyn.attr_value,
+        rule=dyn.rule, tie=dyn.tie, dtype=dtype,
+    )
+    ex = HPRGroupExec([(graph, data)], config)
+    TT = int(config.max_sweeps)
 
     ckpt = None
     state = None
@@ -208,7 +193,12 @@ def hpr_solve(
             and a["chi"].shape == (data.num_directed, data.K, data.K)
         )
         if arrays is not None:
-            state = tuple(jnp.asarray(arrays[k]) for k in _HPR_CHAIN_FIELDS)
+            t_res = int(np.asarray(arrays["t"]))
+            state = ex.init_state(
+                [arrays["chi"]], [arrays["biases"]], [arrays["s"]],
+                [np.asarray(arrays["key"])], t=t_res,
+                m_final=[np.float32(arrays["m_final"])],
+            )
 
     if state is None:
         rng = np.random.default_rng(seed)
@@ -217,37 +207,38 @@ def hpr_solve(
             chi0 = data.init_messages(rng)
         biases0 = rng.random((n, 2))
         biases0 /= biases0.sum(axis=1, keepdims=True)
-        biases0 = jnp.asarray(biases0, setup.dtype)
-        s0 = jnp.where(biases0[:, 0] > biases0[:, 1], 1, -1).astype(jnp.int8)
-        state = (
-            jnp.asarray(chi0), biases0, s0, jax.random.PRNGKey(seed),
-            jnp.int32(0), m_of_end(s0),
-        )
+        biases0 = np.asarray(biases0, dtype)
+        s0 = np.where(biases0[:, 0] > biases0[:, 1], 1, -1).astype(np.int8)
+        state = ex.init_state([np.asarray(chi0)], [biases0], [s0], [seed])
+
+    def payload(st):
+        return dict(zip(_HPR_CHAIN_FIELDS, (
+            np.asarray(st.chi[0]), np.asarray(st.biases[0]),
+            np.asarray(st.s[0]), np.asarray(st.keys[0]),
+            np.asarray(st.t), np.asarray(st.m_final[0]),
+        )))
 
     if ckpt is None:
-        state = run_chunk(*state, jnp.int32(TT + 2))
+        state = ex.run(state, chunk_sweeps=TT + 2)   # one device call
     else:
         state = ckpt.drive(
             state,
-            advance=lambda st: run_chunk(
-                *st, jnp.minimum(st[4] + jnp.int32(chunk_sweeps), TT + 2)
+            advance=lambda st: ex.advance(
+                st, min(int(st.t) + int(chunk_sweeps), TT + 2)
             ),
-            active=lambda st: bool(st[5] < 1.0),
-            payload=lambda st: {
-                k: np.asarray(v) for k, v in zip(_HPR_CHAIN_FIELDS, st)
-            },
+            active=lambda st: bool(np.asarray(st.active)[0]),
+            payload=payload,
         )
 
-    chi, biases, s, _, t, m_final = state
-    s = np.asarray(s)
+    s = np.asarray(state.s[0])
     return HPRResult(
         s=s,
         # graftlint: disable-next-line=GD004  host observable, exact sum
         mag_reached=np.float32(s.astype(np.float64).mean()),
-        num_steps=int(t),
-        m_final=float(m_final),
-        biases=np.asarray(biases),
-        chi=np.asarray(chi),
+        num_steps=int(np.asarray(state.steps)[0]),
+        m_final=float(np.asarray(state.m_final)[0]),
+        biases=np.asarray(state.biases[0]),
+        chi=np.asarray(state.chi[0]),
         elapsed_s=time.perf_counter() - t_start,
     )
 
@@ -689,19 +680,45 @@ def hpr_ensemble(
     save_path: str | None = None,
     checkpoint_path: str | None = None,
     checkpoint_interval_s: float = 30.0,
+    group_size: int | None = None,
+    prefetch: int = 2,
 ) -> HPREnsembleResult:
     """The reference's experiment driver (`HPR_pytorch_RRG.py:259-377`):
     ``n_rep`` repetitions, each on a freshly sampled RRG(n, d); pass
     ``save_path`` to persist the npz with the reference's key names
     (`HPR:377` — the only live persistence in the reference repo).
 
+    ``group_size`` selects the execution pipeline (ARCHITECTURE.md
+    "Ensemble pipeline"): the default (None) runs repetitions
+    ``group_size``-at-a-time as ONE vmapped device program over stacked
+    BDCM tables, with the next group's graphs/tables built on a background
+    thread (``prefetch`` bounds the build-ahead; 0 disables the thread) —
+    element-wise identical to the serial path (per-repetition streams
+    derive from ``seed + k``). ``group_size=0`` forces the legacy serial
+    repetition loop.
+
     ``checkpoint_path`` makes the driver preemption-safe, exactly as in
     :func:`graphdyn.models.sa.sa_ensemble`: completed repetitions snapshot
-    with the next repetition index, the in-flight chain checkpoints at
-    ``<path>_chain<k>`` (exact resume), graphs re-derive from ``seed + k``;
-    graceful shutdown snapshots the completed-rep prefix before propagating
-    :class:`~graphdyn.resilience.ShutdownRequested`, and fault site
-    ``rep.boundary`` simulates a hard preemption between repetitions."""
+    with the next repetition index; under the serial path the in-flight
+    chain additionally checkpoints at ``<path>_chain<k>`` (exact resume),
+    while the grouped path checkpoints at group boundaries (an interrupted
+    group re-runs from its start, bit-exactly; snapshots are
+    interchangeable between paths and group sizes). Graphs re-derive from
+    ``seed + k``; graceful shutdown snapshots the completed-rep prefix
+    before propagating :class:`~graphdyn.resilience.ShutdownRequested`,
+    and fault site ``rep.boundary`` fires once per repetition in
+    repetition order (at group boundaries under the grouped path)."""
+    if group_size is None:
+        group_size = min(max(n_rep, 1), 8)
+    if group_size:
+        from graphdyn.pipeline.hpr_group import hpr_ensemble_grouped
+
+        return hpr_ensemble_grouped(
+            n, d, config, n_rep=n_rep, seed=seed, graph_method=graph_method,
+            save_path=save_path, checkpoint_path=checkpoint_path,
+            checkpoint_interval_s=checkpoint_interval_s,
+            group_size=group_size, prefetch=prefetch,
+        )
     from graphdyn.graphs import random_regular_graph
     from graphdyn.resilience import faults as _faults
     from graphdyn.resilience.shutdown import (

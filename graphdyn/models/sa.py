@@ -578,21 +578,57 @@ def sa_ensemble(
     checkpoint_path: str | None = None,
     checkpoint_interval_s: float = 30.0,
     rollout_mode: str = "full",
+    group_size: int | None = None,
+    prefetch: int = 2,
 ) -> SAEnsembleResult:
     """The reference's experiment driver (`SA_RRG.py:58-92`): ``n_stat``
-    repetitions, each on a freshly sampled RRG(n, d). Each repetition runs as
-    one replica of the batched solver; pass ``save_path`` to persist the
-    npz with the reference's key names (`SA_RRG.py:92`).
+    repetitions, each on a freshly sampled RRG(n, d). Pass ``save_path`` to
+    persist the npz with the reference's key names (`SA_RRG.py:92`).
+
+    ``group_size`` selects the execution pipeline (ARCHITECTURE.md
+    "Ensemble pipeline"): the default (None) runs repetitions
+    ``group_size``-at-a-time as ONE vmapped device program over stacked
+    neighbor tables, with the next group's graphs prefetched on a
+    background thread (``prefetch`` bounds the build-ahead; 0 disables the
+    thread) — element-wise identical to the serial path, since every
+    repetition's RNG streams still derive from ``seed + k``.
+    ``group_size=0`` forces the legacy serial repetition loop (always used
+    for ``backend='cpu'`` and ``rollout_mode='lightcone'``, which the
+    grouped program does not cover).
 
     ``checkpoint_path`` makes the whole driver preemption-safe: completed
-    repetitions are snapshotted (with the next repetition index), and the
-    in-flight chain checkpoints its own state at ``<path>_chain<k>`` (exact
-    resume — see :func:`simulated_annealing`). Graphs re-derive from
-    ``seed + k``, so a resumed run records identical graphs. A graceful
-    shutdown (SIGTERM under :func:`graphdyn.resilience.graceful_shutdown`)
-    snapshots the completed-rep prefix before propagating
+    repetitions are snapshotted (with the next repetition index). Under the
+    serial path the in-flight chain additionally checkpoints its own state
+    at ``<path>_chain<k>`` (exact resume — see
+    :func:`simulated_annealing`); under the grouped path checkpointing is
+    group-boundary-granular — an interrupted group re-runs from its start
+    on resume, bit-exactly, and snapshots are interchangeable between the
+    two paths and between group sizes. Graphs re-derive from ``seed + k``,
+    so a resumed run records identical graphs. A graceful shutdown (SIGTERM
+    under :func:`graphdyn.resilience.graceful_shutdown`) snapshots the
+    completed-rep prefix before propagating
     :class:`~graphdyn.resilience.ShutdownRequested`; fault site
-    ``rep.boundary`` simulates a hard preemption between repetitions."""
+    ``rep.boundary`` fires once per repetition in repetition order (at
+    group boundaries under the grouped path)."""
+    serial_only = backend == "cpu" or rollout_mode != "full"
+    if group_size is None:
+        group_size = 0 if serial_only else min(max(n_stat, 1), 8)
+    if group_size and serial_only:
+        raise ValueError(
+            "group_size >= 1 requires the jax backend and "
+            "rollout_mode='full' (pass group_size=0 for the serial loop)"
+        )
+    if group_size:
+        from graphdyn.pipeline.sa_group import sa_ensemble_grouped
+
+        return sa_ensemble_grouped(
+            n, d, config, n_stat=n_stat, seed=seed,
+            graph_method=graph_method, max_steps=max_steps,
+            save_path=save_path, backend=backend,
+            checkpoint_path=checkpoint_path,
+            checkpoint_interval_s=checkpoint_interval_s,
+            group_size=group_size, prefetch=prefetch,
+        )
     from graphdyn.graphs import random_regular_graph
     from graphdyn.resilience import faults as _faults
     from graphdyn.resilience.shutdown import (

@@ -261,3 +261,13 @@ def replicate(mesh: Mesh, x):
     """Place array fully replicated over the mesh."""
     spec = P(*([None] * np.ndim(x)))
     return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def shard_stack(mesh: Mesh, x, axis: str = "group"):
+    """Place one array of an ensemble-pipeline stack: leading ``[G, ...]``
+    axes shard over ``axis`` (repetitions are independent, so the
+    partitioned group program is communication-free except its stop test);
+    scalars replicate. The placement helper the grouped solvers use to
+    consume the stacked layout on a mesh — results are bit-identical to the
+    unsharded program (tested)."""
+    return shard_batch(mesh, x, axis) if np.ndim(x) else replicate(mesh, x)

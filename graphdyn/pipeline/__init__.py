@@ -1,0 +1,29 @@
+"""graphdyn.pipeline — batched multi-graph ensembles with host/device
+prefetch overlap (ARCHITECTURE.md "Ensemble pipeline").
+
+Three pieces close the gap between per-kernel rates and end-to-end driver
+rates:
+
+- **Batched multi-graph execution**: a disorder ensemble's repetitions run
+  ``group_size`` at a time as ONE vmapped compiled program over stacked
+  per-repetition tables (:mod:`~graphdyn.pipeline.sa_group`,
+  :mod:`~graphdyn.pipeline.hpr_group`), element-wise identical to the
+  serial drivers because per-repetition RNG streams still derive from
+  ``seed + k``.
+- **Host/device prefetch overlap**: a bounded background thread builds the
+  next group's graphs while the current group computes
+  (:mod:`~graphdyn.pipeline.prefetch`) — deterministic by construction.
+- **Persistent compile cache**: opt-in ``jax_compilation_cache_dir`` wiring
+  (:func:`graphdyn.utils.platform.apply_compile_cache`,
+  ``GRAPHDYN_COMPILE_CACHE`` / CLI ``--compile-cache``) so re-runs and
+  resumed jobs skip the multi-second XLA compile.
+"""
+
+from graphdyn.pipeline.groups import GroupDriver, group_ranges
+from graphdyn.pipeline.prefetch import HostPrefetcher
+
+__all__ = [
+    "GroupDriver",
+    "HostPrefetcher",
+    "group_ranges",
+]

@@ -1,0 +1,124 @@
+"""Group-boundary driver protocol shared by the grouped ensemble drivers.
+
+One implementation of the bookkeeping every grouped driver needs — resume
+prefix, interval-gated snapshots, the ``rep.boundary`` fault site, and the
+graceful-shutdown poll — so the SA and HPr pipelines cannot drift from each
+other or from the serial drivers' PR-2 resilience contract.
+
+Semantics relative to the serial drivers (``sa_ensemble``/``hpr_ensemble``):
+
+- Snapshots carry the SAME metadata (``run_id`` + ``next_rep``), so a
+  checkpoint written by the serial path resumes under the grouped path and
+  vice versa, and a resume may use a different ``group_size`` — results are
+  per-repetition deterministic (``seed + k``), so regrouping cannot change
+  them.
+- The ``rep.boundary`` fault site and the shutdown poll fire once per
+  repetition, in repetition order, at each **group boundary** (after the
+  group's device program returns) — a fault plan written against the serial
+  driver observes the same hit sequence.
+- Mid-group, the device program is chunked and
+  :func:`~graphdyn.resilience.shutdown.shutdown_requested` is polled
+  between chunks: a SIGTERM during a long group snapshots the completed
+  prefix (``next_rep`` = the group's first repetition) and exits 75; the
+  resumed run re-runs the interrupted group from its start, bit-exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from graphdyn.resilience import faults as _faults
+from graphdyn.resilience.shutdown import raise_if_requested, shutdown_requested
+
+
+def group_ranges(start: int, stop: int, size: int) -> Iterator[list[int]]:
+    """Partition ``range(start, stop)`` into consecutive groups of at most
+    ``size`` repetitions (the tail group may be shorter; the group runners
+    pad it back to ``size`` with inactive rows for shape stability)."""
+    if size < 1:
+        raise ValueError(f"group_size must be >= 1, got {size}")
+    k = start
+    while k < stop:
+        ks = list(range(k, min(k + size, stop)))
+        yield ks
+        k = ks[-1] + 1
+
+
+class GroupDriver:
+    """Checkpoint/fault/shutdown bookkeeping for one grouped ensemble run.
+
+    ``payload()`` must return the driver's result-array dict (the completed
+    prefix is what matters; rows past ``next_rep`` are garbage exactly as in
+    the serial drivers). ``run_id`` is the identity dict stamped into every
+    snapshot and validated on resume."""
+
+    def __init__(self, checkpoint_path: str | None, interval_s: float,
+                 run_id: dict, payload):
+        from graphdyn.utils.io import Checkpoint, PeriodicCheckpointer
+
+        self.path = checkpoint_path
+        self.run_id = run_id
+        self.payload = payload
+        self.ck = Checkpoint(checkpoint_path) if checkpoint_path else None
+        self.pc = (
+            PeriodicCheckpointer(checkpoint_path, interval_s=interval_s)
+            if checkpoint_path else None
+        )
+
+    def resume_prefix(self):
+        """(arrays, start_rep) from a validated snapshot, or None."""
+        from graphdyn.utils.io import load_resume_prefix
+
+        if self.ck is None:
+            return None
+        return load_resume_prefix(self.ck, self.run_id)
+
+    def resume_into(self, dest: dict) -> int:
+        """Restore the completed-repetition prefix of a validated snapshot
+        into the driver arrays (``dest`` is the payload dict — keys match
+        by construction) and return the first repetition to run."""
+        resumed = self.resume_prefix()
+        if resumed is None:
+            return 0
+        arrays, start_rep = resumed
+        for key, arr in dest.items():
+            arr[:start_rep] = arrays[key][:start_rep]
+        return start_rep
+
+    def chunk_poll(self, next_rep: int) -> None:
+        """Between device chunks of an in-flight group: honor a pending
+        graceful shutdown with a prefix snapshot (the group re-runs from
+        ``next_rep`` on resume)."""
+        if shutdown_requested():
+            if self.pc is not None:
+                self.pc.save_now(self.payload(), {**self.run_id,
+                                                  "next_rep": next_rep})
+            raise_if_requested()
+
+    def rep_boundary(self, k: int) -> None:
+        """After repetition ``k``'s results land in the driver arrays:
+        interval-gated snapshot, the ``rep.boundary`` fault site, and the
+        shutdown poll — the serial drivers' exact per-repetition sequence."""
+        if self.path is not None:
+            # a SERIAL-path run preempted mid-repetition leaves its
+            # in-flight chain snapshot at <path>_chain<k>; this repetition
+            # just recomputed under the grouped path, so the stale file
+            # must go — a later serial run reusing this checkpoint path
+            # would otherwise hit its fingerprint check and refuse to
+            # resume, wedging mid-ensemble
+            from graphdyn.utils.io import Checkpoint
+
+            Checkpoint(f"{self.path}_chain{k}").remove()
+        if self.pc is not None:
+            self.pc.maybe_save(self.payload(), {**self.run_id,
+                                                "next_rep": k + 1})
+        _faults.maybe_fail("rep.boundary", key=f"rep={k}")
+        if shutdown_requested():
+            if self.pc is not None:
+                self.pc.save_now(self.payload(), {**self.run_id,
+                                                  "next_rep": k + 1})
+            raise_if_requested()
+
+    def finish(self) -> None:
+        if self.ck is not None:
+            self.ck.remove()

@@ -1,0 +1,509 @@
+"""Batched multi-graph HPr ensembles — the reinforced-BP half of the
+pipeline (ARCHITECTURE.md "Ensemble pipeline").
+
+The serial driver (`graphdyn.models.hpr.hpr_ensemble`) runs ``n_rep``
+chains one after another, each on its own freshly sampled RRG: the host
+builds edge tables and factor tensors while the device idles, then one
+``[2E, K, K]`` sweep runs per iteration while every other repetition
+waits. Here a group of ``G`` repetitions runs as ONE compiled program: the
+per-repetition BDCM index tables stack to ``[G, Ed, ...]`` (the
+:class:`graphdyn.ops.bdcm.EnsembleBDCM` layout), chi carries a leading
+group axis, and the sweep / marginals / reinforcement / rollout stop-test
+all vmap over the group.
+
+Element-wise identity with the serial path is structural:
+:func:`graphdyn.models.hpr.hpr_solve` itself advances its chain through
+this module's shared group program (:class:`HPRGroupExec` at G=1), so the
+serial driver (a loop of ``hpr_solve``) and the grouped driver run the
+SAME compiled body — per-repetition RNG streams (host init AND the device
+reinforcement keys) derive from ``seed + k``, finished chains freeze under
+per-repetition masks, and per-member float schedules are invariant under
+the leading group extent (tested). That sharing is load-bearing: two
+*differently structured* loop programs computing the same chain law (e.g.
+a fused while-loop vs its own op-by-op restatement) differ at the ulp
+level under XLA CPU fusion, and an 800-sweep reinforcement chain
+eventually amplifies one ulp into a flipped marginal comparison (observed;
+regression-anchored in tests). Tested element-wise against the serial
+driver for several group sizes, including 1 and non-divisors of
+``n_rep``.
+
+Checkpoint/fault semantics are the group-boundary protocol of
+:mod:`graphdyn.pipeline.groups` — snapshots interchangeable with the
+serial driver's, ``rep.boundary`` firing per repetition in order.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from graphdyn.config import HPRConfig
+from graphdyn.ops.bdcm import class_update
+from graphdyn.ops.dynamics import batched_rollout_impl, rule_coefficients
+
+
+class _HPRGroupSpec(NamedTuple):
+    """Hashable static configuration of one grouped HPr program (everything
+    traced is an argument of the module-level executor, so every group of
+    the same shape reuses ONE compiled program)."""
+
+    T: int
+    K: int
+    n: int
+    damp: float
+    eps: float            # marginal ε-clamp (`HPR:147`)
+    TT: int
+    rollout_steps: int
+    R_coef: int
+    C_coef: int
+    class_ds: tuple       # per-edge-class incoming-message count d
+
+
+class _HPRGroupState(NamedTuple):
+    chi: jnp.ndarray      # f[G, 2E, K, K]
+    biases: jnp.ndarray   # f[G, n, 2]
+    s: jnp.ndarray        # int8[G, n]
+    keys: jnp.ndarray     # [G] PRNG keys
+    t: jnp.ndarray        # int32[] — shared sweep clock (all chains start
+    #                       together; frozen chains ignore it)
+    m_final: jnp.ndarray  # f32[G]
+    active: jnp.ndarray   # bool[G]
+    steps: jnp.ndarray    # int32[G] — per-chain stop sweep
+
+
+def _group_m_of_end(nbr_stack, s, spec: _HPRGroupSpec):
+    """Per-repetition rollout magnetization, each on its OWN graph — the
+    serial solver's ``m_of_end`` vmapped over stacked neighbor tables."""
+
+    def one(nb, sv):
+        return batched_rollout_impl(
+            nb, sv[None], spec.rollout_steps, spec.R_coef, spec.C_coef
+        )[0]
+
+    s_end = jax.vmap(one)(nbr_stack, s)
+    return (
+        s_end.astype(jnp.int32).sum(axis=1).astype(jnp.float32) / spec.n
+    )
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def _hpr_group_init_m(nbr_stack, s0, real, *, spec: _HPRGroupSpec):
+    m0 = _group_m_of_end(nbr_stack, s0, spec)
+    return m0, (m0 < 1.0) & real
+
+
+@partial(
+    jax.jit,
+    static_argnames=("spec",),
+    # group-to-group carry reuse: the ensemble driver only reads the final
+    # (s, m_final, steps); chi/biases update in place across chunks
+    donate_argnums=(0,),
+)
+def _hpr_group_loop(
+    state: _HPRGroupState,
+    t_end,
+    lmbd,
+    pie,
+    gamma,
+    x0f,
+    sel_plus_b,
+    sel_plus_f,
+    src,
+    rev,
+    out_edges,
+    nbr_stack,
+    tables,
+    *,
+    spec: _HPRGroupSpec,
+):
+    """Advance all chains of the group until every one stops or the sweep
+    clock reaches ``t_end`` (the shutdown-poll granularity). The body is
+    `hpr_solve`'s iteration on a group axis: same sweep core, same
+    marginal/reinforcement arithmetic, per-repetition tables throughout."""
+    T, K, n = spec.T, spec.K, spec.n
+    dt = x0f.dtype
+    tilt = jnp.exp(-lmbd * x0f)                      # [K], shared λ
+
+    def bias_to_edge_one(biases_g, src_g):
+        # bias of the source node at its trajectory's initial value
+        # (`positions_biases`, `HPR:120-133`): [2E, K]
+        return jnp.where(
+            sel_plus_b[None, :], biases_g[src_g, 0, None],
+            biases_g[src_g, 1, None],
+        )
+
+    def sweep_one(chi_g, bias_edge_g, *tabs):
+        # the serial _sweep_core for the HPr variant (with_bias=True,
+        # mask_invalid_src=False, eps_clamp=0) on one member's tables
+        for d, A, (idx, in_edges) in zip(
+            spec.class_ds, [t[2] for t in tables], zip(*[iter(tabs)] * 2)
+        ):
+            chi_in = chi_g[in_edges]                 # [Ed, d, K, K]
+            chi_in = chi_in * bias_edge_g[in_edges][:, :, :, None]
+            upd = class_update(
+                chi_in, A, tilt, chi_g[idx], d=d, T=T, K=K,
+                damp=spec.damp, eps_clamp=0.0,
+            )
+            chi_g = chi_g.at[idx].set(upd)
+        return chi_g
+
+    def marginals_one(chi_g, rev_g, out_g):
+        # make_marginals body (`HPR:147-167` semantics), per member
+        P = chi_g * jnp.swapaxes(chi_g[rev_g], 1, 2)
+        Zp = (P * sel_plus_f[None, :, None]).sum(axis=(1, 2))
+        Zm = (P * (1.0 - sel_plus_f)[None, :, None]).sum(axis=(1, 2))
+        Zp = jnp.maximum(Zp, spec.eps)
+        Zm = jnp.maximum(Zm, spec.eps)
+        tot = Zp + Zm
+        Zp, Zm = Zp / tot, Zm / tot
+        Zp_ext = jnp.concatenate([Zp, jnp.ones((1,), Zp.dtype)])
+        Zm_ext = jnp.concatenate([Zm, jnp.ones((1,), Zm.dtype)])
+        mp = jnp.prod(Zp_ext[out_g], axis=1)
+        mm = jnp.prod(Zm_ext[out_g], axis=1)
+        marg = jnp.stack([mp, mm], axis=1)
+        return marg / marg.sum(axis=1, keepdims=True)
+
+    flat_tables = [a for t in tables for a in (t[0], t[1])]
+    vsweep = jax.vmap(
+        sweep_one, in_axes=(0, 0) + (0,) * len(flat_tables)
+    )
+    vmarg = jax.vmap(marginals_one)
+    vbias = jax.vmap(bias_to_edge_one)
+
+    def cond(st: _HPRGroupState):
+        return jnp.any(st.active) & (st.t < t_end)
+
+    def body(st: _HPRGroupState):
+        bias_edge = vbias(st.biases, src)
+        chi_new = vsweep(st.chi, bias_edge, *flat_tables)
+        marg = vmarg(chi_new, rev, out_edges)        # [G, n, 2]
+        # reinforcement (`new_biases_i`, `HPR:137-145`), per repetition
+        minus_wins = marg[..., 1] >= marg[..., 0]
+        new_bias = jnp.where(
+            minus_wins[..., None],
+            jnp.stack([pie, 1 - pie]),
+            jnp.stack([1 - pie, pie]),
+        )
+        ks = jax.vmap(jax.random.split)(st.keys)     # [G, 2, key]
+        keys_new, ku = ks[:, 0], ks[:, 1]
+        u = jax.vmap(lambda k: jax.random.uniform(k, (n,), dt))(ku)
+        update = u < 1.0 - (1.0 + st.t.astype(dt)) ** (-gamma)
+        biases_new = jnp.where(update[..., None], new_bias, st.biases)
+        s_new = jnp.where(
+            biases_new[..., 0] > biases_new[..., 1], 1, -1
+        ).astype(jnp.int8)
+        t_new = st.t + 1
+        m_new = jnp.where(
+            t_new > spec.TT, 2.0, _group_m_of_end(nbr_stack, s_new, spec)
+        )
+        an = st.active                               # frozen chains keep state
+        return _HPRGroupState(
+            chi=jnp.where(an[:, None, None, None], chi_new, st.chi),
+            biases=jnp.where(an[:, None, None], biases_new, st.biases),
+            s=jnp.where(an[:, None], s_new, st.s),
+            keys=jnp.where(an[:, None], keys_new, st.keys),
+            t=t_new,
+            m_final=jnp.where(an, m_new, st.m_final),
+            active=an & (jnp.where(an, m_new, st.m_final) < 1.0)
+            & (t_new <= spec.TT),
+            steps=jnp.where(an, t_new, st.steps),
+        )
+
+    return lax.while_loop(cond, body, state)
+
+
+class HPRGroupResult(NamedTuple):
+    s: np.ndarray          # int8[G, n]
+    num_steps: np.ndarray  # int32[G]
+    m_final: np.ndarray    # f32[G]
+
+
+def _build_rep(n, d, config: HPRConfig, rep_seed: int, graph_method: str):
+    """Host build for ONE repetition — everything that depends only on
+    ``seed + k``, so the prefetch thread can run it ahead: graph, edge
+    tables, BDCM factor data, and the serial solver's exact host init
+    (chi drawn first, then biases, from one ``default_rng(seed + k)``
+    stream — `hpr_solve`'s order)."""
+    from graphdyn.graphs import build_edge_tables, random_regular_graph
+    from graphdyn.ops.bdcm import BDCMData
+
+    dyn = config.dynamics
+    g = random_regular_graph(n, d, seed=rep_seed, method=graph_method)
+    tables = build_edge_tables(g)
+    data = BDCMData(
+        g, tables, p=dyn.p, c=dyn.c, attr_value=dyn.attr_value,
+        rule=dyn.rule, tie=dyn.tie, dtype=jnp.dtype(config.dtype),
+    )
+    rng = np.random.default_rng(rep_seed)
+    chi0 = rng.random((data.num_directed, data.K, data.K))
+    chi0 /= chi0.sum(axis=(1, 2), keepdims=True)
+    biases0 = rng.random((n, 2))
+    biases0 /= biases0.sum(axis=1, keepdims=True)
+    np_dt = np.dtype(config.dtype)
+    chi0 = chi0.astype(np_dt)
+    biases0 = biases0.astype(np_dt)
+    # trial solution from the CAST biases — the dtype the device compares
+    s0 = np.where(biases0[:, 0] > biases0[:, 1], 1, -1).astype(np.int8)
+    return g, data, chi0, biases0, s0
+
+
+class HPRGroupExec:
+    """Compiled-program handle for one (padded) group of congruent HPr
+    chains — stacked tables, static spec, init and chunked advance. The
+    SINGLE program family every HPr chain in the drivers runs through:
+    ``hpr_solve`` executes a G=1 instance and the grouped ensemble driver
+    a G=``group_size`` instance of the same vmapped body. That sharing is
+    what makes serial-vs-grouped parity structural: per-member float
+    schedules are invariant under the leading group extent (tested),
+    whereas two *differently structured* loop programs — e.g. a fused
+    while-loop vs its own op-by-op restatement — differ at the ulp level
+    under XLA fusion and eventually flip a chain decision."""
+
+    def __init__(self, items, config: HPRConfig, *,
+                 group_size: int | None = None):
+        G_real = len(items)
+        G = group_size or G_real
+        if G < G_real:
+            raise ValueError(f"group_size={G} < group population {G_real}")
+        dyn = config.dynamics
+        R_coef, C_coef = rule_coefficients(dyn.rule, dyn.tie)
+        datas = [it[1] for it in items]
+        d0 = datas[0]
+        sig = [(c.d, c.idx.shape[0]) for c in d0.edge_classes]
+        for dd in datas[1:]:
+            if (dd.n != d0.n or dd.K != d0.K
+                    or [(c.d, c.idx.shape[0]) for c in dd.edge_classes] != sig):
+                raise ValueError(
+                    "grouped HPr repetitions must be structurally congruent "
+                    "(same n and degree-class signature — RRG ensembles are)"
+                )
+        if d0.leaf_idx.size:
+            raise ValueError(
+                "the batched HPr program does not cover leaf edges "
+                "(degree-1 nodes)"
+            )
+        from graphdyn.graphs import stack_graphs
+
+        def pad(rows):
+            return rows + [rows[0]] * (G - G_real)
+
+        self.G, self.G_real, self.d0 = G, G_real, d0
+        self._pad = pad
+        self.spec = _HPRGroupSpec(
+            T=d0.T, K=d0.K, n=d0.n, damp=float(config.damp),
+            eps=float(config.eps_clamp), TT=int(config.max_sweeps),
+            rollout_steps=dyn.p + dyn.c - 1, R_coef=R_coef, C_coef=C_coef,
+            class_ds=tuple(c.d for c in d0.edge_classes),
+        )
+        dt = d0.dtype
+        padded = pad(list(items))
+        self.tables = tuple(
+            (
+                jnp.asarray(np.stack([dd[1].edge_classes[k].idx
+                                      for dd in padded])),
+                jnp.asarray(np.stack([dd[1].edge_classes[k].in_edges
+                                      for dd in padded])),
+                jnp.asarray(cls.A, dt),
+            )
+            for k, cls in enumerate(d0.edge_classes)
+        )
+        twoE = d0.num_directed
+        self.src = jnp.asarray(np.stack([
+            np.asarray(dd[1].tables.src) for dd in padded
+        ]))
+        self.rev = jnp.asarray(np.stack([
+            dd[1].tables.rev(np.arange(twoE)) for dd in padded
+        ]).astype(np.int32))
+        self.out_edges = jnp.asarray(np.stack([
+            np.asarray(dd[1].tables.node_out_edges) for dd in padded
+        ]))
+        self.nbr_stack = jnp.asarray(
+            stack_graphs([dd[0] for dd in padded]).nbr
+        )
+        self.consts = (
+            jnp.asarray(config.lmbd, dt),
+            jnp.asarray(config.pie, dt),
+            jnp.asarray(config.gamma, dt),
+            jnp.asarray(d0.x0, dt),
+            jnp.asarray(d0.x0 == 1),
+            jnp.asarray(d0.x0 == 1, dt),
+        )
+
+    def init_state(self, chi0, biases0, s0, rep_seeds, *, t=0, m_final=None,
+                   steps=None) -> _HPRGroupState:
+        """State from per-member host arrays (length ``G_real`` lists; pad
+        rows are appended here and start frozen). ``m_final=None`` runs
+        the initial rollout stop-test — exactly the serial solver's
+        ``m_of_end(s0)``; a resume passes the snapshot's values through."""
+        pad = self._pad
+        chi = jnp.asarray(np.stack(pad(list(chi0))))
+        biases = jnp.asarray(np.stack(pad(list(biases0))))
+        s = jnp.asarray(np.stack(pad(list(s0))))
+        # per-member root keys: exactly hpr_solve's PRNGKey(seed + k) when
+        # given ints; a resume passes raw key arrays through unchanged
+        keys_in = pad(list(rep_seeds))
+        if np.ndim(keys_in[0]) == 0:
+            keys = jax.vmap(jax.random.PRNGKey)(
+                np.asarray([np.uint32(sd) for sd in keys_in], np.uint32)
+            )
+        else:
+            keys = jnp.asarray(np.stack([np.asarray(k) for k in keys_in]))
+        real = np.zeros(self.G, bool)
+        real[:self.G_real] = True
+        if m_final is None:
+            m0, active0 = _hpr_group_init_m(
+                self.nbr_stack, s, jnp.asarray(real), spec=self.spec
+            )
+        else:
+            m0 = jnp.asarray(np.asarray(pad(list(m_final)), np.float32))
+            active0 = (m0 < 1.0) & jnp.asarray(real)
+        steps0 = (jnp.full((self.G,), int(t), jnp.int32) if steps is None
+                  else jnp.asarray(np.asarray(pad(list(steps)), np.int32)))
+        return _HPRGroupState(
+            chi=chi, biases=biases, s=s, keys=keys,
+            t=jnp.int32(t), m_final=m0, active=active0, steps=steps0,
+        )
+
+    def advance(self, state: _HPRGroupState, t_end) -> _HPRGroupState:
+        """One bounded chunk of the shared loop program (donates the
+        carry)."""
+        return _hpr_group_loop(
+            state, jnp.int32(t_end), *self.consts,
+            self.src, self.rev, self.out_edges, self.nbr_stack, self.tables,
+            spec=self.spec,
+        )
+
+    def run(self, state: _HPRGroupState, *, chunk_sweeps: int = 200,
+            on_chunk=None) -> _HPRGroupState:
+        """Advance until every member stops, ``chunk_sweeps`` per device
+        call; ``on_chunk`` is polled between chunks (the graceful-shutdown
+        hook — it may raise)."""
+        while bool(np.asarray(jnp.any(state.active))):
+            t_end = min(int(state.t) + int(chunk_sweeps), self.spec.TT + 2)
+            state = self.advance(state, t_end)
+            if on_chunk is not None:
+                on_chunk()
+        return state
+
+
+def run_hpr_group(
+    items,
+    rep_seeds,
+    config: HPRConfig,
+    *,
+    group_size: int | None = None,
+    chunk_sweeps: int = 200,
+    on_chunk=None,
+) -> HPRGroupResult:
+    """Run one group of HPr chains (one per freshly sampled graph) as a
+    single device program. ``items`` are :func:`_build_rep` outputs;
+    ``group_size`` pads with inactive rows for shape stability;
+    ``on_chunk`` is polled between device chunks (the graceful-shutdown
+    hook — it may raise)."""
+    ex = HPRGroupExec(items, config, group_size=group_size)
+    state = ex.init_state(
+        [it[2] for it in items], [it[3] for it in items],
+        [it[4] for it in items], rep_seeds,
+    )
+    state = ex.run(state, chunk_sweeps=chunk_sweeps, on_chunk=on_chunk)
+    return HPRGroupResult(
+        s=np.asarray(state.s)[:ex.G_real],
+        num_steps=np.asarray(state.steps)[:ex.G_real],
+        m_final=np.asarray(state.m_final)[:ex.G_real],
+    )
+
+
+
+def hpr_ensemble_grouped(
+    n: int,
+    d: int,
+    config: HPRConfig | None = None,
+    *,
+    n_rep: int = 1,
+    seed: int = 0,
+    graph_method: str = "pairing",
+    save_path: str | None = None,
+    checkpoint_path: str | None = None,
+    checkpoint_interval_s: float = 30.0,
+    group_size: int = 8,
+    prefetch: int = 2,
+    chunk_sweeps: int = 200,
+):
+    """The grouped HPr experiment driver: ``n_rep`` repetitions on fresh
+    RRG(n, d) instances, ``group_size`` at a time as one vmapped device
+    program, with the next group's graphs/tables/factor data built on a
+    background thread while the current group computes. Element-wise
+    identical to the serial :func:`graphdyn.models.hpr.hpr_ensemble`; see
+    the module docstring for the identity and checkpoint/fault contracts.
+
+    Per-repetition wall-clock (the reference's ``time`` array) is the
+    group's wall-clock divided evenly — per-chain attribution does not
+    exist inside one device program."""
+    from graphdyn.graphs import random_regular_graph
+    from graphdyn.models.hpr import HPREnsembleResult
+    from graphdyn.pipeline.groups import GroupDriver, group_ranges
+    from graphdyn.pipeline.prefetch import HostPrefetcher
+    from graphdyn.utils.io import save_results_npz
+
+    config = config or HPRConfig()
+    mag = np.empty(n_rep, np.float64)  # graftlint: disable=GD004  host result buffer
+    conf = np.empty((n_rep, n), np.int8)
+    steps = np.empty(n_rep, np.int64)
+    graphs = np.empty((n_rep, n, d), np.int32)
+    times = np.empty(n_rep, np.float64)  # graftlint: disable=GD004  host wall-clock
+
+    def payload():
+        return {"mag_reached": mag, "conf": conf, "num_steps": steps,
+                "time": times}
+
+    run_id = {"seed": seed, "n_rep": n_rep, "n": n, "d": d,
+              "graph_method": graph_method, "config": repr(config)}
+    drv = GroupDriver(checkpoint_path, checkpoint_interval_s, run_id, payload)
+    start_k = drv.resume_into(payload())
+
+    def build(k):
+        return _build_rep(n, d, config, seed + k, graph_method)
+
+    with HostPrefetcher(build, range(start_k, n_rep), depth=prefetch) as pf:
+        for ks in group_ranges(start_k, n_rep, group_size):
+            t0 = time.perf_counter()
+            items = [pf.get(i) for i in ks]
+            res = run_hpr_group(
+                items, [seed + i for i in ks], config,
+                group_size=group_size, chunk_sweeps=chunk_sweeps,
+                on_chunk=lambda k0=ks[0]: drv.chunk_poll(k0),
+            )
+            elapsed = time.perf_counter() - t0
+            for j, i in enumerate(ks):
+                conf[i] = res.s[j]
+                # the serial result's f32 mean, widened into the f64 array
+                # graftlint: disable-next-line=GD004  host observable, exact sum
+                mag[i] = np.float32(res.s[j].astype(np.float64).mean())
+                steps[i] = res.num_steps[j]
+                m = items[j]
+                graphs[i] = m[0].nbr
+                times[i] = elapsed / len(ks)
+                drv.rep_boundary(i)
+    for k in range(start_k):    # resumed prefix: graphs re-derive from seed+k
+        graphs[k] = random_regular_graph(
+            n, d, seed=seed + k, method=graph_method
+        ).nbr
+    drv.finish()
+    out = HPREnsembleResult(mag, conf, steps, graphs, times)
+    if save_path:
+        save_results_npz(
+            save_path,
+            mag_reached=out.mag_reached,
+            conf=out.conf,
+            num_steps=out.num_steps,
+            graphs=out.graphs,
+            time=out.time,
+        )
+    return out

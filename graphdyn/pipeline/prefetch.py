@@ -1,0 +1,122 @@
+"""Bounded host-side prefetcher — the host/device overlap half of the
+ensemble pipeline (ARCHITECTURE.md "Ensemble pipeline").
+
+The serial ensemble drivers alternate two idle phases: the device waits
+while the host samples a graph (NetworkX/NumPy pairing, edge tables), then
+the host waits while the chain runs on device. Here a single background
+thread builds repetition ``k+1 .. k+depth`` while the device computes the
+current group, hiding the host build time entirely once the pipeline fills.
+
+Determinism is structural, not hoped-for: every build is a pure function of
+its repetition index (graphs and RNG streams derive from ``seed + k``), so
+*when* a build happens cannot change *what* it produces — ``prefetch=0``
+(fully synchronous) and ``prefetch=4`` are bit-identical by construction
+(tested). The queue is bounded (``depth`` items), so an ensemble of
+thousands of graphs never materializes more than ``depth`` neighbor tables
+on the host at once.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable
+
+_SENTINEL = object()
+
+
+class HostPrefetcher:
+    """Build ``build(k)`` for each ``k`` in ``keys`` (in order) on a
+    background thread, at most ``depth`` items ahead of the consumer.
+
+    ``depth=0`` degrades to a synchronous call per :meth:`get` — no thread,
+    no queue — which is both the determinism baseline for tests and the
+    fallback for callers that cannot tolerate a helper thread.
+
+    Exceptions raised by ``build`` are captured and re-raised from the
+    consumer's matching :meth:`get` call, so a failing build surfaces on the
+    driver thread with its original traceback as ``__cause__``.
+
+    Use as a context manager (or call :meth:`close`): the worker thread is
+    a daemon *and* interruptible — ``close()`` unblocks a worker stuck on a
+    full queue, so a driver that dies mid-ensemble (preemption, injected
+    fault) never leaks a thread that keeps building graphs.
+    """
+
+    def __init__(self, build: Callable[[int], object], keys: Iterable[int],
+                 depth: int = 2):
+        if depth < 0:
+            raise ValueError(f"prefetch depth must be >= 0, got {depth}")
+        self._build = build
+        self._keys = list(keys)
+        self.depth = depth
+        self._pos = 0
+        self._stop = threading.Event()
+        self._q: queue.Queue | None = None
+        self._thread: threading.Thread | None = None
+        if depth > 0 and self._keys:
+            self._q = queue.Queue(maxsize=depth)
+            self._thread = threading.Thread(
+                target=self._worker, name="graphdyn-prefetch", daemon=True
+            )
+            self._thread.start()
+
+    def _worker(self) -> None:
+        for k in self._keys:
+            if self._stop.is_set():
+                return
+            try:
+                item = (k, self._build(k), None)
+            except BaseException as e:  # noqa: BLE001 — re-raised in get()
+                item = (k, None, e)
+            # bounded put that stays responsive to close(): a consumer that
+            # died mid-ensemble must not leave this thread blocked forever
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            if item[2] is not None:
+                return                      # a failed build ends the stream
+
+    def get(self, k: int):
+        """The built item for repetition ``k``. Calls MUST follow the
+        ``keys`` order (the driver's group loop does) — enforced, because an
+        out-of-order get against a threaded prefetcher would silently pair
+        repetitions with the wrong builds."""
+        if self._pos >= len(self._keys) or self._keys[self._pos] != k:
+            raise ValueError(
+                f"prefetcher consumed out of order: expected "
+                f"{self._keys[self._pos] if self._pos < len(self._keys) else '<end>'}, "
+                f"got {k}"
+            )
+        self._pos += 1
+        if self._q is None:
+            return self._build(k)
+        got_k, value, exc = self._q.get()
+        assert got_k == k, f"prefetch stream desync: {got_k} != {k}"
+        if exc is not None:
+            raise RuntimeError(
+                f"prefetch build for repetition {k} failed"
+            ) from exc
+        return value
+
+    def close(self) -> None:
+        """Stop the worker and release the queue. Idempotent."""
+        self._stop.set()
+        if self._q is not None:
+            while True:                     # drain so a blocked put exits
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    break
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "HostPrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

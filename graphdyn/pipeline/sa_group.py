@@ -1,0 +1,358 @@
+"""Batched multi-graph SA ensembles — the device half of the pipeline.
+
+The serial driver (`graphdyn.models.sa.sa_ensemble`) runs ``n_stat``
+single-replica chains one after another, each on its own freshly sampled
+RRG; the device computes a ``[1, n]`` rollout per MCMC step while every
+other repetition waits. Here a *group* of ``G`` repetitions runs as ONE
+compiled program: the neighbor tables stack to ``nbr[G, n, dmax]``
+(:func:`graphdyn.graphs.stack_graphs`), the chain state carries a leading
+group axis, and the candidate rollout is the same hot kernel
+(:func:`graphdyn.ops.dynamics.batched_rollout_impl`) vmapped over the
+per-repetition tables.
+
+Element-wise identity with the serial path is structural: the per-replica
+draw (:func:`graphdyn.models.sa.draw_sa_proposal`), the Metropolis/anneal
+arithmetic (:func:`graphdyn.models.sa.metropolis_anneal_update`) and the
+integer rollout are the *same functions* the serial solver runs, on the
+same per-repetition values — RNG streams still derive from ``seed + k``,
+finished chains freeze under the same ``active`` mask the replica-batched
+solver already uses, and inactive pad rows (shape-stabilizing the tail
+group so every group reuses one compiled program) start frozen. Tested
+element-wise against the serial driver for several group sizes, including
+1 and non-divisors of ``n_stat``.
+
+Checkpointing moves from per-repetition chain files to **group-boundary
+snapshots**: the driver persists the completed-repetition prefix exactly as
+the serial driver does (same metadata, same ``next_rep`` key — snapshots
+are interchangeable between the serial and grouped paths, and between
+different group sizes), and a preempted in-flight group simply re-runs from
+its start on resume (bit-exact: graphs and streams re-derive from
+``seed + k``). The PR-2 contract — SIGTERM → snapshot → exit 75 → resume →
+bit-exact completion, and the ``rep.boundary`` fault site — is preserved,
+with faults and shutdown polls firing in repetition order at each group
+boundary.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from graphdyn.config import SAConfig
+from graphdyn.ops.dynamics import batched_rollout_impl, rule_coefficients
+
+
+class _SAGroupState(NamedTuple):
+    s: jnp.ndarray         # int8[G, n]
+    sum_end: jnp.ndarray   # int32[G]
+    a: jnp.ndarray         # f[G]
+    b: jnp.ndarray         # f[G]
+    t: jnp.ndarray         # int[G]
+    m_final: jnp.ndarray   # f[G]
+    active: jnp.ndarray    # bool[G]
+    key: jnp.ndarray       # PRNG key per repetition [G]
+    chunk_t: jnp.ndarray   # int32[] — steps taken in the current chunk
+
+
+def _group_end_sum(nbr_stack, s, steps: int, R_coef: int, C_coef: int):
+    """Σ_i s_endstate(s)_i per repetition, each on its OWN graph: the shared
+    hot kernel vmapped over the stacked neighbor tables. Integer dynamics —
+    exactly the serial solver's per-repetition sums."""
+
+    def one(nb, sv):
+        return batched_rollout_impl(nb, sv[None], steps, R_coef, C_coef)[0]
+
+    s_end = jax.vmap(one)(nbr_stack, s)
+    return s_end.astype(jnp.int32).sum(axis=1)
+
+
+@partial(jax.jit, static_argnames=("rollout_steps", "R_coef", "C_coef"))
+def _sa_group_init(nbr_stack, s0, key0, a0, b0, real, *, rollout_steps: int,
+                   R_coef: int, C_coef: int) -> _SAGroupState:
+    G, n = s0.shape
+    dt = a0.dtype
+    sum_end0 = _group_end_sum(nbr_stack, s0, rollout_steps, R_coef, C_coef)
+    m0 = sum_end0.astype(dt) / n
+    return _SAGroupState(
+        s=s0,
+        sum_end=sum_end0,
+        a=a0,
+        b=b0,
+        t=jnp.zeros((G,), jnp.int64 if jax.config.jax_enable_x64 else jnp.int32),
+        m_final=m0,
+        active=(m0 < 1.0) & real,
+        key=key0,
+        chunk_t=jnp.zeros((), jnp.int32),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("rollout_steps", "R_coef", "C_coef", "max_steps",
+                     "chunk_steps"),
+    # group-to-group carry reuse: the previous chunk's state is never read
+    # again after the call (group checkpoints snapshot the DRIVER arrays,
+    # not the device carry), so the big [G, n] buffers update in place
+    donate_argnums=(1,),
+)
+def _sa_group_loop(
+    nbr_stack,
+    state: _SAGroupState,
+    par_a,
+    par_b,
+    a_cap,
+    b_cap,
+    *,
+    rollout_steps: int,
+    R_coef: int,
+    C_coef: int,
+    max_steps: int,
+    chunk_steps: int | None = None,
+):
+    """Advance all chains of the group until every one stops (or for at most
+    ``chunk_steps`` more steps — the shutdown-poll granularity). The body is
+    the serial solver's body on a group axis: same draw, same accept/anneal
+    arithmetic, per-repetition neighbor tables in the rollout."""
+    from graphdyn.models.sa import draw_sa_proposal, metropolis_anneal_update
+
+    G, n = state.s.shape
+    dt = state.a.dtype
+
+    def cond(st: _SAGroupState):
+        go = jnp.any(st.active)
+        if chunk_steps is not None:
+            go = go & (st.chunk_t < chunk_steps)
+        return go
+
+    def body(st: _SAGroupState):
+        i, u = draw_sa_proposal(
+            st.key, st.t, None, None,
+            injected=False, stream_len=1, n=n, dt=dt,
+        )
+        gidx = jnp.arange(G)
+        s_i = st.s[gidx, i].astype(jnp.int32)
+        s_flip = st.s.at[gidx, i].set((-s_i).astype(jnp.int8))
+        sum_end_flip = _group_end_sum(
+            nbr_stack, s_flip, rollout_steps, R_coef, C_coef
+        )
+        do, sum_end_new, a_new, b_new, t_new, m_final, active = (
+            metropolis_anneal_update(
+                st.active, st.a, st.b, st.t, st.m_final,
+                st.sum_end, sum_end_flip, s_i, u,
+                par_a=par_a, par_b=par_b, a_cap=a_cap, b_cap=b_cap,
+                max_steps=max_steps, n=n,
+            )
+        )
+        s_new = jnp.where(do[:, None], s_flip, st.s)
+        return _SAGroupState(
+            s_new, sum_end_new, a_new, b_new, t_new, m_final, active, st.key,
+            st.chunk_t + 1,
+        )
+
+    return lax.while_loop(cond, body, state)
+
+
+class SAGroupResult(NamedTuple):
+    s: np.ndarray          # int8[G, n]
+    num_steps: np.ndarray  # int[G]
+    m_final: np.ndarray    # f[G]
+
+
+def run_sa_group(
+    graphs,
+    preps,
+    rep_seeds,
+    config: SAConfig,
+    *,
+    dtype=jnp.float32,
+    group_size: int | None = None,
+    chunk_steps: int = 100_000,
+    on_chunk=None,
+    mesh=None,
+    group_axis: str = "group",
+) -> SAGroupResult:
+    """Run one group of single-replica SA chains as a single device program.
+
+    ``graphs``/``preps``/``rep_seeds`` are per-repetition: the sampled
+    graph, the :func:`graphdyn.models.sa.prepare_sa_inputs` tuple for
+    ``n_replicas=1, seed=seed+k``, and ``seed+k`` itself. ``group_size``
+    pads the batch with inactive rows so a short tail group reuses the full
+    group's compiled program. ``on_chunk`` is polled between device chunks
+    (the graceful-shutdown hook — it may raise). With a ``mesh``, the
+    stacked tables and carry shard over ``group_axis`` (repetitions are
+    independent, so the partitioned program is communication-free except
+    the stop test); results are bit-identical to the unsharded program.
+    """
+    from graphdyn.graphs import stack_graphs
+
+    G_real = len(graphs)
+    G = group_size or G_real
+    if G < G_real:
+        raise ValueError(f"group_size={G} < group population {G_real}")
+    if mesh is not None and G % int(np.prod(list(mesh.shape.values()))):
+        raise ValueError(
+            f"group size {G} not divisible by the mesh's "
+            f"{int(np.prod(list(mesh.shape.values())))} devices"
+        )
+    dyn = config.dynamics
+    R_coef, C_coef = rule_coefficients(dyn.rule, dyn.tie)
+    rollout = dyn.p + dyn.c - 1
+    np_dt = np.float32 if dtype == jnp.float32 else np.float64  # graftlint: disable=GD004  dtype mirror for host staging
+    n = graphs[0].n
+
+    max_steps = {int(p[7]) for p in preps}
+    if len(max_steps) != 1:
+        raise ValueError(f"group mixes step budgets: {sorted(max_steps)}")
+    max_steps = max_steps.pop()
+
+    def pad(rows):
+        return rows + [rows[0]] * (G - G_real)
+
+    nbr_stack = stack_graphs(pad(list(graphs))).nbr
+    s0 = np.concatenate(pad([p[2] for p in preps]))
+    a0 = np.concatenate(pad([p[3] for p in preps])).astype(np_dt)
+    b0 = np.concatenate(pad([p[4] for p in preps])).astype(np_dt)
+    # per-repetition root keys: exactly the serial solver's derivation for
+    # R=1, seed=seed+k (np.arange(1, uint32) + uint32(seed+k) == [seed+k])
+    key_seeds = np.asarray(pad([np.uint32(s) for s in rep_seeds]), np.uint32)
+    keys = jax.vmap(jax.random.PRNGKey)(key_seeds)
+    real = np.zeros(G, bool)
+    real[:G_real] = True
+
+    def place(x):
+        x = jnp.asarray(x)
+        if mesh is None:
+            return x
+        from graphdyn.parallel.mesh import shard_stack
+
+        return shard_stack(mesh, x, group_axis)
+
+    nbr_dev = place(nbr_stack)
+    state = _sa_group_init(
+        nbr_dev, place(s0), place(keys),
+        place(a0), place(b0), place(real),
+        rollout_steps=rollout, R_coef=R_coef, C_coef=C_coef,
+    )
+    loop_args = (
+        jnp.asarray(np_dt(config.par_a)),
+        jnp.asarray(np_dt(config.par_b)),
+        jnp.asarray(np_dt(config.a_cap_frac * n)),
+        jnp.asarray(np_dt(config.b_cap_frac * n)),
+    )
+    while bool(jnp.any(state.active)):
+        state = _sa_group_loop(
+            nbr_dev, state._replace(chunk_t=jnp.zeros((), jnp.int32)),
+            *loop_args,
+            rollout_steps=rollout, R_coef=R_coef, C_coef=C_coef,
+            max_steps=max_steps, chunk_steps=int(chunk_steps),
+        )
+        if on_chunk is not None:
+            on_chunk()
+
+    return SAGroupResult(
+        s=np.asarray(state.s)[:G_real],
+        num_steps=np.asarray(state.t)[:G_real],
+        m_final=np.asarray(state.m_final)[:G_real],
+    )
+
+
+def sa_ensemble_grouped(
+    n: int,
+    d: int,
+    config: SAConfig | None = None,
+    *,
+    n_stat: int = 5,
+    seed: int = 0,
+    graph_method: str = "pairing",
+    max_steps: int | None = None,
+    save_path: str | None = None,
+    backend: str = "jax_tpu",
+    checkpoint_path: str | None = None,
+    checkpoint_interval_s: float = 30.0,
+    group_size: int = 8,
+    prefetch: int = 2,
+    chunk_steps: int = 100_000,
+    mesh=None,
+    group_axis: str = "group",
+):
+    """The grouped SA experiment driver: ``n_stat`` repetitions on fresh
+    RRG(n, d) instances, executed ``group_size`` at a time as one vmapped
+    device program, with graph ``k+1..k+G`` built on a background thread
+    while group ``k`` computes (``prefetch`` bounds the build-ahead depth;
+    0 disables the thread). Element-wise identical to the serial
+    :func:`graphdyn.models.sa.sa_ensemble` — see the module docstring for
+    the identity and checkpoint/fault contracts."""
+    from graphdyn.graphs import random_regular_graph
+    from graphdyn.models.sa import SAEnsembleResult, prepare_sa_inputs
+    from graphdyn.pipeline.groups import GroupDriver, group_ranges
+    from graphdyn.pipeline.prefetch import HostPrefetcher
+    from graphdyn.utils.io import save_results_npz
+
+    config = config or SAConfig()
+    mag = np.empty(n_stat, np.float64)  # graftlint: disable=GD004  host result buffer
+    steps = np.empty(n_stat, np.int64)
+    conf = np.empty((n_stat, n), np.int8)
+    graphs = np.empty((n_stat, n, d), np.int32)
+    m_final = np.empty(n_stat, np.float64)  # graftlint: disable=GD004  host result buffer
+
+    def payload():
+        return {"mag_reached": mag, "num_steps": steps,
+                "conf": conf, "m_final": m_final}
+
+    # identical identity metadata to the serial driver: snapshots are
+    # interchangeable between the serial and grouped paths and between
+    # group sizes (per-repetition results depend only on seed + k)
+    run_id = {"seed": seed, "n_stat": n_stat, "n": n, "d": d,
+              "max_steps": max_steps, "graph_method": graph_method,
+              "config": repr(config), "backend": backend}
+    drv = GroupDriver(checkpoint_path, checkpoint_interval_s, run_id, payload)
+    start_k = drv.resume_into(payload())
+
+    def build(k):
+        g = random_regular_graph(n, d, seed=seed + k, method=graph_method)
+        prep = prepare_sa_inputs(
+            g, config, n_replicas=1, seed=seed + k, max_steps=max_steps
+        )
+        return g, prep
+
+    with HostPrefetcher(build, range(start_k, n_stat), depth=prefetch) as pf:
+        for ks in group_ranges(start_k, n_stat, group_size):
+            items = [pf.get(i) for i in ks]
+            res = run_sa_group(
+                [it[0] for it in items], [it[1] for it in items],
+                [seed + i for i in ks], config,
+                group_size=group_size, chunk_steps=chunk_steps,
+                on_chunk=lambda k0=ks[0]: drv.chunk_poll(k0),
+                mesh=mesh, group_axis=group_axis,
+            )
+            for j, i in enumerate(ks):
+                conf[i] = res.s[j]
+                # exact f64 sum, then the serial result's f32 cast — the
+                # driver array holds the same widened-f32 value either way
+                # graftlint: disable-next-line=GD004  host observable, exact sum
+                mag[i] = np.float32(res.s[j].astype(np.float64).sum() / n)
+                steps[i] = res.num_steps[j]
+                m_final[i] = res.m_final[j]
+                graphs[i] = items[j][0].nbr
+                drv.rep_boundary(i)
+    for k in range(start_k):    # resumed prefix: graphs re-derive from seed+k
+        graphs[k] = random_regular_graph(
+            n, d, seed=seed + k, method=graph_method
+        ).nbr
+    drv.finish()
+    out = SAEnsembleResult(mag, steps, conf, graphs, m_final)
+    if save_path:
+        save_results_npz(
+            save_path,
+            mag_reached=out.mag_reached,
+            num_steps=out.num_steps,
+            conf=out.conf,
+            graphs=out.graphs,
+        )
+    return out

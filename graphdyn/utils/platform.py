@@ -24,3 +24,41 @@ def apply_force_platform(env_var: str = "GRAPHDYN_FORCE_PLATFORM") -> str | None
 
         jax.config.update("jax_platforms", force)
     return force or None
+
+
+def apply_compile_cache(
+    path: str | None = None, env_var: str = "GRAPHDYN_COMPILE_CACHE"
+) -> str | None:
+    """Opt-in persistent XLA compile cache (``jax_compilation_cache_dir``).
+
+    A resumed or re-run ensemble job pays the multi-second XLA compile of
+    its group program again for nothing — the program is identical, only
+    the process is new. Pointing ``GRAPHDYN_COMPILE_CACHE`` (or the CLI's
+    ``--compile-cache``) at a directory makes re-runs load the compiled
+    executable from disk instead. Opt-in because the cache directory must
+    be a real, writable path the operator owns (scratch volumes, not
+    containers' ephemeral overlay).
+
+    An explicit ``path`` wins over the environment variable; returns the
+    directory applied, or None when the knob is unset. Cache-eligibility
+    thresholds are lowered so even the smoke-sized programs qualify —
+    the whole point is skipping *every* recompile on resume, not only the
+    giant ones. Silently tolerates jax versions without the tuning knobs
+    (the cache dir itself is supported by every jax this repo targets).
+    """
+    target = path or os.environ.get(env_var)
+    if not target:
+        return None
+    import jax
+
+    os.makedirs(target, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", target)
+    for knob, value in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(knob, value)
+        except (AttributeError, ValueError):  # older jax: knob absent
+            pass
+    return target

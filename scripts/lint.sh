@@ -59,6 +59,34 @@ else
     echo "== faultcheck: pytest not installed — SKIPPED (pip install pytest to enable) =="
 fi
 
+# 5. benchcheck — the benchmark's single-JSON-line contract, live (python
+#    bench.py --smoke on the CPU backend): one line of JSON, a positive
+#    headline value, and a positive ensemble_rate row (the grouped-driver
+#    throughput the pipeline ships). A formatting regression here silently
+#    voids a whole round's benchmark artifact. Skipped with a notice when
+#    GRAPHDYN_SKIP_BENCHCHECK=1 (set by the tier-1 lint-gate test — the
+#    contract already runs in-suite via tests/test_bench_contract.py).
+if [ "${GRAPHDYN_SKIP_BENCHCHECK:-0}" = "1" ]; then
+    echo "== benchcheck: GRAPHDYN_SKIP_BENCHCHECK=1 — SKIPPED (contract runs in tier-1) =="
+else
+    echo "== benchcheck (python bench.py --smoke) =="
+    GRAPHDYN_FORCE_PLATFORM="${GRAPHDYN_FORCE_PLATFORM:-cpu}" JAX_PLATFORMS=cpu \
+        python bench.py --smoke > /tmp/graphdyn_benchcheck.json || fail=1
+    python - /tmp/graphdyn_benchcheck.json <<'PYEOF' || fail=1
+import json, sys
+lines = [ln for ln in open(sys.argv[1]) if ln.strip()]
+assert len(lines) == 1, f"stdout must be ONE JSON line, got {len(lines)}"
+row = json.loads(lines[0])
+assert row.get("value", 0) > 0, f"headline value must be > 0: {row.get('value')}"
+assert row.get("unit") == "spin-updates/s", row.get("unit")
+assert row.get("ensemble_rate", 0) > 0, \
+    f"ensemble_rate row must be > 0: {row.get('ensemble_rate')}"
+print(f"benchcheck: value={row['value']:.3e} "
+      f"ensemble_rate={row['ensemble_rate']:.3e} "
+      f"ensemble_speedup={row.get('ensemble_speedup', 0):.2f}x")
+PYEOF
+fi
+
 if [ "$fail" -ne 0 ]; then
     echo "lint gate: FAILED" >&2
     exit 1

@@ -288,6 +288,84 @@ class TestGD006Donation:
         assert _codes(src) == []
 
 
+class TestGD008HostLoopTransfers:
+    """Per-iteration host→device transfer in a driver-module for-loop —
+    the serial-ensemble anti-pattern the pipeline removes."""
+
+    DRIVER = "graphdyn/models/driver.py"
+    BAD_ASARRAY = (
+        "import jax.numpy as jnp\n"
+        "def ensemble(graphs):\n"
+        "    out = []\n"
+        "    for g in graphs:\n"
+        "        nbr = jnp.asarray(g.nbr)\n"     # one transfer per rep
+        "        out.append(run(nbr))\n"
+        "    return out\n"
+    )
+    BAD_DEVICE_PUT = (
+        "import jax\n"
+        "def ensemble(tables):\n"
+        "    for t in tables:\n"
+        "        jax.device_put(t)\n"
+    )
+
+    def test_bad_asarray_in_driver_loop(self):
+        assert "GD008" in _codes(self.BAD_ASARRAY, path=self.DRIVER)
+
+    def test_bad_device_put_in_driver_loop(self):
+        assert "GD008" in _codes(self.BAD_DEVICE_PUT, path=self.DRIVER)
+
+    def test_good_hoisted_stack(self):
+        # the pipeline fix: stack once, transfer once, run one program
+        src = (
+            "import numpy as np\nimport jax.numpy as jnp\n"
+            "def ensemble(graphs):\n"
+            "    nbr = jnp.asarray(np.stack([g.nbr for g in graphs]))\n"
+            "    return run(nbr)\n"
+        )
+        assert _codes(src, path=self.DRIVER) == []
+
+    def test_good_loop_without_transfer(self):
+        src = (
+            "def ensemble(graphs):\n"
+            "    out = []\n"
+            "    for g in graphs:\n"
+            "        out.append(g.n)\n"
+            "    return out\n"
+        )
+        assert _codes(src, path=self.DRIVER) == []
+
+    def test_non_driver_module_exempt(self):
+        # ops/tests/benchmarks may stage per-iteration buffers freely
+        assert "GD008" not in _codes(self.BAD_ASARRAY, path="graphdyn/ops/x.py")
+
+    def test_jitted_for_loop_exempt(self):
+        # a for-loop inside a jit context unrolls at trace time — there is
+        # no per-iteration host->device transfer to flag
+        src = (
+            "import jax\nimport jax.numpy as jnp\n"
+            "@jax.jit\n"
+            "def body(s):\n"
+            "    for j in range(3):\n"
+            "        s = s + jnp.asarray(1)\n"
+            "    return s\n"
+        )
+        assert "GD008" not in _codes(src, path=self.DRIVER)
+
+    def test_disable_comment(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def ladder(lambdas):\n"
+            "    for lmbd in lambdas:\n"
+            "        # graftlint: disable-next-line=GD008  one scalar per step\n"
+            "        run(jnp.asarray(lmbd))\n"
+        )
+        assert _codes(src, path=self.DRIVER) == []
+
+    def test_catalogued(self):
+        assert "GD008" in RULES
+
+
 class TestGD007AtomicPersistence:
     BAD_SAVEZ = (
         "import numpy as np\n"
@@ -464,7 +542,7 @@ def test_unreadable_file_is_a_finding(tmp_path):
 
 
 def test_rules_registry_complete():
-    assert set(RULES) == {f"GD00{i}" for i in range(1, 8)}
+    assert set(RULES) == {f"GD00{i}" for i in range(1, 9)}
 
 
 def test_repo_package_is_clean():

@@ -31,6 +31,17 @@ def test_bench_smoke_emits_one_json_line():
     assert row["unit"] == "spin-updates/s"
     # the smoke row must not carry the full-shape-only roofline fraction
     assert "roofline_fraction_v5e" not in row
+    # rows skipped on this backend are null + reason, NEVER 0.0 (a skip
+    # must be unmistakable from a measured collapse)
+    for key in ("packed_rate_wide", "packed_rate_pallas"):
+        assert row[key] is None, (key, row[key])
+        assert "chip-only" in row[key + "_skipped_reason"]
+    # the end-to-end driver A/B: the grouped pipeline must beat the serial
+    # repetition loop on the same workload (results are element-wise
+    # identical — tests/test_pipeline.py), and the ratio is recorded
+    assert row["ensemble_rate"] > 0
+    assert row["ensemble_rate_serial"] > 0
+    assert row["ensemble_speedup"] > 1.0, row["ensemble_speedup"]
 
 
 def test_bench_emits_partials_on_midrun_failure(monkeypatch, capsys):

@@ -1,0 +1,345 @@
+"""graphdyn.pipeline: batched multi-graph ensembles + prefetch overlap.
+
+The contract under test (ARCHITECTURE.md "Ensemble pipeline"):
+
+1. the grouped drivers are ELEMENT-WISE IDENTICAL to the serial drivers —
+   same per-repetition ``mag_reached``/``num_steps``/``conf``/``graphs`` —
+   for several group sizes including 1 and non-divisors of the repetition
+   count (pad rows must be inert);
+2. prefetch depth cannot change results (builds are pure functions of
+   ``seed + k``);
+3. the PR-2 resilience contract survives grouping: ``rep.boundary``
+   preempt/signal → snapshot → resume → results equal the uninterrupted
+   run, with snapshots interchangeable across group sizes;
+4. the stacked layout shards over a device mesh bit-identically.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from graphdyn.config import DynamicsConfig, HPRConfig, SAConfig
+from graphdyn.models.hpr import hpr_ensemble
+from graphdyn.models.sa import sa_ensemble
+from graphdyn.pipeline.groups import group_ranges
+from graphdyn.pipeline.prefetch import HostPrefetcher
+from graphdyn.resilience import (
+    FaultPlan, FaultSpec, InjectedPreemption, ShutdownRequested,
+    graceful_shutdown,
+)
+from graphdyn.utils.io import Checkpoint
+
+DYN11 = DynamicsConfig(p=1, c=1)
+SA_CFG = SAConfig(dynamics=DYN11)
+SA_KW = dict(n_stat=5, seed=0, max_steps=20_000)
+
+
+def _assert_ensembles_equal(a, b):
+    for f in a._fields:
+        if f == "time":        # wall-clock is not a deterministic observable
+            continue
+        np.testing.assert_array_equal(getattr(a, f), getattr(b, f), err_msg=f)
+
+
+# ---------------------------------------------------------------------------
+# 1. element-wise parity, grouped vs serial
+# ---------------------------------------------------------------------------
+
+
+def test_sa_grouped_matches_serial_elementwise():
+    """Group sizes 1 (vmapped singleton), 2 (several groups), and 4 (a
+    non-divisor of n_stat=5 — the tail group runs padded) all reproduce the
+    serial driver exactly, per repetition."""
+    base = sa_ensemble(30, 3, SA_CFG, group_size=0, **SA_KW)
+    for gs in (1, 2, 4):
+        res = sa_ensemble(30, 3, SA_CFG, group_size=gs, **SA_KW)
+        _assert_ensembles_equal(base, res)
+
+
+def test_hpr_grouped_matches_serial_elementwise():
+    cfg = HPRConfig(dynamics=DYN11, max_sweeps=2000)
+    kw = dict(n_rep=3, seed=1)
+    base = hpr_ensemble(30, 3, cfg, group_size=0, **kw)
+    for gs in (1, 2):          # 2 is a non-divisor of n_rep=3 (padded tail)
+        res = hpr_ensemble(30, 3, cfg, group_size=gs, **kw)
+        _assert_ensembles_equal(base, res)
+        assert np.all(res.time > 0)
+
+
+def test_hpr_grouped_matches_serial_long_chains():
+    """Regression anchor for the parity design: n=60, d=4, seed=5 drives an
+    800+-sweep chain whose decisions flip under ulp-level float-schedule
+    differences — the case that exposed fused-loop-vs-restatement
+    divergence and forced hpr_solve onto the shared group program. Serial
+    (a loop of hpr_solve) and grouped must stay element-wise identical."""
+    cfg = HPRConfig(dynamics=DYN11, max_sweeps=1000)
+    kw = dict(n_rep=3, seed=5)
+    base = hpr_ensemble(60, 4, cfg, group_size=0, **kw)
+    res = hpr_ensemble(60, 4, cfg, group_size=2, **kw)
+    _assert_ensembles_equal(base, res)
+
+
+def test_sa_grouped_rejected_off_jax_backends():
+    """An explicit group size with the numpy oracle (or lightcone mode)
+    must fail loudly, never silently fall back."""
+    with pytest.raises(ValueError, match="group_size"):
+        sa_ensemble(30, 3, SA_CFG, group_size=2, backend="cpu", **SA_KW)
+    # the auto default quietly picks the serial loop for the oracle
+    res = sa_ensemble(30, 3, SA_CFG, backend="cpu", n_stat=2, seed=0,
+                      max_steps=20_000)
+    assert res.conf.shape == (2, 30)
+
+
+# ---------------------------------------------------------------------------
+# 2. prefetch determinism
+# ---------------------------------------------------------------------------
+
+
+def test_prefetch_depth_does_not_change_results():
+    r0 = sa_ensemble(30, 3, SA_CFG, group_size=2, prefetch=0, **SA_KW)
+    r4 = sa_ensemble(30, 3, SA_CFG, group_size=2, prefetch=4, **SA_KW)
+    _assert_ensembles_equal(r0, r4)
+
+
+def test_prefetcher_unit():
+    built = []
+
+    def build(k):
+        built.append(k)
+        return k * k
+
+    with HostPrefetcher(build, range(5), depth=2) as pf:
+        assert [pf.get(k) for k in range(5)] == [0, 1, 4, 9, 16]
+    assert built == list(range(5))
+    # depth=0 is synchronous — no thread, same values
+    with HostPrefetcher(build, range(3), depth=0) as pf:
+        assert [pf.get(k) for k in range(3)] == [0, 1, 4]
+    # out-of-order consumption is a programming error, not a silent desync
+    with HostPrefetcher(build, range(3), depth=1) as pf:
+        with pytest.raises(ValueError, match="out of order"):
+            pf.get(1)
+
+
+def test_prefetcher_build_failure_surfaces_on_consumer():
+    def build(k):
+        if k == 2:
+            raise RuntimeError("boom at 2")
+        return k
+
+    with HostPrefetcher(build, range(4), depth=3) as pf:
+        assert pf.get(0) == 0
+        assert pf.get(1) == 1
+        with pytest.raises(RuntimeError, match="repetition 2"):
+            pf.get(2)
+
+
+def test_group_ranges_partition():
+    assert list(group_ranges(0, 5, 2)) == [[0, 1], [2, 3], [4]]
+    assert list(group_ranges(3, 5, 8)) == [[3, 4]]
+    assert list(group_ranges(5, 5, 2)) == []
+    with pytest.raises(ValueError):
+        list(group_ranges(0, 5, 0))
+
+
+# ---------------------------------------------------------------------------
+# 3. resilience contract under grouping
+# ---------------------------------------------------------------------------
+
+
+def test_sa_grouped_rep_preemption_resume_parity(tmp_path):
+    """A hard preemption at the rep-1 boundary (inside a group's boundary
+    sweep) resumes to results identical to the uninterrupted grouped run —
+    and to the serial run, by the parity above."""
+    ck = str(tmp_path / "ck")
+    base = sa_ensemble(30, 3, SA_CFG, group_size=2, **SA_KW)
+    with FaultPlan([FaultSpec("rep.boundary", "preempt", at=2)]):
+        with pytest.raises(InjectedPreemption):
+            sa_ensemble(30, 3, SA_CFG, group_size=2, checkpoint_path=ck,
+                        checkpoint_interval_s=0.0, **SA_KW)
+    res = sa_ensemble(30, 3, SA_CFG, group_size=2, checkpoint_path=ck,
+                      checkpoint_interval_s=0.0, **SA_KW)
+    _assert_ensembles_equal(base, res)
+    assert not os.path.exists(ck + ".npz")
+
+
+def test_sa_grouped_resume_across_group_sizes(tmp_path):
+    """Snapshots are interchangeable between group sizes (and with the
+    serial path): per-repetition results depend only on seed + k, so a
+    resume may regroup freely."""
+    ck = str(tmp_path / "ck")
+    base = sa_ensemble(30, 3, SA_CFG, group_size=0, **SA_KW)
+    with FaultPlan([FaultSpec("rep.boundary", "preempt", at=3)]):
+        with pytest.raises(InjectedPreemption):
+            sa_ensemble(30, 3, SA_CFG, group_size=3, checkpoint_path=ck,
+                        checkpoint_interval_s=0.0, **SA_KW)
+    res = sa_ensemble(30, 3, SA_CFG, group_size=0, checkpoint_path=ck,
+                      checkpoint_interval_s=0.0, **SA_KW)
+    _assert_ensembles_equal(base, res)
+
+
+def test_sa_grouped_shutdown_snapshots_prefix(tmp_path):
+    """The graceful-shutdown protocol at a group boundary: the 'signal'
+    action (SIGTERM semantics) propagates ShutdownRequested with the
+    completed-rep prefix snapshotted; the rerun completes bit-exactly."""
+    ck = str(tmp_path / "ck")
+    base = sa_ensemble(30, 3, SA_CFG, group_size=2, **SA_KW)
+    with graceful_shutdown():
+        with FaultPlan([FaultSpec("rep.boundary", "signal", at=1)]):
+            with pytest.raises(ShutdownRequested):
+                sa_ensemble(30, 3, SA_CFG, group_size=2, checkpoint_path=ck,
+                            checkpoint_interval_s=1e9, **SA_KW)
+    arrays, meta = Checkpoint(ck).load()
+    assert meta["next_rep"] == 1
+    res = sa_ensemble(30, 3, SA_CFG, group_size=2, checkpoint_path=ck,
+                      checkpoint_interval_s=0.0, **SA_KW)
+    _assert_ensembles_equal(base, res)
+    assert not os.path.exists(ck + ".npz")
+
+
+def test_grouped_resume_cleans_stale_serial_chain_files(tmp_path):
+    """A SERIAL-path run preempted mid-repetition leaves its in-flight
+    chain snapshot at <path>_chain<k>; a grouped-path resume recomputes
+    that repetition from scratch and must REMOVE the stale file — a later
+    serial run reusing the checkpoint path would otherwise hit the chain
+    fingerprint check and refuse to resume, wedging mid-ensemble."""
+    ck = str(tmp_path / "ck")
+    base = sa_ensemble(30, 3, SA_CFG, group_size=0, **SA_KW)
+    # manufacture the serial driver's preemption leftovers: a prefix
+    # snapshot at rep 1 plus rep 1's in-flight chain file
+    run_id = {"seed": SA_KW["seed"], "n_stat": SA_KW["n_stat"], "n": 30,
+              "d": 3, "max_steps": SA_KW["max_steps"],
+              "graph_method": "pairing", "config": repr(SA_CFG),
+              "backend": "jax_tpu"}
+    Checkpoint(ck).save(
+        {"mag_reached": base.mag_reached, "num_steps": base.num_steps,
+         "conf": base.conf, "m_final": base.m_final},
+        {**run_id, "next_rep": 1},
+    )
+    Checkpoint(ck + "_chain1").save(
+        {"s": np.zeros((1, 30), np.int8)},
+        {"kind": "sa_chain", "seed": 99, "fp": "stale-serial-snapshot"},
+    )
+    res = sa_ensemble(30, 3, SA_CFG, group_size=2, checkpoint_path=ck,
+                      checkpoint_interval_s=0.0, **SA_KW)
+    _assert_ensembles_equal(base, res)
+    assert not os.path.exists(ck + "_chain1.npz")   # stale file removed
+    assert not os.path.exists(ck + ".npz")
+
+
+def test_hpr_grouped_rep_preemption_resume_parity(tmp_path):
+    cfg = HPRConfig(dynamics=DYN11, max_sweeps=2000)
+    kw = dict(n_rep=3, seed=1)
+    ck = str(tmp_path / "ck")
+    base = hpr_ensemble(30, 3, cfg, group_size=2, **kw)
+    with FaultPlan([FaultSpec("rep.boundary", "preempt", at=2)]):
+        with pytest.raises(InjectedPreemption):
+            hpr_ensemble(30, 3, cfg, group_size=2, checkpoint_path=ck,
+                         checkpoint_interval_s=0.0, **kw)
+    res = hpr_ensemble(30, 3, cfg, group_size=2, checkpoint_path=ck,
+                       checkpoint_interval_s=0.0, **kw)
+    _assert_ensembles_equal(base, res)
+    assert not os.path.exists(ck + ".npz")
+
+
+def test_cli_grouped_sa_preemption_exits_75_and_resumes(tmp_path, capsys):
+    """The PR-2 CLI contract under batching, end to end: a shutdown request
+    at a group boundary of the GROUPED sa driver exits EX_TEMPFAIL (75)
+    with a loadable prefix snapshot; rerunning the same command resumes,
+    completes with exit 0, cleans the checkpoint up, and the persisted
+    results are bit-exact vs an uninterrupted run."""
+    import json
+
+    from graphdyn.cli import main
+    from graphdyn.utils.io import load_results_npz
+
+    ck = str(tmp_path / "ck")
+    out = str(tmp_path / "res.npz")
+    base_out = str(tmp_path / "base.npz")
+    common = [
+        "sa", "--n", "30", "--d", "3", "--p", "1", "--c", "1",
+        "--n-stat", "3", "--max-steps", "20000", "--seed", "0",
+        "--group-size", "2", "--prefetch", "2",
+    ]
+    rc = main(common + ["--out", base_out])
+    capsys.readouterr()
+    assert rc == 0
+    args = common + ["--checkpoint", ck, "--checkpoint-interval", "0",
+                     "--out", out]
+    with FaultPlan([FaultSpec("rep.boundary", "signal", at=1)]):
+        rc = main(args)
+    capsys.readouterr()
+    assert rc == 75                              # preempted, requeue me
+    loaded = Checkpoint(ck).load()
+    assert loaded is not None and loaded[1]["next_rep"] >= 1
+    rc2 = main(args)                             # requeue
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc2 == 0
+    assert not os.path.exists(ck + ".npz")
+    base, res = load_results_npz(base_out), load_results_npz(out)
+    for key in base:
+        np.testing.assert_array_equal(base[key], res[key], err_msg=key)
+    assert doc["solver"] == "sa"
+
+
+# ---------------------------------------------------------------------------
+# 4. stacked layout over a device mesh
+# ---------------------------------------------------------------------------
+
+
+def test_sa_group_sharded_over_mesh_bit_identical():
+    """The stacked [G, ...] layout shards over the group axis with no
+    change in per-repetition results (repetitions are independent, so the
+    partitioned program computes exactly the unsharded arithmetic)."""
+    from graphdyn.models.sa import prepare_sa_inputs
+    from graphdyn.parallel.mesh import device_pool, make_mesh
+    from graphdyn.pipeline.sa_group import run_sa_group
+    from graphdyn.graphs import random_regular_graph
+
+    seeds = [7 + k for k in range(4)]
+    graphs = [random_regular_graph(30, 3, seed=s) for s in seeds]
+    preps = [
+        prepare_sa_inputs(g, SA_CFG, n_replicas=1, seed=s, max_steps=20_000)
+        for g, s in zip(graphs, seeds)
+    ]
+    base = run_sa_group(graphs, preps, seeds, SA_CFG, group_size=4)
+    mesh = make_mesh((2,), ("group",), devices=device_pool(2))
+    res = run_sa_group(graphs, preps, seeds, SA_CFG, group_size=4, mesh=mesh)
+    np.testing.assert_array_equal(base.s, res.s)
+    np.testing.assert_array_equal(base.num_steps, res.num_steps)
+    np.testing.assert_array_equal(base.m_final, res.m_final)
+
+
+# ---------------------------------------------------------------------------
+# 5. persistent compile cache wiring
+# ---------------------------------------------------------------------------
+
+
+def test_compile_cache_opt_in(tmp_path, monkeypatch):
+    """GRAPHDYN_COMPILE_CACHE wires jax_compilation_cache_dir and compiled
+    programs land in it; unset leaves the config untouched. The live check
+    runs in a subprocess — jax memoizes cache enablement at the process's
+    first compile, so a long-lived suite process cannot flip it on."""
+    import subprocess
+    import sys
+
+    from graphdyn.utils.platform import apply_compile_cache
+
+    monkeypatch.delenv("GRAPHDYN_COMPILE_CACHE", raising=False)
+    assert apply_compile_cache() is None
+
+    cache = tmp_path / "xla-cache"
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "from graphdyn.utils.platform import apply_compile_cache\n"
+        "d = apply_compile_cache()\n"
+        "assert jax.config.jax_compilation_cache_dir == d, d\n"
+        "jax.jit(lambda x: (x * x).sum())("
+        "jnp.arange(128, dtype=jnp.float32)).block_until_ready()\n"
+    )
+    env = {**os.environ, "GRAPHDYN_COMPILE_CACHE": str(cache),
+           "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert any(cache.iterdir()), "no cache entries written"
