@@ -40,10 +40,28 @@ def probe_relay(budget_s: float, probe_timeout: float = 75.0) -> bool:
     """
     import subprocess
 
+    # the probe child states plugin PRESENCE before it touches jax device
+    # init: a fast-failing attempt with a PJRT chip plugin installed is a
+    # transient relay/plugin error (the relay recovers in windows — keep
+    # probing within the budget), while the same fast failure with NO
+    # plugin registered is deterministic 'no chip here' (terminal). The
+    # r05 misclassification: a relay whose plugin raised quickly was read
+    # as a broken install after three strikes and the window was lost.
     code = (
-        "import jax, jax.numpy as jnp; jax.devices(); "
-        "(jnp.ones((128, 128)) @ jnp.ones((128, 128))).block_until_ready(); "
-        "print('PROBE_OK', jax.default_backend())"
+        "import os\n"
+        "import importlib.metadata as md\n"
+        "try:\n"
+        "    names = sorted({ep.name for ep in"
+        " md.entry_points(group='jax_plugins')})\n"
+        "except Exception:\n"
+        "    names = []\n"
+        "if os.environ.get('PJRT_NAMES_AND_LIBRARY_PATHS'):\n"
+        "    names.append('pjrt-env')\n"
+        "print('PROBE_PLUGINS', ','.join(names) or '-', flush=True)\n"
+        "import jax, jax.numpy as jnp\n"
+        "jax.devices()\n"
+        "(jnp.ones((128, 128)) @ jnp.ones((128, 128))).block_until_ready()\n"
+        "print('PROBE_OK', jax.default_backend())\n"
     )
     deadline = time.monotonic() + budget_s
     attempt = fast_fails = 0
@@ -70,20 +88,32 @@ def probe_relay(budget_s: float, probe_timeout: float = 75.0) -> bool:
                       "backend — no chip in this environment, not retrying",
                       file=sys.stderr, flush=True)
                 return False
-            # completed-but-failed (rc != 0): could be a transient relay
-            # error OR deterministic breakage (broken install, plugin that
-            # raises). Three consecutive FAST failures = deterministic —
-            # stop burning the budget on them; a wedge manifests as a
-            # hang/timeout, never as a quick clean exit.
-            if time.monotonic() - t0 < 10.0:
+            # completed-but-failed (rc != 0): transient relay error, or
+            # deterministic breakage? The plugin marker decides. Plugin
+            # PRESENT -> init failed, which is exactly what a bouncing
+            # relay looks like: keep probing within the budget. Plugin
+            # ABSENT (or the child died before the marker) -> three
+            # consecutive fast failures = deterministic, stop burning the
+            # budget; a wedge manifests as a hang/timeout, never as a
+            # quick clean exit.
+            marker = [ln for ln in p.stdout.splitlines()
+                      if ln.startswith("PROBE_PLUGINS ")]
+            plugin_present = bool(marker) and marker[0].split(None, 1)[1] != "-"
+            if time.monotonic() - t0 < 10.0 and not plugin_present:
                 fast_fails += 1
                 if fast_fails >= 3:
                     print(f"[probe] attempt {attempt}: third consecutive "
-                          "fast failure — deterministic, not retrying; "
+                          "fast failure with no PJRT plugin registered — "
+                          "deterministic, not retrying; "
                           f"last stderr: {p.stderr.strip()[-200:]}",
                           file=sys.stderr, flush=True)
                     return False
             else:
+                if plugin_present and p.returncode != 0:
+                    print(f"[probe] attempt {attempt}: plugin present "
+                          f"({marker[0].split(None, 1)[1]}) but init "
+                          "failed — transient, keep probing",
+                          file=sys.stderr, flush=True)
                 fast_fails = 0
         except subprocess.TimeoutExpired:
             fast_fails = 0

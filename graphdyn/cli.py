@@ -250,10 +250,17 @@ def build_parser() -> argparse.ArgumentParser:
     ent.add_argument("--checkpoint-interval", type=float, default=30.0)
     _add_resilience_flags(ent)
     ent.add_argument(
+        "--group-size", type=int, default=None, metavar="G",
+        help="advance G grid cells' λ-ladders at a time as ONE batched "
+             "device program over stacked ragged BDCM tables (element-wise "
+             "identical to the serial cell loop; default: auto, "
+             "min(cells, 8); 0 forces the legacy serial cell loop)",
+    )
+    ent.add_argument(
         "--prefetch", type=int, default=2, metavar="D",
-        help="build up to D upcoming grid-cell ER graphs on a background "
-             "thread while the current cell sweeps (deterministic; 0 "
-             "disables)",
+        help="build up to D upcoming grid cells' ER graphs + BDCM tables "
+             "on a background thread while the current cells sweep "
+             "(deterministic; 0 disables)",
     )
     _add_dtype_flag(ent, "float64 matches the reference's precision "
                           "(enables x64)")
@@ -471,7 +478,7 @@ def _run(args) -> int:
         rows = consensus_curve(
             g, args.replicas, args.m0, args.max_steps, chunk=args.chunk,
             nbr_dev=nbr_dev, deg_dev=deg_dev, rule=args.rule, tie=args.tie,
-            near_eps=args.near_eps, mesh=mesh,
+            near_eps=args.near_eps, mesh=mesh, graph_seed=args.seed,
         )
         doc = consensus_doc(
             g, n_iso, rows, c=args.c, seed=args.seed, rule=args.rule,
@@ -586,7 +593,7 @@ def _run(args) -> int:
             verbose=args.verbose, save_path=args.out,
             checkpoint_path=args.checkpoint,
             checkpoint_interval_s=args.checkpoint_interval,
-            prefetch=args.prefetch,
+            prefetch=args.prefetch, group_size=args.group_size,
         )
         if args.plot:
             from graphdyn.plotting import plot_entropy_grid

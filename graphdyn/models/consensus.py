@@ -144,7 +144,7 @@ def consensus_curve_ensemble(n: int, R: int, m0_list: Sequence[float],
         rows = consensus_curve(
             g, R, m0_list, max_steps, chunk, nbr_dev=nbr_dev,
             deg_dev=deg_dev, rule=rule, tie=tie, near_eps=near_eps,
-            mesh=mesh,
+            mesh=mesh, graph_seed=s,
             progress=(lambda pt, s=s: progress(s, pt)) if progress else None,
         )
         per_seed.append({"graph_seed": int(s), "n": g.n,
@@ -251,19 +251,32 @@ def consensus_doc(g, n_iso: int, rows: list[dict], *, c: float = 6.0,
     }
 
 
+def draw_seed(graph_seed: int, k: int) -> int:
+    """The replica-draw seed for curve point ``k`` on graph instance
+    ``graph_seed``: both coordinates folded through a SeedSequence (stable,
+    platform-independent mixing — NOT Python's process-randomized
+    ``hash``), so every (instance, point) pair draws an independent initial
+    replica set. The pre-fix derivation (``1000 + k`` alone) gave every
+    ensemble instance the SAME initial spins at each m(0) — instance
+    spread was graph-only, under-measuring the replica noise."""
+    return int(np.random.SeedSequence([int(graph_seed), 1000 + int(k)])
+               .generate_state(1)[0])
+
+
 def consensus_curve(g, R: int, m0_list: Sequence[float], max_steps: int,
                     chunk: int = 10, nbr_dev=None, deg_dev=None,
                     rule: str = "majority", tie: str = "stay",
                     near_eps: float = 0.01, mesh=None,
-                    progress=None) -> list[dict]:
-    """The m(0)→consensus curve as a list of row dicts (one per m(0), seed
-    offset 1000+k so points are independent). ``progress`` is an optional
-    per-row callback (e.g. a print); ``mesh`` word-shards every point (see
-    :func:`consensus_point`)."""
+                    progress=None, graph_seed: int = 0) -> list[dict]:
+    """The m(0)→consensus curve as a list of row dicts (one per m(0); the
+    replica-draw seed folds ``(graph_seed, k)`` via :func:`draw_seed`, so
+    points are independent of each other AND of other ensemble instances).
+    ``progress`` is an optional per-row callback (e.g. a print); ``mesh``
+    word-shards every point (see :func:`consensus_point`)."""
     rows = []
     for k, m0 in enumerate(m0_list):
         pt = consensus_point(
-            g, R, m0, max_steps, chunk, seed=1000 + k,
+            g, R, m0, max_steps, chunk, seed=draw_seed(graph_seed, k),
             nbr_dev=nbr_dev, deg_dev=deg_dev, rule=rule, tie=tie,
             near_eps=near_eps, mesh=mesh,
         )

@@ -32,13 +32,7 @@ from graphdyn.config import EntropyConfig
 from graphdyn.resilience import faults as _faults
 from graphdyn.resilience.shutdown import raise_if_requested, shutdown_requested
 from graphdyn.graphs import Graph, erdos_renyi_graph, remove_isolates
-from graphdyn.ops.bdcm import (
-    BDCMData,
-    make_free_entropy,
-    make_leaf_setter,
-    make_mean_m_init,
-    make_sweep,
-)
+from graphdyn.ops.bdcm import BDCMData, make_leaf_setter
 
 log = logging.getLogger("graphdyn.models")
 
@@ -176,7 +170,7 @@ def _run_ladder(
             # the uninterrupted run had already exited inside the prefix
             return visited, ents, m_inits, ent1s, sweeps, nonconverged, chi
     for lmbd in lambdas:
-        # graftlint: disable-next-line=GD008  one SCALAR λ per ladder step — the warm-started ladder is inherently sequential, there is no table to stack
+        # graftlint: disable-next-line=GD008  one SCALAR λ per ladder step — the ladder is sequential in λ (warm starts); the CELL axis is what batches, via pipeline.entropy_group (entropy_grid group_size)
         lm = jnp.asarray(lmbd, dtype)
         chi = set_leaves(chi, lm)
         chi, t, delta = fixed_point(chi, lm)
@@ -286,11 +280,26 @@ def entropy_sweep(
     (plus, when ``config.plateau_eps > 0``, the visited prefix's
     ``(m_init, ent1)`` rows as ``prev_rows`` so the plateau streak resumes
     where it left off).
+
+    The ladder advances through the ensemble pipeline's shared cell-group
+    program (:class:`graphdyn.pipeline.entropy_group.EntropyCellExec` with
+    G=1; ARCHITECTURE.md "Ensemble pipeline"): the grouped ``entropy_grid``
+    driver runs the SAME vmapped body at G=``group_size``, which is what
+    makes serial-vs-grouped cell results element-wise identical — the PR-3
+    lesson that two *differently structured* loop programs computing the
+    same chain law diverge at the ulp level under XLA fusion. Regression-
+    anchored against the pre-refactor serial values. (As with ``hpr_solve``,
+    the shared cell program is the pure-XLA sweep core: the fused Pallas
+    kernel the pre-refactor serial fixed point could select on TPU is not
+    batched over cells, so the ladder trades it for cell parallelism; the
+    Pallas sweep remains available via ``make_sweep``/``make_fixed_point``.)
     """
     config = config or EntropyConfig()
     dyn = config.dynamics
     n_total = n_total or graph.n
     sub, n_iso = remove_isolates(graph)
+
+    from graphdyn.pipeline.entropy_group import EntropyCellExec
 
     data = BDCMData(
         sub,
@@ -302,14 +311,10 @@ def entropy_sweep(
         class_bucket=class_bucket,
         dtype=config.dtype,
     )
-    fixed_point = make_fixed_point(data, config)
-    set_leaves = make_leaf_setter(data)
-    phi_fn = make_free_entropy(
-        data, n_total=n_total, n_iso=n_iso, eps_clamp=config.eps_clamp
-    )
-    minit_fn = make_mean_m_init(
-        data, n_total=n_total, n_iso=n_iso, eps_clamp=config.eps_clamp
-    )
+    ex = EntropyCellExec([(data, n_total, n_iso)], config)
+    fixed_point = ex.fixed_point1
+    set_leaves = ex.set_leaves1
+    phi_fn, minit_fn = ex.observe_fns(0)
 
     if lambdas is None:
         lambdas = lambda_ladder(config)
@@ -889,6 +894,87 @@ class EntropyGridResult(NamedTuple):
                                 # None on grids built by pre-r4 callers
 
 
+def _next_cell_after(cell, num_rep: int):
+    """The (deg, rep) cell after ``cell`` in grid iteration order."""
+    di, rep = cell
+    return (di, rep + 1) if rep + 1 < num_rep else (di + 1, 0)
+
+
+def _load_grid_resume(checkpoint_path, grid_id, grids, lambdas, max_sweeps):
+    """Load + normalize an entropy-grid snapshot into ``(start_cell,
+    resume_cells, done_cells)`` — the ONE reader both execution paths use.
+
+    Two writer formats, interchangeable by construction:
+
+    - the SERIAL in-flight-cell format (``deg_index``/``rep``/
+      ``lmbd_offset`` + the cell's λ-segment arrays + ``chi``) written by
+      the per-cell ladder's :class:`_GridCheckpointAdapter`;
+    - the GROUPED format (``cells`` = per-in-flight-cell ``[di, rep,
+      visited, failed]`` + per-cell ``chi_<di>_<rep>`` arrays +
+      ``done_cells``), which ALSO carries the serial keys for its first
+      in-flight cell, so a ``group_size=0`` rerun can resume a grouped
+      snapshot (and vice versa — per-cell results depend only on the cell
+      seed and its λ cursor, so regrouping cannot change them).
+    """
+    from graphdyn.utils.io import load_validated
+
+    loaded = load_validated(checkpoint_path, "grid_id", grid_id,
+                            "entropy grid")
+    if loaded is None:
+        return (0, 0), {}, set()
+    arrays, meta = loaded
+    for key, arr in grids.items():
+        if key in arrays:
+            arr[:] = arrays[key]
+    resume: dict = {}
+    done: set = set()
+    ent1 = grids["grid_ent1"]
+    if "cells" in meta:
+        start = tuple(int(v) for v in meta["next_cell"])
+        for di, rep, vis, failed in meta["cells"]:
+            di, rep, vis = int(di), int(rep), int(vis)
+            if vis < 1:
+                continue                      # never visited: cold start
+            resume[(di, rep)] = {
+                "chi": arrays[f"chi_{di}_{rep}"],
+                "visited": vis,
+                "last_lmbd": float(lambdas[vis - 1]),
+                "last_e1": float(ent1[di, rep, vis - 1]),
+                "failed": bool(failed),
+            }
+        for di, rep in meta.get("done_cells", []):
+            done.add((int(di), int(rep)))
+    else:
+        start = (int(meta["deg_index"]), int(meta["rep"]))
+        # the interrupted cell: λ points [k_off, k_off+seg) of the ladder
+        # live in the sweep-local arrays; earlier segments of a
+        # twice-interrupted cell are already in the grid rows
+        k_off = int(meta.get("lmbd_offset", 0))
+        seg = int(arrays["lambdas"].size)
+        sl = slice(k_off, k_off + seg)
+        grids["grid_ent"][start[0], start[1], sl] = arrays["ent"]
+        grids["grid_m_init"][start[0], start[1], sl] = arrays["m_init"]
+        ent1[start[0], start[1], sl] = arrays["ent1"]
+        if "grid_sweeps" in grids:
+            # keep the restored cell's per-λ sweep counts truthful for any
+            # later grouped snapshot's compat "sweeps" segment
+            grids["grid_sweeps"][start[0], start[1], sl] = arrays["sweeps"]
+        resume[start] = {
+            "chi": arrays["chi"],
+            "visited": k_off + seg,
+            "last_lmbd": float(arrays["lambdas"][-1]),
+            "last_e1": float(arrays["ent1"][-1]),
+            # the recorded flag, not a sweeps>=max inference — a fixed
+            # point that converges on exactly the last allowed sweep is
+            # NOT a failure (legacy snapshots without the flag fall back
+            # to the inference)
+            "failed": bool(meta.get(
+                "failed", int(arrays["sweeps"][-1]) >= max_sweeps,
+            )),
+        }
+    return start, resume, done
+
+
 def entropy_grid(
     n: int,
     deg_grid: np.ndarray,
@@ -902,34 +988,50 @@ def entropy_grid(
     checkpoint_interval_s: float = 30.0,
     class_bucket: int | None = 64,
     prefetch: int = 2,
+    group_size: int | None = None,
 ) -> EntropyGridResult:
     """The notebook's full experiment driver: deg-grid × repetitions × λ
     ladder on fresh ER instances (`ipynb:496-513`); ``save_path`` persists
     the result grids npz-style (the commented save at `ipynb:515`).
 
-    ``prefetch`` overlaps the host-side ER sampling of upcoming grid cells
-    with the current cell's device sweep (a bounded background thread —
-    ARCHITECTURE.md "Ensemble pipeline"; 0 disables the thread). Each
+    ``group_size`` selects the execution pipeline (ARCHITECTURE.md
+    "Ensemble pipeline"). Default (None → ``min(cells, 8)``): the grid's
+    (deg, rep) cells advance through their λ-ladders ``group_size`` at a
+    time as ONE vmapped device program over stacked ragged BDCM tables
+    (:mod:`graphdyn.pipeline.entropy_group`) — the ladder is sequential in
+    λ but embarrassingly parallel across cells; each cell keeps its own λ
+    cursor, warm-start chi, and early exits, frozen by an active mask once
+    stopped. Element-wise identical to the serial loop (one shared program
+    family — ``entropy_sweep`` runs the G=1 instance). ``group_size=0``
+    forces the legacy serial cell loop.
+
+    ``prefetch`` overlaps the host-side ER sampling (and, grouped, the
+    BDCM table builds) of upcoming grid cells with the current cells'
+    device sweeps (a bounded background thread — 0 disables it). Each
     cell's graph depends only on its ``seed + 1000·di + rep``, so the
-    overlap cannot change results. Cell batching itself stays the λ-warm-
-    started sequential ladder; for device-batched ER ensembles use
-    :func:`entropy_ensemble_union` (the ``--union`` CLI path).
+    overlap cannot change results. For device-batched ER ensembles of a
+    single degree use :func:`entropy_ensemble_union` (the ``--union`` CLI
+    path).
 
     ``checkpoint_path`` enables time-triggered intermediate saves every
     ``checkpoint_interval_s`` seconds (the notebook's ``saving_time=30``
     sketch, `ipynb:439-445,475-476`) — **and exact resume**: a rerun
     pointing at an existing checkpoint restores every completed grid cell,
-    re-enters the interrupted cell at the first unvisited λ with the saved
-    warm-start chi (λ-granular — exactly the state the uninterrupted run
-    would carry, so the continuation is bit-exact), and refuses a
-    checkpoint whose run identity (n, grid, config, seed, sampler)
-    mismatches. Fitting, given that the reference notebook's own stored run
-    ends in a KeyboardInterrupt (`ipynb:47-49`). The file is removed on
-    completion."""
+    re-enters each interrupted cell at its first unvisited λ with its
+    saved warm-start chi (λ-granular — exactly the state the
+    uninterrupted run would carry, so the continuation is bit-exact), and
+    refuses a checkpoint whose run identity (n, grid, config, seed,
+    sampler) mismatches. Snapshots are interchangeable between the serial
+    and grouped paths and across group sizes (see :func:`_load_grid_resume`).
+    Fitting, given that the reference notebook's own stored run ends in a
+    KeyboardInterrupt (`ipynb:47-49`). The file is removed on completion."""
     config = config or EntropyConfig()
+    dyn = config.dynamics
     lambdas = lambda_ladder(config)
     L = lambdas.size
     D, Rr = len(deg_grid), config.num_rep
+    if group_size is None:
+        group_size = min(max(D * Rr, 1), 8)
 
     ent = np.zeros((D, Rr, L))
     m_init = np.zeros((D, Rr, L))
@@ -940,122 +1042,257 @@ def entropy_grid(
     mean_degrees_total = np.zeros((D, Rr))
     counts = np.zeros((D, Rr))
     n_lambda = np.zeros((D, Rr), np.int64)
+    sweeps_grid = np.zeros((D, Rr, L), np.int64)    # snapshot payloads only
     grids = {
         "grid_ent": ent, "grid_m_init": m_init, "grid_ent1": ent1,
         "grid_counts": counts, "grid_nodes_isolated": nodes_isolated,
         "grid_mean_degrees": mean_degrees, "grid_max_degrees": max_degrees,
         "grid_mean_degrees_total": mean_degrees_total,
         "grid_n_lambda": n_lambda,
+        # persisted so a twice-interrupted grouped run's compat "sweeps"
+        # segment stays truthful across resumes (serial-written snapshots
+        # predate this key; the loader's `if key in arrays` guard copes)
+        "grid_sweeps": sweeps_grid,
     }
 
     checkpointer = None
-    start_di = start_rep = 0
-    resume_cell = None
+    grid_id = None
+    resume_cells: dict = {}
+    done_cells: set = set()
+    start_cell = (0, 0)
     if checkpoint_path is not None:
-        from graphdyn.utils.io import (
-            PeriodicCheckpointer, load_validated, run_fingerprint,
-        )
+        from graphdyn.utils.io import PeriodicCheckpointer, run_fingerprint
 
         grid_id = run_fingerprint(
             n, np.asarray(deg_grid, float), config, seed, graph_method,
             class_bucket,
         )
-        loaded = load_validated(checkpoint_path, "grid_id", grid_id,
-                                "entropy grid")
-        if loaded is not None:
-            arrays, meta = loaded
-            start_di, start_rep = int(meta["deg_index"]), int(meta["rep"])
-            for key, arr in grids.items():
-                if key in arrays:
-                    arr[:] = arrays[key]
-            # the interrupted cell: λ points [k_off, k_off+seg) of the
-            # ladder live in the sweep-local arrays; earlier segments of a
-            # twice-interrupted cell are already in the grid rows
-            k_off = int(meta.get("lmbd_offset", 0))
-            seg = int(arrays["lambdas"].size)
-            sl = slice(k_off, k_off + seg)
-            ent[start_di, start_rep, sl] = arrays["ent"]
-            m_init[start_di, start_rep, sl] = arrays["m_init"]
-            ent1[start_di, start_rep, sl] = arrays["ent1"]
-            resume_cell = {
-                "chi": arrays["chi"],
-                "visited": k_off + seg,
-                "last_lmbd": float(arrays["lambdas"][-1]),
-                "last_e1": float(arrays["ent1"][-1]),
-                # the recorded flag, not a sweeps>=max inference — a fixed
-                # point that converges on exactly the last allowed sweep is
-                # NOT a failure (legacy snapshots without the flag fall back
-                # to the inference)
-                "failed": bool(meta.get(
-                    "failed",
-                    int(arrays["sweeps"][-1]) >= config.max_sweeps,
-                )),
-            }
+        start_cell, resume_cells, done_cells = _load_grid_resume(
+            checkpoint_path, grid_id, grids, lambdas, config.max_sweeps,
+        )
         checkpointer = PeriodicCheckpointer(
             checkpoint_path, interval_s=checkpoint_interval_s
         )
+
+    # resume cells that had already stopped (failed / entropy floor / full
+    # ladder): record and retire them before any execution
+    for cell, rc in list(resume_cells.items()):
+        if rc["failed"] or rc["last_e1"] < config.ent_floor \
+                or rc["visited"] >= L:
+            di, rep = cell
+            if rc["failed"]:
+                counts[di, rep] = rc["last_lmbd"]
+            n_lambda[di, rep] = rc["visited"]
+            done_cells.add(cell)
+            del resume_cells[cell]
 
     from graphdyn.pipeline.prefetch import HostPrefetcher
 
     pending = [
         (di, rep)
         for di in range(D) for rep in range(Rr)
-        if (di, rep) >= (start_di, start_rep)   # completed cells restored
+        if (di, rep) >= start_cell and (di, rep) not in done_cells
     ]
 
-    def build_cell(ci):
-        di, rep = pending[ci]
-        return erdos_renyi_graph(
-            n, deg_grid[di] / (n - 1), seed=seed + 1000 * di + rep,
-            method=graph_method,
-        )
+    def cell_stats(g, di, rep):
+        live = g.deg[g.deg > 0]
+        nodes_isolated[di, rep] = g.n - live.size
+        mean_degrees[di, rep] = live.mean() if live.size else 0.0
+        max_degrees[di, rep] = g.deg.max(initial=0)
+        mean_degrees_total[di, rep] = g.deg.mean()
 
-    with HostPrefetcher(build_cell, range(len(pending)), depth=prefetch) as pf:
-        for ci, (di, rep) in enumerate(pending):
-            gseed = seed + 1000 * di + rep
-            g = pf.get(ci)
-            live = g.deg[g.deg > 0]
-            nodes_isolated[di, rep] = g.n - live.size
-            mean_degrees[di, rep] = live.mean() if live.size else 0.0
-            max_degrees[di, rep] = g.deg.max(initial=0)
-            mean_degrees_total[di, rep] = g.deg.mean()
-
-            cell_resume = resume_cell if (di, rep) == (start_di, start_rep) else None
-            k0 = 0
-            chi0 = None
-            if cell_resume is not None:
-                k0 = cell_resume["visited"]
-                chi0 = cell_resume["chi"]
-                failed = cell_resume["failed"]
-                if failed:
-                    counts[di, rep] = cell_resume["last_lmbd"]
-                if failed or cell_resume["last_e1"] < config.ent_floor or k0 >= L:
-                    n_lambda[di, rep] = k0      # cell had already stopped
-                    continue
-
-            ck = None
-            if checkpointer is not None:
-                ck = _GridCheckpointAdapter(
-                    checkpointer,
-                    {"deg_index": di, "rep": rep, "lmbd_offset": k0,
-                     "grid_id": grid_id},
-                    grids,
-                )
-            res = entropy_sweep(
-                g, config, seed=gseed, lambdas=lambdas[k0:], chi0=chi0,
-                verbose=verbose, checkpointer=ck, class_bucket=class_bucket,
-                # restored prefix rows keep the plateau streak (if enabled)
-                # identical to an uninterrupted run's
-                prev_rows=(m_init[di, rep, :k0], ent1[di, rep, :k0])
-                if k0 > 0 else None,
+    if group_size == 0:
+        # legacy serial cell loop: one warm-started ladder at a time
+        def build_cell(ci):
+            di, rep = pending[ci]
+            return erdos_renyi_graph(
+                n, deg_grid[di] / (n - 1), seed=seed + 1000 * di + rep,
+                method=graph_method,
             )
-            k = res.lambdas.size
-            sl = slice(k0, k0 + k)
-            ent[di, rep, sl] = res.ent
-            m_init[di, rep, sl] = res.m_init
-            ent1[di, rep, sl] = res.ent1
-            counts[di, rep] = res.nonconverged
-            n_lambda[di, rep] = k0 + k
+
+        with HostPrefetcher(build_cell, range(len(pending)),
+                            depth=prefetch) as pf:
+            for ci, (di, rep) in enumerate(pending):
+                gseed = seed + 1000 * di + rep
+                g = pf.get(ci)
+                cell_stats(g, di, rep)
+                rc = resume_cells.get((di, rep))
+                k0 = rc["visited"] if rc is not None else 0
+                chi0 = rc["chi"] if rc is not None else None
+
+                ck = None
+                if checkpointer is not None:
+                    ck = _GridCheckpointAdapter(
+                        checkpointer,
+                        {"deg_index": di, "rep": rep, "lmbd_offset": k0,
+                         "grid_id": grid_id},
+                        grids,
+                    )
+                res = entropy_sweep(
+                    g, config, seed=gseed, lambdas=lambdas[k0:], chi0=chi0,
+                    verbose=verbose, checkpointer=ck,
+                    class_bucket=class_bucket,
+                    # restored prefix rows keep the plateau streak (if
+                    # enabled) identical to an uninterrupted run's
+                    prev_rows=(m_init[di, rep, :k0], ent1[di, rep, :k0])
+                    if k0 > 0 else None,
+                )
+                k = res.lambdas.size
+                sl = slice(k0, k0 + k)
+                ent[di, rep, sl] = res.ent
+                m_init[di, rep, sl] = res.m_init
+                ent1[di, rep, sl] = res.ent1
+                sweeps_grid[di, rep, sl] = res.sweeps
+                counts[di, rep] = res.nonconverged
+                n_lambda[di, rep] = k0 + k
+    else:
+        from graphdyn.pipeline.entropy_group import (
+            EntropyCellExec, run_cell_ladder,
+        )
+        from graphdyn.pipeline.groups import group_ranges
+
+        def build_group_cell(ci):
+            # everything that depends only on the cell coordinates, so the
+            # prefetch thread can run it ahead: ER sample + BDCM tables
+            di, rep = pending[ci]
+            g = erdos_renyi_graph(
+                n, deg_grid[di] / (n - 1), seed=seed + 1000 * di + rep,
+                method=graph_method,
+            )
+            sub, n_iso = remove_isolates(g)
+            data = BDCMData(
+                sub, p=dyn.p, c=dyn.c, attr_value=dyn.attr_value,
+                rule=dyn.rule, tie=dyn.tie, class_bucket=class_bucket,
+                dtype=config.dtype,
+            )
+            return g, data, n_iso
+
+        with HostPrefetcher(build_group_cell, range(len(pending)),
+                            depth=prefetch) as pf:
+            for ks in group_ranges(0, len(pending), group_size):
+                items = [pf.get(ci) for ci in ks]
+                cellmap = [pending[ci] for ci in ks]
+                cells, k0s, chis, prevs = [], [], [], []
+                for (di, rep), (g, data, n_iso) in zip(cellmap, items):
+                    cell_stats(g, di, rep)
+                    cells.append((data, g.n, n_iso))
+                    rc = resume_cells.get((di, rep))
+                    k0 = rc["visited"] if rc is not None else 0
+                    if k0 > 0:
+                        # the restored prefix counts as visited even when
+                        # the cell exits immediately (plateau in prefix)
+                        n_lambda[di, rep] = k0
+                    k0s.append(k0)
+                    chis.append(
+                        np.asarray(rc["chi"])
+                        if rc is not None
+                        else np.asarray(
+                            data.init_messages(seed + 1000 * di + rep)
+                        )
+                    )
+                    prevs.append(
+                        (m_init[di, rep, :k0], ent1[di, rep, :k0])
+                        if k0 > 0 else None
+                    )
+                ex = EntropyCellExec(cells, config, group_size=group_size)
+
+                def record(gi, kk, lmv, phi, m0, e1, sw, failed,
+                           _cm=cellmap):
+                    di, rep = _cm[gi]
+                    ent[di, rep, kk] = phi
+                    m_init[di, rep, kk] = m0
+                    ent1[di, rep, kk] = e1
+                    sweeps_grid[di, rep, kk] = sw
+                    n_lambda[di, rep] = kk + 1
+                    if failed:
+                        counts[di, rep] = lmv
+
+                def boundary(stopping, info, _cm=cellmap):
+                    if checkpointer is None or not (
+                        stopping or checkpointer.due()
+                    ):
+                        return
+                    inflight = sorted(info, key=lambda d_: _cm[d_["g"]])
+                    visited = [d_ for d_ in inflight if d_["visited"] >= 1]
+                    if inflight and not visited:
+                        # nothing recorded yet for any in-flight cell: a
+                        # snapshot would carry no resumable state beyond
+                        # the previous one — skip (cold starts re-derive)
+                        return
+                    if inflight:
+                        next_cell = _cm[inflight[0]["g"]]
+                        # serial-FORMAT keys describing the FIRST in-flight
+                        # cell (== next_cell, so they can never point past
+                        # a still-running earlier cell). They are
+                        # DIAGNOSTIC legibility only — resume interop, in
+                        # both directions, goes through
+                        # _load_grid_resume's normalized "cells" branch,
+                        # never through these keys
+                        lead = inflight[0]
+                        di0, rep0 = next_cell
+                        vis0 = lead["visited"]
+                    else:
+                        # the whole group retired at this boundary: mark
+                        # the next grid cell and keep the last group cell
+                        # as the (complete) serial-compat in-flight record
+                        next_cell = _next_cell_after(max(_cm), Rr)
+                        di0, rep0 = max(_cm)
+                        vis0 = int(n_lambda[di0, rep0])
+                        lead = None
+                    arrays = dict(grids)
+                    for d_ in inflight:
+                        di, rep = _cm[d_["g"]]
+                        arrays[f"chi_{di}_{rep}"] = d_["chi"]
+                    arrays["chi"] = (
+                        lead["chi"] if lead is not None
+                        else arrays[f"chi_{di0}_{rep0}"]
+                        if f"chi_{di0}_{rep0}" in arrays else
+                        np.zeros((0,), np.float32)
+                    )
+                    arrays["lambdas"] = lambdas[:vis0]
+                    arrays["ent"] = ent[di0, rep0, :vis0].copy()
+                    arrays["m_init"] = m_init[di0, rep0, :vis0].copy()
+                    arrays["ent1"] = ent1[di0, rep0, :vis0].copy()
+                    arrays["sweeps"] = sweeps_grid[di0, rep0, :vis0].copy()
+                    inflight_set = {_cm[d_["g"]] for d_ in inflight}
+                    known_done = done_cells | (set(_cm) - inflight_set)
+                    meta = {
+                        "grid_id": grid_id,
+                        "deg_index": di0, "rep": rep0, "lmbd_offset": 0,
+                        "lmbd": (lead["lmbd"] if lead is not None
+                                 else float(lambdas[max(vis0 - 1, 0)])),
+                        "failed": bool(lead["failed"]) if lead is not None
+                        else bool(counts[di0, rep0]),
+                        "next_cell": list(next_cell),
+                        "cells": [
+                            [*_cm[d_["g"]], d_["visited"],
+                             bool(d_["failed"])]
+                            for d_ in visited
+                        ],
+                        "done_cells": sorted(
+                            [list(c) for c in known_done
+                             if c >= next_cell]
+                        ),
+                    }
+                    if stopping:
+                        checkpointer.save_now(arrays, meta)
+                    else:
+                        checkpointer.maybe_save(arrays, meta)
+
+                run_cell_ladder(
+                    ex, chis, lambdas,
+                    eps=config.eps, ent_floor=config.ent_floor,
+                    k0=k0s, plateau_eps=config.plateau_eps,
+                    plateau_patience=config.plateau_patience,
+                    prev_rows=prevs, record=record,
+                    # no callback at all without checkpointing: the runner
+                    # keys its per-boundary chi device→host captures off
+                    # `boundary is not None`, and an uncheckpointed run
+                    # must not pay one [2E, K, K] transfer per cell per λ
+                    boundary=boundary if checkpointer is not None else None,
+                    verbose=verbose,
+                )
+                done_cells.update(cellmap)
 
     out = EntropyGridResult(
         deg=np.asarray(deg_grid),

@@ -614,6 +614,116 @@ class EnsembleBDCM:
         return jnp.asarray(chi, self.dtype)
 
 
+class StackedBDCM:
+    """Stacked per-cell BDCM edge tables for a RAGGED ensemble — graphs that
+    need NOT be congruent (different edge counts, different degree-class
+    signatures: the entropy grid's ER cells across a whole deg × rep plane).
+
+    Where :class:`EnsembleBDCM` demands one shared class signature,
+    :func:`stack_bdcm` takes the UNION of the cells' degree classes and pads
+    every class table to the class's maximum population ``Ed_max`` across
+    cells: padded members gather from the ghost message row ``2E_max`` and
+    scatter their garbage updates back to it (the exact ghost mechanism
+    :func:`_pad_class` already uses per graph, lifted to the cell axis), so
+    a cell that lacks a class entirely just runs that class as all-ghost
+    rows. chi stacks to ``[G, 2E_max, K, K]`` with rows past a cell's own
+    ``2E`` held constant (they are never indexed, so they contribute 0 to
+    the per-cell convergence delta).
+
+    Only the SWEEP tables are stacked — observables (φ, m_init) run per
+    cell through the serial executors on the cell's own ``chi[:2E]`` slice,
+    which is what keeps grouped observables bit-identical to the serial
+    ladder by construction (see ``graphdyn.pipeline.entropy_group``).
+    """
+
+    def __init__(self, datas: list[BDCMData]):
+        if not datas:
+            raise ValueError("empty cell stack")
+        d0 = datas[0]
+        for dd in datas[1:]:
+            if (
+                dd.p != d0.p
+                or dd.c != d0.c
+                or dd.attr_value != d0.attr_value
+                or dd.rule != d0.rule
+                or dd.tie != d0.tie
+                or dd.dtype != d0.dtype
+            ):
+                raise ValueError(
+                    "stacked cells must share dynamics parameters and dtype "
+                    "(p, c, attr_value, rule, tie, dtype) — factor tensors "
+                    "are shared"
+                )
+        self.datas = datas
+        self.G = len(datas)
+        self.T, self.K = d0.T, d0.K
+        self.dtype = d0.dtype
+        self.valid = d0.valid
+        self.x0 = d0.x0
+        self.leaf01 = d0.leaf01
+        self.twoE = np.asarray([dd.num_directed for dd in datas])
+        self.num_edges = np.asarray([dd.num_edges for dd in datas])
+        self.twoE_max = int(self.twoE.max())
+        ghost = self.twoE_max                 # row 2E_max of the extended chi
+
+        def remap(arr, dd):
+            # per-cell ghost references (class_bucket padding points at the
+            # CELL's own ghost row 2E_g) move to the stacked ghost row
+            out = np.asarray(arr, np.int64)
+            return np.where(out == dd.num_directed, ghost, out)
+
+        ds = sorted({cls.d for dd in datas for cls in dd.edge_classes})
+        self.edge_classes = []
+        for d in ds:
+            percell = [
+                next((c for c in dd.edge_classes if c.d == d), None)
+                for dd in datas
+            ]
+            Ed = max(c.idx.shape[0] for c in percell if c is not None)
+            idx = np.full((self.G, Ed), ghost, np.int64)
+            in_edges = np.full((self.G, Ed, d), ghost, np.int64)
+            A = next(c for c in percell if c is not None).A
+            for g, (dd, c) in enumerate(zip(datas, percell)):
+                if c is None:
+                    continue
+                m = c.idx.shape[0]
+                idx[g, :m] = remap(c.idx, dd)
+                in_edges[g, :m] = remap(c.in_edges, dd)
+            self.edge_classes.append((d, idx, in_edges, A))
+
+        L = max(dd.leaf_idx.size for dd in datas)
+        self.leaf_idx = np.full((self.G, L), ghost, np.int64)
+        for g, dd in enumerate(datas):
+            self.leaf_idx[g, :dd.leaf_idx.size] = remap(dd.leaf_idx, dd)
+
+    def stack_chi(self, chi_list) -> jnp.ndarray:
+        """Stack per-cell chi arrays ``[2E_g, K, K]`` to ``[G, 2E_max, K,
+        K]``; pad rows hold the uniform message (constant — never indexed
+        by any cell's tables, so they stay fixed through every sweep)."""
+        if len(chi_list) != self.G:
+            raise ValueError(f"need {self.G} chi arrays, got {len(chi_list)}")
+        K = self.K
+        out = np.full(
+            (self.G, self.twoE_max, K, K), 1.0 / (K * K),
+            dtype=np.dtype(self.dtype),
+        )
+        for g, (chi, e2) in enumerate(zip(chi_list, self.twoE)):
+            chi = np.asarray(chi)
+            if chi.shape != (e2, K, K):
+                raise ValueError(
+                    f"cell {g}: chi shape {chi.shape} != {(int(e2), K, K)}"
+                )
+            out[g, :e2] = chi
+        return jnp.asarray(out)
+
+
+def stack_bdcm(data_list: list[BDCMData]) -> StackedBDCM:
+    """Stack ragged per-cell BDCM tables into the ``[G, Ed_max, …]`` layout
+    of :class:`StackedBDCM` (padding with the existing ghost-row
+    machinery). The table half of the cell-parallel entropy pipeline."""
+    return StackedBDCM(data_list)
+
+
 def make_ensemble_sweep(
     ens: EnsembleBDCM,
     *,

@@ -265,9 +265,11 @@ def replicate(mesh: Mesh, x):
 
 def shard_stack(mesh: Mesh, x, axis: str = "group"):
     """Place one array of an ensemble-pipeline stack: leading ``[G, ...]``
-    axes shard over ``axis`` (repetitions are independent, so the
-    partitioned group program is communication-free except its stop test);
-    scalars replicate. The placement helper the grouped solvers use to
-    consume the stacked layout on a mesh — results are bit-identical to the
-    unsharded program (tested)."""
+    axes shard over ``axis`` (repetitions — SA/HPr groups — and entropy
+    grid CELLS are independent, so the partitioned group program is
+    communication-free except its stop test); scalars replicate. The
+    placement helper the grouped solvers use to consume the stacked layout
+    on a mesh — ``run_sa_group(mesh=...)`` shards repetitions,
+    ``EntropyCellExec(mesh=..., cell_axis=...)`` shards the entropy cell
+    axis — results are bit-identical to the unsharded program (tested)."""
     return shard_batch(mesh, x, axis) if np.ndim(x) else replicate(mesh, x)

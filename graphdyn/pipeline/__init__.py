@@ -19,11 +19,14 @@ rates:
   resumed jobs skip the multi-second XLA compile.
 """
 
+from graphdyn.pipeline.entropy_group import EntropyCellExec, run_cell_ladder
 from graphdyn.pipeline.groups import GroupDriver, group_ranges
 from graphdyn.pipeline.prefetch import HostPrefetcher
 
 __all__ = [
+    "EntropyCellExec",
     "GroupDriver",
     "HostPrefetcher",
     "group_ranges",
+    "run_cell_ladder",
 ]

@@ -1,0 +1,546 @@
+"""Cell-parallel BDCM λ-ladders — the entropy half of the ensemble pipeline
+(ARCHITECTURE.md "Ensemble pipeline").
+
+The entropy grid (`graphdyn.models.entropy.entropy_grid`) is the repo's
+slowest workload: every (deg, rep) cell runs a warm-started λ-ladder of
+~10² fixed-point sweeps per λ, and the serial driver runs the cells one
+after another — the ladder is sequential *in λ* (each λ warm-starts from
+the previous fixed point; there is nothing to batch along that axis) but
+embarrassingly parallel *across cells*, the same replica-parallel structure
+the TPU Ising literature exploits (Yang et al., arXiv:1903.11714; Isakov
+et al., arXiv:1401.1084). Here a group of ``G`` cells advances as ONE
+compiled program: the per-cell BDCM index tables stack to ``[G, Ed_max,
+…]`` (:func:`graphdyn.ops.bdcm.stack_bdcm` — ragged edge counts pad to
+``Ed_max`` with the existing ghost-row machinery), chi carries a leading
+cell axis, and each cell solves its OWN λ (a per-cell λ vector — cells sit
+at different ladder positions).
+
+The group program runs in bounded **sweep chunks** rather than joint
+fixed-point barriers: each device call advances every unfinished lane by
+at most ``chunk_sweeps`` sweeps, a lane that reaches ITS OWN fixed point
+freezes mid-chunk (the per-lane while-loop cond — so its sweep count and
+final state are bit-identical to the serial ladder's), and at the chunk
+boundary the host moves converged cells on to their next λ (leaf write +
+carry reset) while slower cells keep iterating. Without this, a joint
+barrier would cost ``G·max(t)`` sweeps per λ against the serial path's
+``sum(t)`` — the chunk scheme bounds the lockstep waste at
+``chunk_sweeps`` per cell per λ. Converged/stopped cells are frozen by an
+active mask (the same pad-row freeze trick as ``sa_group``); plateau /
+entropy-floor / non-convergence exits are evaluated per cell on the host
+at ladder boundaries, exactly as the serial ladder evaluates them.
+
+Element-wise identity with the serial path is structural, the PR-3 lesson:
+:func:`graphdyn.models.entropy.entropy_sweep` itself advances through this
+module's group program at G=1 (as ``hpr_solve`` advances through
+``HPRGroupExec``), so serial-vs-grouped parity is one-program-family
+parity, not a maintained coincidence — the per-row sweep arithmetic
+(:func:`graphdyn.ops.bdcm.class_update`) is row-independent, the per-cell
+convergence delta is a max (reassociation-immune), and the observables
+(φ, m_init) run per cell through the SAME serial executors on the cell's
+own ``chi[:2E]`` slice, never through a re-derived stacked reduction whose
+float schedule could drift at the ulp level. Tested element-wise against
+the pre-refactor serial values (regression anchor) and across group sizes
+including 1 and non-divisors of the cell count.
+
+Checkpoint/fault semantics at ladder boundaries mirror the serial ladder:
+``lambda.boundary`` fires once per cell per visited λ (key
+``lmbd=<value>`` — a plan written against the serial ladder matches the
+same λ); the ``sweep.nan`` site is checked once per completed fixed point
+per cell; shutdown is polled at every chunk boundary and a pending
+graceful shutdown snapshots λ-granularly (each cell's last-boundary chi)
+and raises — see ``entropy_grid`` for the snapshot format shared (and
+interchangeable) with the serial path.
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from graphdyn.resilience import faults as _faults
+from graphdyn.resilience.shutdown import raise_if_requested, shutdown_requested
+from graphdyn.ops.bdcm import (
+    StackedBDCM,
+    class_update,
+    make_free_entropy,
+    make_mean_m_init,
+    stack_bdcm,
+)
+
+log = logging.getLogger("graphdyn.pipeline")
+
+
+class _CellSpec(NamedTuple):
+    """Hashable static configuration of one cell-group program. Everything
+    traced (chi, λ, active mask, carry, index tables) is an argument of the
+    module-level executors, so groups whose stacked table shapes coincide
+    share ONE compiled program (shapes are cell-count + class-population
+    maxima; ``class_bucket`` keeps those stable across ER instances)."""
+
+    T: int
+    K: int
+    damp: float
+    eps_clamp: float
+    eps: float            # fixed-point tolerance (per-cell max|Δchi|)
+    t_max: int            # max_sweeps
+    chunk: int            # sweep budget per device call
+    class_ds: tuple       # union degree-class neighbor counts d
+
+
+@partial(jax.jit, static_argnames=("spec",))
+# warm-start ladders replay chi through leaf-set + fixed-point variants;
+# donation would invalidate their input buffer (same contract as the
+# serial _fixed_point_exec had)
+# graftlint: disable-next-line=GD006  callers reuse chi across variants
+def _cell_chunk_exec(chi, lmbd, active, delta0, t0, valid, x0, tables,
+                     spec: _CellSpec):
+    """One bounded chunk of every cell's fixed point, vmapped over the cell
+    axis: lane g iterates ITS OWN λ's sweep from carry ``(chi_g, delta0_g,
+    t0_g)`` until ``max|Δchi| < eps``, ``t_max``, or ``t0_g + chunk``
+    sweeps. Per-lane freezing is the while_loop batching rule itself — a
+    lane whose cond is False keeps its state bit-for-bit while other lanes
+    advance, so a cell's sweep trajectory is identical to the serial
+    ladder's, merely sliced into chunks. Pad rows past a cell's own 2E are
+    never indexed by its tables, so they stay constant and contribute 0 to
+    the per-cell delta; the ghost row 2E_max is concatenated per sweep,
+    scattered with pad-member garbage, and sliced off — exactly the serial
+    ghost mechanism."""
+    K = spec.K
+    flat = [t for (idx, ie, _) in tables for t in (idx, ie)]
+    As = [A for (_, _, A) in tables]
+
+    def one(c0, lm, act, d0, tt0, *tabs):
+        tilt = jnp.exp(-lm * x0)
+        cap = tt0 + spec.chunk
+
+        def sweep(c):
+            ghost = jnp.full((1,) + c.shape[1:], 1.0 / (K * K), c.dtype)
+            ce = jnp.concatenate([c, ghost], axis=0)
+            for d, A, (idx, ie) in zip(
+                spec.class_ds, As, zip(*[iter(tabs)] * 2)
+            ):
+                chi_in = ce[ie] * valid[None, None, :, None]
+                upd = class_update(
+                    chi_in, A, tilt, ce[idx], d=d, T=spec.T, K=K,
+                    damp=spec.damp, eps_clamp=spec.eps_clamp,
+                )
+                ce = ce.at[idx].set(upd)
+            return ce[:-1]
+
+        def cond(st):
+            _, delta, t = st
+            return (
+                act & (delta > spec.eps) & (t < spec.t_max) & (t < cap)
+            )
+
+        def body(st):
+            c, _, t = st
+            new = sweep(c)
+            return new, jnp.abs(new - c).max(), t + 1
+
+        c, delta, t = lax.while_loop(cond, body, (c0, d0, tt0))
+        return c, t, delta
+
+    return jax.vmap(one, in_axes=(0, 0, 0, 0, 0) + (0,) * len(flat))(
+        chi, lmbd, active, delta0, t0, *flat
+    )
+
+
+@partial(jax.jit, static_argnames=("K",))
+def _cell_set_leaves_exec(chi, lmbd, active, leaf01, x0, leaf_idx, K: int):
+    """Per-cell closed-form leaf messages at the cell's OWN λ; lanes not in
+    ``active`` keep their chi untouched (frozen warm-start or mid-sweep
+    state). Pad leaf slots target the ghost row, which is sliced off."""
+
+    def one(c, lm, act, li):
+        t = leaf01 * jnp.exp(-lm * x0)[:, None]
+        t = t / t.sum()
+        ghost = jnp.full((1,) + c.shape[1:], 1.0 / (K * K), c.dtype)
+        ce = jnp.concatenate([c, ghost], axis=0).at[li].set(t[None])
+        return jnp.where(act, ce[:-1], c)
+
+    return jax.vmap(one, in_axes=(0, 0, 0, 0))(chi, lmbd, active, leaf_idx)
+
+
+class EntropyCellExec:
+    """Compiled-program handle for one (padded) group of entropy λ-ladder
+    cells — stacked ragged tables, static spec, the vmapped leaf-set /
+    chunked fixed-point executors, and per-cell serial observables. The
+    SINGLE program family every entropy ladder runs through:
+    :func:`graphdyn.models.entropy.entropy_sweep` executes a G=1 instance
+    and the grouped ``entropy_grid`` a G=``group_size`` instance of the
+    same vmapped body, which is what makes serial-vs-grouped parity
+    structural (module docstring).
+
+    ``cells``: list of ``(BDCMData, n_total, n_iso)`` per REAL cell (the
+    isolate-removed graph's tables plus the analytic isolate terms).
+    ``group_size`` pads the stack with inactive copies of cell 0 so a short
+    tail group reuses the full group's compiled program. ``mesh`` shards
+    the CELL axis over ``cell_axis`` via
+    :func:`graphdyn.parallel.mesh.shard_stack` — cells are independent, so
+    the partitioned program is communication-free except the per-lane
+    while-loop stop test; results are bit-identical to the unsharded
+    program (tested)."""
+
+    def __init__(self, cells, config, *, group_size: int | None = None,
+                 chunk_sweeps: int = 64, mesh=None, cell_axis: str = "cell"):
+        G_real = len(cells)
+        G = group_size or G_real
+        if G < G_real:
+            raise ValueError(f"group_size={G} < group population {G_real}")
+        if chunk_sweeps < 1:
+            raise ValueError(f"chunk_sweeps must be >= 1, got {chunk_sweeps}")
+        if mesh is not None:
+            n_dev = int(np.prod(list(mesh.shape.values())))
+            if G % n_dev:
+                raise ValueError(
+                    f"group size {G} not divisible by the mesh's "
+                    f"{n_dev} devices"
+                )
+        padded = list(cells) + [cells[0]] * (G - G_real)
+        stk = stack_bdcm([c[0] for c in padded])
+        self.stk: StackedBDCM = stk
+        self.G, self.G_real = G, G_real
+        self.dtype = stk.dtype
+        self.spec = _CellSpec(
+            T=stk.T, K=stk.K, damp=float(config.damp),
+            eps_clamp=float(config.eps_clamp), eps=float(config.eps),
+            t_max=int(config.max_sweeps), chunk=int(chunk_sweeps),
+            class_ds=tuple(d for d, _, _, _ in stk.edge_classes),
+        )
+
+        if mesh is None:
+            place_g = place_r = jnp.asarray
+        else:
+            from graphdyn.parallel.mesh import replicate, shard_stack
+
+            def place_g(x):
+                return shard_stack(mesh, jnp.asarray(x), cell_axis)
+
+            def place_r(x):
+                return replicate(mesh, jnp.asarray(x))
+
+        self._place_g = place_g
+        self.tables = tuple(
+            (place_g(idx), place_g(ie), place_r(np.asarray(A, stk.dtype)))
+            for _, idx, ie, A in stk.edge_classes
+        )
+        self.valid = place_r(stk.valid)
+        self.x0 = place_r(np.asarray(stk.x0, stk.dtype))
+        self.leaf01 = place_r(np.asarray(stk.leaf01, stk.dtype))
+        self.leaf_idx = place_g(stk.leaf_idx)
+        self._act1 = jnp.ones((1,), bool)
+        # per-cell serial observables — the SAME executors the serial ladder
+        # calls, on the cell's own chi slice: grouped observables are
+        # bit-identical to serial by construction, not by float luck
+        self._observe = [
+            (
+                make_free_entropy(
+                    data, n_total=n_total, n_iso=n_iso,
+                    eps_clamp=config.eps_clamp,
+                ),
+                make_mean_m_init(
+                    data, n_total=n_total, n_iso=n_iso,
+                    eps_clamp=config.eps_clamp,
+                ),
+            )
+            for data, n_total, n_iso in cells
+        ]
+
+    # -- stacked (group) surface ----------------------------------------
+
+    def stack_chi(self, chi_list) -> jnp.ndarray:
+        """[G, 2E_max, K, K] from per-REAL-cell chi arrays (pad lanes get
+        copies of cell 0's chi — inert: their lane is never active)."""
+        padded = list(chi_list) + [chi_list[0]] * (self.G - self.G_real)
+        return self._place_g(np.asarray(self.stk.stack_chi(padded)))
+
+    def set_leaves(self, chi, lmbd_vec, active):
+        return _cell_set_leaves_exec(
+            chi, lmbd_vec, active, self.leaf01, self.x0, self.leaf_idx,
+            self.spec.K,
+        )
+
+    def fixed_point_chunk(self, chi, lmbd_vec, active, delta0, t0):
+        """``(chi', t[G], delta[G])`` after at most ``chunk_sweeps`` more
+        sweeps per unfinished lane (carry resumes exactly)."""
+        return _cell_chunk_exec(
+            chi, lmbd_vec, active, delta0, t0, self.valid, self.x0,
+            self.tables, self.spec,
+        )
+
+    def poison_cell(self, chi, g: int):
+        """The ``sweep.nan`` fault payload for cell ``g`` — one NaN seeded
+        into its carry (the serial :func:`~graphdyn.ops.bdcm.poison_nan`
+        position)."""
+        return chi.at[g, 0, 0, 0].set(jnp.nan)
+
+    def unstack_chi(self, chi, g: int) -> jnp.ndarray:
+        """Cell ``g``'s own ``[2E_g, K, K]`` slice of the stacked chi."""
+        return chi[g, : int(self.stk.twoE[g])]
+
+    def observe(self, chi, g: int, lmbd):
+        """(φ, m_init) of cell ``g`` via its serial executors."""
+        phi_fn, m_fn = self._observe[g]
+        cg = self.unstack_chi(chi, g)
+        return phi_fn(cg, lmbd), m_fn(cg)
+
+    def observe_fns(self, g: int):
+        return self._observe[g]
+
+    # -- G=1 (serial-ladder) surface ------------------------------------
+
+    def set_leaves1(self, chi, lmbd):
+        return self.set_leaves(chi[None], jnp.reshape(lmbd, (1,)),
+                               self._act1)[0]
+
+    def fixed_point1(self, chi, lmbd):
+        """The single cell's FULL fixed point — the serial ladder's
+        ``(chi, lmbd) -> (chi*, sweeps, delta)`` surface, advanced through
+        the group program at G=1 in host-driven chunks. Fault site
+        ``sweep.nan`` is checked once per completed fixed point (the
+        serial contract) and poisons the carry for NaN-path tests."""
+        c = chi[None]
+        lm = jnp.reshape(lmbd, (1,))
+        delta = jnp.full((1,), jnp.inf, self.dtype)
+        t = jnp.zeros((1,), jnp.int32)
+        while True:
+            c, t, delta = self.fixed_point_chunk(c, lm, self._act1, delta, t)
+            d = float(delta[0])
+            if not (d > self.spec.eps) or int(t[0]) >= self.spec.t_max:
+                break
+        if _faults.transform_spec("sweep.nan", "nan") is not None:
+            c = self.poison_cell(c, 0)
+            delta = jnp.full_like(delta, jnp.nan)
+        return c[0], t[0], delta[0]
+
+
+class CellLadderResult(NamedTuple):
+    """Per-cell ladder outputs (lists indexed by real cell)."""
+
+    lambdas: list          # visited λ values per cell
+    ent: list              # φ rows per cell
+    m_init: list
+    ent1: list
+    sweeps: list
+    nonconverged: np.ndarray   # [G_real] — λ whose fixed point failed, or 0
+    chi: list              # final [2E_g, K, K] resume state per cell
+
+
+def run_cell_ladder(
+    ex: EntropyCellExec,
+    chi_list,
+    lambdas: np.ndarray,
+    *,
+    eps: float,
+    ent_floor: float,
+    k0=None,
+    plateau_eps: float = 0.0,
+    plateau_patience: int = 3,
+    prev_rows=None,
+    record=None,
+    boundary=None,
+    verbose: bool = False,
+) -> CellLadderResult:
+    """Advance every cell of the group through ITS OWN remaining ladder
+    positions — the grouped restatement of the serial ``_run_ladder`` host
+    loop, chunk-pipelined so a converged cell moves on to its next λ while
+    slower cells keep iterating (module docstring).
+
+    ``k0[g]`` is cell g's first unvisited ladder index (a resumed cell may
+    start mid-ladder); ``prev_rows[g] = (m_init_rows, ent1_rows)`` is its
+    restored prefix for plateau-streak reconstruction (None on cold
+    starts). ``record(g, k, lmbd, phi, m0, e1, sweeps, failed)`` fires per
+    cell per visited λ; ``boundary(stopping, active_info)`` fires at each
+    chunk boundary where at least one cell crossed a λ boundary (and at
+    every chunk when a shutdown is pending), BEFORE the shutdown raise and
+    the per-cell ``lambda.boundary`` faults — ``active_info`` lists
+    ``{"g", "visited", "lmbd", "failed", "chi"}`` per still-unfinished
+    cell, where ``chi`` is the cell's LAST-BOUNDARY state (captured only
+    when a ``boundary`` callback is given), so a snapshot resumes
+    λ-granularly and bit-exactly.
+    """
+    G, Gr = ex.G, ex.G_real
+    L = int(np.asarray(lambdas).size)
+    lambdas = np.asarray(lambdas, float)
+    plateau_patience = max(1, int(plateau_patience))
+    k = np.zeros(G, np.int64)
+    if k0 is not None:
+        k[:Gr] = np.asarray(k0, np.int64)
+    active = np.zeros(G, bool)
+    active[:Gr] = k[:Gr] < L
+
+    rows_l = [[] for _ in range(Gr)]
+    rows_e = [[] for _ in range(Gr)]
+    rows_m = [[] for _ in range(Gr)]
+    rows_e1 = [[] for _ in range(Gr)]
+    rows_t = [[] for _ in range(Gr)]
+    nonconv = np.zeros(Gr)
+    streak = np.zeros(Gr, np.int64)
+    prev_m: list = [None] * Gr
+    prev_e: list = [None] * Gr
+    if plateau_eps > 0 and prev_rows is not None:
+        # reconstruct each cell's plateau streak from its restored prefix,
+        # exactly as the serial ladder does — a resumed cell exits at the
+        # λ an uninterrupted run would
+        for g in range(Gr):
+            pr = prev_rows[g] if g < len(prev_rows) else None
+            if pr is None or len(pr[0]) == 0:
+                continue
+            pm, pe = (np.asarray(r) for r in pr)
+            for i in range(1, len(pm)):
+                moved = max(float(np.max(np.abs(pm[i] - pm[i - 1]))),
+                            float(np.max(np.abs(pe[i] - pe[i - 1]))))
+                streak[g] = streak[g] + 1 if moved < plateau_eps else 0
+            prev_m[g], prev_e[g] = pm[-1], pe[-1]
+            if streak[g] >= plateau_patience:
+                active[g] = False     # already exited inside the prefix
+
+    chi = ex.stack_chi(chi_list)
+    capture = boundary is not None
+    # each cell's last-λ-BOUNDARY chi (the λ-granular snapshot payload);
+    # before a cell's first crossing this is its start state — exactly
+    # what a resume at its current cursor needs
+    bchi: list = [
+        (np.asarray(c) if capture else None) for c in chi_list
+    ]
+    np_dt = np.dtype(ex.dtype)
+    lam_h = np.zeros(G, np_dt)
+    lam_h[:Gr] = lambdas[np.minimum(k[:Gr], L - 1)]
+    delta_h = np.full(G, np.inf, np_dt)
+    t_h = np.zeros(G, np.int32)
+    need_leaf = active.copy()          # lanes entering a fresh λ
+
+    def info_active():
+        return [
+            {"g": g, "visited": int(k[g]),
+             "lmbd": float(lambdas[max(k[g] - 1, 0)]),
+             "failed": False, "chi": bchi[g]}
+            for g in range(Gr) if active[g]
+        ]
+
+    while active[:Gr].any():
+        # jnp.array (NOT asarray): on the CPU backend asarray may ALIAS the
+        # numpy buffer, and these host arrays are mutated below while the
+        # async device computation still reads them — an explicit copy is
+        # the difference between determinism and a data race (observed)
+        lm_dev = jnp.array(lam_h)
+        if need_leaf.any():
+            chi = ex.set_leaves(chi, lm_dev, jnp.array(need_leaf))
+            delta_h[need_leaf] = np.inf
+            t_h[need_leaf] = 0
+            need_leaf[:] = False
+        chi, t_v, delta_v = ex.fixed_point_chunk(
+            chi, lm_dev, jnp.array(active),
+            jnp.array(delta_h), jnp.array(t_h),
+        )
+        t_h_new, delta_h_new = np.asarray(t_v), np.asarray(delta_v)
+        t_h[active] = t_h_new[active]
+        delta_h[active] = delta_h_new[active]
+
+        # a lane is at its λ boundary when its own fixed point finished:
+        # converged (delta <= eps — note a NaN delta reads `> eps` as
+        # False, the poison path) or out of sweep budget
+        crossed = [
+            g for g in range(Gr)
+            if active[g] and (
+                not (float(delta_h[g]) > eps) or int(t_h[g]) >= ex.spec.t_max
+            )
+        ]
+        poisoned_now: dict = {}
+        for g in crossed:
+            # serial contract: one sweep.nan check per completed fixed
+            # point per cell
+            if _faults.transform_spec("sweep.nan", "nan") is not None:
+                chi = ex.poison_cell(chi, g)
+                delta_h[g] = np.nan
+                poisoned_now[g] = True
+        # dispatch every crossed cell's observables BEFORE the first
+        # blocking host read — the per-cell executors queue asynchronously,
+        # so the boundary pays one pipeline drain instead of one sync per
+        # cell
+        obs = {g: ex.observe(chi, g, lm_dev[g]) for g in crossed}
+        fired = []
+        for g in crossed:
+            lmv = float(lambdas[k[g]])
+            phi, m0 = obs[g]
+            phi, m0 = np.asarray(phi), np.asarray(m0)
+            e1 = phi + lmv * m0
+            t_g = int(t_h[g])
+            failed = float(delta_h[g]) > eps
+            poisoned = bool(
+                np.isnan(float(delta_h[g]))
+                or np.isnan(phi).any() or np.isnan(m0).any()
+            ) or poisoned_now.get(g, False)
+            if poisoned and not failed:
+                failed = True
+            if poisoned:
+                log.warning(
+                    "non-finite sweep state at lambda=%g (cell %d, "
+                    "delta=%r) — recording non-convergence and stopping "
+                    "the cell's ladder", lmv, g, delta_h[g],
+                )
+            if failed:
+                nonconv[g] = lmv
+            rows_l[g].append(lmv)
+            rows_e[g].append(phi)
+            rows_m[g].append(m0)
+            rows_e1[g].append(e1)
+            rows_t[g].append(t_g)
+            if record is not None:
+                record(g, int(k[g]), lmv, phi, m0, e1, t_g, failed)
+            if verbose:
+                m_s = (f"{m0:.5f}" if np.ndim(m0) == 0
+                       else f"{np.mean(m0):.5f}(mean)")
+                print(f"cell={g} lambda={lmv:.2f} t={t_g} m_init={m_s}")
+            if capture:
+                bchi[g] = np.asarray(ex.unstack_chi(chi, g))
+            fired.append((g, lmv))
+
+            # per-cell exits, then the next ladder position
+            k[g] += 1
+            if bool(np.all(np.asarray(e1) < ent_floor)) or failed:
+                active[g] = False
+                continue
+            if k[g] >= L:
+                active[g] = False
+                continue
+            if plateau_eps > 0:
+                if prev_m[g] is not None:
+                    moved = max(
+                        float(np.max(np.abs(m0 - prev_m[g]))),
+                        float(np.max(np.abs(e1 - prev_e[g]))),
+                    )
+                    streak[g] = streak[g] + 1 if moved < plateau_eps else 0
+                    if streak[g] >= plateau_patience:
+                        active[g] = False
+                prev_m[g], prev_e[g] = m0, e1
+                if not active[g]:
+                    continue
+            lam_h[g] = lambdas[k[g]]
+            need_leaf[g] = True
+
+        stopping = shutdown_requested()
+        if boundary is not None and (fired or stopping):
+            boundary(stopping, info_active())
+        if stopping:
+            raise_if_requested()
+        for g, lmv in fired:
+            _faults.maybe_fail("lambda.boundary", key=f"lmbd={lmv:g}")
+
+    return CellLadderResult(
+        lambdas=[np.array(r) for r in rows_l],
+        ent=[np.array(r) for r in rows_e],
+        m_init=[np.array(r) for r in rows_m],
+        ent1=[np.array(r) for r in rows_e1],
+        sweeps=[np.array(r, np.int64) for r in rows_t],
+        nonconverged=nonconv,
+        chi=[np.asarray(ex.unstack_chi(chi, g)) for g in range(Gr)],
+    )

@@ -37,6 +37,16 @@ CHIP_BACKENDS = ("tpu", "axon")
 UNKNOWN_BACKENDS = (None, "unknown")
 
 
+def _write_json_atomic(path, doc):
+    """Temp-file + os.replace JSON write (the graphdyn.utils.io discipline,
+    inlined because this tool stays stdlib-pure): a preemption mid-write
+    leaves the old artifact intact, never a torn one."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+
+
 def read_json_lines(path):
     if not os.path.exists(path):
         return []
@@ -157,8 +167,7 @@ def main(session_dir, bench_configs="BENCH_CONFIGS_r05.json"):
     stamp = "tpu_full captured from " + os.path.basename(session_dir)
     if stamp not in doc.get("status", ""):          # reruns stay idempotent
         doc["status"] = doc.get("status", "") + " | " + stamp
-    with open(bench_configs, "w") as f:
-        json.dump(doc, f, indent=1)
+    _write_json_atomic(bench_configs, doc)
 
     print(f"merged into {bench_configs}:")
     if "headline" in out:

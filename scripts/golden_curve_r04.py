@@ -33,6 +33,7 @@ import numpy as np
 from graphdyn.config import EntropyConfig
 from graphdyn.graphs import erdos_renyi_graph
 from graphdyn.models.entropy import entropy_sweep
+from graphdyn.utils.io import write_json_atomic
 
 # `ER_BDCM_entropy.ipynb:18-46` stored stream output (full precision,
 # BASELINE.md) — the only numeric ground truth in the reference repo.
@@ -107,8 +108,7 @@ def main(n_seeds: int = 8, out_path: str = "GOLDEN_r04.json") -> None:
         "spread_at_golden_lambdas": spread,
         "per_seed": rows,
     }
-    with open(out_path, "w") as f:
-        json.dump(out, f, indent=1, default=float)
+    write_json_atomic(out_path, out, indent=1, default=float)
     print(f"wrote {out_path}", flush=True)
 
 

@@ -42,7 +42,13 @@ else
 fi
 
 echo "== graftlint =="
-python -m graphdyn.analysis "${@:-graphdyn/}" --format=text || fail=1
+# default scope: the package AND scripts/ — capture scripts persist round
+# artifacts, so GD007 (atomic-write discipline) gates there too
+if [ "$#" -eq 0 ]; then
+    python -m graphdyn.analysis graphdyn/ scripts/ --format=text || fail=1
+else
+    python -m graphdyn.analysis "$@" --format=text || fail=1
+fi
 
 # 4. faultcheck — the fault-injection test subset standalone (pytest -m
 #    faultinject): every recovery path in graphdyn/resilience must survive
@@ -81,9 +87,19 @@ assert row.get("value", 0) > 0, f"headline value must be > 0: {row.get('value')}
 assert row.get("unit") == "spin-updates/s", row.get("unit")
 assert row.get("ensemble_rate", 0) > 0, \
     f"ensemble_rate row must be > 0: {row.get('ensemble_rate')}"
+# the entropy cell-ladder row: a measured positive rate, or an explicit
+# null + reason — NEVER 0.0 (a skip must be unmistakable from a collapse)
+assert "entropy_cell_rate" in row, "entropy_cell_rate row absent"
+ecr = row["entropy_cell_rate"]
+if ecr is None:
+    assert row.get("entropy_cell_rate_skipped_reason"), \
+        "null entropy_cell_rate needs entropy_cell_rate_skipped_reason"
+else:
+    assert ecr > 0, f"entropy_cell_rate must be > 0 or null+reason: {ecr}"
 print(f"benchcheck: value={row['value']:.3e} "
       f"ensemble_rate={row['ensemble_rate']:.3e} "
-      f"ensemble_speedup={row.get('ensemble_speedup', 0):.2f}x")
+      f"ensemble_speedup={row.get('ensemble_speedup', 0):.2f}x "
+      f"entropy_cell_rate={row['entropy_cell_rate']}")
 PYEOF
 fi
 

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from graphdyn.graphs import erdos_renyi_graph, random_regular_graph
+from graphdyn.utils.io import write_json_atomic
 from graphdyn.ops.bdcm import BDCMData, class_update, make_sweep
 from graphdyn.ops.pallas_bdcm import dp_contract, pallas_supported, vmem_block_edges
 
@@ -231,8 +232,7 @@ def main():
         "packed_general_equivalence": packed_general_equivalence(),
         "timing": timing(),
     }
-    with open("PALLAS_TPU.json", "w") as f:
-        json.dump(doc, f, indent=1)
+    write_json_atomic("PALLAS_TPU.json", doc, indent=1)
     print(json.dumps(info))
     print("WROTE PALLAS_TPU.json")
 

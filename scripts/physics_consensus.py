@@ -29,6 +29,7 @@ sys.path.insert(0, __import__("os").path.dirname(
     __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
 
 import benchmarks.common  # noqa: F401 — repo root + platform forcing
+from graphdyn.utils.io import write_json_atomic
 
 M0_GRID = (0.0, 0.01, 0.02, 0.03, 0.05, 0.07, 0.1, 0.15, 0.2, 0.3)
 
@@ -83,8 +84,7 @@ def main():
         elapsed_s=round(time.time() - t0, 1),
         **({"relay": relay_note} if relay_note else {}),
     )
-    with open(a.out_json, "w") as f:
-        json.dump(doc, f, indent=1)
+    write_json_atomic(a.out_json, doc, indent=1)
     print(f"wrote {a.out_json} (backend={doc['backend']}, "
           f"{len(per_seed)} instances)")
 

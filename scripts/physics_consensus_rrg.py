@@ -29,6 +29,7 @@ sys.path.insert(0, __import__("os").path.dirname(
     __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
 
 import benchmarks.common  # noqa: F401 — repo root + platform forcing
+from graphdyn.utils.io import write_json_atomic
 
 # bracketing grid: smoke showed the random-init transition sits at
 # m(0) ≈ 0.4–0.6 on RRG (vs 0.01 on ER c=6 — degree homogeneity freezes
@@ -87,8 +88,7 @@ def main():
         "curves": curves,
         **({"relay": relay_note} if relay_note else {}),
     }
-    with open(a.out_json, "w") as f:
-        json.dump(doc, f, indent=1)
+    write_json_atomic(a.out_json, doc, indent=1)
     print(f"wrote {a.out_json} (backend={doc['backend']})")
 
     if a.out_png:

@@ -33,6 +33,7 @@ from graphdyn.graphs import random_regular_graph
 from graphdyn.models.hpr import hpr_solve
 from graphdyn.models.sa import simulated_annealing
 from graphdyn.ops.dynamics import end_state
+from graphdyn.utils.io import write_json_atomic
 
 
 def run_sa(n=10_000, d=4, replicas=4, max_steps=100_000_000, out=None):
@@ -112,8 +113,7 @@ def _merge(path, key, value):
         with open(path) as f:
             data = json.load(f)
     data[key] = value
-    with open(path, "w") as f:
-        json.dump(data, f, indent=1)
+    write_json_atomic(path, data, indent=1)
     print(f"updated {path} [{key}]", flush=True)
 
 

@@ -701,3 +701,52 @@ class TestContractedEntryPoints:
         bad = jnp.concatenate([chi, chi], axis=2)  # [2E, K, 2K]
         with pytest.raises(ContractError):
             sweep(bad, jnp.float32(0.1))
+
+
+class TestGD007ScriptsScope:
+    """GD007 gates scripts/ too (the capture scripts persist round
+    artifacts): a direct open-for-write there is a finding; routing the
+    write through graphdyn.utils.io (or a temp + os.replace pair) is
+    clean."""
+
+    BAD = (
+        "import json\n"
+        "def persist(path, doc):\n"
+        "    with open(path, \"w\") as f:\n"
+        "        json.dump(doc, f)\n"
+    )
+    GOOD = (
+        "from graphdyn.utils.io import write_json_atomic\n"
+        "def persist(path, doc):\n"
+        "    write_json_atomic(path, doc)\n"
+    )
+    GOOD_INLINE = (
+        "import json, os\n"
+        "def persist(path, doc):\n"
+        "    tmp = path + \".tmp\"\n"
+        "    with open(tmp, \"w\") as f:\n"
+        "        json.dump(doc, f)\n"
+        "    os.replace(tmp, path)\n"
+    )
+
+    def test_bad_script_write_flagged(self):
+        assert "GD007" in _codes(self.BAD, path="scripts/capture_foo.py")
+
+    def test_good_script_writes_clean(self):
+        assert _codes(self.GOOD, path="scripts/capture_foo.py") == []
+        assert _codes(self.GOOD_INLINE, path="scripts/capture_foo.py") == []
+
+    def test_repo_scripts_are_clean(self):
+        """The gate's own scope: every checked-in scripts/*.py lints clean
+        (the same invocation scripts/lint.sh now runs by default)."""
+        import subprocess
+        import sys
+        from pathlib import Path
+
+        repo = Path(__file__).resolve().parent.parent
+        proc = subprocess.run(
+            [sys.executable, "-m", "graphdyn.analysis", "scripts/",
+             "--format=json"],
+            cwd=repo, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout[-2000:]

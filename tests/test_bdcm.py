@@ -355,3 +355,136 @@ class TestBucketedClasses:
             sizes.append(_fixed_point_exec._cache_size())
         assert sizes[0] <= before + 1
         assert sizes[1] == sizes[0], "second instance must hit the jit cache"
+
+
+class TestStackBDCM:
+    """stack_bdcm: ragged per-cell tables → the padded [G, Ed_max, …]
+    cell-group layout (ghost-row machinery lifted to the cell axis)."""
+
+    def _cells(self):
+        from graphdyn.graphs import erdos_renyi_graph, remove_isolates
+
+        graphs = [
+            erdos_renyi_graph(40, 1.0 / 39, seed=1),
+            erdos_renyi_graph(60, 2.5 / 59, seed=2),   # different n, E, classes
+            erdos_renyi_graph(24, 1.2 / 23, seed=5),
+        ]
+        datas = []
+        for g in graphs:
+            sub, _ = remove_isolates(g)
+            datas.append(BDCMData(sub, p=1, c=1))
+        return datas
+
+    def test_ragged_padding_layout(self):
+        from graphdyn.ops.bdcm import stack_bdcm
+
+        datas = self._cells()
+        stk = stack_bdcm(datas)
+        ghost = stk.twoE_max
+        assert stk.twoE_max == max(d.num_directed for d in datas)
+        # union of the cells' degree classes, each padded to its max
+        # population; pad entries gather from/scatter to the ghost row
+        union_ds = sorted({c.d for d in datas for c in d.edge_classes})
+        assert [d for d, _, _, _ in stk.edge_classes] == union_ds
+        for d, idx, ie, A in stk.edge_classes:
+            assert idx.shape[0] == len(datas) and ie.shape[2] == d
+            for g, data in enumerate(datas):
+                cls = next((c for c in data.edge_classes if c.d == d), None)
+                m = cls.idx.shape[0] if cls is not None else 0
+                if cls is not None:
+                    np.testing.assert_array_equal(idx[g, :m], cls.idx)
+                    np.testing.assert_array_equal(ie[g, :m], cls.in_edges)
+                # a cell missing the class (or its padded tail) is all-ghost
+                assert (idx[g, m:] == ghost).all()
+                assert (ie[g, m:] == ghost).all()
+                # real entries never alias the ghost row
+                assert (idx[g, :m] < data.num_directed).all()
+
+    def test_bucketed_ghost_references_remapped(self):
+        """class_bucket padding points at each CELL's own ghost row 2E_g;
+        stacking must remap those to the stacked ghost 2E_max."""
+        from graphdyn.graphs import erdos_renyi_graph, remove_isolates
+        from graphdyn.ops.bdcm import stack_bdcm
+
+        datas = []
+        for s, n in ((1, 40), (2, 60)):
+            sub, _ = remove_isolates(erdos_renyi_graph(n, 1.5 / (n - 1), seed=s))
+            datas.append(BDCMData(sub, p=1, c=1, class_bucket=32))
+        stk = stack_bdcm(datas)
+        ghost = stk.twoE_max
+        for g, data in enumerate(datas):
+            for d, idx, ie, _ in stk.edge_classes:
+                own = np.concatenate([idx[g], ie[g].ravel()])
+                # nothing points at the CELL-local ghost of the smaller cell
+                if data.num_directed != ghost:
+                    real = own[own != ghost]
+                    assert (real < data.num_directed).all()
+
+    def test_stacked_sweep_matches_per_cell(self):
+        """One chunk of the stacked fixed point reproduces each cell's own
+        serial sweep trajectory bit-for-bit; chi pad rows stay untouched."""
+        import jax.numpy as jnp
+
+        from graphdyn.config import EntropyConfig
+        from graphdyn.graphs import erdos_renyi_graph, remove_isolates
+        from graphdyn.pipeline.entropy_group import EntropyCellExec
+
+        cfg = EntropyConfig(lmbd_max=0.2, lmbd_step=0.1, max_sweeps=7)
+        cells, chis = [], []
+        for s, n in ((1, 40), (2, 60), (5, 24)):
+            g = erdos_renyi_graph(n, 1.5 / (n - 1), seed=s)
+            sub, n_iso = remove_isolates(g)
+            data = BDCMData(sub, p=1, c=1)
+            cells.append((data, g.n, n_iso))
+            chis.append(np.asarray(data.init_messages(s)))
+        ex = EntropyCellExec(cells, cfg, group_size=4)   # padded tail lane
+        chi0 = ex.stack_chi(chis)
+        lm = jnp.asarray(np.full(4, 0.1), ex.dtype)
+        act = jnp.asarray(np.array([True, True, True, False]))
+        d0 = jnp.full((4,), jnp.inf, ex.dtype)
+        t0 = jnp.zeros((4,), jnp.int32)
+        out, t_v, _ = ex.fixed_point_chunk(chi0, lm, act, d0, t0)
+        assert np.asarray(t_v)[:3].tolist() == [7, 7, 7]  # ran to the budget
+        for g, (data, _, _) in enumerate(cells):
+            sweep = make_sweep(data, damp=cfg.damp, use_pallas=False)
+            ref = jnp.asarray(chis[g])
+            for _ in range(7):
+                ref = sweep(ref, jnp.asarray(0.1, data.dtype))
+            np.testing.assert_array_equal(
+                np.asarray(ex.unstack_chi(out, g)), np.asarray(ref),
+                err_msg=f"cell {g}",
+            )
+            # pad rows beyond the cell's own 2E never moved
+            e2 = data.num_directed
+            np.testing.assert_array_equal(
+                np.asarray(out[g, e2:]), np.asarray(chi0[g, e2:]),
+            )
+        # the inactive pad lane froze entirely
+        np.testing.assert_array_equal(np.asarray(out[3]), np.asarray(chi0[3]))
+
+    def test_mismatched_dynamics_rejected(self):
+        from graphdyn.graphs import erdos_renyi_graph, remove_isolates
+        from graphdyn.ops.bdcm import stack_bdcm
+
+        sub1, _ = remove_isolates(erdos_renyi_graph(40, 1.5 / 39, seed=1))
+        sub2, _ = remove_isolates(erdos_renyi_graph(40, 1.5 / 39, seed=2))
+        a = BDCMData(sub1, p=1, c=1)
+        b = BDCMData(sub2, p=2, c=1)
+        with pytest.raises(ValueError, match="dynamics parameters"):
+            stack_bdcm([a, b])
+        with pytest.raises(ValueError, match="empty"):
+            stack_bdcm([])
+
+    def test_stack_chi_validates_shapes(self):
+        from graphdyn.graphs import erdos_renyi_graph, remove_isolates
+        from graphdyn.ops.bdcm import stack_bdcm
+
+        datas = self._cells()
+        stk = stack_bdcm(datas)
+        chis = [np.asarray(d.init_messages(0)) for d in datas]
+        out = np.asarray(stk.stack_chi(chis))
+        assert out.shape == (3, stk.twoE_max, stk.K, stk.K)
+        with pytest.raises(ValueError, match="chi shape"):
+            stk.stack_chi([chis[1], chis[0], chis[2]])
+        with pytest.raises(ValueError, match="chi arrays"):
+            stk.stack_chi(chis[:2])

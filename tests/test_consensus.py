@@ -82,3 +82,25 @@ def test_rrg_ensemble_dispatch_and_doc_provenance():
     with pytest.raises(ValueError, match="'er' or 'rrg'"):
         consensus_curve_ensemble(300, 32, (0.1,), max_steps=100,
                                  graph="cycle")
+
+
+def test_ensemble_instances_draw_independent_replicas():
+    """The replica-draw seed folds (graph_seed, k): two ensemble instances
+    at the same m(0) point draw DIFFERENT initial replicas (pre-fix, every
+    instance reused seed 1000+k and the instance spread under-measured the
+    replica noise). Same instance + same point stays deterministic."""
+    from graphdyn.models.consensus import consensus_curve, draw_seed
+
+    assert draw_seed(0, 0) != draw_seed(1, 0)
+    assert draw_seed(0, 0) != draw_seed(0, 1)
+    assert draw_seed(3, 2) == draw_seed(3, 2)
+
+    g, _, nbr, deg = er_consensus_ensemble(300, c=3.0, seed=0)
+    kw = dict(nbr_dev=nbr, deg_dev=deg, max_steps=10, chunk=5)
+    # SAME graph, different instance labels: only the draws differ — the
+    # final magnetizations must not coincide
+    a = consensus_curve(g, 128, [0.0], graph_seed=0, **kw)
+    b = consensus_curve(g, 128, [0.0], graph_seed=1, **kw)
+    assert a[0]["mean_abs_m_final"] != b[0]["mean_abs_m_final"]
+    a2 = consensus_curve(g, 128, [0.0], graph_seed=0, **kw)
+    assert a[0] == a2[0]

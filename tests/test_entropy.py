@@ -512,3 +512,118 @@ def test_plateau_streak_resume_invariant():
         prev_rows=(first3.m_init, first3.ent1),
     )
     assert rest3.lambdas.size == 0
+
+
+# ---------------------------------------------------------------------------
+# cell-parallel λ-ladders (graphdyn.pipeline.entropy_group)
+# ---------------------------------------------------------------------------
+
+
+def test_entropy_sweep_pre_refactor_anchor():
+    """Regression anchor for the G=1 group-program refactor (the PR-3
+    identity discipline): these values were captured from the PRE-refactor
+    serial ladder (`_fixed_point_exec`'s fused while_loop) on two CPU
+    shapes — unbucketed and class-bucketed — and the shared cell-group
+    program at G=1 must reproduce them bit-for-bit, sweep counts and final
+    chi state included."""
+    from graphdyn.config import DynamicsConfig
+
+    g = erdos_renyi_graph(60, 1.5 / 59, seed=3)
+    cfg = EntropyConfig(dynamics=DynamicsConfig(p=1, c=1), lmbd_max=0.3,
+                        lmbd_step=0.1, max_sweeps=300, eps=1e-5)
+    r = entropy_sweep(g, cfg, seed=3)
+    assert [float(x) for x in r.m_init] == [
+        0.6456124782562256, 0.6203604340553284,
+        0.5962358117103577, 0.5734946131706238,
+    ]
+    assert [float(x) for x in r.ent1] == [
+        0.2942521274089813, 0.2929973900318146,
+        0.289388507604599, 0.28371450304985046,
+    ]
+    assert r.sweeps.tolist() == [136, 84, 89, 94]
+    assert float(r.chi.astype(np.float64).sum()) == 89.99998668581247
+
+    g2 = erdos_renyi_graph(80, 2.0 / 79, seed=7)
+    r2 = entropy_sweep(g2, EntropyConfig(lmbd_max=0.2, lmbd_step=0.1),
+                       seed=7, class_bucket=64)
+    assert [float(x) for x in r2.m_init] == [
+        0.6252278685569763, 0.5984280705451965, 0.5722740888595581,
+    ]
+    assert [float(x) for x in r2.ent1] == [
+        0.3218421936035156, 0.32050633430480957, 0.3165897727012634,
+    ]
+    assert r2.sweeps.tolist() == [177, 133, 140]
+
+
+def _assert_grids_equal(a, b):
+    for f in a._fields:
+        av, bv = getattr(a, f), getattr(b, f)
+        if av is None and bv is None:
+            continue
+        np.testing.assert_array_equal(av, bv, err_msg=f)
+
+
+def test_entropy_grid_grouped_matches_serial_elementwise():
+    """The grouped grid (cells advancing their λ-ladders in lockstep chunks
+    through the stacked cell program) is element-wise IDENTICAL to the
+    serial cell loop — group sizes 1 (vmapped singleton), 3 (non-divisor of
+    the 4-cell grid: padded tail group), and the default."""
+    cfg = EntropyConfig(lmbd_max=0.2, lmbd_step=0.1, num_rep=2)
+    deg = np.array([1.2, 1.6])
+    base = entropy_grid(40, deg, cfg, seed=3, group_size=0)
+    for gs in (1, 3):
+        res = entropy_grid(40, deg, cfg, seed=3, group_size=gs)
+        _assert_grids_equal(base, res)
+
+
+def test_entropy_grid_grouped_cells_stop_at_different_lambda():
+    """Cells exiting at different ladder positions (entropy floor crossed
+    by some cells only) stay frozen while the rest of the group runs on —
+    per-cell rows, counts, and n_lambda all match the serial loop."""
+    # ent_floor between the deg=1.2 and deg=1.6 ent1 levels: the low-deg
+    # cells cross at λ0 while the high-deg cells visit the whole ladder
+    cfg = EntropyConfig(lmbd_max=0.3, lmbd_step=0.1, num_rep=2,
+                        ent_floor=0.2)
+    deg = np.array([1.2, 1.6])
+    base = entropy_grid(40, deg, cfg, seed=3, group_size=0)
+    assert base.n_lambda.min() < base.n_lambda.max()   # exits actually differ
+    res = entropy_grid(40, deg, cfg, seed=3, group_size=4)
+    _assert_grids_equal(base, res)
+    # and with the opt-in plateau exit active
+    cfgp = EntropyConfig(lmbd_max=0.5, lmbd_step=0.1, num_rep=2,
+                         ent_floor=-1e9, plateau_eps=1e9, plateau_patience=2)
+    basep = entropy_grid(30, np.array([1.1, 1.4]), cfgp, seed=2, group_size=0)
+    resp = entropy_grid(30, np.array([1.1, 1.4]), cfgp, seed=2, group_size=4)
+    _assert_grids_equal(basep, resp)
+    assert int(basep.n_lambda.max()) == 3              # plateau exit fired
+
+
+def test_entropy_grid_resume_interop_across_paths(tmp_path, abort_after_save):
+    """Snapshots are interchangeable between the serial and grouped cell
+    paths, λ-granularly: a grouped-written snapshot resumes under
+    group_size=0 and a serial-written snapshot resumes under grouping —
+    both bit-exact vs the uninterrupted run (regrouping cannot change
+    per-cell results: each cell's ladder depends only on its seed and λ
+    cursor)."""
+    from conftest import CheckpointAbort
+
+    cfg = EntropyConfig(lmbd_max=0.2, lmbd_step=0.1, num_rep=2)
+    deg = np.array([1.2, 1.6])
+    kw = dict(seed=3, checkpoint_interval_s=0.0)
+    base = entropy_grid(40, deg, cfg, seed=3)
+
+    # grouped write → serial resume
+    p = str(tmp_path / "g2s")
+    with abort_after_save(n=2):
+        with pytest.raises(CheckpointAbort):
+            entropy_grid(40, deg, cfg, checkpoint_path=p, group_size=4, **kw)
+    res = entropy_grid(40, deg, cfg, checkpoint_path=p, group_size=0, **kw)
+    _assert_grids_equal(base, res)
+
+    # serial write → grouped resume (different group sizes)
+    p2 = str(tmp_path / "s2g")
+    with abort_after_save(n=2):
+        with pytest.raises(CheckpointAbort):
+            entropy_grid(40, deg, cfg, checkpoint_path=p2, group_size=0, **kw)
+    res2 = entropy_grid(40, deg, cfg, checkpoint_path=p2, group_size=3, **kw)
+    _assert_grids_equal(base, res2)

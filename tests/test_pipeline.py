@@ -343,3 +343,76 @@ def test_compile_cache_opt_in(tmp_path, monkeypatch):
                           capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert any(cache.iterdir()), "no cache entries written"
+
+
+# ---------------------------------------------------------------------------
+# 6. cell-parallel entropy λ-ladders (pipeline.entropy_group)
+# ---------------------------------------------------------------------------
+
+
+def test_entropy_grouped_multicell_lambda_preemption_resume(tmp_path):
+    """A hard preemption at a λ boundary of a multi-cell GROUP snapshots
+    λ-granularly (every in-flight cell's last-boundary chi) and resumes —
+    under a DIFFERENT group size — to results identical to the
+    uninterrupted run."""
+    import numpy as np
+
+    from graphdyn.config import EntropyConfig
+    from graphdyn.models.entropy import entropy_grid
+
+    cfg = EntropyConfig(lmbd_max=0.2, lmbd_step=0.1, num_rep=2)
+    deg = np.array([1.2, 1.6])
+    ck = str(tmp_path / "ck")
+    base = entropy_grid(40, deg, cfg, seed=3, group_size=4)
+    with FaultPlan([FaultSpec("lambda.boundary", "preempt", at=5)]):
+        with pytest.raises(InjectedPreemption):
+            entropy_grid(40, deg, cfg, seed=3, group_size=4,
+                         checkpoint_path=ck, checkpoint_interval_s=0.0)
+    loaded = Checkpoint(ck).load()
+    assert loaded is not None and "cells" in loaded[1]   # grouped format
+    res = entropy_grid(40, deg, cfg, seed=3, group_size=2,
+                       checkpoint_path=ck, checkpoint_interval_s=0.0)
+    for f in base._fields:
+        np.testing.assert_array_equal(getattr(base, f), getattr(res, f),
+                                      err_msg=f)
+    assert not os.path.exists(ck + ".npz")
+
+
+def test_entropy_cell_group_sharded_over_mesh_bit_identical():
+    """The stacked [G, …] cell layout shards over the cell axis
+    (parallel.mesh.shard_stack) with no change in per-cell ladder results
+    — cells are independent, so the partitioned program computes exactly
+    the unsharded arithmetic."""
+    import numpy as np
+
+    from graphdyn.config import EntropyConfig
+    from graphdyn.graphs import erdos_renyi_graph, remove_isolates
+    from graphdyn.ops.bdcm import BDCMData
+    from graphdyn.parallel.mesh import device_pool, make_mesh
+    from graphdyn.pipeline.entropy_group import (
+        EntropyCellExec, run_cell_ladder,
+    )
+
+    cfg = EntropyConfig(lmbd_max=0.2, lmbd_step=0.1)
+    cells, chis = [], []
+    for s in range(4):
+        g = erdos_renyi_graph(40, (1.0 + 0.3 * s) / 39, seed=s)
+        sub, n_iso = remove_isolates(g)
+        data = BDCMData(sub, p=1, c=1, class_bucket=32)
+        cells.append((data, g.n, n_iso))
+        chis.append(np.asarray(data.init_messages(s)))
+    lambdas = np.array([0.0, 0.1, 0.2])
+    kw = dict(eps=cfg.eps, ent_floor=cfg.ent_floor)
+    ex = EntropyCellExec(cells, cfg, group_size=4)
+    base = run_cell_ladder(ex, chis, lambdas, **kw)
+    mesh = make_mesh((2,), ("cell",), devices=device_pool(2))
+    exm = EntropyCellExec(cells, cfg, group_size=4, mesh=mesh)
+    res = run_cell_ladder(exm, chis, lambdas, **kw)
+    for g in range(4):
+        np.testing.assert_array_equal(base.ent1[g], res.ent1[g])
+        np.testing.assert_array_equal(base.sweeps[g], res.sweeps[g])
+        np.testing.assert_array_equal(base.chi[g], res.chi[g])
+    np.testing.assert_array_equal(base.nonconverged, res.nonconverged)
+    # indivisible group/mesh shapes are refused loudly
+    with pytest.raises(ValueError, match="not divisible"):
+        EntropyCellExec(cells[:3], cfg, group_size=3, mesh=mesh)
