@@ -173,19 +173,45 @@ def entropy_cell_rate(smoke: bool):
             dynamics=DynamicsConfig(p=1, c=1), lmbd_max=0.5, lmbd_step=0.1,
             num_rep=reps, max_sweeps=400, eps=1e-5,
         )
+    # the XLA legs measure the execution-schedule A/B (grouped vs serial);
+    # on chip backends a third leg A/Bs the grouped-Pallas kernel against
+    # grouped-XLA on the same workload (kernel tag in the row). Interpret
+    # mode is not a rate, so the Pallas leg is chip-only — skipped with an
+    # explicit reason, never a 0.0
+    on_chip = jax.default_backend() in ("tpu", "axon")
+    legs = [("serial", 0, "xla"), ("grouped", group, "xla")]
+    if on_chip:
+        legs.append(("grouped_pallas", group, "pallas"))
     walls, points = {}, {}
-    for label, gs in (("serial", 0), ("grouped", group)):
-        kw = dict(seed=0, group_size=gs, class_bucket=bucket)
-        _mark(f"entropy_cell_rate {label}: warmup (compile)")
+    for label, gs, kern in legs:
+        kw = dict(seed=0, group_size=gs, class_bucket=bucket, kernel=kern)
+        _mark(f"entropy_cell_rate {label} [kernel={kern}]: warmup (compile)")
         entropy_grid(n, np.asarray(degs), cfg, **kw)
-        _mark(f"entropy_cell_rate {label}: timing")
+        _mark(f"entropy_cell_rate {label} [kernel={kern}]: timing")
         t0 = time.perf_counter()
         r = entropy_grid(n, np.asarray(degs), cfg, **kw)
         walls[label] = time.perf_counter() - t0
         points[label] = int(np.sum(r.n_lambda))
     speedup = walls["serial"] / walls["grouped"]
     workload = {"n": n, "deg": degs, "num_rep": reps, "group_size": group,
-                "lambda_points": points["grouped"]}
+                "lambda_points": points["grouped"],
+                # which sweep core each leg ran (the Pallas A/B tag)
+                "kernel": {label: kern for label, _, kern in legs}}
+    if on_chip:
+        pallas_row = {
+            "entropy_cell_rate_pallas":
+                points["grouped_pallas"] / walls["grouped_pallas"],
+            "entropy_cell_pallas_speedup":
+                walls["grouped"] / walls["grouped_pallas"],
+        }
+    else:
+        pallas_row = {
+            "entropy_cell_rate_pallas": None,
+            "entropy_cell_rate_pallas_skipped_reason": (
+                "grouped-Pallas A/B is chip-only (backend=%s): interpret "
+                "mode is not a rate" % jax.default_backend()
+            ),
+        }
     if speedup < 1.2:
         return {
             "entropy_cell_rate": None,
@@ -198,12 +224,14 @@ def entropy_cell_rate(smoke: bool):
                 f"{points['serial'] / walls['serial']:.1f} cell-lambda/s"
             ),
             "entropy_cell_speedup_measured": speedup,
+            **pallas_row,
             "entropy_cell_workload": workload,
         }
     return {
         "entropy_cell_rate": points["grouped"] / walls["grouped"],
         "entropy_cell_rate_serial": points["serial"] / walls["serial"],
         "entropy_cell_speedup": speedup,
+        **pallas_row,
         "entropy_cell_workload": workload,
     }
 
@@ -409,8 +437,23 @@ def main():
             "entropy_cell_rate": None,
             "entropy_cell_rate_skipped_reason":
                 f"entropy cell A/B failed: {str(e)[:150]}",
+            "entropy_cell_rate_pallas": None,
+            "entropy_cell_rate_pallas_skipped_reason":
+                f"entropy cell A/B failed: {str(e)[:150]}",
         })
-    _mark(f"wide rate {rate_wide:.3e}; pallas rate {rate_pallas:.3e}; int8 row")
+    # progress log: a backend-skipped row says skipped(<reason>), NEVER a
+    # zero rate — the JSON already emits null + <row>_skipped_reason, and
+    # the human-readable line must be just as unmistakable
+    def _rate_or_skip(row_key, rate):
+        if row_key in skipped:
+            return f"skipped({skipped[row_key]})"
+        return f"{rate:.3e}"
+
+    _mark(
+        f"wide rate {_rate_or_skip('packed_rate_wide', rate_wide)}; "
+        f"pallas rate {_rate_or_skip('packed_rate_pallas', rate_pallas)}; "
+        f"int8 row"
+    )
     try:
         v8 = int8_rate(g, R_int8, steps)
         partial["int8_rate"] = v8

@@ -54,6 +54,15 @@ Rules (stable codes; each can be silenced per line with
   :class:`graphdyn.pipeline.prefetch.HostPrefetcher`).  ``for``-loops
   inside jit contexts are exempt (they unroll at trace time — no per-step
   transfer exists).
+- **GD009** ``jax.vmap`` applied to a ``pallas_call``-backed callable
+  (a function whose body — directly or through module-local calls —
+  reaches ``pl.pallas_call``, a name bound to one, or a lambda/partial
+  wrapping one).  ``vmap`` has no batching rule for a custom kernel: it
+  lowers to a SERIAL loop of per-element kernel launches, silently
+  forfeiting the batch parallelism the kernel was written for.  Make the
+  batch axis a Pallas **grid dimension** instead (cf.
+  ``ops/pallas_bdcm.dp_contract_grouped`` — the group axis is
+  ``grid[0]``, never a vmap).
 
 Escape hatches, all requiring an explicit code list (``all`` allowed):
 
@@ -87,6 +96,7 @@ RULES = {
     "GD006": "rollout-shaped jitted entry point without donate_argnums",
     "GD007": "non-atomic persistence (direct np.savez / open-for-write outside utils/io.py)",
     "GD008": "per-iteration host->device transfer (jnp.asarray/device_put) in a driver-module for-loop",
+    "GD009": "jax.vmap over a pallas_call-backed callable (serial kernel-launch loop, not a batched grid)",
 }
 
 # host->device transfer calls GD008 watches inside host for-loops
@@ -331,6 +341,7 @@ class _FileLinter:
         self._check_dtypes(tree)
         self._check_persistence(tree)
         self._check_host_loop_transfers(tree, seen)
+        self._check_vmap_pallas(tree)
         self.findings.sort(key=lambda f: (f.line, f.col, f.code))
         return self.findings
 
@@ -525,6 +536,104 @@ class _FileLinter:
                         f"(see graphdyn.pipeline), or hoist the transfer "
                         f"out of the loop",
                     )
+
+    def _check_vmap_pallas(self, tree: ast.Module):
+        """GD009: ``jax.vmap`` over a ``pallas_call``-backed callable.
+        ``vmap`` has no batching rule for a custom kernel — it lowers to a
+        serial Python loop of per-element kernel launches, not a batched
+        grid.  'Backed' is resolved syntactically within the module:
+        functions whose body calls ``pallas_call`` (transitively through
+        module-local calls), names assigned from ``pl.pallas_call(...)``,
+        and ``partial(...)`` wrappers of either."""
+
+        def is_pallas_call(call: ast.Call) -> bool:
+            return _dotted(call.func).rsplit(".", 1)[-1] == "pallas_call"
+
+        def is_partial(call: ast.Call) -> bool:
+            d = _dotted(call.func)
+            return d == "partial" or d.endswith(".partial")
+
+        # module-local call graph + direct pallas_call containment
+        fn_calls: dict[str, set] = {}
+        backed: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                called = set()
+                direct = False
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        if is_pallas_call(sub):
+                            direct = True
+                        base = _dotted(sub.func).rsplit(".", 1)[-1]
+                        if base:
+                            called.add(base)
+                fn_calls.setdefault(node.name, set()).update(called)
+                if direct:
+                    backed.add(node.name)
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                # f = pl.pallas_call(...) / f = partial(backed, ...) are
+                # resolved below once `backed` is complete; record the
+                # direct pallas_call binding here
+                if is_pallas_call(node.value):
+                    backed.update(
+                        t.id for t in node.targets if isinstance(t, ast.Name)
+                    )
+        # propagate through module-local calls to a fixpoint (a wrapper of
+        # a kernel-backed function is itself kernel-backed)
+        changed = True
+        while changed:
+            changed = False
+            for name, called in fn_calls.items():
+                if name not in backed and called & backed:
+                    backed.add(name)
+                    changed = True
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ) and is_partial(node.value):
+                if any(
+                    isinstance(a, ast.Name) and a.id in backed
+                    for a in node.value.args
+                ):
+                    backed.update(
+                        t.id for t in node.targets if isinstance(t, ast.Name)
+                    )
+
+        def arg_is_backed(arg: ast.expr) -> bool:
+            if isinstance(arg, ast.Name):
+                return arg.id in backed
+            if isinstance(arg, ast.Call):
+                if is_pallas_call(arg):
+                    return True
+                if is_partial(arg):
+                    return any(arg_is_backed(a) for a in arg.args)
+            if isinstance(arg, ast.Lambda):
+                return any(
+                    isinstance(sub, ast.Call) and (
+                        is_pallas_call(sub)
+                        or (isinstance(sub.func, ast.Name)
+                            and sub.func.id in backed)
+                    )
+                    for sub in ast.walk(arg)
+                )
+            return False
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if not (d == "vmap" or d.endswith(".vmap")):
+                continue
+            if node.args and arg_is_backed(node.args[0]):
+                self.emit(
+                    node, "GD009",
+                    "jax.vmap over a pallas_call-backed callable lowers to "
+                    "a SERIAL loop of kernel launches — make the batch "
+                    "axis a Pallas grid dimension instead (cf. "
+                    "ops/pallas_bdcm.dp_contract_grouped)",
+                )
 
     def _check_persistence(self, tree: ast.Module):
         """GD007: direct durable writes outside utils/io.py. A torn npz/json

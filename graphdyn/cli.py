@@ -78,6 +78,21 @@ def _add_pipeline_flags(ap: argparse.ArgumentParser) -> None:
     )
 
 
+def _add_kernel_flag(ap: argparse.ArgumentParser) -> None:
+    """The shared sweep-core axis of the BDCM-backed commands
+    (ARCHITECTURE.md "Kernel selection")."""
+    ap.add_argument(
+        "--kernel", choices=["auto", "xla", "pallas"], default="auto",
+        help="BDCM sweep core: 'auto' fuses qualifying degree classes into "
+             "the grouped Pallas DP+contraction kernel on TPU backends "
+             "(group axis as a Pallas grid dimension); 'xla' forces the "
+             "pure-XLA sweep; 'pallas' forces the kernel (interpret mode "
+             "off-TPU — for tests, not a throughput mode). Pallas-vs-XLA "
+             "is an approximate mode (~1e-3 max rel err, PALLAS_TPU.json); "
+             "grouped and serial paths stay bit-identical WITHIN a mode",
+    )
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="graphdyn",
@@ -162,6 +177,7 @@ def build_parser() -> argparse.ArgumentParser:
     hpr.add_argument("--checkpoint-interval", type=float, default=30.0)
     _add_resilience_flags(hpr)
     _add_pipeline_flags(hpr)
+    _add_kernel_flag(hpr)
     _add_dtype_flag(hpr, "float64 matches the reference's solver precision "
                           "(`HPR_pytorch_RRG.py:11`; enables x64)")
     hpr.add_argument(
@@ -262,6 +278,7 @@ def build_parser() -> argparse.ArgumentParser:
              "on a background thread while the current cells sweep "
              "(deterministic; 0 disables)",
     )
+    _add_kernel_flag(ent)
     _add_dtype_flag(ent, "float64 matches the reference's precision "
                           "(enables x64)")
     ent.add_argument(
@@ -409,7 +426,7 @@ def _run(args) -> int:
                 g, cfg, n_replicas=args.batch_replicas, seed=args.seed,
                 checkpoint_path=args.checkpoint,
                 checkpoint_interval_s=args.checkpoint_interval,
-                device_init=args.device_init,
+                device_init=args.device_init, kernel=args.kernel,
             )
             if args.out:
                 from graphdyn.utils.io import save_results_npz
@@ -436,6 +453,7 @@ def _run(args) -> int:
             checkpoint_path=args.checkpoint,
             checkpoint_interval_s=args.checkpoint_interval,
             group_size=args.group_size, prefetch=args.prefetch,
+            kernel=args.kernel,
         )
         print(json.dumps({
             "solver": "hpr",
@@ -594,6 +612,7 @@ def _run(args) -> int:
             checkpoint_path=args.checkpoint,
             checkpoint_interval_s=args.checkpoint_interval,
             prefetch=args.prefetch, group_size=args.group_size,
+            kernel=args.kernel,
         )
         if args.plot:
             from graphdyn.plotting import plot_entropy_grid

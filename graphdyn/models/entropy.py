@@ -259,6 +259,7 @@ def entropy_sweep(
     checkpointer=None,
     class_bucket: int | None = None,
     prev_rows=None,
+    kernel: str = "auto",
 ) -> EntropyResult:
     """Run the λ ladder on one graph instance.
 
@@ -288,11 +289,14 @@ def entropy_sweep(
     makes serial-vs-grouped cell results element-wise identical — the PR-3
     lesson that two *differently structured* loop programs computing the
     same chain law diverge at the ulp level under XLA fusion. Regression-
-    anchored against the pre-refactor serial values. (As with ``hpr_solve``,
-    the shared cell program is the pure-XLA sweep core: the fused Pallas
-    kernel the pre-refactor serial fixed point could select on TPU is not
-    batched over cells, so the ladder trades it for cell parallelism; the
-    Pallas sweep remains available via ``make_sweep``/``make_fixed_point``.)
+    anchored against the pre-refactor serial values.
+
+    ``kernel`` selects the sweep core (``'auto'``/``'xla'``/``'pallas'``,
+    ARCHITECTURE.md "Kernel selection"): on TPU the default fuses each
+    qualifying degree class's DP + contraction into the grouped Pallas
+    kernel — the same kernel the grouped ``entropy_grid`` runs, at G=1, so
+    grouped == serial stays structural under EITHER core. Pallas-vs-XLA is
+    an approximate mode (~1e-3 max rel err, PALLAS_TPU.json).
     """
     config = config or EntropyConfig()
     dyn = config.dynamics
@@ -311,7 +315,7 @@ def entropy_sweep(
         class_bucket=class_bucket,
         dtype=config.dtype,
     )
-    ex = EntropyCellExec([(data, n_total, n_iso)], config)
+    ex = EntropyCellExec([(data, n_total, n_iso)], config, kernel=kernel)
     fixed_point = ex.fixed_point1
     set_leaves = ex.set_leaves1
     phi_fn, minit_fn = ex.observe_fns(0)
@@ -989,6 +993,7 @@ def entropy_grid(
     class_bucket: int | None = 64,
     prefetch: int = 2,
     group_size: int | None = None,
+    kernel: str = "auto",
 ) -> EntropyGridResult:
     """The notebook's full experiment driver: deg-grid × repetitions × λ
     ladder on fresh ER instances (`ipynb:496-513`); ``save_path`` persists
@@ -1004,6 +1009,14 @@ def entropy_grid(
     stopped. Element-wise identical to the serial loop (one shared program
     family — ``entropy_sweep`` runs the G=1 instance). ``group_size=0``
     forces the legacy serial cell loop.
+
+    ``kernel`` selects the sweep core for both paths
+    (``'auto'``/``'xla'``/``'pallas'``, ARCHITECTURE.md "Kernel
+    selection"): on TPU the default runs each qualifying degree class
+    through the fused grouped Pallas kernel with the cell axis as a
+    Pallas grid dimension; grouped == serial still holds bit-exactly
+    within a mode (one program family), while Pallas-vs-XLA is the
+    documented ~1e-3 tolerance mode.
 
     ``prefetch`` overlaps the host-side ER sampling (and, grouped, the
     BDCM table builds) of upcoming grid cells with the current cells'
@@ -1131,7 +1144,7 @@ def entropy_grid(
                 res = entropy_sweep(
                     g, config, seed=gseed, lambdas=lambdas[k0:], chi0=chi0,
                     verbose=verbose, checkpointer=ck,
-                    class_bucket=class_bucket,
+                    class_bucket=class_bucket, kernel=kernel,
                     # restored prefix rows keep the plateau streak (if
                     # enabled) identical to an uninterrupted run's
                     prev_rows=(m_init[di, rep, :k0], ent1[di, rep, :k0])
@@ -1194,7 +1207,9 @@ def entropy_grid(
                         (m_init[di, rep, :k0], ent1[di, rep, :k0])
                         if k0 > 0 else None
                     )
-                ex = EntropyCellExec(cells, config, group_size=group_size)
+                ex = EntropyCellExec(
+                    cells, config, group_size=group_size, kernel=kernel
+                )
 
                 def record(gi, kk, lmv, phi, m0, e1, sw, failed,
                            _cm=cellmap):

@@ -140,6 +140,7 @@ def hpr_solve(
     checkpoint_path: str | None = None,
     checkpoint_interval_s: float = 30.0,
     chunk_sweeps: int = 200,
+    kernel: str = "auto",
 ) -> HPRResult:
     """Run one HPr chain on one graph instance.
 
@@ -158,8 +159,10 @@ def hpr_solve(
     *differently structured* loop programs (e.g. a fused while-loop vs its
     own op-by-op restatement) differ at the ulp level under XLA fusion and
     eventually flip a chain decision, so sharing one program family is the
-    only robust identity. (The chain body is the pure-XLA sweep core; the
-    Pallas sweep remains available to ``hpr_solve_batch``/``make_sweep``.)
+    only robust identity. ``kernel`` selects the chain's sweep core
+    (``'auto'``/``'xla'``/``'pallas'`` — on TPU the default fuses
+    qualifying classes into the grouped Pallas kernel at G=1, the same
+    kernel the grouped driver runs; ARCHITECTURE.md "Kernel selection").
     """
     t_start = time.perf_counter()
     config = config or HPRConfig()
@@ -173,7 +176,7 @@ def hpr_solve(
         graph, tables, p=dyn.p, c=dyn.c, attr_value=dyn.attr_value,
         rule=dyn.rule, tie=dyn.tie, dtype=dtype,
     )
-    ex = HPRGroupExec([(graph, data)], config)
+    ex = HPRGroupExec([(graph, data)], config, kernel=kernel)
     TT = int(config.max_sweeps)
 
     ckpt = None
@@ -254,7 +257,8 @@ class HPRBatchResult(NamedTuple):
 
 
 def union_setup(
-    graph: Graph, config: HPRConfig, R: int, *, device: bool = False
+    graph: Graph, config: HPRConfig, R: int, *, device: bool = False,
+    use_pallas="auto",
 ) -> _HPRSetup:
     """R-replica disjoint-union HPr setup in the REPLICA-MAJOR edge layout
     (:func:`graphdyn.graphs.replicate_edge_tables`): replica ``r``'s directed
@@ -276,12 +280,13 @@ def union_setup(
             rule=dyn.rule, tie=dyn.tie, dtype=jnp.dtype(config.dtype),
         )
         data_u = replicate_bdcm_device(base, R)
-        return _prep(data_u.graph, config, tables=data_u.tables, data=data_u)
+        return _prep(data_u.graph, config, tables=data_u.tables, data=data_u,
+                     use_pallas=use_pallas)
     from graphdyn.graphs import replicate_disjoint, replicate_edge_tables
 
     gu = replicate_disjoint(graph, R)
     tabs = replicate_edge_tables(build_edge_tables(graph), R, graph.n)
-    return _prep(gu, config, tables=tabs)
+    return _prep(gu, config, tables=tabs, use_pallas=use_pallas)
 
 
 def _draw_union_chi(rng, R: int, twoE: int, K: int, np_dt) -> np.ndarray:
@@ -360,6 +365,18 @@ def _make_hpr_batch_body(setup: _HPRSetup, graph: Graph, R_blk: int):
     return body, m_per_replica
 
 
+def _kernel_to_use_pallas(kernel: str):
+    """Map the drivers' ``kernel`` axis onto the serial sweep's
+    ``use_pallas`` knob (one vocabulary at the CLI, both program
+    families)."""
+    try:
+        return {"auto": "auto", "xla": False, "pallas": True}[kernel]
+    except KeyError:
+        raise ValueError(
+            f"kernel must be 'auto', 'xla' or 'pallas', got {kernel!r}"
+        ) from None
+
+
 def make_hpr_batch_chunk(
     graph: Graph,
     config: HPRConfig,
@@ -368,6 +385,7 @@ def make_hpr_batch_chunk(
     mesh=None,
     replica_axis: str = "replica",
     device_tables: bool = False,
+    kernel: str = "auto",
 ):
     """Build the jitted chunk program ``(chi, biases, s, keys, t, m_final,
     active, steps, t_end) -> same-shape state`` advancing ``Rtot`` batched
@@ -387,8 +405,10 @@ def make_hpr_batch_chunk(
             "device_tables=True is incompatible with mesh= (the mesh path "
             "host-shards its per-device union blocks)"
         )
+    use_pallas = _kernel_to_use_pallas(kernel)
     if mesh is None:
-        setup = union_setup(graph, config, Rtot, device=device_tables)
+        setup = union_setup(graph, config, Rtot, device=device_tables,
+                            use_pallas=use_pallas)
         body, m_per_replica = _make_hpr_batch_body(setup, graph, Rtot)
 
         @jax.jit
@@ -412,7 +432,7 @@ def make_hpr_batch_chunk(
     if Rtot % shards:
         raise ValueError(f"Rtot={Rtot} not divisible by {shards} replica shards")
     R_local = Rtot // shards
-    setup_l = union_setup(graph, config, R_local)
+    setup_l = union_setup(graph, config, R_local, use_pallas=use_pallas)
     body_l, _ = _make_hpr_batch_body(setup_l, graph, R_local)
     rep = P(replica_axis)
 
@@ -455,6 +475,7 @@ def hpr_solve_batch(
     checkpoint_interval_s: float = 30.0,
     chunk_sweeps: int = 200,
     device_init: bool = False,
+    kernel: str = "auto",
 ) -> HPRBatchResult:
     """Run R independent HPr chains on ONE graph as a single batched device
     program — the BASELINE config-2 replica axis (`N=1e5, 256 replicas`).
@@ -511,7 +532,7 @@ def hpr_solve_batch(
 
     run_chunk, setup = make_hpr_batch_chunk(
         graph, config, Rtot, mesh=mesh, replica_axis=replica_axis,
-        device_tables=device_init,
+        device_tables=device_init, kernel=kernel,
     )
     TT = setup.TT
 
@@ -682,6 +703,7 @@ def hpr_ensemble(
     checkpoint_interval_s: float = 30.0,
     group_size: int | None = None,
     prefetch: int = 2,
+    kernel: str = "auto",
 ) -> HPREnsembleResult:
     """The reference's experiment driver (`HPR_pytorch_RRG.py:259-377`):
     ``n_rep`` repetitions, each on a freshly sampled RRG(n, d); pass
@@ -717,7 +739,7 @@ def hpr_ensemble(
             n, d, config, n_rep=n_rep, seed=seed, graph_method=graph_method,
             save_path=save_path, checkpoint_path=checkpoint_path,
             checkpoint_interval_s=checkpoint_interval_s,
-            group_size=group_size, prefetch=prefetch,
+            group_size=group_size, prefetch=prefetch, kernel=kernel,
         )
     from graphdyn.graphs import random_regular_graph
     from graphdyn.resilience import faults as _faults
@@ -767,6 +789,7 @@ def hpr_ensemble(
                 # from a later rep would wedge the earlier rep's resume
                 checkpoint_path=(checkpoint_path + f"_chain{k}") if checkpoint_path else None,
                 checkpoint_interval_s=checkpoint_interval_s,
+                kernel=kernel,
             )
         except ShutdownRequested:
             # the in-flight chain checkpointed itself; persist the

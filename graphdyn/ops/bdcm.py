@@ -383,35 +383,94 @@ def _sweep_exec(chi, lmbd, bias_edge, valid, x0, tables, spec: _SweepSpec):
     return _sweep_core(chi, lmbd, bias_edge, valid, x0, tables, spec)
 
 
-def _resolve_pallas_modes(data: BDCMData, use_pallas) -> tuple:
-    # graftlint: disable-next-line=GD004  dtype *guard*, no f64 created
-    if data.dtype == jnp.float64:
-        # the fused kernel is f32-only; f64 runs always take the XLA path.
-        # Refuse an explicit force rather than silently comparing XLA to
-        # itself in a parity test.
-        if use_pallas is True:
-            raise ValueError(
-                "use_pallas=True is incompatible with BDCMData(dtype=float64) "
-                "— the Pallas kernel is f32-only; use dtype=float32 or "
-                "use_pallas='auto'/False"
-            )
-        return tuple("" for _ in data.edge_classes)
-    on_tpu = jax.default_backend() == "tpu"
-    if use_pallas == "auto":
-        pallas_mode = "tpu" if on_tpu else "off"
-    elif use_pallas:
-        pallas_mode = "tpu" if on_tpu else "interpret"
-    else:
-        pallas_mode = "off"
-    modes = []
-    for cls in data.edge_classes:
-        ok = False
-        if pallas_mode != "off":
-            from graphdyn.ops.pallas_bdcm import pallas_supported
+def _pallas_class_modes(choice: str, dtype, gates, *, force_err: str) -> tuple:
+    """The ONE mode-resolution core behind the serial
+    (:func:`_resolve_pallas_modes`) and grouped
+    (:func:`resolve_group_pallas_modes`) resolvers: the f32-only dtype
+    guard (forcing the kernel under f64 is refused loudly — never silently
+    comparing XLA to itself in a parity test), the backend→mode mapping,
+    and the per-class degrade loop. ``choice`` is ``'auto'``/``'force'``/
+    ``'off'``; ``gates`` holds one zero-arg support predicate per class.
 
-            ok = pallas_supported(cls.d, data.T, int(cls.idx.shape[0]))
-        modes.append(pallas_mode if ok else "")
-    return tuple(modes)
+    Chip backends: the tunneled TPU plugin reports ``"tpu"``; ``"axon"``
+    is hedged like every other chip-backend allowlist in the repo
+    (bench.py ``on_chip``, ``CHIP_BACKENDS``) — on either, ``'auto'``
+    selects the compiled kernel and ``'force'`` compiles too; off-chip a
+    force means interpret mode (tests, not throughput)."""
+    # graftlint: disable-next-line=GD004  dtype *guard*, no f64 created
+    if jnp.dtype(dtype) == jnp.float64:
+        if choice == "force":
+            raise ValueError(force_err)
+        return ("",) * len(gates)
+    on_chip = jax.default_backend() in ("tpu", "axon")
+    if choice == "auto":
+        mode = "tpu" if on_chip else "off"
+    elif choice == "force":
+        mode = "tpu" if on_chip else "interpret"
+    else:
+        mode = "off"
+    return tuple(
+        mode if (mode != "off" and gate()) else "" for gate in gates
+    )
+
+
+def _resolve_pallas_modes(data: BDCMData, use_pallas) -> tuple:
+    from graphdyn.ops.pallas_bdcm import pallas_supported
+
+    gates = [
+        lambda d=cls.d, Ed=int(cls.idx.shape[0]): pallas_supported(
+            d, data.T, Ed
+        )
+        for cls in data.edge_classes
+    ]
+    choice = (
+        "auto" if use_pallas == "auto" else ("force" if use_pallas else "off")
+    )
+    return _pallas_class_modes(
+        choice, data.dtype, gates,
+        force_err=(
+            "use_pallas=True is incompatible with BDCMData(dtype=float64) "
+            "— the Pallas kernel is f32-only; use dtype=float32 or "
+            "use_pallas='auto'/False"
+        ),
+    )
+
+
+def resolve_group_pallas_modes(
+    class_ds, class_eds, *, T: int, dtype, kernel: str, G: int,
+    per_group_a: bool,
+) -> tuple:
+    """Per-class kernel modes (``''`` XLA | ``'tpu'`` | ``'interpret'``) for
+    the GROUPED executors — the grouped mirror of
+    :func:`_resolve_pallas_modes`, with the group-aware VMEM gate
+    (:func:`graphdyn.ops.pallas_bdcm.pallas_group_supported`).
+
+    ``kernel``: ``'auto'`` selects the fused grouped kernel on chip
+    backends for every class whose spec fits; ``'pallas'`` forces it
+    (interpret mode off-chip, for tests); ``'xla'`` keeps the pure-XLA
+    path. A class whose group-resident VMEM model returns 0 degrades to
+    XLA per call rather than erroring (the static half of the contract;
+    runtime lowering failures go through :func:`pallas_fallback_spec`)."""
+    if kernel not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"kernel must be 'auto', 'xla' or 'pallas', got {kernel!r}"
+        )
+    from graphdyn.ops.pallas_bdcm import pallas_group_supported
+
+    gates = [
+        lambda d=int(d), Ed=int(Ed): pallas_group_supported(
+            d, T, Ed, int(G), per_group_a=per_group_a
+        )
+        for d, Ed in zip(class_ds, class_eds)
+    ]
+    choice = {"auto": "auto", "xla": "off", "pallas": "force"}[kernel]
+    return _pallas_class_modes(
+        choice, dtype, gates,
+        force_err=(
+            "kernel='pallas' is incompatible with dtype=float64 — the "
+            "Pallas kernel is f32-only; use float32 or kernel='auto'/'xla'"
+        ),
+    )
 
 
 def pallas_fallback_spec(spec: _SweepSpec, exc: BaseException) -> _SweepSpec:
@@ -422,7 +481,10 @@ def pallas_fallback_spec(spec: _SweepSpec, exc: BaseException) -> _SweepSpec:
     failure with no Pallas mode to blame — re-raises. Callers swap their
     spec for the returned one, so the rebuild happens once per program
     (``_resolve_pallas_modes`` alone only makes the *static* dtype/backend
-    choice and cannot see a lowering failure)."""
+    choice and cannot see a lowering failure). Duck-typed on the spec's
+    ``pallas`` tuple, so the grouped executors' specs
+    (``pipeline.hpr_group._HPRGroupSpec``,
+    ``pipeline.entropy_group._CellSpec``) ride the same machinery."""
     if not any(spec.pallas) or not _faults.is_lowering_failure(exc):
         raise exc
     log.warning(

@@ -12,6 +12,27 @@ Layout: **edges are the lane axis** (last, 128-multiple). All per-edge work
 is elementwise across edges with *identical* control flow, so one vector op
 serves a whole tile; K = 2^T and M = (d+1)^T ride the sublane axis.
 
+Group axis: the batched executors (``graphdyn.pipeline`` — HPr ensembles and
+entropy λ-ladder cell groups) carry a leading group axis ``G``. The grouped
+variant (:func:`dp_contract_grouped`) makes that axis a **grid dimension**
+``grid = (G, n_tiles)`` — NOT a ``vmap`` of the serial kernel, which would
+lower to a serial Python loop of G kernel launches (graftlint GD009). The
+serial :func:`dp_contract` is the G=1 instance of the grouped kernel, so
+"grouped == serial within the same kernel" is structural, not maintained.
+
+The ``A_tilted`` rows come in two variants:
+
+- **shared** (``a_tilted[K, K, M]``): every group contracts against the same
+  rows — the HPr ensembles' shape (one λ, congruent reps). The block is
+  grid-invariant; Pallas fetches it once.
+- **group-resident** (``a_tilted[G, K, K, M]``): each group carries its own
+  rows — the entropy cell groups' shape (per-cell λ-tilt). The whole stack
+  sits VMEM-resident with a constant index map (one up-front DMA; the block
+  never revolves, so the byte model charges it singly) and the kernel
+  selects its group's rows by ``pl.program_id(0)``. ``vmem_block_edges(d, T,
+  G=G)`` models this residency; 0 means the stack cannot fit and the caller
+  must keep that class on the XLA path.
+
 The ρ-lattice shift-convolution uses a *flat* mixed-radix shift: trajectory
 ``k`` with bits ``b_t`` advances the flat index by
 ``off_k = Σ_t b_t·(d+1)^{T−1−t}``. This equals the per-axis rolls of the XLA
@@ -54,15 +75,34 @@ VMEM_BUDGET = 10 * 1024 * 1024
 MAX_BLOCK_EDGES = 8192  # wider tiles add nothing once the VPU is saturated
 
 
-def vmem_block_edges(d: int, T: int, budget: int = VMEM_BUDGET) -> int:
+def vmem_block_edges(d: int, T: int, budget: int = VMEM_BUDGET,
+                     G: int = 0) -> int:
     """Largest lane-multiple edge-tile width whose VMEM working set fits
-    ``budget``: 2×(chi_in + chi_old + out) pipelined blocks, the broadcast A
-    rows, and the two [K, M, Eb] DP scratch buffers — capped at
-    ``MAX_BLOCK_EDGES``. Returns 0 when even a single lane-width tile does
-    not fit."""
+    ``budget``, capped at ``MAX_BLOCK_EDGES``. Returns 0 when even a single
+    lane-width tile does not fit (callers keep that class on the XLA path).
+
+    Byte model (f32 = 4 B):
+
+    - ``G=0`` — the serial / shared-A kernel: the broadcast A rows
+      ``[K², M]`` ride the grid pipeline double-buffered → fixed
+      ``8·K²·M``.
+    - ``G>=1`` — the group-resident variant (per-group ``A_tilted``): the
+      whole ``[G, K², M]`` stack sits resident under a constant index map —
+      fetched once before the grid sweep, never revolved, so it is charged
+      SINGLY → fixed ``4·G·K²·M``. At G=1 this coincides with half the
+      shared fixed term, so a grouped G=1 program never tiles narrower
+      than the serial program.
+
+    Per edge lane: the pipelined chi_in/chi_old/out blocks
+    (``(d+2)·K²`` values, ×2 buffers) plus the two un-pipelined DP scratch
+    buffers (``K·M`` each) → ``8·(K²·(d+2) + K·M)`` bytes.
+    """
     K = 2**T
     M = (d + 1) ** T
-    fixed = 8 * K * K * M                        # a_rows, double-buffered
+    if G:
+        fixed = 4 * G * K * K * M                # resident A stack, single
+    else:
+        fixed = 8 * K * K * M                    # a_rows, double-buffered
     per_edge = 8 * (K * K * (d + 2) + K * M)     # blocks ×2 + scratch ×2
     eb = (budget - fixed) // per_edge
     return int(min(MAX_BLOCK_EDGES, max(0, eb // LANE) * LANE))
@@ -77,10 +117,10 @@ def _flat_offsets(d: int, T: int) -> np.ndarray:
 
 
 def _dp_contract_kernel(
-    chi_in_ref,   # [d, K, K, Eb]  gathered incoming messages (src-traj major)
-    a_ref,        # [K*K, M, 1]    tilted factor tensor rows (x_i*K + x_j)
-    chi_old_ref,  # [K, K, Eb]     current messages of this tile (for damping)
-    out_ref,      # [K, K, Eb]
+    chi_in_ref,   # [1, d, K, K, Eb] gathered incoming messages (this group)
+    a_ref,        # [K*K, M, 1] shared | [G, K*K, M, 1] group-resident rows
+    chi_old_ref,  # [1, K, K, Eb]  current messages of this tile (damping)
+    out_ref,      # [1, K, K, Eb]
     ll_ref,       # scratch [K, M, Eb]
     acc_ref,      # scratch [K, M, Eb]
     *,
@@ -90,6 +130,7 @@ def _dp_contract_kernel(
     offsets: tuple,
     damp: float,
     eps_clamp: float,
+    per_group_a: bool,
 ):
     # DP base case: δ(ρ = 0) for every destination trajectory x_i
     ll_ref[:] = jnp.zeros_like(ll_ref)
@@ -102,30 +143,42 @@ def _dp_contract_kernel(
         for k in range(K):
             off = offsets[k]
             for xi in range(K):
-                w = chi_in_ref[D, k, xi, :]       # [Eb]
+                w = chi_in_ref[0, D, k, xi, :]    # [Eb]
                 if off == 0:
                     dst[xi, :, :] += src[xi, :, :] * w[None, :]
                 else:
                     dst[xi, off:M, :] += src[xi, 0 : M - off, :] * w[None, :]
     final = ll_ref if d % 2 == 0 else acc_ref
 
+    if per_group_a:
+        # group-resident rows: the whole [G, K*K, M, 1] stack is in VMEM;
+        # this program instance reads its own group's slab
+        g = pl.program_id(0)
+
+        def a_row(row):
+            return a_ref[g, row, :, :]
+    else:
+
+        def a_row(row):
+            return a_ref[row, :, :]
+
     # contraction chi2[xi, xj, :] = Σ_m A_tilted[xi, xj, m]·LL[xi, m, :],
     # then ε-clamp, tile-local normalization, damping — all in VMEM
-    z = jnp.zeros_like(out_ref[0, 0, :])
+    z = jnp.zeros_like(out_ref[0, 0, 0, :])
     for xi in range(K):
         for xj in range(K):
             row = jnp.maximum(
-                jnp.sum(a_ref[xi * K + xj, :, :] * final[xi, :, :], axis=0),
+                jnp.sum(a_row(xi * K + xj) * final[xi, :, :], axis=0),
                 eps_clamp,
             )
-            out_ref[xi, xj, :] = row
+            out_ref[0, xi, xj, :] = row
             z = z + row
     inv = 1.0 / jnp.maximum(z, jnp.finfo(jnp.float32).tiny)
     for xi in range(K):
         for xj in range(K):
-            out_ref[xi, xj, :] = (
-                damp * out_ref[xi, xj, :] * inv
-                + (1.0 - damp) * chi_old_ref[xi, xj, :]
+            out_ref[0, xi, xj, :] = (
+                damp * out_ref[0, xi, xj, :] * inv
+                + (1.0 - damp) * chi_old_ref[0, xi, xj, :]
             )
 
 
@@ -133,10 +186,10 @@ def _dp_contract_kernel(
     jax.jit,
     static_argnames=("d", "T", "damp", "eps_clamp", "block_edges", "interpret"),
 )
-def dp_contract(
-    chi_in,      # f32[Ed, d, K, K]  (gathered, bias/mask already applied)
-    a_tilted,    # f32[K, K, M]
-    chi_old,     # f32[Ed, K, K]
+def dp_contract_grouped(
+    chi_in,      # f32[G, Ed, d, K, K]  (gathered, bias/mask already applied)
+    a_tilted,    # f32[K, K, M] shared | f32[G, K, K, M] per-group
+    chi_old,     # f32[G, Ed, K, K]
     *,
     d: int,
     T: int,
@@ -145,26 +198,34 @@ def dp_contract(
     block_edges: int | None = None,
     interpret: bool = False,
 ):
-    """Fused DP + contraction + normalize + damp for one edge-degree class.
+    """Fused DP + contraction + normalize + damp for one edge-degree class
+    of a GROUP of independent instances — group axis as the leading Pallas
+    grid dimension (``grid = (G, n_tiles)``), never a ``vmap`` over kernel
+    launches.
 
-    ``block_edges=None`` picks the widest lane-multiple tile that fits the
-    VMEM budget (:func:`vmem_block_edges`); an explicit value is still
-    clamped to that budget. Returns f32[Ed, K, K] — the damped updated
-    messages for these edges.
+    ``a_tilted``'s rank selects the A variant: rank 3 is one shared row set
+    (HPr ensembles — same λ across reps), rank 4 carries per-group rows
+    VMEM-resident (entropy cell groups — per-cell λ-tilt; gated by
+    ``vmem_block_edges(d, T, G=G)``). ``block_edges=None`` picks the widest
+    lane-multiple tile that fits the VMEM budget; tile width never changes
+    numerics (all per-lane work is elementwise across lanes). Returns
+    f32[G, Ed, K, K].
     """
     K = 2**T
     M = (d + 1) ** T
-    Ed = chi_in.shape[0]
+    G, Ed = chi_in.shape[0], chi_in.shape[1]
+    per_group_a = a_tilted.ndim == 4
     # trace-time kernel constants from static (d, T) — no device value
     # graftlint: disable-next-line=GD003  static ints for the kernel spec
     offsets = tuple(int(o) for o in _flat_offsets(d, T))
 
-    budget_eb = vmem_block_edges(d, T)
+    budget_eb = vmem_block_edges(d, T, G=G if per_group_a else 0)
     if budget_eb == 0 and not interpret:
         raise ValueError(
-            f"dp_contract(d={d}, T={T}): no lane-multiple edge tile fits the "
+            f"dp_contract_grouped(d={d}, T={T}, G={G}, per_group_a="
+            f"{per_group_a}): no lane-multiple edge tile fits the "
             f"{VMEM_BUDGET >> 20} MiB VMEM budget (K·M = {K * M}); use the "
-            "XLA path (pallas_supported() gates this automatically)"
+            "XLA path (pallas_group_supported() gates this automatically)"
         )
     vmem_eb = max(LANE, budget_eb)               # interpret mode has no VMEM
     Eb = min(
@@ -178,12 +239,22 @@ def dp_contract(
     # edges -> lane axis; pad lanes carry zeros (z=0 -> tiny denominator,
     # outputs on pad lanes are discarded by the final slice)
     chi_in_t = jnp.pad(
-        jnp.transpose(chi_in, (1, 2, 3, 0)), ((0, 0),) * 3 + ((0, pad),)
+        jnp.transpose(chi_in, (0, 2, 3, 4, 1)), ((0, 0),) * 4 + ((0, pad),)
     )
     chi_old_t = jnp.pad(
-        jnp.transpose(chi_old, (1, 2, 0)), ((0, 0),) * 2 + ((0, pad),)
+        jnp.transpose(chi_old, (0, 2, 3, 1)), ((0, 0),) * 3 + ((0, pad),)
     )
-    a_rows = a_tilted.reshape(K * K, M, 1).astype(jnp.float32)
+    if per_group_a:
+        a_rows = a_tilted.reshape(G, K * K, M, 1).astype(jnp.float32)
+        a_spec = pl.BlockSpec(
+            (G, K * K, M, 1), lambda g, i: (0, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        )
+    else:
+        a_rows = a_tilted.reshape(K * K, M, 1).astype(jnp.float32)
+        a_spec = pl.BlockSpec(
+            (K * K, M, 1), lambda g, i: (0, 0, 0), memory_space=pltpu.VMEM
+        )
 
     kernel = functools.partial(
         _dp_contract_kernel,
@@ -193,35 +264,80 @@ def dp_contract(
         offsets=offsets,
         damp=float(damp),
         eps_clamp=float(eps_clamp),
+        per_group_a=per_group_a,
     )
     out_t = pl.pallas_call(
         kernel,
-        grid=(n_tiles,),
+        grid=(G, n_tiles),
         in_specs=[
             pl.BlockSpec(
-                (d, K, K, Eb), lambda i: (0, 0, 0, i), memory_space=pltpu.VMEM
+                (1, d, K, K, Eb), lambda g, i: (g, 0, 0, 0, i),
+                memory_space=pltpu.VMEM,
             ),
-            pl.BlockSpec((K * K, M, 1), lambda i: (0, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((K, K, Eb), lambda i: (0, 0, i), memory_space=pltpu.VMEM),
+            a_spec,
+            pl.BlockSpec(
+                (1, K, K, Eb), lambda g, i: (g, 0, 0, i),
+                memory_space=pltpu.VMEM,
+            ),
         ],
         out_specs=pl.BlockSpec(
-            (K, K, Eb), lambda i: (0, 0, i), memory_space=pltpu.VMEM
+            (1, K, K, Eb), lambda g, i: (g, 0, 0, i), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((K, K, Ed + pad), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((G, K, K, Ed + pad), jnp.float32),
         scratch_shapes=[
             pltpu.VMEM((K, M, Eb), jnp.float32),
             pltpu.VMEM((K, M, Eb), jnp.float32),
         ],
         interpret=interpret,
     )(chi_in_t.astype(jnp.float32), a_rows, chi_old_t.astype(jnp.float32))
-    return jnp.transpose(out_t[:, :, :Ed], (2, 0, 1))
+    return jnp.transpose(out_t[:, :, :, :Ed], (0, 3, 1, 2))
+
+
+def dp_contract(
+    chi_in,      # f32[Ed, d, K, K]  (gathered, bias/mask already applied)
+    a_tilted,    # f32[K, K, M]
+    chi_old,     # f32[Ed, K, K]
+    *,
+    d: int,
+    T: int,
+    damp: float,
+    eps_clamp: float = 0.0,
+    block_edges: int | None = None,
+    interpret: bool = False,
+):
+    """Fused DP + contraction + normalize + damp for one edge-degree class —
+    the G=1 instance of :func:`dp_contract_grouped` (shared-A variant), so
+    the serial Pallas path and the grouped Pallas path run the SAME kernel
+    body: grouped-vs-serial parity is one-kernel parity, bit-exact by
+    construction (per-lane work is elementwise across lanes and tile
+    widths; tested). Returns f32[Ed, K, K]."""
+    return dp_contract_grouped(
+        chi_in[None], a_tilted, chi_old[None],
+        d=d, T=T, damp=damp, eps_clamp=eps_clamp,
+        block_edges=block_edges, interpret=interpret,
+    )[0]
 
 
 def pallas_supported(d: int, T: int, Ed: int) -> bool:
-    """Gate for the fused kernel. Bounds validated on a real v5e chip
-    (see PALLAS_TPU.md): the unrolled body scales as d·K² slice-FMAs, so we
-    keep the reference regime (T ≤ 4, d ≤ 8), require at least one full lane
-    tile of edges, and require a lane-multiple tile to fit the VMEM budget
-    (:func:`vmem_block_edges` — replaces the earlier K·M heuristic that
-    admitted >2×16 MiB scratch at its own upper end)."""
+    """Gate for the fused kernel (serial / shared-A). Bounds validated on a
+    real v5e chip (see PALLAS_TPU.md): the unrolled body scales as d·K²
+    slice-FMAs, so we keep the reference regime (T ≤ 4, d ≤ 8), require at
+    least one full lane tile of edges, and require a lane-multiple tile to
+    fit the VMEM budget (:func:`vmem_block_edges` — replaces the earlier
+    K·M heuristic that admitted >2×16 MiB scratch at its own upper end)."""
     return T <= 4 and d <= 8 and Ed >= LANE and vmem_block_edges(d, T) >= LANE
+
+
+def pallas_group_supported(
+    d: int, T: int, Ed: int, G: int, *, per_group_a: bool
+) -> bool:
+    """Gate for the grouped kernel: the serial regime bounds plus the
+    grouped VMEM model — with ``per_group_a`` the resident ``[G, K², M]``
+    A stack joins the working set (:func:`vmem_block_edges` with ``G``), so
+    a group too large for VMEM degrades that class to the XLA path instead
+    of erroring (the executors re-check per call via the
+    ``pallas_fallback_spec`` machinery for anything the model misses)."""
+    return (
+        T <= 4 and d <= 8 and Ed >= LANE and G >= 1
+        and vmem_block_edges(d, T, G=G if per_group_a else 0) >= LANE
+    )

@@ -71,6 +71,8 @@ from graphdyn.ops.bdcm import (
     class_update,
     make_free_entropy,
     make_mean_m_init,
+    resilient_exec,
+    resolve_group_pallas_modes,
     stack_bdcm,
 )
 
@@ -92,6 +94,9 @@ class _CellSpec(NamedTuple):
     t_max: int            # max_sweeps
     chunk: int            # sweep budget per device call
     class_ds: tuple       # union degree-class neighbor counts d
+    pallas: tuple = ()    # per-class kernel mode: '' (XLA) | 'tpu' |
+    #                       'interpret' (resolve_group_pallas_modes; the
+    #                       runtime Pallas→XLA fallback swaps this tuple)
 
 
 @partial(jax.jit, static_argnames=("spec",))
@@ -111,7 +116,20 @@ def _cell_chunk_exec(chi, lmbd, active, delta0, t0, valid, x0, tables,
     never indexed by its tables, so they stay constant and contribute 0 to
     the per-cell delta; the ghost row 2E_max is concatenated per sweep,
     scattered with pad-member garbage, and sliced off — exactly the serial
-    ghost mechanism."""
+    ghost mechanism.
+
+    With any Pallas class mode set (``spec.pallas``), the chunk runs the
+    JOINT restatement (:func:`_cell_chunk_pallas`) instead: the fused
+    grouped kernel needs the cell axis as a Pallas grid dimension, which a
+    per-lane ``vmap`` cannot provide. Kernel choice is a numeric MODE
+    (Pallas-vs-XLA ≈ documented tolerance), never silently mixed: the
+    identity contract is grouped == serial *within the same mode*, and the
+    serial ladder (``entropy_sweep`` → G=1 instance of this same program)
+    follows the mode with it."""
+    if any(spec.pallas):
+        return _cell_chunk_pallas(
+            chi, lmbd, active, delta0, t0, valid, x0, tables, spec
+        )
     K = spec.K
     flat = [t for (idx, ie, _) in tables for t in (idx, ie)]
     As = [A for (_, _, A) in tables]
@@ -153,6 +171,85 @@ def _cell_chunk_exec(chi, lmbd, active, delta0, t0, valid, x0, tables,
     )
 
 
+def _cell_chunk_pallas(chi, lmbd, active, delta0, t0, valid, x0, tables,
+                       spec: _CellSpec):
+    """The Pallas-mode cell chunk: one JOINT while_loop whose body sweeps
+    every live lane through the fused grouped kernel
+    (:func:`graphdyn.ops.pallas_bdcm.dp_contract_grouped` — cell axis as
+    the leading grid dimension, per-cell λ-tilt carried as the
+    group-resident ``A_tilted`` stack) and freezes finished lanes by
+    select, which is exactly the transform ``vmap`` applies to the XLA
+    path's per-lane while_loop — so a lane's sweep count and freeze
+    semantics match the XLA chunk one-for-one, while the sweep arithmetic
+    is the kernel's (tolerance-based vs XLA, bit-exact across group
+    extents). Classes whose shape fails the grouped VMEM gate stay on the
+    vmapped :func:`class_update` inside the same sweep (mixed-mode
+    programs are still one program family at every G)."""
+    from graphdyn.ops.pallas_bdcm import dp_contract_grouped
+
+    K = spec.K
+    tilt = jnp.exp(-lmbd[:, None] * x0[None, :])        # [G, K] per-cell
+    cap = t0 + spec.chunk
+
+    def gather(ce, tab):
+        return jax.vmap(lambda c, t: c[t])(ce, tab)
+
+    # named apart from the XLA path's nested `sweep`: graftlint's GD009
+    # call-graph is module-local by bare name, and THIS one reaches
+    # pallas_call (via dp_contract_grouped) while the XLA one must stay
+    # freely vmappable
+    def fused_sweep(c):
+        ghost = jnp.full(
+            (c.shape[0], 1) + c.shape[2:], 1.0 / (K * K), c.dtype
+        )
+        ce = jnp.concatenate([c, ghost], axis=1)
+        for (d, mode), (idx, ie, A) in zip(
+            zip(spec.class_ds, spec.pallas), tables
+        ):
+            chi_in = gather(ce, ie) * valid[None, None, None, :, None]
+            chi_old = gather(ce, idx)
+            if mode:
+                # trace-time site: a firing plan here stands in for a real
+                # kernel lowering/compile failure on this backend
+                _faults.maybe_fail("pallas.lower", key=f"d={d}")
+                a_stack = A[None] * tilt[:, :, None, None]   # [G, K, K, M]
+                upd = dp_contract_grouped(
+                    chi_in, a_stack, chi_old, d=d, T=spec.T,
+                    damp=spec.damp, eps_clamp=spec.eps_clamp,
+                    interpret=mode == "interpret",
+                ).astype(c.dtype)
+            else:
+                upd = jax.vmap(
+                    lambda ci, co, tl, A=A, d=d: class_update(
+                        ci, A, tl, co, d=d, T=spec.T, K=K,
+                        damp=spec.damp, eps_clamp=spec.eps_clamp,
+                    )
+                )(chi_in, chi_old, tilt)
+            ce = jax.vmap(lambda c_, i_, u_: c_.at[i_].set(u_))(ce, idx, upd)
+        return ce[:, :-1]
+
+    def live_lanes(delta, t):
+        return active & (delta > spec.eps) & (t < spec.t_max) & (t < cap)
+
+    def cond(st):
+        _, delta, t = st
+        return jnp.any(live_lanes(delta, t))
+
+    def body(st):
+        c, delta, t = st
+        live = live_lanes(delta, t)
+        new = fused_sweep(c)
+        d_new = jnp.abs(new - c).max(axis=(1, 2, 3))
+        return (
+            jnp.where(live[:, None, None, None], new, c),
+            jnp.where(live, d_new, delta),
+            jnp.where(live, t + 1, t),
+        )
+
+    c, delta, t = lax.while_loop(cond, body, (chi, delta0, t0))
+    return c, t, delta
+
+
 @partial(jax.jit, static_argnames=("K",))
 def _cell_set_leaves_exec(chi, lmbd, active, leaf01, x0, leaf_idx, K: int):
     """Per-cell closed-form leaf messages at the cell's OWN λ; lanes not in
@@ -187,10 +284,29 @@ class EntropyCellExec:
     :func:`graphdyn.parallel.mesh.shard_stack` — cells are independent, so
     the partitioned program is communication-free except the per-lane
     while-loop stop test; results are bit-identical to the unsharded
-    program (tested)."""
+    program (tested).
+
+    ``kernel`` selects the sweep core per union degree class
+    (ARCHITECTURE.md "Kernel selection"): ``'auto'`` (default) fuses the
+    class's DP + contraction into the grouped Pallas kernel on TPU
+    backends when the group-resident spec fits
+    (:func:`graphdyn.ops.bdcm.resolve_group_pallas_modes` — the cell axis
+    becomes a Pallas grid dimension, each cell's λ-tilt carried in the
+    resident ``A_tilted`` stack); ``'pallas'`` forces it (interpret mode
+    off-TPU, for tests); ``'xla'`` keeps the pure-XLA path. Pallas-vs-XLA
+    is an approximate mode (~1e-3 max rel err, PALLAS_TPU.json); grouped
+    == serial holds bit-exactly WITHIN a mode because ``entropy_sweep``
+    runs the G=1 instance of this same program. A kernel
+    lowering/compile failure at run time degrades the program to XLA via
+    the shared :func:`graphdyn.ops.bdcm.pallas_fallback_spec` machinery
+    (logged, run continues); a spec the VMEM model rejects never selects
+    Pallas in the first place. The mesh path keeps the XLA core
+    (``kernel='pallas'`` with a mesh is refused: a Pallas launch inside a
+    GSPMD-partitioned cell axis is not a supported composition)."""
 
     def __init__(self, cells, config, *, group_size: int | None = None,
-                 chunk_sweeps: int = 64, mesh=None, cell_axis: str = "cell"):
+                 chunk_sweeps: int = 64, mesh=None, cell_axis: str = "cell",
+                 kernel: str = "auto"):
         G_real = len(cells)
         G = group_size or G_real
         if G < G_real:
@@ -204,17 +320,31 @@ class EntropyCellExec:
                     f"group size {G} not divisible by the mesh's "
                     f"{n_dev} devices"
                 )
+        if mesh is not None and kernel == "pallas":
+            raise ValueError(
+                "kernel='pallas' is incompatible with mesh= (a Pallas "
+                "launch inside the GSPMD-partitioned cell axis is not a "
+                "supported composition); use kernel='auto' or 'xla'"
+            )
         padded = list(cells) + [cells[0]] * (G - G_real)
         stk = stack_bdcm([c[0] for c in padded])
         self.stk: StackedBDCM = stk
         self.G, self.G_real = G, G_real
         self.dtype = stk.dtype
-        self.spec = _CellSpec(
+        self._state = {"spec": _CellSpec(
             T=stk.T, K=stk.K, damp=float(config.damp),
             eps_clamp=float(config.eps_clamp), eps=float(config.eps),
             t_max=int(config.max_sweeps), chunk=int(chunk_sweeps),
             class_ds=tuple(d for d, _, _, _ in stk.edge_classes),
-        )
+            # per-cell λ-tilts ride the group-resident A_tilted stack
+            pallas=resolve_group_pallas_modes(
+                [d for d, _, _, _ in stk.edge_classes],
+                [idx.shape[1] for _, idx, _, _ in stk.edge_classes],
+                T=stk.T, dtype=stk.dtype,
+                kernel="xla" if mesh is not None else kernel,
+                G=G, per_group_a=True,
+            ),
+        )}
 
         if mesh is None:
             place_g = place_r = jnp.asarray
@@ -254,6 +384,12 @@ class EntropyCellExec:
             for data, n_total, n_iso in cells
         ]
 
+    @property
+    def spec(self) -> _CellSpec:
+        """The CURRENT static spec — the runtime Pallas→XLA fallback swaps
+        the held spec, and every later chunk must see the rebuilt one."""
+        return self._state["spec"]
+
     # -- stacked (group) surface ----------------------------------------
 
     def stack_chi(self, chi_list) -> jnp.ndarray:
@@ -270,11 +406,14 @@ class EntropyCellExec:
 
     def fixed_point_chunk(self, chi, lmbd_vec, active, delta0, t0):
         """``(chi', t[G], delta[G])`` after at most ``chunk_sweeps`` more
-        sweeps per unfinished lane (carry resumes exactly)."""
-        return _cell_chunk_exec(
+        sweeps per unfinished lane (carry resumes exactly). A Pallas
+        lowering/compile failure degrades the program to the XLA path at
+        runtime (:func:`graphdyn.ops.bdcm.resilient_exec` — logged, the
+        rebuilt spec sticks for all later chunks)."""
+        return resilient_exec(self._state, lambda sp: _cell_chunk_exec(
             chi, lmbd_vec, active, delta0, t0, self.valid, self.x0,
-            self.tables, self.spec,
-        )
+            self.tables, sp,
+        ))
 
     def poison_cell(self, chi, g: int):
         """The ``sweep.nan`` fault payload for cell ``g`` — one NaN seeded

@@ -45,8 +45,13 @@ import jax.numpy as jnp
 from jax import lax
 
 from graphdyn.config import HPRConfig
-from graphdyn.ops.bdcm import class_update
+from graphdyn.ops.bdcm import (
+    class_update,
+    resilient_exec,
+    resolve_group_pallas_modes,
+)
 from graphdyn.ops.dynamics import batched_rollout_impl, rule_coefficients
+from graphdyn.resilience import faults as _faults
 
 
 class _HPRGroupSpec(NamedTuple):
@@ -64,6 +69,9 @@ class _HPRGroupSpec(NamedTuple):
     R_coef: int
     C_coef: int
     class_ds: tuple       # per-edge-class incoming-message count d
+    pallas: tuple = ()    # per-class kernel mode: '' (XLA) | 'tpu' |
+    #                       'interpret' (resolve_group_pallas_modes; the
+    #                       runtime Pallas→XLA fallback swaps this tuple)
 
 
 class _HPRGroupState(NamedTuple):
@@ -177,12 +185,58 @@ def _hpr_group_loop(
     vmarg = jax.vmap(marginals_one)
     vbias = jax.vmap(bias_to_edge_one)
 
+    if any(spec.pallas):
+        # Pallas-mode sweep: the fused grouped kernel with the rep axis as
+        # the leading grid dimension (never a vmap of kernel launches —
+        # graftlint GD009); λ is shared across reps, so A_tilted is the
+        # SHARED variant and one broadcast row block serves every rep.
+        # Classes that fail the grouped gate keep the vmapped XLA core
+        # inside the same sweep. Grouped == serial stays structural:
+        # hpr_solve runs the G=1 instance of this same program.
+        from graphdyn.ops.pallas_bdcm import dp_contract_grouped
+
+        def gather(arrs, tab):
+            return jax.vmap(lambda a, t_: a[t_])(arrs, tab)
+
+        def group_sweep(chi, bias_edge):
+            for (d, mode), (idx, in_edges, A) in zip(
+                zip(spec.class_ds, spec.pallas), tables
+            ):
+                chi_in = gather(chi, in_edges)       # [G, Ed, d, K, K]
+                chi_in = chi_in * gather(bias_edge, in_edges)[..., None]
+                chi_old = gather(chi, idx)
+                if mode:
+                    # trace-time site: a firing plan stands in for a real
+                    # kernel lowering/compile failure on this backend
+                    _faults.maybe_fail("pallas.lower", key=f"d={d}")
+                    upd = dp_contract_grouped(
+                        chi_in, A * tilt[:, None, None], chi_old,
+                        d=d, T=T, damp=spec.damp, eps_clamp=0.0,
+                        interpret=mode == "interpret",
+                    ).astype(chi.dtype)
+                else:
+                    upd = jax.vmap(
+                        lambda ci, co, A=A, d=d: class_update(
+                            ci, A, tilt, co, d=d, T=T, K=K,
+                            damp=spec.damp, eps_clamp=0.0,
+                        )
+                    )(chi_in, chi_old)
+                chi = jax.vmap(lambda c, i, u: c.at[i].set(u))(chi, idx, upd)
+            return chi
+
+        def run_sweep(chi, bias_edge):
+            return group_sweep(chi, bias_edge)
+    else:
+
+        def run_sweep(chi, bias_edge):
+            return vsweep(chi, bias_edge, *flat_tables)
+
     def cond(st: _HPRGroupState):
         return jnp.any(st.active) & (st.t < t_end)
 
     def body(st: _HPRGroupState):
         bias_edge = vbias(st.biases, src)
-        chi_new = vsweep(st.chi, bias_edge, *flat_tables)
+        chi_new = run_sweep(st.chi, bias_edge)
         marg = vmarg(chi_new, rev, out_edges)        # [G, n, 2]
         # reinforcement (`new_biases_i`, `HPR:137-145`), per repetition
         minus_wins = marg[..., 1] >= marg[..., 0]
@@ -264,10 +318,22 @@ class HPRGroupExec:
     schedules are invariant under the leading group extent (tested),
     whereas two *differently structured* loop programs — e.g. a fused
     while-loop vs its own op-by-op restatement — differ at the ulp level
-    under XLA fusion and eventually flip a chain decision."""
+    under XLA fusion and eventually flip a chain decision.
+
+    ``kernel`` selects the sweep core per degree class (ARCHITECTURE.md
+    "Kernel selection"): ``'auto'`` (default) fuses qualifying classes
+    into the grouped Pallas kernel on TPU backends (rep axis as a Pallas
+    grid dimension, shared ``A_tilted`` — one λ across reps);
+    ``'pallas'`` forces it (interpret off-TPU, for tests); ``'xla'``
+    keeps the pure-XLA path. Pallas-vs-XLA is an approximate mode (~1e-3
+    max rel err, PALLAS_TPU.json); grouped == serial holds bit-exactly
+    WITHIN a mode because ``hpr_solve`` runs the G=1 instance. A kernel
+    lowering/compile failure at run time degrades the program to XLA via
+    :func:`graphdyn.ops.bdcm.pallas_fallback_spec` (logged, run
+    continues)."""
 
     def __init__(self, items, config: HPRConfig, *,
-                 group_size: int | None = None):
+                 group_size: int | None = None, kernel: str = "auto"):
         G_real = len(items)
         G = group_size or G_real
         if G < G_real:
@@ -296,12 +362,19 @@ class HPRGroupExec:
 
         self.G, self.G_real, self.d0 = G, G_real, d0
         self._pad = pad
-        self.spec = _HPRGroupSpec(
+        self._state = {"spec": _HPRGroupSpec(
             T=d0.T, K=d0.K, n=d0.n, damp=float(config.damp),
             eps=float(config.eps_clamp), TT=int(config.max_sweeps),
             rollout_steps=dyn.p + dyn.c - 1, R_coef=R_coef, C_coef=C_coef,
             class_ds=tuple(c.d for c in d0.edge_classes),
-        )
+            # one λ across reps -> the SHARED A_tilted variant
+            pallas=resolve_group_pallas_modes(
+                [c.d for c in d0.edge_classes],
+                [c.idx.shape[0] for c in d0.edge_classes],
+                T=d0.T, dtype=d0.dtype, kernel=kernel, G=G,
+                per_group_a=False,
+            ),
+        )}
         dt = d0.dtype
         padded = pad(list(items))
         self.tables = tuple(
@@ -335,6 +408,12 @@ class HPRGroupExec:
             jnp.asarray(d0.x0 == 1),
             jnp.asarray(d0.x0 == 1, dt),
         )
+
+    @property
+    def spec(self) -> _HPRGroupSpec:
+        """The CURRENT static spec — the runtime Pallas→XLA fallback swaps
+        the held spec, and every later chunk must see the rebuilt one."""
+        return self._state["spec"]
 
     def init_state(self, chi0, biases0, s0, rep_seeds, *, t=0, m_final=None,
                    steps=None) -> _HPRGroupState:
@@ -373,12 +452,16 @@ class HPRGroupExec:
 
     def advance(self, state: _HPRGroupState, t_end) -> _HPRGroupState:
         """One bounded chunk of the shared loop program (donates the
-        carry)."""
-        return _hpr_group_loop(
+        carry). A Pallas lowering/compile failure degrades the program to
+        the XLA path at runtime (:func:`graphdyn.ops.bdcm.resilient_exec`
+        — logged; safe to retry because both the injected fault and a real
+        Mosaic failure fire at trace/compile time, before the donated
+        buffers are consumed)."""
+        return resilient_exec(self._state, lambda sp: _hpr_group_loop(
             state, jnp.int32(t_end), *self.consts,
             self.src, self.rev, self.out_edges, self.nbr_stack, self.tables,
-            spec=self.spec,
-        )
+            spec=sp,
+        ))
 
     def run(self, state: _HPRGroupState, *, chunk_sweeps: int = 200,
             on_chunk=None) -> _HPRGroupState:
@@ -401,13 +484,15 @@ def run_hpr_group(
     group_size: int | None = None,
     chunk_sweeps: int = 200,
     on_chunk=None,
+    kernel: str = "auto",
 ) -> HPRGroupResult:
     """Run one group of HPr chains (one per freshly sampled graph) as a
     single device program. ``items`` are :func:`_build_rep` outputs;
     ``group_size`` pads with inactive rows for shape stability;
     ``on_chunk`` is polled between device chunks (the graceful-shutdown
-    hook — it may raise)."""
-    ex = HPRGroupExec(items, config, group_size=group_size)
+    hook — it may raise); ``kernel`` selects the sweep core (see
+    :class:`HPRGroupExec`)."""
+    ex = HPRGroupExec(items, config, group_size=group_size, kernel=kernel)
     state = ex.init_state(
         [it[2] for it in items], [it[3] for it in items],
         [it[4] for it in items], rep_seeds,
@@ -435,6 +520,7 @@ def hpr_ensemble_grouped(
     group_size: int = 8,
     prefetch: int = 2,
     chunk_sweeps: int = 200,
+    kernel: str = "auto",
 ):
     """The grouped HPr experiment driver: ``n_rep`` repetitions on fresh
     RRG(n, d) instances, ``group_size`` at a time as one vmapped device
@@ -479,6 +565,7 @@ def hpr_ensemble_grouped(
                 items, [seed + i for i in ks], config,
                 group_size=group_size, chunk_sweeps=chunk_sweeps,
                 on_chunk=lambda k0=ks[0]: drv.chunk_poll(k0),
+                kernel=kernel,
             )
             elapsed = time.perf_counter() - t0
             for j, i in enumerate(ks):

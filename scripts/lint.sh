@@ -65,7 +65,26 @@ else
     echo "== faultcheck: pytest not installed — SKIPPED (pip install pytest to enable) =="
 fi
 
-# 5. benchcheck — the benchmark's single-JSON-line contract, live (python
+# 5. pallascheck — the interpret-mode Pallas kernel parity subset
+#    standalone (pytest -m pallas_interpret): the fused BDCM kernel —
+#    serial and grouped — must reproduce the XLA sweep within the
+#    documented tolerance, and grouped must equal G=1 bit-exactly, on
+#    every PR, not only when a chip window happens to run
+#    scripts/pallas_tpu_validate.py. Skipped with a notice when pytest is
+#    absent, or when GRAPHDYN_SKIP_PALLASCHECK=1 (set by the tier-1
+#    lint-gate test: the same subset already runs in the suite proper —
+#    no double work; mirrors faultcheck).
+if [ "${GRAPHDYN_SKIP_PALLASCHECK:-0}" = "1" ]; then
+    echo "== pallascheck: GRAPHDYN_SKIP_PALLASCHECK=1 — SKIPPED (subset runs in tier-1) =="
+elif python -c 'import pytest' 2>/dev/null; then
+    echo "== pallascheck (pytest -m pallas_interpret) =="
+    JAX_PLATFORMS=cpu python -m pytest tests/ -q -m pallas_interpret \
+        -p no:cacheprovider || fail=1
+else
+    echo "== pallascheck: pytest not installed — SKIPPED (pip install pytest to enable) =="
+fi
+
+# 6. benchcheck — the benchmark's single-JSON-line contract, live (python
 #    bench.py --smoke on the CPU backend): one line of JSON, a positive
 #    headline value, and a positive ensemble_rate row (the grouped-driver
 #    throughput the pipeline ships). A formatting regression here silently
@@ -96,6 +115,14 @@ if ecr is None:
         "null entropy_cell_rate needs entropy_cell_rate_skipped_reason"
 else:
     assert ecr > 0, f"entropy_cell_rate must be > 0 or null+reason: {ecr}"
+# the grouped-Pallas A/B column (chip-only): same null-or-positive contract
+assert "entropy_cell_rate_pallas" in row, "entropy_cell_rate_pallas absent"
+ecp = row["entropy_cell_rate_pallas"]
+if ecp is None:
+    assert row.get("entropy_cell_rate_pallas_skipped_reason"), \
+        "null entropy_cell_rate_pallas needs a skipped_reason"
+else:
+    assert ecp > 0, f"entropy_cell_rate_pallas must be > 0 or null+reason: {ecp}"
 print(f"benchcheck: value={row['value']:.3e} "
       f"ensemble_rate={row['ensemble_rate']:.3e} "
       f"ensemble_speedup={row.get('ensemble_speedup', 0):.2f}x "

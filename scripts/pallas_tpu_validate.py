@@ -31,11 +31,26 @@ import jax.numpy as jnp
 from graphdyn.graphs import erdos_renyi_graph, random_regular_graph
 from graphdyn.utils.io import write_json_atomic
 from graphdyn.ops.bdcm import BDCMData, class_update, make_sweep
-from graphdyn.ops.pallas_bdcm import dp_contract, pallas_supported, vmem_block_edges
+from graphdyn.ops.pallas_bdcm import (
+    LANE,
+    VMEM_BUDGET,
+    dp_contract,
+    dp_contract_grouped,
+    pallas_group_supported,
+    pallas_supported,
+    vmem_block_edges,
+)
 
 EQUIV_MATRIX = [(1, 2), (2, 2), (3, 2), (4, 2), (5, 2), (6, 2), (8, 2), (3, 3), (4, 3), (2, 4)]
 TIMING_GRID_DT = [(3, 2), (4, 2), (5, 2), (3, 3), (4, 3), (2, 4)]
 TIMING_GRID_ED = [512, 4096, 32768, 131072]
+# grouped grid: (d, T, G) — equivalence + VMEM-model check per point; G
+# spans the drivers' default group sizes and the model's 0-fallback edge
+GROUP_MATRIX = [
+    (3, 2, 1), (3, 2, 8), (3, 2, 32), (4, 2, 8), (3, 3, 8), (2, 4, 4),
+    (4, 3, 8), (3, 4, 8), (3, 4, 32),   # (3,4,32): group-resident stack
+    #                                     crowds out the lane tile -> 0
+]
 
 
 def _inputs(d, T, Ed, seed=7):
@@ -79,6 +94,120 @@ def equivalence():
         out.append(row)
         print("equiv", row, flush=True)
     return out
+
+
+def grouped_equivalence():
+    """Compiled-mode checks of the GROUPED kernel (group axis as grid dim)
+    per (d, T, G): grouped-vs-XLA max rel err for the shared and the
+    group-resident A variants, grouped-G>1-vs-G=1 bit-equality, and the
+    VMEM model's verdict (a point the model rejects records the honest
+    0-fallback instead of launching)."""
+    out = []
+    damp = 0.3
+    for d, T, G in GROUP_MATRIX:
+        Ed = 1000
+        K, M = 2**T, (d + 1) ** T
+        row = {
+            "d": d, "T": T, "G": G, "Ed": Ed,
+            "vmem_block_edges_shared": vmem_block_edges(d, T),
+            "vmem_block_edges_group": vmem_block_edges(d, T, G=G),
+            "supported_shared": pallas_group_supported(
+                d, T, Ed, G, per_group_a=False),
+            "supported_group_a": pallas_group_supported(
+                d, T, Ed, G, per_group_a=True),
+        }
+        # model audit: the group-resident fixed term must be linear in G
+        row["group_a_fixed_bytes"] = 4 * G * K * K * M
+        row["group_a_fits_budget"] = row["group_a_fixed_bytes"] + \
+            8 * (K * K * (d + 2) + K * M) * LANE <= VMEM_BUDGET
+        assert row["group_a_fits_budget"] == (
+            row["vmem_block_edges_group"] >= LANE
+        ), f"VMEM model inconsistent at {(d, T, G)}"
+        if row["supported_shared"] or row["supported_group_a"]:
+            rng = np.random.default_rng(11)
+            chi_in = jnp.asarray(rng.random((G, Ed, d, K, K)), jnp.float32)
+            A = jnp.asarray(rng.random((K, K, M)), jnp.float32)
+            chi_old = jnp.asarray(rng.random((G, Ed, K, K)), jnp.float32)
+        if row["supported_shared"]:
+            tilt1 = jnp.ones((K,), jnp.float32)
+            ref = jax.vmap(
+                lambda ci, co: class_update(
+                    ci, A, tilt1, co, d=d, T=T, K=K, damp=damp, eps_clamp=0.0
+                )
+            )(chi_in, chi_old)
+            got = dp_contract_grouped(
+                chi_in, A, chi_old, d=d, T=T, damp=damp)
+            rel = float(jnp.max(
+                jnp.abs(got - ref) / jnp.maximum(jnp.abs(ref), 1e-30)))
+            one = dp_contract_grouped(
+                chi_in[:1], A, chi_old[:1], d=d, T=T, damp=damp)
+            row.update(
+                shared_max_rel_err=rel,
+                shared_ok=bool(rel < 1e-3),
+                g1_bit_equal=bool(jnp.array_equal(got[0], one[0])),
+            )
+        if row["supported_group_a"]:
+            tilts = jnp.asarray(
+                np.random.default_rng(12).random((G, K)) + 0.5, jnp.float32)
+            a_stack = A[None] * tilts[:, :, None, None]
+            refg = jax.vmap(
+                lambda ci, co, tl: class_update(
+                    ci, A, tl, co, d=d, T=T, K=K, damp=damp, eps_clamp=0.0
+                )
+            )(chi_in, chi_old, tilts)
+            gotg = dp_contract_grouped(
+                chi_in, a_stack, chi_old, d=d, T=T, damp=damp)
+            relg = float(jnp.max(
+                jnp.abs(gotg - refg) / jnp.maximum(jnp.abs(refg), 1e-30)))
+            oneg = dp_contract_grouped(
+                chi_in[:1], a_stack[:1], chi_old[:1], d=d, T=T, damp=damp)
+            row.update(
+                group_a_max_rel_err=relg,
+                group_a_ok=bool(relg < 1e-3),
+                group_a_g1_bit_equal=bool(jnp.array_equal(gotg[0], oneg[0])),
+            )
+        out.append(row)
+        print("group_equiv", row, flush=True)
+    return out
+
+
+def grouped_timing():
+    """XLA vmapped class_update vs the grouped kernel at driver-realistic
+    (d, T, G, Ed) points — the number the grouped default paths ship."""
+    rows = []
+    for d, T, G, Ed in [(3, 2, 8, 4096), (3, 2, 8, 32768), (4, 2, 8, 8192),
+                        (3, 3, 8, 8192), (3, 2, 32, 8192)]:
+        if not pallas_group_supported(d, T, Ed, G, per_group_a=True):
+            rows.append({"d": d, "T": T, "G": G, "Ed": Ed,
+                         "supported": False})
+            continue
+        K, M = 2**T, (d + 1) ** T
+        rng = np.random.default_rng(13)
+        chi_in = jnp.asarray(rng.random((G, Ed, d, K, K)), jnp.float32)
+        A = jnp.asarray(rng.random((K, K, M)), jnp.float32)
+        chi_old = jnp.asarray(rng.random((G, Ed, K, K)), jnp.float32)
+        tilts = jnp.asarray(rng.random((G, K)) + 0.5, jnp.float32)
+        a_stack = A[None] * tilts[:, :, None, None]
+
+        def xla_fn(ci, a, co):
+            return jax.vmap(
+                lambda c1, c2, tl: class_update(
+                    c1, A, tl, c2, d=d, T=T, K=K, damp=0.3, eps_clamp=0.0
+                )
+            )(ci, co, tilts)
+
+        pal = partial(dp_contract_grouped, d=d, T=T, damp=0.3, eps_clamp=0.0)
+        t_x = _time(jax.jit(xla_fn), chi_in, a_stack, chi_old, iters=5)
+        t_p = _time(pal, chi_in, a_stack, chi_old, iters=5)
+        row = {
+            "d": d, "T": T, "G": G, "Ed": Ed, "supported": True,
+            "xla_us": round(t_x * 1e6, 1),
+            "pallas_us": round(t_p * 1e6, 1),
+            "speedup": round(t_x / t_p, 2),
+        }
+        rows.append(row)
+        print("group_time", row, flush=True)
+    return rows
 
 
 def sweep_equivalence():
@@ -227,10 +356,12 @@ def main():
     doc = {
         "info": info,
         "equivalence": equivalence(),
+        "grouped_equivalence": grouped_equivalence(),
         "sweep_equivalence": sweep_equivalence(),
         "packed_equivalence": packed_equivalence(),
         "packed_general_equivalence": packed_general_equivalence(),
         "timing": timing(),
+        "grouped_timing": grouped_timing(),
     }
     write_json_atomic("PALLAS_TPU.json", doc, indent=1)
     print(json.dumps(info))

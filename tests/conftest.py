@@ -117,6 +117,19 @@ _SLOW = {
     ("test_hpr_oracle.py", "test_iterated_sweep_matches_oracle"),
     ("test_hpr_oracle.py", "test_sweep_matches_bruteforce_oracle[14-3-2-1-2.0]"),
     ("test_packed.py", "test_draw_packed_biased_mean_bias"),
+    ("test_pallas_group.py", "test_entropy_exec_pallas_matches_xla_ragged"),
+    ("test_pallas_group.py",
+     "test_entropy_exec_pallas_grouped_equals_g1_bit_exact"),
+    ("test_pallas_group.py", "test_entropy_grid_kernel_pallas_end_to_end"),
+    ("test_pallas_group.py", "test_grouped_equals_g1_bit_exact_both_variants"),
+    ("test_pallas_group.py", "test_serial_dp_contract_is_g1_of_grouped"),
+    ("test_pallas_group.py", "test_grouped_kernel_matches_xla_per_group_a[2-3]"),
+    ("test_pallas_group.py", "test_grouped_kernel_matches_xla_shared_a[2-3]"),
+    ("test_pallas_group.py", "test_grouped_kernel_matches_xla_shared_a[3-2]"),
+    ("test_pallas_group.py",
+     "test_entropy_exec_pallas_freezes_inactive_lanes"),
+    ("test_pallas_group.py",
+     "test_grouped_kernel_nondivisor_tail_and_tiling_invariance"),
     ("test_pallas.py", "test_dp_contract_matches_xla[2-2-1e-10]"),
     ("test_pallas.py", "test_dp_contract_matches_xla[3-2-0.0]"),
     ("test_pallas.py", "test_dp_contract_matches_xla[3-3-0.0]"),

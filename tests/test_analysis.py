@@ -366,6 +366,99 @@ class TestGD008HostLoopTransfers:
         assert "GD008" in RULES
 
 
+class TestGD009VmapOverPallas:
+    """jax.vmap over a pallas_call-backed callable — lowers to a serial
+    loop of kernel launches instead of a batched grid."""
+
+    BAD_DIRECT = (
+        "import jax\n"
+        "from jax.experimental import pallas as pl\n"
+        "def kernel(x_ref, o_ref):\n"
+        "    o_ref[:] = x_ref[:] + 1\n"
+        "def fused(x):\n"
+        "    return pl.pallas_call(kernel, out_shape=x)(x)\n"
+        "batched = jax.vmap(fused)\n"
+    )
+    BAD_TRANSITIVE = (
+        "import jax\n"
+        "from jax.experimental import pallas as pl\n"
+        "def fused(x):\n"
+        "    return pl.pallas_call(k, out_shape=x)(x)\n"
+        "def wrapper(x):\n"
+        "    return fused(x) * 2\n"
+        "out = jax.vmap(wrapper)(xs)\n"
+    )
+    BAD_PARTIAL = (
+        "import jax\n"
+        "from functools import partial\n"
+        "from jax.experimental import pallas as pl\n"
+        "def fused(x, d):\n"
+        "    return pl.pallas_call(k, out_shape=x)(x)\n"
+        "f3 = partial(fused, d=3)\n"
+        "out = jax.vmap(f3)(xs)\n"
+    )
+    BAD_LAMBDA = (
+        "import jax\n"
+        "from jax.experimental import pallas as pl\n"
+        "def fused(x):\n"
+        "    return pl.pallas_call(k, out_shape=x)(x)\n"
+        "out = jax.vmap(lambda x: fused(x))(xs)\n"
+    )
+
+    def test_bad_vmap_of_kernel_fn(self):
+        assert "GD009" in _codes(self.BAD_DIRECT)
+
+    def test_bad_vmap_of_transitive_wrapper(self):
+        assert "GD009" in _codes(self.BAD_TRANSITIVE)
+
+    def test_bad_vmap_of_partial(self):
+        assert "GD009" in _codes(self.BAD_PARTIAL)
+
+    def test_bad_vmap_of_lambda_wrapper(self):
+        assert "GD009" in _codes(self.BAD_LAMBDA)
+
+    def test_good_grid_axis(self):
+        # the fix: the batch axis is a grid dimension of ONE kernel launch
+        src = (
+            "import jax\n"
+            "from jax.experimental import pallas as pl\n"
+            "def kernel(x_ref, o_ref):\n"
+            "    o_ref[:] = x_ref[:] + 1\n"
+            "def fused_grouped(x):\n"
+            "    return pl.pallas_call(kernel, grid=(x.shape[0],),\n"
+            "                          out_shape=x)(x)\n"
+        )
+        assert _codes(src) == []
+
+    def test_good_vmap_of_plain_fn(self):
+        # vmap over XLA-only callables stays legal, even in a module that
+        # also defines a kernel-backed function
+        src = (
+            "import jax\n"
+            "from jax.experimental import pallas as pl\n"
+            "def fused(x):\n"
+            "    return pl.pallas_call(k, out_shape=x)(x)\n"
+            "def plain(x):\n"
+            "    return x + 1\n"
+            "out = jax.vmap(plain)(xs)\n"
+        )
+        assert _codes(src) == []
+
+    def test_disable_comment(self):
+        src = (
+            "import jax\n"
+            "from jax.experimental import pallas as pl\n"
+            "def fused(x):\n"
+            "    return pl.pallas_call(k, out_shape=x)(x)\n"
+            "# graftlint: disable-next-line=GD009  measured: G<=2, launch overhead negligible\n"
+            "out = jax.vmap(fused)(xs)\n"
+        )
+        assert _codes(src) == []
+
+    def test_catalogued(self):
+        assert "GD009" in RULES
+
+
 class TestGD007AtomicPersistence:
     BAD_SAVEZ = (
         "import numpy as np\n"
@@ -542,7 +635,7 @@ def test_unreadable_file_is_a_finding(tmp_path):
 
 
 def test_rules_registry_complete():
-    assert set(RULES) == {f"GD00{i}" for i in range(1, 9)}
+    assert set(RULES) == {f"GD00{i}" for i in range(1, 10)}
 
 
 def test_repo_package_is_clean():

@@ -33,9 +33,14 @@ def test_bench_smoke_emits_one_json_line():
     assert "roofline_fraction_v5e" not in row
     # rows skipped on this backend are null + reason, NEVER 0.0 (a skip
     # must be unmistakable from a measured collapse)
-    for key in ("packed_rate_wide", "packed_rate_pallas"):
+    for key in ("packed_rate_wide", "packed_rate_pallas",
+                "entropy_cell_rate_pallas"):
         assert row[key] is None, (key, row[key])
         assert "chip-only" in row[key + "_skipped_reason"]
+    # the human-readable progress log honors the same contract: a skipped
+    # row says skipped(<reason>), never a 0.000e+00 rate
+    assert "rate 0.000e+00" not in proc.stderr
+    assert "rate skipped(" in proc.stderr
     # the end-to-end driver A/B: the grouped pipeline must beat the serial
     # repetition loop on the same workload (results are element-wise
     # identical — tests/test_pipeline.py), and the ratio is recorded
@@ -110,6 +115,16 @@ def test_bench_smoke_entropy_cell_row(monkeypatch, capsys):
         assert out["entropy_cell_rate"] > 0
         assert out["entropy_cell_speedup"] >= 1.2
     assert out["entropy_cell_workload"]["lambda_points"] > 0
+    # the grouped-Pallas A/B column: chip-only, null + reason elsewhere,
+    # and the kernel tag names each leg's sweep core
+    assert "entropy_cell_rate_pallas" in out
+    if out["entropy_cell_rate_pallas"] is None:
+        assert out["entropy_cell_rate_pallas_skipped_reason"]
+    else:
+        assert out["entropy_cell_rate_pallas"] > 0
+        assert out["entropy_cell_pallas_speedup"] > 0
+    kern = out["entropy_cell_workload"]["kernel"]
+    assert kern["serial"] == "xla" and kern["grouped"] == "xla"
 
 
 def test_probe_relay_plugin_presence_classification(monkeypatch):

@@ -5,6 +5,9 @@ The kernel (graphdyn/ops/pallas_bdcm.py) must reproduce the XLA path
 the flat mixed-radix ρ-shift must equal the per-axis rolls for every (d, T)
 the reference targets, including the no-shift (all-ones trajectory) and
 full-shift combos.
+
+Marked ``pallas_interpret``: scripts/lint.sh pallascheck runs this file (and
+tests/test_pallas_group.py, the grouped-kernel half) standalone.
 """
 
 import numpy as np
@@ -17,6 +20,8 @@ from graphdyn.graphs import erdos_renyi_graph, random_regular_graph
 from graphdyn.ops.bdcm import BDCMData, _neighbor_dp, make_sweep
 from graphdyn.ops.pallas_bdcm import _flat_offsets, dp_contract, pallas_supported
 from graphdyn.attractors import rho_lattice, trajectories01
+
+pytestmark = pytest.mark.pallas_interpret
 
 
 @pytest.mark.parametrize("d,T", [(1, 2), (2, 2), (3, 2), (4, 2), (3, 3), (2, 4)])

@@ -1,0 +1,498 @@
+"""Grouped Pallas BDCM kernel — interpret-mode parity + the kernel-mode
+executors (ISSUE 5 acceptance tests).
+
+The contracts under test (ARCHITECTURE.md "Kernel selection"):
+
+- grouped-Pallas ≈ grouped-XLA within the documented tolerance (the
+  Pallas-vs-XLA numeric MODE, ~1e-3 max rel err on chip; interpret mode
+  here reproduces the same accumulation order);
+- grouped-Pallas == serial-Pallas (G=1) BIT-exact — one kernel body, the
+  group axis a grid dimension, per-lane work elementwise across lanes and
+  tile widths;
+- non-divisor edge tails and pad lanes are inert (sliced off / never
+  indexed);
+- the VMEM byte model (``vmem_block_edges``) is LANE-multiple, maximal
+  within budget, and 0 exactly when nothing fits — for the serial model
+  and the group-resident ``(d, T, G)`` variant;
+- a spec the model rejects resolves to the XLA path statically; a kernel
+  lowering failure at run time degrades via ``pallas_fallback_spec``.
+
+Every test runs ``interpret=True`` on CPU (marker ``pallas_interpret`` —
+``scripts/lint.sh`` pallascheck runs the subset standalone); compiled-mode
+equivalence on a real chip is scripts/pallas_tpu_validate.py's job.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from graphdyn.config import DynamicsConfig, EntropyConfig, HPRConfig
+from graphdyn.graphs import erdos_renyi_graph, remove_isolates
+from graphdyn.ops.bdcm import (
+    BDCMData,
+    class_update,
+    resolve_group_pallas_modes,
+)
+from graphdyn.ops.pallas_bdcm import (
+    LANE,
+    MAX_BLOCK_EDGES,
+    VMEM_BUDGET,
+    dp_contract,
+    dp_contract_grouped,
+    pallas_group_supported,
+    vmem_block_edges,
+)
+from graphdyn.resilience.faults import FaultPlan, FaultSpec
+
+pytestmark = pytest.mark.pallas_interpret
+
+
+def _group_inputs(d, T, G, Ed, seed=7):
+    rng = np.random.default_rng(seed)
+    K, M = 2**T, (d + 1) ** T
+    chi_in = jnp.asarray(rng.random((G, Ed, d, K, K)), jnp.float32)
+    A = jnp.asarray(rng.random((K, K, M)), jnp.float32)
+    chi_old = jnp.asarray(rng.random((G, Ed, K, K)), jnp.float32)
+    tilts = jnp.asarray(rng.random((G, K)) + 0.5, jnp.float32)
+    return chi_in, A, chi_old, tilts
+
+
+# ---------------------------------------------------------------------------
+# kernel: grouped vs XLA (tolerance) and across group extents (bit-exact)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("d,T", [(3, 2), (2, 3)])
+def test_grouped_kernel_matches_xla_shared_a(d, T):
+    K = 2**T
+    chi_in, A, chi_old, _ = _group_inputs(d, T, G=3, Ed=200)
+    tilt = jnp.ones((K,), jnp.float32)
+    ref = jax.vmap(
+        lambda ci, co: class_update(
+            ci, A, tilt, co, d=d, T=T, K=K, damp=0.3, eps_clamp=0.0
+        )
+    )(chi_in, chi_old)
+    out = dp_contract_grouped(
+        chi_in, A, chi_old, d=d, T=T, damp=0.3, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=5e-3, atol=1e-6
+    )
+
+
+@pytest.mark.parametrize("d,T", [(3, 2), (2, 3)])
+def test_grouped_kernel_matches_xla_per_group_a(d, T):
+    """The group-resident A_tilted variant: each lane contracts against its
+    OWN tilted rows (the entropy cell groups' per-cell λ shape)."""
+    K = 2**T
+    chi_in, A, chi_old, tilts = _group_inputs(d, T, G=3, Ed=200)
+    a_stack = A[None] * tilts[:, :, None, None]        # [G, K, K, M]
+    ref = jax.vmap(
+        lambda ci, co, tl: class_update(
+            ci, A, tl, co, d=d, T=T, K=K, damp=0.3, eps_clamp=0.0
+        )
+    )(chi_in, chi_old, tilts)
+    out = dp_contract_grouped(
+        chi_in, a_stack, chi_old, d=d, T=T, damp=0.3, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=5e-3, atol=1e-6
+    )
+
+
+def test_grouped_equals_g1_bit_exact_both_variants():
+    """Lane g of a G>1 launch equals the G=1 launch of lane g's data
+    bit-for-bit, for the shared AND the group-resident A variant — the
+    'grouped == serial within the same kernel' identity."""
+    d, T = 3, 2
+    chi_in, A, chi_old, tilts = _group_inputs(d, T, G=4, Ed=200)
+    a_stack = A[None] * tilts[:, :, None, None]
+    shared = dp_contract_grouped(
+        chi_in, A, chi_old, d=d, T=T, damp=0.3, interpret=True
+    )
+    grouped = dp_contract_grouped(
+        chi_in, a_stack, chi_old, d=d, T=T, damp=0.3, interpret=True
+    )
+    for g in range(4):
+        one_s = dp_contract_grouped(
+            chi_in[g : g + 1], A, chi_old[g : g + 1],
+            d=d, T=T, damp=0.3, interpret=True,
+        )
+        one_g = dp_contract_grouped(
+            chi_in[g : g + 1], a_stack[g : g + 1], chi_old[g : g + 1],
+            d=d, T=T, damp=0.3, interpret=True,
+        )
+        np.testing.assert_array_equal(np.asarray(shared[g]), np.asarray(one_s[0]))
+        np.testing.assert_array_equal(np.asarray(grouped[g]), np.asarray(one_g[0]))
+
+
+def test_serial_dp_contract_is_g1_of_grouped():
+    """The serial entry point IS the G=1 instance (shared-A) — bit-equal to
+    the matching grouped lane."""
+    d, T = 4, 2
+    chi_in, A, chi_old, _ = _group_inputs(d, T, G=2, Ed=150)
+    grouped = dp_contract_grouped(
+        chi_in, A, chi_old, d=d, T=T, damp=0.4, interpret=True
+    )
+    ser = dp_contract(
+        chi_in[1], A, chi_old[1], d=d, T=T, damp=0.4, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(ser), np.asarray(grouped[1]))
+
+
+def test_grouped_kernel_nondivisor_tail_and_tiling_invariance():
+    """Ed that is neither a lane multiple nor a tile multiple: pad lanes are
+    sliced off, and an explicit narrower tile width changes nothing (per-
+    lane work is elementwise across lanes — the bit-exactness substrate)."""
+    d, T = 3, 2
+    chi_in, A, chi_old, tilts = _group_inputs(d, T, G=2, Ed=130)
+    a_stack = A[None] * tilts[:, :, None, None]
+    wide = dp_contract_grouped(
+        chi_in, a_stack, chi_old, d=d, T=T, damp=0.3, interpret=True
+    )
+    narrow = dp_contract_grouped(
+        chi_in, a_stack, chi_old, d=d, T=T, damp=0.3, block_edges=LANE,
+        interpret=True,
+    )
+    assert wide.shape == (2, 130, 2**T, 2**T)
+    np.testing.assert_array_equal(np.asarray(wide), np.asarray(narrow))
+
+
+# ---------------------------------------------------------------------------
+# VMEM byte model: LANE-multiple, maximal within budget, honest 0-fallback
+# ---------------------------------------------------------------------------
+
+
+def _model_bytes(d, T, G, eb):
+    """The documented model, restated independently of the implementation."""
+    K, M = 2**T, (d + 1) ** T
+    fixed = (4 * G * K * K * M) if G else (8 * K * K * M)
+    per_edge = 8 * (K * K * (d + 2) + K * M)
+    return fixed + eb * per_edge
+
+
+@pytest.mark.parametrize("G", [0, 1, 2, 8, 32])
+def test_vmem_block_edges_model_property(G):
+    """For a sweep of (d, T) and (d, T, G): the returned width's modeled
+    working set fits the budget, the width is LANE-multiple and maximal
+    (one more lane would overflow, unless capped), and 0 is returned
+    exactly when even one lane does not fit."""
+    for d in range(1, 9):
+        for T in range(2, 5):
+            eb = vmem_block_edges(d, T, G=G)
+            assert eb % LANE == 0
+            assert 0 <= eb <= MAX_BLOCK_EDGES
+            if eb == 0:
+                # honest 0-fallback: even a single lane-width tile overflows
+                assert _model_bytes(d, T, G, LANE) > VMEM_BUDGET, (d, T, G)
+            else:
+                assert _model_bytes(d, T, G, eb) <= VMEM_BUDGET, (d, T, G)
+                if eb < MAX_BLOCK_EDGES:
+                    assert _model_bytes(d, T, G, eb + LANE) > VMEM_BUDGET, \
+                        (d, T, G)
+
+
+def test_vmem_group_resident_shrinks_with_g():
+    """The group-resident A stack is charged linearly in G: the admitted
+    tile width is non-increasing in G and eventually hits the 0-fallback,
+    while the shared model (G=0) is unaffected. (d=3, T=4 is the shape
+    where the resident stack dominates: K²M = 64 Ki floats.)"""
+    widths = [vmem_block_edges(3, 4, G=g) for g in (1, 4, 8, 16, 32)]
+    assert all(a >= b for a, b in zip(widths, widths[1:]))
+    assert widths[0] > 0
+    assert vmem_block_edges(3, 4, G=32) == 0      # stack crowds out the tile
+    assert vmem_block_edges(3, 4) > 0             # shared model unaffected
+
+
+def test_pallas_group_supported_gate():
+    assert pallas_group_supported(3, 2, 1000, 8, per_group_a=True)
+    assert pallas_group_supported(3, 2, 1000, 8, per_group_a=False)
+    # too few edges to fill one lane tile
+    assert not pallas_group_supported(3, 2, 16, 8, per_group_a=True)
+    # beyond the reference regime
+    assert not pallas_group_supported(3, 5, 100000, 2, per_group_a=True)
+    # group-resident A stack overflows at large G; shared variant survives
+    assert not pallas_group_supported(3, 4, 100000, 32, per_group_a=True)
+    assert pallas_group_supported(3, 4, 100000, 32, per_group_a=False)
+
+
+def test_resolve_group_pallas_modes_contract():
+    f32, f64 = jnp.float32, jnp.float64
+    # CPU backend: auto keeps the XLA path, pallas forces interpret
+    assert resolve_group_pallas_modes(
+        [3], [1000], T=2, dtype=f32, kernel="auto", G=4, per_group_a=True
+    ) == ("",)
+    assert resolve_group_pallas_modes(
+        [3, 9], [1000, 1000], T=2, dtype=f32, kernel="pallas", G=4,
+        per_group_a=True,
+    ) == ("interpret", "")          # d=9 beyond the regime -> XLA per class
+    assert resolve_group_pallas_modes(
+        [3], [1000], T=2, dtype=f32, kernel="xla", G=4, per_group_a=True
+    ) == ("",)
+    # f64 is XLA-only; forcing the f32 kernel is refused loudly
+    assert resolve_group_pallas_modes(
+        [3], [1000], T=2, dtype=f64, kernel="auto", G=4, per_group_a=True
+    ) == ("",)
+    with pytest.raises(ValueError, match="f32-only"):
+        resolve_group_pallas_modes(
+            [3], [1000], T=2, dtype=f64, kernel="pallas", G=4,
+            per_group_a=True,
+        )
+    with pytest.raises(ValueError, match="kernel"):
+        resolve_group_pallas_modes(
+            [3], [1000], T=2, dtype=f32, kernel="fused", G=4,
+            per_group_a=True,
+        )
+
+
+# ---------------------------------------------------------------------------
+# executors: kernel="pallas" parity with kernel="xla", bit-exact across G
+# ---------------------------------------------------------------------------
+
+
+def _entropy_cells(n=260, c=3.0, seeds=(0, 1, 2)):
+    cells, chis = [], []
+    for i, s in enumerate(seeds):
+        g = erdos_renyi_graph(n, c / (n - 1), seed=s)
+        sub, n_iso = remove_isolates(g)
+        data = BDCMData(sub, p=1, c=1)
+        cells.append((data, g.n, n_iso))
+        chis.append(data.init_messages(7 + i))
+    return cells, chis
+
+
+def _entropy_cfg(**kw):
+    kw.setdefault("damp", 0.2)
+    kw.setdefault("eps", 1e-4)
+    kw.setdefault("max_sweeps", 50)
+    return EntropyConfig(dynamics=DynamicsConfig(p=1, c=1), **kw)
+
+
+def test_entropy_exec_pallas_matches_xla_ragged():
+    """Grouped-Pallas ≈ grouped-XLA on RAGGED cells (mixed per-class modes:
+    small union classes stay XLA inside the Pallas-mode program)."""
+    from graphdyn.pipeline.entropy_group import EntropyCellExec
+
+    cfg = _entropy_cfg()
+    cells, chis = _entropy_cells()
+    lm = jnp.asarray([0.1, 0.3, 0.2], jnp.float32)
+    act = jnp.ones(3, bool)
+    d0 = jnp.full(3, jnp.inf, jnp.float32)
+    t0 = jnp.zeros(3, jnp.int32)
+    outs = {}
+    for kern in ("pallas", "xla"):
+        ex = EntropyCellExec(cells, cfg, chunk_sweeps=4, kernel=kern)
+        outs[kern] = ex.fixed_point_chunk(ex.stack_chi(chis), lm, act, d0, t0)
+    assert any(m == "interpret" for m in ex.spec.pallas) is False  # xla exec
+    cp, tp, dp = outs["pallas"]
+    cx, tx, dx = outs["xla"]
+    np.testing.assert_array_equal(np.asarray(tp), np.asarray(tx))
+    np.testing.assert_allclose(
+        np.asarray(cp), np.asarray(cx), rtol=5e-3, atol=1e-5
+    )
+
+
+def test_entropy_exec_pallas_grouped_equals_g1_bit_exact():
+    """Grouped-Pallas == serial-Pallas (G=1) bit-exact, per cell — the
+    executor-level identity (same kernel, same per-class modes: the cells
+    share one graph so the union class shapes cannot straddle the gate;
+    each cell still solves its OWN λ)."""
+    from graphdyn.pipeline.entropy_group import EntropyCellExec
+
+    cfg = _entropy_cfg()
+    g = erdos_renyi_graph(260, 3.0 / 259, seed=0)
+    sub, n_iso = remove_isolates(g)
+    data = BDCMData(sub, p=1, c=1)
+    cells = [(data, g.n, n_iso)] * 3
+    chis = [data.init_messages(7 + i) for i in range(3)]
+    lm = jnp.asarray([0.1, 0.3, 0.2], jnp.float32)
+    d0 = jnp.full(3, jnp.inf, jnp.float32)
+    t0 = jnp.zeros(3, jnp.int32)
+
+    ex = EntropyCellExec(cells, cfg, chunk_sweeps=5, kernel="pallas")
+    assert any(m == "interpret" for m in ex.spec.pallas)
+    cp, tp, dp = ex.fixed_point_chunk(
+        ex.stack_chi(chis), lm, jnp.ones(3, bool), d0, t0
+    )
+    for g_i in range(3):
+        e1 = EntropyCellExec([cells[g_i]], cfg, chunk_sweeps=5,
+                             kernel="pallas")
+        assert e1.spec.pallas == ex.spec.pallas
+        c1, t1, d1 = e1.fixed_point_chunk(
+            e1.stack_chi([chis[g_i]]), lm[g_i : g_i + 1],
+            jnp.ones(1, bool), d0[:1], t0[:1],
+        )
+        np.testing.assert_array_equal(np.asarray(cp[g_i]), np.asarray(c1[0]))
+        assert int(tp[g_i]) == int(t1[0])
+        assert float(dp[g_i]) == float(d1[0])
+
+
+def test_entropy_exec_pallas_freezes_inactive_lanes():
+    """Pad/stopped lanes under the Pallas chunk keep their state bit-for-bit
+    (the joint-while select is the same freeze the vmapped XLA path's
+    batching rule applies)."""
+    from graphdyn.pipeline.entropy_group import EntropyCellExec
+
+    cfg = _entropy_cfg()
+    cells, chis = _entropy_cells(seeds=(0, 1))
+    lm = jnp.asarray([0.1, 0.3], jnp.float32)
+    act = jnp.asarray([True, False])
+    d0 = jnp.full(2, jnp.inf, jnp.float32)
+    t0 = jnp.zeros(2, jnp.int32)
+    ex = EntropyCellExec(cells, cfg, chunk_sweeps=3, kernel="pallas")
+    stacked = ex.stack_chi(chis)
+    c, t, dlt = ex.fixed_point_chunk(stacked, lm, act, d0, t0)
+    np.testing.assert_array_equal(np.asarray(c[1]), np.asarray(stacked[1]))
+    assert int(t[1]) == 0 and int(t[0]) == 3
+
+
+def test_entropy_exec_mesh_refuses_forced_pallas():
+    from jax.sharding import Mesh
+
+    from graphdyn.pipeline.entropy_group import EntropyCellExec
+
+    cfg = _entropy_cfg()
+    cells, _ = _entropy_cells(seeds=(0, 1))
+    mesh = Mesh(np.array(jax.devices("cpu")[:2]), ("cell",))
+    with pytest.raises(ValueError, match="mesh"):
+        EntropyCellExec(cells, cfg, kernel="pallas", mesh=mesh)
+
+
+def _hpr_items(n=64, d=4, reps=3, seed0=100):
+    cfg = HPRConfig(dynamics=DynamicsConfig(p=1, c=1), max_sweeps=6)
+    from graphdyn.pipeline.hpr_group import _build_rep
+
+    return cfg, [_build_rep(n, d, cfg, seed0 + k, "pairing")
+                 for k in range(reps)]
+
+
+def _run_hpr(cfg, items, kernel, chunk=3, seeds=None):
+    from graphdyn.pipeline.hpr_group import HPRGroupExec
+
+    ex = HPRGroupExec(items, cfg, kernel=kernel)
+    st = ex.init_state(
+        [it[2] for it in items], [it[3] for it in items],
+        [it[4] for it in items],
+        seeds if seeds is not None
+        else [100 + k for k in range(len(items))],
+    )
+    return ex, ex.run(st, chunk_sweeps=chunk)
+
+
+def test_hpr_exec_pallas_matches_xla():
+    cfg, items = _hpr_items()
+    exp, sp = _run_hpr(cfg, items, "pallas")
+    exx, sx = _run_hpr(cfg, items, "xla")
+    assert exp.spec.pallas == ("interpret",)
+    assert exx.spec.pallas == ("",)
+    np.testing.assert_allclose(
+        np.asarray(sp.chi), np.asarray(sx.chi), rtol=5e-3, atol=1e-5
+    )
+    np.testing.assert_array_equal(np.asarray(sp.steps), np.asarray(sx.steps))
+
+
+def test_hpr_exec_pallas_grouped_equals_g1_bit_exact():
+    """Grouped-Pallas HPr == serial-Pallas (G=1) bit-exact per repetition —
+    full chains to completion, chi AND the discrete reinforcement state."""
+    cfg, items = _hpr_items()
+    _, sp = _run_hpr(cfg, items, "pallas")
+    for g in range(len(items)):
+        _, s1 = _run_hpr(cfg, [items[g]], "pallas", seeds=[100 + g])
+        np.testing.assert_array_equal(
+            np.asarray(sp.chi[g]), np.asarray(s1.chi[0])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sp.biases[g]), np.asarray(s1.biases[0])
+        )
+        np.testing.assert_array_equal(np.asarray(sp.s[g]), np.asarray(s1.s[0]))
+        assert int(sp.steps[g]) == int(s1.steps[0])
+
+
+# ---------------------------------------------------------------------------
+# resilience: runtime Pallas -> XLA degrade through the grouped executors
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.faultinject
+def test_entropy_exec_lowering_failure_degrades_to_xla(caplog):
+    from graphdyn.pipeline.entropy_group import EntropyCellExec
+
+    cfg = _entropy_cfg()
+    cells, chis = _entropy_cells(seeds=(0, 0))
+    lm = jnp.asarray([0.1, 0.3], jnp.float32)
+    act = jnp.ones(2, bool)
+    d0 = jnp.full(2, jnp.inf, jnp.float32)
+    t0 = jnp.zeros(2, jnp.int32)
+    exx = EntropyCellExec(cells, cfg, chunk_sweeps=4, kernel="xla")
+    cx, tx, dx = exx.fixed_point_chunk(exx.stack_chi(chis), lm, act, d0, t0)
+    exp = EntropyCellExec(cells, cfg, chunk_sweeps=4, kernel="pallas")
+    assert any(exp.spec.pallas)
+    with caplog.at_level(logging.WARNING, logger="graphdyn.ops"):
+        with FaultPlan([FaultSpec("pallas.lower", count=99)]):
+            cp, tp, dp = exp.fixed_point_chunk(
+                exp.stack_chi(chis), lm, act, d0, t0
+            )
+    # degraded, not aborted; the rebuilt XLA spec sticks and matches the
+    # pure-XLA program bit-for-bit
+    assert not any(exp.spec.pallas)
+    assert "use_pallas=False" in caplog.text
+    np.testing.assert_array_equal(np.asarray(cp), np.asarray(cx))
+    cp2, _, _ = exp.fixed_point_chunk(exp.stack_chi(chis), lm, act, d0, t0)
+    np.testing.assert_array_equal(np.asarray(cp2), np.asarray(cx))
+
+
+@pytest.mark.faultinject
+def test_hpr_exec_lowering_failure_degrades_to_xla():
+    cfg, items = _hpr_items(reps=2)
+    exx, sx = _run_hpr(cfg, items, "xla", chunk=2)
+    from graphdyn.pipeline.hpr_group import HPRGroupExec
+
+    exp = HPRGroupExec(items, cfg, kernel="pallas")
+    st = exp.init_state(
+        [it[2] for it in items], [it[3] for it in items],
+        [it[4] for it in items], [100, 101],
+    )
+    with FaultPlan([FaultSpec("pallas.lower", count=99)]):
+        sp = exp.run(st, chunk_sweeps=2)
+    assert not any(exp.spec.pallas)
+    np.testing.assert_array_equal(np.asarray(sp.chi), np.asarray(sx.chi))
+    np.testing.assert_array_equal(np.asarray(sp.s), np.asarray(sx.s))
+
+
+# ---------------------------------------------------------------------------
+# driver + CLI plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_entropy_grid_kernel_pallas_end_to_end():
+    """entropy_grid(kernel='pallas') runs the grouped ladder through the
+    fused kernel (interpret) and lands within the documented tolerance of
+    the XLA grid on every visited λ."""
+    from graphdyn.models.entropy import entropy_grid
+
+    cfg = _entropy_cfg(lmbd_max=0.2, lmbd_step=0.1, num_rep=1,
+                       eps=1e-3, max_sweeps=40)
+    kw = dict(seed=0, group_size=2, class_bucket=16)
+    rx = entropy_grid(220, np.asarray([2.8, 3.2]), cfg, kernel="xla", **kw)
+    rp = entropy_grid(220, np.asarray([2.8, 3.2]), cfg, kernel="pallas", **kw)
+    np.testing.assert_array_equal(rp.n_lambda, rx.n_lambda)
+    np.testing.assert_allclose(rp.ent, rx.ent, rtol=5e-3, atol=1e-4)
+    np.testing.assert_allclose(rp.m_init, rx.m_init, rtol=5e-3, atol=1e-4)
+
+
+def test_cli_kernel_flag_parses():
+    from graphdyn.cli import build_parser
+
+    ap = build_parser()
+    a = ap.parse_args(["entropy", "--kernel", "pallas"])
+    assert a.kernel == "pallas"
+    a = ap.parse_args(["hpr", "--kernel", "xla"])
+    assert a.kernel == "xla"
+    a = ap.parse_args(["entropy"])
+    assert a.kernel == "auto"
+    with pytest.raises(SystemExit):
+        ap.parse_args(["entropy", "--kernel", "fused"])
