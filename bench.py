@@ -236,6 +236,27 @@ def entropy_cell_rate(smoke: bool):
     }
 
 
+def fingerprint_rows():
+    """The graftcheck program-fingerprint summary persisted with every
+    round (``BENCH_*.json``): per headline entry point, the ledger-gated
+    structural fields (op-category counts, fusion count, while-loop count,
+    donated-parameter set, largest baked constant). benchcheck diffs these
+    against the previous round's row, so a structural regression in a
+    headline program shows up round-over-round even when the TPU was
+    unreachable and no rate row carries signal (ROADMAP item 5 — three of
+    five rounds measured nothing). Fingerprints are backend-specific, so
+    the backend rides in the row and the diff only compares same-backend
+    rounds."""
+    import jax
+
+    from graphdyn.analysis.graftcheck import collect_fingerprints
+
+    return {
+        "backend": jax.default_backend(),
+        "entries": collect_fingerprints(compact=True, diag=_mark),
+    }
+
+
 def torch_cpu_rate(g, steps=3):
     import torch
 
@@ -440,6 +461,16 @@ def main():
             "entropy_cell_rate_pallas": None,
             "entropy_cell_rate_pallas_skipped_reason":
                 f"entropy cell A/B failed: {str(e)[:150]}",
+        })
+    _mark("program fingerprints (graftcheck structural summary)")
+    try:
+        extra["fingerprints"] = fingerprint_rows()
+    except Exception as e:  # noqa: BLE001 — optional row, never silent
+        _mark(f"fingerprint row failed: {str(e)[:150]}")
+        extra.update({
+            "fingerprints": None,
+            "fingerprints_skipped_reason":
+                f"fingerprint collection failed: {str(e)[:150]}",
         })
     # progress log: a backend-skipped row says skipped(<reason>), NEVER a
     # zero rate — the JSON already emits null + <row>_skipped_reason, and

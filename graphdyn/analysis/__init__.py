@@ -12,9 +12,26 @@ on (ARCHITECTURE.md "Static analysis & contracts"):
   shapes/dtypes of jitted-function inputs/outputs at trace time (zero cost
   post-compile), applied to the public kernels in ``ops/`` and
   ``parallel/``.
+- :mod:`graphdyn.analysis.graftcheck` — the jaxpr/HLO program auditor:
+  fingerprints of the headline compiled programs diffed against the
+  committed ``GRAFTCHECK_FINGERPRINTS.json`` ledger (structural regression
+  detection without hardware), rules GC001–GC004, and the recompile guard.
+  Run as ``python -m graphdyn.analysis.graftcheck [--update-ledger]``.
+  NOT imported here: it builds canonical programs (jax + the pipeline
+  stack), which would make the pure-AST graftlint CLI pay a device-init
+  cost.
+- :mod:`graphdyn.analysis.sanitize` — the runtime host-aliasing sanitizer
+  (``GRAPHDYN_SANITIZE=alias``): host→device crossings digest their source
+  buffers and a mutation during the alias window raises
+  :class:`~graphdyn.analysis.sanitize.AliasRaceError` deterministically.
 """
 
 from graphdyn.analysis.contracts import ContractError, contract  # noqa: F401
+from graphdyn.analysis.sanitize import (  # noqa: F401
+    AliasRaceError,
+    alias_sanitizer,
+    maybe_alias_sanitizer,
+)
 from graphdyn.analysis.graftlint import (  # noqa: F401
     Finding,
     RULES,

@@ -1,0 +1,884 @@
+"""graftcheck — a jaxpr/HLO program auditor with a fingerprint ledger.
+
+graftlint (:mod:`graphdyn.analysis.graftlint`) reads *source text*; it
+cannot see what XLA actually builds. But the compiled program's *structure*
+— which ops appear, how they fuse, whether donations were honored, how many
+while-loops survive, what constants got baked in — IS the perf contract:
+fusion shapes and donation are exactly where the TPU-cluster Ising work
+(arXiv:1903.11714) locates its throughput, and a structural regression
+(a new gather, a lost donation, a program that recompiles per call) costs
+throughput *silently* while results stay correct. Three of five bench
+rounds ran with no TPU at all, so trace-time structure is the only perf
+signal that is always available: this module makes every headline program's
+structure a committed, diffable artifact.
+
+Three pieces (ARCHITECTURE.md "Program-structure contracts"):
+
+1. **Program fingerprinter.** Each headline entry point (the packed
+   rollout, the BDCM sweep XLA core behind ``dp_contract``/
+   ``dp_contract_grouped``, the ``EntropyCellExec`` chunk program, the
+   ``HPRGroupExec`` sweep loop, the grouped SA rollout, and the mesh
+   rollout) lowers at a small canonical shape and yields a stable
+   fingerprint: HLO **op-category** counts (opcodes bucketed into
+   elementwise / layout / gather / scatter / dot / reduce / control /
+   fusion / … so benign instruction-selection jitter does not alias real
+   drift), fusion count and root shapes, the donated (input/output-aliased)
+   parameter set, the largest baked-in constant, and the while-loop count.
+   Fingerprints persist to ``GRAFTCHECK_FINGERPRINTS.json`` (the ledger,
+   committed); :func:`check_ledger` diffs live traces against it with
+   per-field tolerance bands and fails tier-1 on structural drift.
+
+2. **jaxpr/HLO-level rules** the AST linter cannot express:
+
+   - **GC001** — donation declared but not honored: the entry point
+     declares ``donate_argnums`` but the compiled executable carries no
+     input/output alias (the state buffer is silently double-buffered).
+   - **GC002** — unintended f32→f64 promotion inside a jitted graph: the
+     inputs are ≤32-bit but the traced program contains float64 values
+     (under x64 a stray Python float or ``np.float64`` scalar widens a
+     whole chain — doubling message HBM traffic, invisible to GD004 when
+     it arrives through an argument).
+   - **GC003** — a large (> 1 MiB) host constant baked into the program:
+     a closed-over table that should be an argument gets embedded per
+     compilation, bloating executables and defeating compile-cache reuse.
+   - **GC004** — recompile budget exceeded: :class:`RecompileWatch` counts
+     *distinct compiled signatures* per entry point across a driver run;
+     grouped executors must compile once per shape class, so a G-extent or
+     weak-shape cache miss (every group recompiling) is caught here.
+
+3. **Runtime host-aliasing sanitizer** (:mod:`graphdyn.analysis.sanitize`,
+   opt-in via ``GRAPHDYN_SANITIZE=alias``): wraps host→device crossings,
+   digests source buffers at dispatch and verifies them while the device
+   array is alive — the PR-4 ``jnp.asarray`` aliasing race class as a
+   deterministic failure instead of observed nondeterminism.
+
+CLI, mirroring graftlint (exit code = number of findings)::
+
+    python -m graphdyn.analysis.graftcheck [--format=text|json]
+        [--update-ledger] [--ledger PATH] [--entries a,b,...]
+
+JSON mode emits exactly ONE JSON document on stdout; all diagnostics
+(progress, backend notes) go to stderr, so CI can pipe the output.
+
+Tolerance bands (per field; "informational" = recorded, never gated):
+
+====================  =====================================================
+field                 band
+====================  =====================================================
+op_categories         a category present live but absent from the ledger
+                      fails (GC101); per-category count drift beyond
+                      max(4, 50% of ledger) fails (GC102)
+fusion_count          drift beyond max(2, 25% of ledger) fails (GC103)
+donated_params        ledger's aliased set must be a subset of live —
+                      any lost donation fails (GC104)
+largest_const_bytes   live > max(4× ledger, 1 MiB) fails (GC105)
+while_loop_count      any change fails (GC106)
+fusion_root_shapes    informational (shape text tracks workload tweaks)
+opcode_counts         informational (instruction selection jitters)
+====================  =====================================================
+
+The ledger records the backend and jax version it was built on; the checker
+diffs only when the live backend matches (the gate runs ``JAX_PLATFORMS=
+cpu``, so the committed ledger is the CPU-container contract — exactly the
+hardware-free signal ROADMAP item 5 asks for). A pure refactor that
+preserves program structure passes without touching the ledger; a
+deliberate structural change updates it via ``--update-ledger`` (reviewed
+like any other committed artifact).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import re
+import sys
+from pathlib import Path
+from typing import Callable, NamedTuple
+
+RULES = {
+    "GC001": "donation declared but not honored by the compiled executable",
+    "GC002": "unintended f32->f64 promotion inside a jitted graph",
+    "GC003": "large host constant baked into the program",
+    "GC004": "compile-signature budget exceeded (recompile guard)",
+    "GC100": "entry point missing from the fingerprint ledger",
+    "GC101": "new HLO op category vs the ledger",
+    "GC102": "HLO op-category count drift beyond the tolerance band",
+    "GC103": "fusion-count jump beyond the tolerance band",
+    "GC104": "donation lost vs the ledger",
+    "GC105": "baked-constant size blowup vs the ledger",
+    "GC106": "while-loop count change vs the ledger",
+}
+
+#: live-rule threshold: constants above this are GC003 findings
+LARGE_CONSTANT_BYTES = 1 << 20
+
+LEDGER_NAME = "GRAFTCHECK_FINGERPRINTS.json"
+
+
+def default_ledger_path() -> Path:
+    """The committed ledger at the repo root (next to ROADMAP.md)."""
+    return Path(__file__).resolve().parents[2] / LEDGER_NAME
+
+
+class Finding(NamedTuple):
+    entry: str
+    code: str
+    message: str
+
+
+# ---------------------------------------------------------------------------
+# HLO text parsing
+# ---------------------------------------------------------------------------
+
+# `%name = <shape> opcode(...)` — shape is either an array type
+# (`f32[2,3]{1,0}`) or a tuple type (`(f32[2]{0}, s32[])`)
+_OP_RE = re.compile(
+    r"=\s+((?:[a-z0-9]+\[[^\]]*\](?:\{[^}]*\})?|\([^=]*?\)))\s+"
+    r"([a-z][a-z0-9-]*)\("
+)
+_CONST_RE = re.compile(
+    r"=\s+([a-z0-9]+)\[([0-9,]*)\](?:\{[^}]*\})?\s+constant\("
+)
+_LAYOUT_RE = re.compile(r"\{[0-9,]*\}")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1,
+    "f8e5m2": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+# opcode -> structural category. Anything unlisted is "elementwise" — the
+# default absorbs XLA's per-version instruction-selection jitter (add vs
+# and vs select swaps) while a *new category* (a gather appearing in a
+# program that had none, a custom-call, a collective) stays a hard signal.
+_CATEGORY = {
+    "while": "control", "conditional": "control", "call": "control",
+    "fusion": "fusion",
+    "constant": "constant",
+    "gather": "gather", "dynamic-slice": "gather",
+    "scatter": "scatter", "dynamic-update-slice": "scatter",
+    "dot": "dot", "convolution": "dot",
+    "reduce": "reduce", "reduce-window": "reduce",
+    "sort": "sort",
+    "rng": "rng", "rng-bit-generator": "rng",
+    "rng-get-and-update-state": "rng",
+    "custom-call": "custom-call",
+    "all-reduce": "collective", "all-gather": "collective",
+    "all-to-all": "collective", "collective-permute": "collective",
+    "reduce-scatter": "collective", "collective-broadcast": "collective",
+    "infeed": "hostio", "outfeed": "hostio",
+    "send": "hostio", "recv": "hostio",
+    "send-done": "hostio", "recv-done": "hostio",
+    # data movement / shape plumbing
+    "bitcast": "layout", "bitcast-convert": "layout", "broadcast": "layout",
+    "reshape": "layout", "transpose": "layout", "copy": "layout",
+    "copy-start": "layout", "copy-done": "layout", "pad": "layout",
+    "slice": "layout", "concatenate": "layout", "reverse": "layout",
+    "iota": "layout", "get-tuple-element": "layout", "tuple": "layout",
+    "parameter": "layout", "convert": "layout", "after-all": "layout",
+    "optimization-barrier": "layout",
+}
+
+
+def _find_alias_blob(txt: str) -> str | None:
+    """The brace-balanced body of ``input_output_alias={...}`` in the
+    module header, or None when the program aliases nothing."""
+    key = "input_output_alias={"
+    start = txt.find(key)
+    if start < 0:
+        return None
+    i = start + len(key)
+    depth = 1
+    while i < len(txt) and depth:
+        if txt[i] == "{":
+            depth += 1
+        elif txt[i] == "}":
+            depth -= 1
+        i += 1
+    return txt[start + len(key):i - 1]
+
+
+def fingerprint_text(hlo_text: str) -> dict:
+    """Fingerprint one compiled-HLO module text (see module docstring for
+    the field semantics and which fields the checker gates on)."""
+    opcode_counts: dict[str, int] = {}
+    fusion_shapes: list[str] = []
+    for m in _OP_RE.finditer(hlo_text):
+        shape, op = m.group(1), m.group(2)
+        opcode_counts[op] = opcode_counts.get(op, 0) + 1
+        if op == "fusion":
+            fusion_shapes.append(_LAYOUT_RE.sub("", shape))
+
+    categories: dict[str, int] = {}
+    for op, cnt in opcode_counts.items():
+        cat = _CATEGORY.get(op, "elementwise")
+        categories[cat] = categories.get(cat, 0) + cnt
+
+    largest_const = 0
+    for m in _CONST_RE.finditer(hlo_text):
+        dt, dims = m.group(1), m.group(2)
+        size = _DTYPE_BYTES.get(dt, 8)
+        for d in dims.split(","):
+            if d.strip():
+                size *= int(d)
+        largest_const = max(largest_const, size)
+
+    alias = _find_alias_blob(hlo_text)
+    donated = sorted(
+        {int(p) for p in re.findall(r"\(\s*(\d+)\s*,", alias)}
+    ) if alias else []
+
+    # the declared input dtypes, from the entry computation layout — used
+    # by the GC002 live rule (f64 in the graph but not in the inputs)
+    mlay = re.search(r"entry_computation_layout=\{\((.*?)\)->", hlo_text)
+    input_dtypes = sorted(
+        set(re.findall(r"([a-z0-9]+)\[", mlay.group(1)))
+    ) if mlay else []
+
+    return {
+        "op_categories": dict(sorted(categories.items())),
+        "opcode_counts": dict(sorted(opcode_counts.items())),
+        "fusion_count": opcode_counts.get("fusion", 0),
+        "fusion_root_shapes": sorted(fusion_shapes),
+        "while_loop_count": opcode_counts.get("while", 0),
+        "donated_params": donated,
+        "largest_constant_bytes": largest_const,
+        "input_dtypes": input_dtypes,
+        "has_f64": bool(re.search(r"\bf64\[", hlo_text)),
+    }
+
+
+def fingerprint_lowered(lowered) -> dict:
+    """Compile a ``jax.stages.Lowered`` and fingerprint the optimized HLO."""
+    return fingerprint_text(lowered.compile().as_text())
+
+
+# ---------------------------------------------------------------------------
+# canonical entry points
+# ---------------------------------------------------------------------------
+
+
+class EntrySpec(NamedTuple):
+    """One fingerprinted entry point: a builder returning the canonical
+    ``jax.stages.Lowered`` (the ``lower_*`` surfaces live next to the code
+    they lower — ops/pipeline/parallel — so refactors update them in
+    place), whether the program declares buffer donation (the GC001
+    contract), and a human note on the canonical shape."""
+
+    build: Callable[..., object]
+    donates: bool
+    canon: str
+
+
+def _canon_rrg(n: int, d: int, seed: int):
+    from graphdyn.graphs import random_regular_graph
+
+    return random_regular_graph(n, d, seed=seed)
+
+
+def _build_packed_rollout(steps: int = 4):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from graphdyn.ops.packed import pack_spins, packed_rollout
+
+    g = _canon_rrg(256, 3, 0)
+    rng = np.random.default_rng(0)
+    s = (2 * rng.integers(0, 2, size=(128, g.n)) - 1).astype(np.int8)
+    return packed_rollout.lower(
+        jnp.asarray(g.nbr), jnp.asarray(g.deg), jnp.asarray(pack_spins(s)),
+        steps=steps,
+    )
+
+
+def _build_bdcm_sweep():
+    from graphdyn.ops.bdcm import BDCMData, lower_sweep
+
+    data = BDCMData(_canon_rrg(64, 3, 1), p=1, c=1)
+    return lower_sweep(data, damp=0.9)
+
+
+def _entropy_config():
+    from graphdyn.config import DynamicsConfig, EntropyConfig
+
+    return EntropyConfig(
+        dynamics=DynamicsConfig(p=1, c=1), max_sweeps=50, eps=1e-4,
+    )
+
+
+def _build_entropy_cell_chunk(G: int = 2):
+    import jax.numpy as jnp
+
+    from graphdyn.ops.bdcm import BDCMData
+    from graphdyn.pipeline.entropy_group import EntropyCellExec
+
+    cells = [
+        (BDCMData(_canon_rrg(48, 3, k), p=1, c=1), 48, 0) for k in range(G)
+    ]
+    ex = EntropyCellExec(
+        cells, _entropy_config(), group_size=G, chunk_sweeps=8, kernel="xla"
+    )
+    chi = ex.stack_chi([c[0].init_messages(k) for k, c in enumerate(cells)])
+    return ex.lower_chunk(
+        chi,
+        jnp.zeros(G, jnp.float32),
+        jnp.ones(G, bool),
+        jnp.full(G, jnp.inf, jnp.float32),
+        jnp.zeros(G, jnp.int32),
+    )
+
+
+def _hpr_config():
+    from graphdyn.config import DynamicsConfig, HPRConfig
+
+    return HPRConfig(dynamics=DynamicsConfig(p=1, c=1), max_sweeps=20)
+
+
+def _build_hpr_group_loop(G: int = 2):
+    from graphdyn.pipeline.hpr_group import HPRGroupExec, _build_rep
+
+    config = _hpr_config()
+    items = [_build_rep(24, 3, config, k, "pairing") for k in range(G)]
+    ex = HPRGroupExec(items, config, group_size=G, kernel="xla")
+    state = ex.init_state(
+        [it[2] for it in items], [it[3] for it in items],
+        [it[4] for it in items], list(range(G)),
+    )
+    return ex.lower_loop(state, 5)
+
+
+def _build_sa_group_loop(G: int = 2):
+    from graphdyn.config import DynamicsConfig, SAConfig
+    from graphdyn.models.sa import prepare_sa_inputs
+    from graphdyn.pipeline.sa_group import lower_group_loop
+
+    config = SAConfig(dynamics=DynamicsConfig(p=1, c=1))
+    graphs = [_canon_rrg(32, 3, k) for k in range(G)]
+    preps = [
+        prepare_sa_inputs(g, config, n_replicas=1, seed=k, max_steps=50)
+        for k, g in enumerate(graphs)
+    ]
+    return lower_group_loop(
+        graphs, preps, list(range(G)), config, group_size=G, chunk_steps=10,
+    )
+
+
+def _build_sharded_rollout():
+    import jax
+
+    from graphdyn.parallel.mesh import make_mesh
+    from graphdyn.parallel.sharded import lower_sharded_rollout
+
+    # a 1-device mesh: the canonical mesh-path program must fingerprint
+    # identically under the test harness's 8 simulated host devices and a
+    # bare 1-device CLI run (the partitioned program depends only on the
+    # mesh SHAPE, and (1, 1) exists in both environments)
+    mesh = make_mesh((1, 1), ("replica", "node"), devices=jax.devices()[:1])
+    return lower_sharded_rollout(mesh, _canon_rrg(64, 3, 0), 8, steps=2)
+
+
+ENTRIES: dict[str, EntrySpec] = {
+    "packed_rollout": EntrySpec(
+        _build_packed_rollout, donates=False,
+        canon="RRG n=256 d=3, R=128 packed (W=4), steps=4",
+    ),
+    "bdcm_sweep": EntrySpec(
+        _build_bdcm_sweep, donates=False,
+        canon="RRG n=64 d=3, p=c=1, damp=0.9, XLA core (use_pallas=False)",
+    ),
+    "entropy_cell_chunk": EntrySpec(
+        _build_entropy_cell_chunk, donates=False,
+        canon="G=2 cells, RRG n=48 d=3, p=c=1, chunk_sweeps=8, kernel=xla",
+    ),
+    "hpr_group_loop": EntrySpec(
+        _build_hpr_group_loop, donates=True,
+        canon="G=2 reps, RRG n=24 d=3, p=c=1, t_end=5, kernel=xla",
+    ),
+    "sa_group_loop": EntrySpec(
+        _build_sa_group_loop, donates=True,
+        canon="G=2 reps, RRG n=32 d=3, p=c=1, max_steps=50, chunk_steps=10",
+    ),
+    "sharded_rollout": EntrySpec(
+        _build_sharded_rollout, donates=False,
+        canon="1-device (replica, node) mesh, RRG n=64 d=3, R=8, steps=2",
+    ),
+}
+
+# fingerprint fields gated by the ledger diff (everything else is
+# informational — see the band table in the module docstring)
+_COMPACT_FIELDS = (
+    "op_categories", "fusion_count", "while_loop_count", "donated_params",
+    "largest_constant_bytes",
+)
+
+
+def lower_entry(name: str, **overrides):
+    """The canonical ``jax.stages.Lowered`` for one entry point
+    (``overrides`` reach the builder — e.g. ``G=8`` on the grouped
+    entries, for the fingerprint-invariance tests)."""
+    return ENTRIES[name].build(**overrides)
+
+
+def collect_fingerprints(
+    entries=None, *, compact: bool = False, diag=None, **overrides
+) -> dict[str, dict]:
+    """Fingerprints for ``entries`` (default: all). ``compact`` keeps only
+    the ledger-gated fields (the bench summary row); ``diag`` is an
+    optional progress sink (called with one string per entry — stderr in
+    the CLI, so stdout stays a single JSON document)."""
+    out = {}
+    for name in entries or sorted(ENTRIES):
+        if diag:
+            diag(f"graftcheck: lowering + compiling {name} "
+                 f"({ENTRIES[name].canon})")
+        fp = fingerprint_lowered(lower_entry(name, **overrides))
+        if compact:
+            fp = {k: fp[k] for k in _COMPACT_FIELDS}
+        out[name] = fp
+    return out
+
+
+# ---------------------------------------------------------------------------
+# live rules (no ledger needed): GC001 / GC002 / GC003
+# ---------------------------------------------------------------------------
+
+
+def audit_fingerprint(name: str, fp: dict, *, donates: bool) -> list[Finding]:
+    """The ledger-free structural rules on one live fingerprint."""
+    findings = []
+    if donates and not fp["donated_params"]:
+        findings.append(Finding(
+            name, "GC001",
+            "declares donate_argnums but the compiled executable carries "
+            "NO input/output alias — the donated state buffer is silently "
+            "double-buffered (backend dropped the donation, or an "
+            "input/output shape-dtype mismatch made it unusable)",
+        ))
+    if fp.get("has_f64") and "f64" not in fp.get("input_dtypes", ()):
+        findings.append(Finding(
+            name, "GC002",
+            "compiled program contains float64 values but no input is "
+            "float64 — an implicit f32->f64 promotion inside the jitted "
+            "graph (a Python float or np.float64 scalar under x64 widens "
+            "the chain and doubles its HBM traffic)",
+        ))
+    if fp["largest_constant_bytes"] > LARGE_CONSTANT_BYTES:
+        findings.append(Finding(
+            name, "GC003",
+            f"a {fp['largest_constant_bytes']} B constant is baked into "
+            f"the program (> {LARGE_CONSTANT_BYTES} B) — a closed-over "
+            "host table that should be a traced argument (it re-embeds "
+            "per compile and defeats compile-cache sharing)",
+        ))
+    return findings
+
+
+def check_no_f64(fn, *args, **kwargs) -> list[Finding]:
+    """GC002 at the jaxpr level: trace ``fn`` and report every equation
+    that *produces* a float64 value from non-float64 inputs. Usable on any
+    callable (jitted or not) — complements the HLO-level scan inside
+    :func:`audit_fingerprint` with primitive names for the report."""
+    import jax
+    import numpy as np
+
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    in_f64 = any(
+        # graftlint: disable-next-line=GD004  dtype *guard*, no f64 created
+        getattr(v.aval, "dtype", None) == np.float64
+        for v in closed.jaxpr.invars
+    )
+    if in_f64:
+        return []
+
+    hits: list[str] = []
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            for v in eqn.outvars:
+                # graftlint: disable-next-line=GD004  dtype *guard* only
+                if getattr(v.aval, "dtype", None) == np.float64:
+                    hits.append(eqn.primitive.name)
+            for val in eqn.params.values():
+                for sub in _subjaxprs(val):
+                    walk(sub)
+
+    def _subjaxprs(val):
+        import jax.extend.core as jex_core
+
+        if isinstance(val, jex_core.ClosedJaxpr):
+            yield val.jaxpr
+        elif isinstance(val, jex_core.Jaxpr):
+            yield val
+        elif isinstance(val, (tuple, list)):
+            for v in val:
+                yield from _subjaxprs(v)
+
+    walk(closed.jaxpr)
+    if not hits:
+        return []
+    uniq = sorted(set(hits))
+    return [Finding(
+        getattr(fn, "__name__", repr(fn)), "GC002",
+        f"{len(hits)} equation(s) produce float64 from non-float64 inputs "
+        f"(primitives: {', '.join(uniq[:8])}) — unintended f32->f64 "
+        "promotion inside the traced graph",
+    )]
+
+
+# ---------------------------------------------------------------------------
+# GC004 — the recompile guard
+# ---------------------------------------------------------------------------
+
+
+class RecompileWatch:
+    """Counts distinct compiled signatures per jitted function across a
+    driver run, via ``jax_log_compiles`` (the compile path logs one
+    "Compiling <name> with global shapes and types [...]" line per cache
+    miss — a cache hit logs nothing, so hits are free and misses are
+    exact). Use as a context manager::
+
+        with RecompileWatch() as watch:
+            run_driver(...)
+        findings = check_recompiles(watch, {"_sa_group_loop": 1})
+
+    Grouped executors must compile once per shape class: a G-extent or
+    weak-shape mismatch (every group recompiling) shows up as multiple
+    distinct signatures for one entry-point name.
+    """
+
+    _COMPILE_RE = re.compile(r"^Compiling\s+(\S+)")
+
+    def __init__(self):
+        self.events: list[tuple[str, str]] = []   # (name, signature)
+        self._handler = None
+        self._prev_flag = None
+
+    # the compile log line is emitted by the lowering machinery; hook the
+    # jax logger subtree so a module rename inside jax keeps working
+    _LOGGER = "jax"
+
+    def __enter__(self):
+        import jax
+
+        watch = self
+
+        class _Handler(logging.Handler):
+            def emit(self, record):
+                try:
+                    msg = record.getMessage()
+                except Exception:
+                    return
+                m = watch._COMPILE_RE.match(msg)
+                if m:
+                    watch.events.append((m.group(1), msg))
+
+        self._handler = _Handler(level=logging.DEBUG)
+        self._prev_flag = jax.config.jax_log_compiles
+        jax.config.update("jax_log_compiles", True)
+        logging.getLogger(self._LOGGER).addHandler(self._handler)
+        return self
+
+    def __exit__(self, *exc):
+        import jax
+
+        logging.getLogger(self._LOGGER).removeHandler(self._handler)
+        jax.config.update("jax_log_compiles", self._prev_flag)
+        return False
+
+    def signatures(self, name_pattern: str) -> set:
+        """Distinct compile signatures whose function name matches the
+        (substring or regex) pattern."""
+        pat = re.compile(name_pattern)
+        return {sig for name, sig in self.events if pat.search(name)}
+
+    def counts(self) -> dict[str, int]:
+        """Distinct-signature count per compiled function name."""
+        per: dict[str, set] = {}
+        for name, sig in self.events:
+            per.setdefault(name, set()).add(sig)
+        return {name: len(sigs) for name, sigs in sorted(per.items())}
+
+
+def check_recompiles(
+    watch: RecompileWatch, budgets: dict[str, int]
+) -> list[Finding]:
+    """GC004: each ``budgets`` pattern's distinct-signature count must not
+    exceed its budget (budget = expected shape classes; 1 for a
+    fixed-shape driver run)."""
+    findings = []
+    for pattern, budget in budgets.items():
+        sigs = watch.signatures(pattern)
+        if len(sigs) > budget:
+            findings.append(Finding(
+                pattern, "GC004",
+                f"{len(sigs)} distinct compiled signatures (budget "
+                f"{budget}) — the entry point recompiles across the run "
+                "(G-extent / weak-shape cache miss: pad the group or make "
+                "the varying value a traced argument). Signatures: "
+                + " | ".join(sorted(s[:120] for s in sigs)),
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# ledger
+# ---------------------------------------------------------------------------
+
+
+def load_ledger(path: Path | str | None = None) -> dict | None:
+    p = Path(path) if path else default_ledger_path()
+    if not p.exists():
+        return None
+    with open(p) as fh:
+        return json.load(fh)
+
+
+def write_ledger(fingerprints: dict, path: Path | str | None = None) -> Path:
+    """Persist the ledger atomically (the GD007 discipline — a torn ledger
+    would fail every subsequent gate run)."""
+    import jax
+
+    from graphdyn.utils.io import write_json_atomic
+
+    p = Path(path) if path else default_ledger_path()
+    write_json_atomic(str(p), {
+        "version": 1,
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "canon": {name: ENTRIES[name].canon for name in sorted(ENTRIES)},
+        "entries": fingerprints,
+    }, indent=2, sort_keys=True)
+    return p
+
+
+def diff_fingerprints(entry: str, ledger_fp: dict, live_fp: dict) -> list[Finding]:
+    """Per-field tolerance-band diff of one live fingerprint against its
+    ledger row (band table in the module docstring)."""
+    findings = []
+    lcat = ledger_fp.get("op_categories", {})
+    vcat = live_fp.get("op_categories", {})
+    for cat, cnt in sorted(vcat.items()):
+        if cnt and cat not in lcat:
+            findings.append(Finding(
+                entry, "GC101",
+                f"new HLO op category {cat!r} ({cnt} op(s)) absent from "
+                "the ledger — the program gained a structurally new kind "
+                "of operation (e.g. a gather/scatter/custom-call that was "
+                "never there). If intentional, re-run with --update-ledger",
+            ))
+    for cat in sorted(set(lcat) | set(vcat)):
+        want, got = lcat.get(cat, 0), vcat.get(cat, 0)
+        if cat not in lcat:
+            continue                      # already a GC101 finding
+        band = max(4, int(0.5 * want))
+        if abs(got - want) > band:
+            findings.append(Finding(
+                entry, "GC102",
+                f"op category {cat!r}: {want} -> {got} ops "
+                f"(band ±{band}) — structural drift beyond benign "
+                "instruction-selection jitter",
+            ))
+    want_f = ledger_fp.get("fusion_count", 0)
+    got_f = live_fp.get("fusion_count", 0)
+    band_f = max(2, int(0.25 * want_f))
+    if abs(got_f - want_f) > band_f:
+        findings.append(Finding(
+            entry, "GC103",
+            f"fusion count {want_f} -> {got_f} (band ±{band_f}) — XLA "
+            "now builds a structurally different program (a fused loop "
+            "body split apart, or new unfused HBM round-trips)",
+        ))
+    lost = sorted(
+        set(ledger_fp.get("donated_params", ()))
+        - set(live_fp.get("donated_params", ()))
+    )
+    if lost:
+        findings.append(Finding(
+            entry, "GC104",
+            f"donation LOST: input parameter(s) {lost} were input/output-"
+            "aliased in the ledger but the live program no longer donates "
+            "them — the state buffer is double-buffered in HBM every call. "
+            "If intentional, re-run with --update-ledger",
+        ))
+    want_c = ledger_fp.get("largest_constant_bytes", 0)
+    got_c = live_fp.get("largest_constant_bytes", 0)
+    if got_c > max(4 * want_c, LARGE_CONSTANT_BYTES):
+        findings.append(Finding(
+            entry, "GC105",
+            f"largest baked-in constant {want_c} B -> {got_c} B — a host "
+            "table is being embedded into the program instead of passed "
+            "as an argument",
+        ))
+    want_w = ledger_fp.get("while_loop_count", 0)
+    got_w = live_fp.get("while_loop_count", 0)
+    if got_w != want_w:
+        findings.append(Finding(
+            entry, "GC106",
+            f"while-loop count {want_w} -> {got_w} — loop structure "
+            "changed (a fused while-loop split, a scan unrolled, or a "
+            "loop disappeared into host Python). If intentional, re-run "
+            "with --update-ledger",
+        ))
+    return findings
+
+
+def check_ledger(
+    live: dict[str, dict], ledger: dict | None, *, diag=None
+) -> list[Finding]:
+    """Diff live fingerprints against the ledger. A missing ledger (or a
+    missing entry) is a finding — the gate must fail until
+    ``--update-ledger`` commits the contract, not silently pass."""
+    import jax
+
+    if ledger is None:
+        return [
+            Finding(name, "GC100",
+                    f"no ledger found ({LEDGER_NAME}) — run `python -m "
+                    "graphdyn.analysis.graftcheck --update-ledger` and "
+                    "commit it")
+            for name in sorted(live)
+        ]
+    backend = jax.default_backend()
+    if ledger.get("backend") != backend:
+        if diag:
+            diag(
+                f"graftcheck: ledger was built on backend="
+                f"{ledger.get('backend')!r}, live backend is {backend!r} — "
+                "skipping the structural diff (fingerprints are backend-"
+                "specific; the gate runs on JAX_PLATFORMS=cpu)"
+            )
+        return []
+    if ledger.get("jax") != jax.__version__ and diag:
+        diag(
+            f"graftcheck: ledger jax={ledger.get('jax')} != live "
+            f"jax={jax.__version__} — diffing anyway (bands absorb minor "
+            "drift; re-run --update-ledger after a jax upgrade if the "
+            "diff fails)"
+        )
+    findings = []
+    entries = ledger.get("entries", {})
+    for name in sorted(live):
+        if name not in entries:
+            findings.append(Finding(
+                name, "GC100",
+                "entry point not in the fingerprint ledger — run "
+                "--update-ledger and commit the new row",
+            ))
+            continue
+        findings.extend(diff_fingerprints(name, entries[name], live[name]))
+    return findings
+
+
+def diff_bench_fingerprints(prev_row: dict, new_row: dict) -> list[Finding]:
+    """Round-over-round structural diff for ``bench.py``'s persisted
+    fingerprint summaries (the benchcheck hook): same band policy as the
+    ledger diff, applied between two BENCH_*.json rows. Rows from
+    different backends — or rounds predating the fingerprint column —
+    produce no findings (nothing comparable)."""
+    prev = prev_row or {}
+    new = new_row or {}
+    if not prev.get("entries") or not new.get("entries"):
+        return []
+    if prev.get("backend") != new.get("backend"):
+        return []
+    findings = []
+    for name, new_fp in sorted(new["entries"].items()):
+        old_fp = prev["entries"].get(name)
+        if old_fp:
+            findings.extend(diff_fingerprints(name, old_fp, new_fp))
+    return findings
+
+
+def bench_drift_blessed(new_row: dict, ledger: dict | None = None) -> bool:
+    """Whether a bench fingerprint row that drifted from the *previous
+    round* agrees with the committed LEDGER — i.e. the structural change
+    was deliberately blessed via ``--update-ledger`` in a reviewed PR.
+    This is benchcheck's update path: round artifacts (``BENCH_r*.json``)
+    are immutable history, so after a blessed change the round-over-round
+    diff stays red only until the checker sees the new row matches the
+    ledger; the comparison baseline then refreshes when the next round
+    persists its row."""
+    ledger = ledger if ledger is not None else load_ledger()
+    if not ledger or not new_row or not new_row.get("entries"):
+        return False
+    if ledger.get("backend") != new_row.get("backend"):
+        return False
+    entries = ledger.get("entries", {})
+    for name, fp in new_row["entries"].items():
+        old = entries.get(name)
+        if old is None or diff_fingerprints(name, old, fp):
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _diag(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m graphdyn.analysis.graftcheck",
+        description="graftcheck: jaxpr/HLO program auditor over the "
+                    "fingerprint ledger (exit code = number of findings)",
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--ledger", default=None,
+                    help=f"ledger path (default: repo-root {LEDGER_NAME})")
+    ap.add_argument("--update-ledger", action="store_true",
+                    help="recompute every entry and rewrite the ledger "
+                         "(live GC001-GC003 rules still gate)")
+    ap.add_argument("--entries", default=None,
+                    help="comma-separated subset of entry points "
+                         f"(default: all of {', '.join(sorted(ENTRIES))})")
+    args = ap.parse_args(argv)
+
+    names = sorted(ENTRIES)
+    if args.entries:
+        names = [e.strip() for e in args.entries.split(",") if e.strip()]
+        unknown = [e for e in names if e not in ENTRIES]
+        if unknown:
+            ap.error(f"unknown entries: {', '.join(unknown)} "
+                     f"(known: {', '.join(sorted(ENTRIES))})")
+
+    live = collect_fingerprints(names, diag=_diag)
+    findings: list[Finding] = []
+    for name in names:
+        findings.extend(
+            audit_fingerprint(name, live[name], donates=ENTRIES[name].donates)
+        )
+    if args.update_ledger:
+        if set(names) != set(ENTRIES):
+            ap.error("--update-ledger rewrites the WHOLE ledger; it cannot "
+                     "be combined with --entries")
+        path = write_ledger(live, args.ledger)
+        _diag(f"graftcheck: wrote {len(live)} fingerprint(s) to {path}")
+    else:
+        findings.extend(
+            check_ledger(live, load_ledger(args.ledger), diag=_diag)
+        )
+
+    if args.format == "json":
+        # exactly ONE JSON document on stdout; diagnostics live on stderr
+        print(json.dumps({
+            "findings": [f._asdict() for f in findings],
+            "fingerprints": live,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f"{f.entry}: {f.code} {f.message}")
+    if findings:
+        _diag(f"graftcheck: {len(findings)} finding(s)")
+    else:
+        _diag(f"graftcheck: {len(live)} entry point(s) clean")
+    return min(len(findings), 125)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,6 +63,16 @@ Rules (stable codes; each can be silenced per line with
   batch axis a Pallas **grid dimension** instead (cf.
   ``ops/pallas_bdcm.dp_contract_grouped`` — the group axis is
   ``grid[0]``, never a vmap).
+- **GD010** ``jnp.asarray`` on a *mutable host buffer* in a driver module
+  (``graphdyn/models/``, ``graphdyn/pipeline/``, ``cli.py``): a name the
+  same function also mutates in place (subscript assignment / ``.fill``
+  etc.).  On the CPU backend ``asarray`` may ALIAS the numpy buffer for
+  the device array's whole lifetime, so the later mutation races the
+  asynchronous device reads — the PR-4 nondeterminism class.  Use
+  ``jnp.array`` (explicit copy) at the crossing; the runtime half of this
+  contract is the ``GRAPHDYN_SANITIZE=alias`` sanitizer
+  (:mod:`graphdyn.analysis.sanitize`), which turns a surviving race into
+  a deterministic failure.
 
 Escape hatches, all requiring an explicit code list (``all`` allowed):
 
@@ -97,7 +107,14 @@ RULES = {
     "GD007": "non-atomic persistence (direct np.savez / open-for-write outside utils/io.py)",
     "GD008": "per-iteration host->device transfer (jnp.asarray/device_put) in a driver-module for-loop",
     "GD009": "jax.vmap over a pallas_call-backed callable (serial kernel-launch loop, not a batched grid)",
+    "GD010": "jnp.asarray of a host buffer this function mutates (CPU alias race with async device reads)",
 }
+
+# host->device crossings GD010 watches (the potentially-aliasing ones;
+# jnp.array copies and is the suggested fix)
+_GD010_CALLS = {"jnp.asarray", "jax.numpy.asarray"}
+# in-place ndarray methods that count as mutation for GD010
+_GD010_MUTATORS = {"fill", "sort", "put", "partition", "resize"}
 
 # host->device transfer calls GD008 watches inside host for-loops
 _GD008_CALLS = {
@@ -342,6 +359,7 @@ class _FileLinter:
         self._check_persistence(tree)
         self._check_host_loop_transfers(tree, seen)
         self._check_vmap_pallas(tree)
+        self._check_alias_crossings(tree)
         self.findings.sort(key=lambda f: (f.line, f.col, f.code))
         return self.findings
 
@@ -535,6 +553,74 @@ class _FileLinter:
                         f"per-iteration tables and run one batched program "
                         f"(see graphdyn.pipeline), or hoist the transfer "
                         f"out of the loop",
+                    )
+
+    def _check_alias_crossings(self, tree: ast.Module):
+        """GD010: ``jnp.asarray(x)`` in a driver module where the SAME
+        function mutates ``x`` in place (``x[...] = ...``, ``x[...] += ...``
+        or an in-place ndarray method).  On CPU the device array may alias
+        the numpy buffer for its whole lifetime, so the mutation races the
+        asynchronous device reads — the PR-4 nondeterminism class; the fix
+        is an explicit copy (``jnp.array``) at the crossing."""
+        if not self.driver_mod:
+            return
+
+        def own_nodes(fn):
+            # the function's OWN statements only: nested defs/lambdas are
+            # separate scopes analyzed on their own walk — a shadowed local
+            # mutated in an inner function must not flag the outer one
+            stack = list(ast.iter_child_nodes(fn))
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                    continue
+                yield node
+                stack.extend(ast.iter_child_nodes(node))
+
+        flagged: set[int] = set()
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            mutated: set[str] = set()
+            for node in own_nodes(fn):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Subscript) and isinstance(
+                        t.value, ast.Name
+                    ):
+                        mutated.add(t.value.id)
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _GD010_MUTATORS
+                    and isinstance(node.func.value, ast.Name)
+                ):
+                    mutated.add(node.func.value.id)
+            if not mutated:
+                continue
+            for node in own_nodes(fn):
+                if (
+                    isinstance(node, ast.Call)
+                    and id(node) not in flagged
+                    and _dotted(node.func) in _GD010_CALLS
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in mutated
+                ):
+                    flagged.add(id(node))
+                    self.emit(
+                        node, "GD010",
+                        f"jnp.asarray({node.args[0].id}) may ALIAS a host "
+                        f"buffer this function mutates — on CPU the "
+                        f"mutation races the device array's async reads "
+                        f"(PR-4 class); copy at the crossing with "
+                        f"jnp.array({node.args[0].id}) or drop the device "
+                        f"array before mutating",
                     )
 
     def _check_vmap_pallas(self, tree: ast.Module):
@@ -780,12 +866,14 @@ def main(argv: list[str] | None = None) -> int:
 
     findings = lint_paths(args.paths)
     if args.format == "json":
+        # exactly ONE JSON document on stdout (CI pipes it); the summary —
+        # like every other diagnostic — goes to stderr only
         print(json.dumps([f._asdict() for f in findings], indent=2))
     else:
         for f in findings:
             print(f"{f.path}:{f.line}:{f.col}: {f.code} {f.message}")
-        if findings:
-            print(f"graftlint: {len(findings)} finding(s)", file=sys.stderr)
+    if findings:
+        print(f"graftlint: {len(findings)} finding(s)", file=sys.stderr)
     # exit code = findings, clamped to the 8-bit exit-status range
     return min(len(findings), 125)
 

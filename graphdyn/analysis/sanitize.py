@@ -1,0 +1,261 @@
+"""Runtime host-aliasing sanitizer — the PR-4 ``jnp.asarray`` race class as
+a deterministic failure.
+
+On the CPU backend ``jnp.asarray`` (and ``jax.device_put``) may return a
+``jax.Array`` that ALIASES the source numpy buffer for the array's entire
+lifetime: any host mutation of that buffer while the device array is alive
+races the asynchronous device reads — observed in PR 4 as nondeterministic
+entropy-ladder results, fixed there by copying (``jnp.array``) at every
+mutated-buffer crossing, and guarded statically by graftlint **GD010**.
+Statics can only see syntactic patterns; this module catches the class at
+RUN time, deterministically:
+
+- :func:`alias_sanitizer` patches the host→device crossing functions
+  (``jnp.asarray`` / ``jnp.array`` with ``copy=False`` semantics left to
+  jax, and ``jax.device_put``) for the duration of the context. Every
+  crossing whose source is a *writeable* host ``np.ndarray`` snapshots a
+  digest of the buffer at dispatch and registers the returned device
+  array.
+- The digest is re-verified while the device array is alive: at the
+  array's finalization (GC), at every explicit :meth:`AliasSanitizer.
+  verify` call, and at context exit. A source buffer that changed while
+  its device alias lived raises :class:`AliasRaceError` naming the
+  crossing site — the race is now a test failure with a file:line, not a
+  wrong number three plots later.
+
+The contract is intentionally strict: on CPU the alias persists for the
+array's lifetime, so "I mutated after the computation finished" is still
+inside the hazard window. The fix is the same as PR 4's — copy at the
+crossing (``jnp.array``) or drop the device array before mutating.
+
+Opt-in: ``GRAPHDYN_SANITIZE=alias`` in the environment turns
+:func:`maybe_alias_sanitizer` (wrapped around every CLI driver run) into
+the real context; otherwise it is a no-op with zero overhead. Tests use
+:func:`alias_sanitizer` directly.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import traceback
+import weakref
+from contextlib import contextmanager
+
+ENV_VAR = "GRAPHDYN_SANITIZE"
+ENV_VALUE = "alias"
+
+
+class AliasRaceError(RuntimeError):
+    """A host buffer was mutated while a device array aliasing it was
+    alive — the PR-4 nondeterminism class, caught deterministically."""
+
+
+def _digest(arr) -> bytes:
+    # tobytes() copies, which is exactly what makes the snapshot immune to
+    # the mutation it is trying to catch; the sanitizer is opt-in, so the
+    # copy cost is a diagnostic-mode price, not a hot-path one
+    return hashlib.blake2b(arr.tobytes(), digest_size=16).digest()
+
+
+def _call_site() -> str:
+    """file:line of the crossing, skipping this module and jax frames."""
+    for frame in reversed(traceback.extract_stack()):
+        fn = frame.filename.replace("\\", "/")
+        if "/analysis/sanitize.py" in fn:
+            continue
+        if "/jax/" in fn or "/jax/_src/" in fn:
+            continue
+        return f"{frame.filename}:{frame.lineno}"
+    return "<unknown>"
+
+
+class _Record:
+    __slots__ = ("source", "digest", "site", "dead", "finalizer")
+
+    def __init__(self, source, digest, site):
+        self.source = source
+        self.digest = digest
+        self.site = site
+        self.dead = False
+        self.finalizer = None
+
+
+class AliasSanitizer:
+    """The active sanitizer: crossing records plus verification. Not
+    re-entrant (one active context at a time — :func:`alias_sanitizer`
+    enforces it)."""
+
+    def __init__(self):
+        self.records: list[_Record] = []
+        self.violations: list[str] = []
+        self._saved = None
+
+    # -- record / verify -------------------------------------------------
+
+    def _track(self, source, out):
+        import numpy as np
+
+        if not isinstance(source, np.ndarray):
+            return
+        if not source.flags.writeable or source.size == 0:
+            return                      # read-only / empty: cannot race
+        if source.dtype == object:
+            return
+        try:
+            import jax
+            from jax.core import Tracer
+
+            # tracers ARE jax.Array instances, so the exclusion must test
+            # Tracer directly: a traced crossing is consumed at trace time
+            # (no alias survives into execution) and tracking it would pay
+            # digest + stack-walk cost per closure constant for nothing
+            if isinstance(out, Tracer) or not isinstance(out, jax.Array):
+                return
+        except Exception:
+            return
+        if not self._may_alias(source, out):
+            return                      # provably a copy: cannot race
+        rec = _Record(source, _digest(source), _call_site())
+        # verify at the device array's death: the alias window closes
+        # there, and a buffer that already changed inside it is a race
+        # regardless of what happens later
+        rec.finalizer = weakref.finalize(out, self._on_dead, rec)
+        self.records.append(rec)
+
+    @staticmethod
+    def _may_alias(source, out) -> bool:
+        """Could ``out`` share ``source``'s memory? MAY-alias semantics on
+        purpose: whether a same-dtype contiguous crossing actually aliases
+        depends on allocator alignment luck (measured: an int8 buffer
+        aliased — mutations visible through the device array — while f32
+        siblings copied), which is exactly the nondeterminism PR 4
+        observed. The sanitizer therefore flags the hazard CLASS
+        deterministically and skips only crossings that are *provably*
+        copies — a dtype conversion or a non-contiguous source — which
+        would otherwise turn legitimate buffer reuse into false
+        AliasRaceErrors."""
+        if out.dtype != source.dtype:
+            return False                # conversion always copies
+        if not source.flags.c_contiguous:
+            return False                # jax materializes a contiguous copy
+        return True
+
+    def _on_dead(self, rec: _Record):
+        if not rec.dead:
+            rec.dead = True
+            self._verify_record(rec)
+            # the alias window is closed and the verdict recorded: drop the
+            # strong source reference and the record itself, so an
+            # hours-long sanitized driver run does not pin every staging
+            # buffer it ever crossed
+            rec.source = None
+            try:
+                self.records.remove(rec)
+            except ValueError:
+                pass
+
+    def _verify_record(self, rec: _Record):
+        if rec.source is None:
+            return                      # already verified and released
+        if _digest(rec.source) != rec.digest:
+            msg = (
+                f"host buffer mutated while a device array aliasing it "
+                f"was alive (crossing at {rec.site}, "
+                f"shape={rec.source.shape}, dtype={rec.source.dtype}) — "
+                f"copy at the crossing (jnp.array) or drop the device "
+                f"array before mutating (graftlint GD010)"
+            )
+            if msg not in self.violations:
+                self.violations.append(msg)
+
+    def verify(self):
+        """Re-verify every live crossing now; raise on any violation seen
+        so far (including ones collected at array finalization)."""
+        # snapshot: a GC triggered mid-loop can run finalizers that prune
+        # self.records while we iterate
+        for rec in list(self.records):
+            if not rec.dead:
+                self._verify_record(rec)
+        if self.violations:
+            raise AliasRaceError(
+                "GRAPHDYN_SANITIZE=alias: "
+                + "; ".join(self.violations)
+            )
+
+    # -- patching --------------------------------------------------------
+
+    def _patch(self):
+        import jax
+        import jax.numpy as jnp
+
+        saved = {
+            "asarray": jnp.asarray,
+            "device_put": jax.device_put,
+        }
+        san = self
+
+        def asarray(a, *args, **kwargs):
+            out = saved["asarray"](a, *args, **kwargs)
+            san._track(a, out)
+            return out
+
+        def device_put(x, *args, **kwargs):
+            out = saved["device_put"](x, *args, **kwargs)
+            san._track(x, out)
+            return out
+
+        jnp.asarray = asarray
+        jax.device_put = device_put
+        self._saved = saved
+
+    def _unpatch(self):
+        import jax
+        import jax.numpy as jnp
+
+        jnp.asarray = self._saved["asarray"]
+        jax.device_put = self._saved["device_put"]
+        self._saved = None
+
+
+_ACTIVE: list[AliasSanitizer] = []
+
+
+@contextmanager
+def alias_sanitizer():
+    """Context manager: patch the crossings, yield the
+    :class:`AliasSanitizer`, verify on clean exit (an exception already
+    propagating is not masked by a verification failure)."""
+    if _ACTIVE:
+        raise RuntimeError("alias_sanitizer() is already active "
+                           "(not re-entrant)")
+    san = AliasSanitizer()
+    san._patch()
+    _ACTIVE.append(san)
+    try:
+        yield san
+    except BaseException:
+        raise
+    else:
+        san.verify()
+    finally:
+        _ACTIVE.pop()
+        san._unpatch()
+        # detach finalizers: verification responsibility ends with the
+        # context; late GC of device arrays must not re-verify against
+        # legitimately-reused buffers
+        for rec in list(san.records):
+            rec.dead = True
+            if rec.finalizer is not None:
+                rec.finalizer.detach()
+
+
+@contextmanager
+def maybe_alias_sanitizer():
+    """The env-gated wrapper the CLI drivers run under: the real sanitizer
+    when ``GRAPHDYN_SANITIZE=alias``, a zero-overhead no-op otherwise."""
+    if os.environ.get(ENV_VAR, "") == ENV_VALUE:
+        with alias_sanitizer() as san:
+            yield san
+    else:
+        yield None

@@ -321,8 +321,14 @@ def main(argv=None) -> int:
     if getattr(args, "max_save_retries", None) is not None:
         set_save_retry(args.max_save_retries)
 
+    # GRAPHDYN_SANITIZE=alias: run the whole driver under the host-aliasing
+    # sanitizer (graphdyn.analysis.sanitize) — a mutated host buffer whose
+    # device alias is still alive becomes a deterministic AliasRaceError
+    # naming the crossing, instead of nondeterministic results
+    from graphdyn.analysis.sanitize import maybe_alias_sanitizer
+
     try:
-        with graceful_shutdown():
+        with graceful_shutdown(), maybe_alias_sanitizer():
             return _run(args)
     except ShutdownRequested as e:
         print(f"graphdyn: {e} — exiting {EX_TEMPFAIL} (requeue me)",

@@ -590,6 +590,33 @@ def make_sweep(
     return lambda chi, lmbd: call(chi, lmbd, None)
 
 
+def lower_sweep(
+    data: BDCMData,
+    *,
+    damp: float,
+    eps_clamp: float = 0.0,
+    mask_invalid_src: bool = True,
+    lmbd: float = 0.1,
+    seed: int = 0,
+):
+    """Lower (without executing) the pure-XLA sweep program for ``data`` at
+    its own shapes — the program-structure surface
+    :mod:`graphdyn.analysis.graftcheck` fingerprints for the
+    ``dp_contract``-equivalent XLA core. Lives next to :func:`make_sweep` so
+    a sweep refactor updates the fingerprinted surface in the same place;
+    always the ``use_pallas=False`` spec (the fingerprint ledger is the
+    hardware-free structural contract — kernel mode is orthogonal to it).
+    Returns a ``jax.stages.Lowered``."""
+    valid, x0, tables, spec = _sweep_args(
+        data, damp=damp, eps_clamp=eps_clamp,
+        mask_invalid_src=mask_invalid_src, with_bias=False, use_pallas=False,
+    )
+    chi = data.init_messages(seed)
+    return _sweep_exec.lower(
+        chi, jnp.asarray(lmbd, data.dtype), None, valid, x0, tables, spec
+    )
+
+
 class EnsembleBDCM:
     """Stacked BDCM data for an ensemble of *structurally congruent* graphs
     (same n, same degree-class signature — e.g. RRG(n, d) instances, where

@@ -126,6 +126,36 @@ def make_sharded_rollout(
     return jax.jit(f)
 
 
+def lower_sharded_rollout(
+    mesh: Mesh,
+    graph,
+    R: int,
+    *,
+    steps: int,
+    rule: str = "majority",
+    tie: str = "stay",
+    replica_axis: str = "replica",
+    node_axis: str = "node",
+):
+    """Lower (without executing) the sharded rollout at this graph's padded
+    shapes with canonically placed arguments — the program
+    :mod:`graphdyn.analysis.graftcheck` fingerprints for the mesh path.
+    Kept next to :func:`make_sharded_rollout` so a rollout refactor updates
+    the fingerprinted surface in the same place. The spin values are
+    placeholders (a lowering sees only shapes/dtypes/shardings). Returns a
+    ``jax.stages.Lowered``."""
+    nbr_pad, n_pad = pad_nodes(graph, int(mesh.shape[node_axis]))
+    nbr_d = place_sharded(mesh, jnp.asarray(nbr_pad), P(node_axis, None))
+    s_d = place_sharded(
+        mesh, jnp.ones((R, n_pad), jnp.int8), P(replica_axis, node_axis)
+    )
+    f = make_sharded_rollout(
+        mesh, n_real=graph.n, steps=steps, rule=rule, tie=tie,
+        replica_axis=replica_axis, node_axis=node_axis,
+    )
+    return f.lower(nbr_d, s_d)
+
+
 def make_sharded_sa_step(
     mesh: Mesh,
     rollout_steps: int,

@@ -415,6 +415,18 @@ class EntropyCellExec:
             self.tables, sp,
         ))
 
+    def lower_chunk(self, chi, lmbd_vec, active, delta0, t0):
+        """Lower (without executing) the chunk program for this group's
+        shapes — the exact :func:`_cell_chunk_exec` invocation
+        :meth:`fixed_point_chunk` dispatches, as a ``jax.stages.Lowered``
+        for :mod:`graphdyn.analysis.graftcheck` fingerprinting. Kept next
+        to ``fixed_point_chunk`` so a chunk refactor updates the
+        fingerprinted surface in the same place."""
+        return _cell_chunk_exec.lower(
+            chi, lmbd_vec, active, delta0, t0, self.valid, self.x0,
+            self.tables, self.spec,
+        )
+
     def poison_cell(self, chi, g: int):
         """The ``sweep.nan`` fault payload for cell ``g`` — one NaN seeded
         into its carry (the serial :func:`~graphdyn.ops.bdcm.poison_nan`

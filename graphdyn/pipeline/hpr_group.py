@@ -436,18 +436,36 @@ class HPRGroupExec:
             keys = jnp.asarray(np.stack([np.asarray(k) for k in keys_in]))
         real = np.zeros(self.G, bool)
         real[:self.G_real] = True
+        # jnp.array (NOT asarray): `real` is a mutated host buffer — the
+        # mutation precedes the crossing today, but the GD010 discipline is
+        # to copy at every mutable-buffer crossing so a reorder can never
+        # reintroduce the PR-4 alias race
+        real_dev = jnp.array(real)
         if m_final is None:
             m0, active0 = _hpr_group_init_m(
-                self.nbr_stack, s, jnp.asarray(real), spec=self.spec
+                self.nbr_stack, s, real_dev, spec=self.spec
             )
         else:
             m0 = jnp.asarray(np.asarray(pad(list(m_final)), np.float32))
-            active0 = (m0 < 1.0) & jnp.asarray(real)
+            active0 = (m0 < 1.0) & real_dev
         steps0 = (jnp.full((self.G,), int(t), jnp.int32) if steps is None
                   else jnp.asarray(np.asarray(pad(list(steps)), np.int32)))
         return _HPRGroupState(
             chi=chi, biases=biases, s=s, keys=keys,
             t=jnp.int32(t), m_final=m0, active=active0, steps=steps0,
+        )
+
+    def lower_loop(self, state: _HPRGroupState, t_end):
+        """Lower (without executing) the chunked loop program for this
+        group's shapes — the exact :func:`_hpr_group_loop` invocation
+        :meth:`advance` dispatches, as a ``jax.stages.Lowered`` for
+        :mod:`graphdyn.analysis.graftcheck` fingerprinting. Kept next to
+        ``advance`` so a loop refactor updates the fingerprinted surface in
+        the same place."""
+        return _hpr_group_loop.lower(
+            state, jnp.int32(t_end), *self.consts,
+            self.src, self.rev, self.out_edges, self.nbr_stack, self.tables,
+            spec=self.spec,
         )
 
     def advance(self, state: _HPRGroupState, t_end) -> _HPRGroupState:

@@ -164,31 +164,15 @@ class SAGroupResult(NamedTuple):
     m_final: np.ndarray    # f[G]
 
 
-def run_sa_group(
-    graphs,
-    preps,
-    rep_seeds,
-    config: SAConfig,
-    *,
-    dtype=jnp.float32,
-    group_size: int | None = None,
-    chunk_steps: int = 100_000,
-    on_chunk=None,
-    mesh=None,
-    group_axis: str = "group",
-) -> SAGroupResult:
-    """Run one group of single-replica SA chains as a single device program.
-
-    ``graphs``/``preps``/``rep_seeds`` are per-repetition: the sampled
-    graph, the :func:`graphdyn.models.sa.prepare_sa_inputs` tuple for
-    ``n_replicas=1, seed=seed+k``, and ``seed+k`` itself. ``group_size``
-    pads the batch with inactive rows so a short tail group reuses the full
-    group's compiled program. ``on_chunk`` is polled between device chunks
-    (the graceful-shutdown hook — it may raise). With a ``mesh``, the
-    stacked tables and carry shard over ``group_axis`` (repetitions are
-    independent, so the partitioned program is communication-free except
-    the stop test); results are bit-identical to the unsharded program.
-    """
+def _assemble_group(
+    graphs, preps, rep_seeds, config: SAConfig, *,
+    dtype, group_size, mesh, group_axis,
+):
+    """The group-program argument assembly shared by :func:`run_sa_group`
+    and :func:`lower_group_loop`: stacked/padded tables, the initial device
+    state, the loop constants, and the static loop parameters — ONE
+    assembly, so the lowered-for-fingerprinting program and the executed
+    program cannot drift apart."""
     from graphdyn.graphs import stack_graphs
 
     G_real = len(graphs)
@@ -224,6 +208,10 @@ def run_sa_group(
     keys = jax.vmap(jax.random.PRNGKey)(key_seeds)
     real = np.zeros(G, bool)
     real[:G_real] = True
+    # jnp.array (NOT asarray): `real` is a mutated host buffer — the GD010
+    # discipline is to copy at every such crossing so a reorder can never
+    # reintroduce the PR-4 alias race (mirrors hpr_group.init_state)
+    real_dev = jnp.array(real)
 
     def place(x):
         x = jnp.asarray(x)
@@ -236,7 +224,7 @@ def run_sa_group(
     nbr_dev = place(nbr_stack)
     state = _sa_group_init(
         nbr_dev, place(s0), place(keys),
-        place(a0), place(b0), place(real),
+        place(a0), place(b0), place(real_dev),
         rollout_steps=rollout, R_coef=R_coef, C_coef=C_coef,
     )
     loop_args = (
@@ -245,12 +233,65 @@ def run_sa_group(
         jnp.asarray(np_dt(config.a_cap_frac * n)),
         jnp.asarray(np_dt(config.b_cap_frac * n)),
     )
+    static = dict(rollout_steps=rollout, R_coef=R_coef, C_coef=C_coef,
+                  max_steps=max_steps)
+    return G_real, nbr_dev, state, loop_args, static
+
+
+def lower_group_loop(
+    graphs, preps, rep_seeds, config: SAConfig, *,
+    dtype=jnp.float32, group_size: int | None = None,
+    chunk_steps: int = 100_000,
+):
+    """Lower (without executing) the grouped SA loop program for these
+    repetitions' shapes — the exact :func:`_sa_group_loop` invocation
+    :func:`run_sa_group` dispatches, as a ``jax.stages.Lowered`` for
+    :mod:`graphdyn.analysis.graftcheck` fingerprinting. Shares
+    :func:`_assemble_group` with the run path, so the fingerprinted program
+    is the executed program by construction."""
+    _, nbr_dev, state, loop_args, static = _assemble_group(
+        graphs, preps, rep_seeds, config,
+        dtype=dtype, group_size=group_size, mesh=None, group_axis="group",
+    )
+    return _sa_group_loop.lower(
+        nbr_dev, state, *loop_args, chunk_steps=int(chunk_steps), **static
+    )
+
+
+def run_sa_group(
+    graphs,
+    preps,
+    rep_seeds,
+    config: SAConfig,
+    *,
+    dtype=jnp.float32,
+    group_size: int | None = None,
+    chunk_steps: int = 100_000,
+    on_chunk=None,
+    mesh=None,
+    group_axis: str = "group",
+) -> SAGroupResult:
+    """Run one group of single-replica SA chains as a single device program.
+
+    ``graphs``/``preps``/``rep_seeds`` are per-repetition: the sampled
+    graph, the :func:`graphdyn.models.sa.prepare_sa_inputs` tuple for
+    ``n_replicas=1, seed=seed+k``, and ``seed+k`` itself. ``group_size``
+    pads the batch with inactive rows so a short tail group reuses the full
+    group's compiled program. ``on_chunk`` is polled between device chunks
+    (the graceful-shutdown hook — it may raise). With a ``mesh``, the
+    stacked tables and carry shard over ``group_axis`` (repetitions are
+    independent, so the partitioned program is communication-free except
+    the stop test); results are bit-identical to the unsharded program.
+    """
+    G_real, nbr_dev, state, loop_args, static = _assemble_group(
+        graphs, preps, rep_seeds, config,
+        dtype=dtype, group_size=group_size, mesh=mesh, group_axis=group_axis,
+    )
     while bool(jnp.any(state.active)):
         state = _sa_group_loop(
             nbr_dev, state._replace(chunk_t=jnp.zeros((), jnp.int32)),
             *loop_args,
-            rollout_steps=rollout, R_coef=R_coef, C_coef=C_coef,
-            max_steps=max_steps, chunk_steps=int(chunk_steps),
+            chunk_steps=int(chunk_steps), **static,
         )
         if on_chunk is not None:
             on_chunk()

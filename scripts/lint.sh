@@ -84,7 +84,32 @@ else
     echo "== pallascheck: pytest not installed — SKIPPED (pip install pytest to enable) =="
 fi
 
-# 6. benchcheck — the benchmark's single-JSON-line contract, live (python
+# 6. hlocheck — the program-structure gate (graphdyn.analysis.graftcheck):
+#    lower the headline entry points on the CPU backend, fingerprint the
+#    compiled HLO, and diff against the committed ledger
+#    (GRAFTCHECK_FINGERPRINTS.json) — a lost donation, a new op category,
+#    a loop-structure change or a constant blowup fails here with a
+#    pointed message, hardware-free. Then the graftcheck pytest subset
+#    (pytest -m graftcheck: ledger parity, fingerprint invariance across
+#    group extents, recompile guard). Skipped with a notice when
+#    GRAPHDYN_SKIP_HLOCHECK=1 (set by the tier-1 lint-gate test: the
+#    subset already runs in the suite proper — no double work; mirrors
+#    faultcheck/pallascheck).
+if [ "${GRAPHDYN_SKIP_HLOCHECK:-0}" = "1" ]; then
+    echo "== hlocheck: GRAPHDYN_SKIP_HLOCHECK=1 — SKIPPED (subset runs in tier-1) =="
+else
+    echo "== hlocheck (graftcheck fingerprint ledger) =="
+    JAX_PLATFORMS=cpu python -m graphdyn.analysis.graftcheck --format=text || fail=1
+    if python -c 'import pytest' 2>/dev/null; then
+        echo "== hlocheck (pytest -m graftcheck) =="
+        JAX_PLATFORMS=cpu python -m pytest tests/ -q -m graftcheck \
+            -p no:cacheprovider || fail=1
+    else
+        echo "== hlocheck: pytest not installed — graftcheck subset SKIPPED (pip install pytest to enable) =="
+    fi
+fi
+
+# 7. benchcheck — the benchmark's single-JSON-line contract, live (python
 #    bench.py --smoke on the CPU backend): one line of JSON, a positive
 #    headline value, and a positive ensemble_rate row (the grouped-driver
 #    throughput the pipeline ships). A formatting regression here silently
@@ -123,6 +148,58 @@ if ecp is None:
         "null entropy_cell_rate_pallas needs a skipped_reason"
 else:
     assert ecp > 0, f"entropy_cell_rate_pallas must be > 0 or null+reason: {ecp}"
+# the graftcheck fingerprint summary: a structural snapshot per round, or
+# an explicit null + reason — never silently absent
+assert "fingerprints" in row, "fingerprints row absent"
+fp = row["fingerprints"]
+if fp is None:
+    assert row.get("fingerprints_skipped_reason"), \
+        "null fingerprints needs fingerprints_skipped_reason"
+    print("benchcheck: fingerprints skipped:",
+          row["fingerprints_skipped_reason"])
+else:
+    assert fp.get("entries"), "fingerprints row carries no entries"
+    # round-over-round structural diff: compare against the most recent
+    # BENCH_r*.json that persisted a same-backend fingerprint row (older
+    # rounds predate the column — skipped with a notice, not silently)
+    import glob
+    from graphdyn.analysis.graftcheck import diff_bench_fingerprints
+    prev_rows = []
+    for p in sorted(glob.glob("BENCH_r*.json")):
+        try:
+            with open(p) as fh:
+                r = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if (r.get("fingerprints") or {}).get("backend") == fp["backend"]:
+            prev_rows.append((p, r["fingerprints"]))
+    if not prev_rows:
+        print("benchcheck: no previous round carries a fingerprint row for "
+              f"backend={fp['backend']} — structural diff starts next round")
+    else:
+        path, prev = prev_rows[-1]
+        drift = diff_bench_fingerprints(prev, fp)
+        if drift:
+            # round artifacts are immutable history: a DELIBERATE change
+            # is blessed by matching the committed ledger
+            # (--update-ledger), and the baseline refreshes next round
+            from graphdyn.analysis.graftcheck import bench_drift_blessed
+            if bench_drift_blessed(fp):
+                print(f"benchcheck: fingerprint drift vs {path} is "
+                      "LEDGER-BLESSED (row matches the committed "
+                      "GRAFTCHECK_FINGERPRINTS.json) — baseline refreshes "
+                      "when the next round persists its row")
+            else:
+                for f in drift:
+                    print(f"benchcheck: FINGERPRINT DRIFT vs {path}: "
+                          f"{f.entry}: {f.code} {f.message}")
+                raise AssertionError(
+                    f"{len(drift)} structural drift finding(s) vs {path} "
+                    "not blessed by the ledger"
+                )
+        else:
+            print(f"benchcheck: fingerprints stable vs {path} "
+                  f"({len(fp['entries'])} entries)")
 print(f"benchcheck: value={row['value']:.3e} "
       f"ensemble_rate={row['ensemble_rate']:.3e} "
       f"ensemble_speedup={row.get('ensemble_speedup', 0):.2f}x "

@@ -6,6 +6,8 @@ decorator must catch shape/dtype violations at trace time and cost nothing
 on conforming calls.
 """
 
+import json
+
 import numpy as np
 import pytest
 
@@ -459,6 +461,108 @@ class TestGD009VmapOverPallas:
         assert "GD009" in RULES
 
 
+class TestGD010AliasCrossing:
+    """jnp.asarray of a host buffer the same function mutates (the PR-4
+    alias race: on CPU the device array may alias the numpy buffer for its
+    whole lifetime, so the mutation races async device reads)."""
+
+    DRIVER = "graphdyn/pipeline/driver.py"
+    BAD = (
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "def ladder(G):\n"
+        "    lam = np.zeros(G, np.float32)\n"
+        "    lam[0] = 0.1\n"                       # mutated host buffer
+        "    dev = jnp.asarray(lam)\n"             # aliasing crossing
+        "    lam[1] = 0.2\n"
+        "    return dev\n"
+    )
+    GOOD_COPY = (
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "def ladder(G):\n"
+        "    lam = np.zeros(G, np.float32)\n"
+        "    lam[0] = 0.1\n"
+        "    dev = jnp.array(lam)\n"               # explicit copy: safe
+        "    lam[1] = 0.2\n"
+        "    return dev\n"
+    )
+    GOOD_UNMUTATED = (
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "def ship(tables):\n"
+        "    t = np.stack(tables)\n"
+        "    return jnp.asarray(t)\n"              # never mutated: fine
+    )
+
+    def test_bad_asarray_of_mutated_buffer(self):
+        assert "GD010" in _codes(self.BAD, path=self.DRIVER)
+
+    def test_bad_inplace_method_counts_as_mutation(self):
+        src = (
+            "import numpy as np\n"
+            "import jax.numpy as jnp\n"
+            "def ladder(G):\n"
+            "    lam = np.zeros(G, np.float32)\n"
+            "    lam.fill(0.5)\n"
+            "    return jnp.asarray(lam)\n"
+        )
+        assert "GD010" in _codes(src, path=self.DRIVER)
+
+    def test_good_copy_crossing(self):
+        assert _codes(self.GOOD_COPY, path=self.DRIVER) == []
+
+    def test_good_unmutated_buffer(self):
+        assert _codes(self.GOOD_UNMUTATED, path=self.DRIVER) == []
+
+    def test_non_driver_module_exempt(self):
+        # ops/ kernels stage read-only tables; the rule targets the driver
+        # layer where the PR-4 race lived
+        assert _codes(self.BAD, path="graphdyn/ops/tables.py") == []
+
+    def test_shadowed_local_in_nested_fn_does_not_flag_outer(self):
+        # the inner function mutates its OWN `lam`; the outer crossing of
+        # a never-mutated same-named buffer is safe (scope-correct)
+        src = (
+            "import numpy as np\n"
+            "import jax.numpy as jnp\n"
+            "def outer(G):\n"
+            "    lam = np.zeros(G, np.float32)\n"
+            "    dev = jnp.asarray(lam)\n"
+            "    def inner(H):\n"
+            "        lam = np.zeros(H, np.float32)\n"
+            "        lam[0] = 1.0\n"
+            "        return lam\n"
+            "    return dev, inner\n"
+        )
+        assert _codes(src, path=self.DRIVER) == []
+
+    def test_nested_fn_own_mutation_still_flagged(self):
+        # the same pattern INSIDE one scope still fires
+        src = (
+            "import numpy as np\n"
+            "import jax.numpy as jnp\n"
+            "def outer(G):\n"
+            "    def inner(H):\n"
+            "        lam = np.zeros(H, np.float32)\n"
+            "        lam[0] = 1.0\n"
+            "        return jnp.asarray(lam)\n"
+            "    return inner\n"
+        )
+        assert "GD010" in _codes(src, path=self.DRIVER)
+
+    def test_disable_comment(self):
+        src = self.BAD.replace(
+            "    dev = jnp.asarray(lam)\n",
+            "    # graftlint: disable-next-line=GD010  device read synced above\n"
+            "    dev = jnp.asarray(lam)\n",
+        )
+        assert _codes(src, path=self.DRIVER) == []
+
+    def test_catalogued(self):
+        assert "GD010" in RULES
+
+
 class TestGD007AtomicPersistence:
     BAD_SAVEZ = (
         "import numpy as np\n"
@@ -635,7 +739,35 @@ def test_unreadable_file_is_a_finding(tmp_path):
 
 
 def test_rules_registry_complete():
-    assert set(RULES) == {f"GD00{i}" for i in range(1, 10)}
+    assert set(RULES) == {f"GD{i:03d}" for i in range(1, 11)}
+
+
+def test_cli_json_is_one_document_stdout_only(tmp_path):
+    """CI pipes ``python -m graphdyn.analysis --format=json``: stdout must
+    be EXACTLY one parseable JSON document (findings list), with every
+    diagnostic — including the findings summary — on stderr only."""
+    import subprocess
+    import sys
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\nimport numpy as np\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return np.tanh(x)\n"   # GD001
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "graphdyn.analysis", str(bad),
+         "--format=json"],
+        capture_output=True, text=True, timeout=120,
+    )
+    # the WHOLE stdout is one JSON document — nothing before or after it
+    findings = json.loads(proc.stdout)
+    assert [f["code"] for f in findings] == ["GD001"]
+    assert proc.returncode == 1
+    # the summary is a diagnostic: stderr, never stdout
+    assert "finding(s)" in proc.stderr
+    assert "finding(s)" not in proc.stdout
 
 
 def test_repo_package_is_clean():

@@ -47,6 +47,20 @@ def test_bench_smoke_emits_one_json_line():
     assert row["ensemble_rate"] > 0
     assert row["ensemble_rate_serial"] > 0
     assert row["ensemble_speedup"] > 1.0, row["ensemble_speedup"]
+    # the graftcheck structural summary rides in every round's row (or is
+    # an explicit null + reason — never silently absent), so benchcheck
+    # can diff op/fusion counts round-over-round even in no-TPU rounds
+    assert "fingerprints" in row
+    fp = row["fingerprints"]
+    if fp is None:
+        assert row["fingerprints_skipped_reason"]
+    else:
+        assert fp["backend"] == "cpu"
+        from graphdyn.analysis.graftcheck import ENTRIES, _COMPACT_FIELDS
+
+        assert set(fp["entries"]) == set(ENTRIES)
+        for entry_fp in fp["entries"].values():
+            assert set(entry_fp) == set(_COMPACT_FIELDS)
 
 
 def test_bench_emits_partials_on_midrun_failure(monkeypatch, capsys):

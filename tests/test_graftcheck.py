@@ -1,0 +1,584 @@
+"""graftcheck: the jaxpr/HLO program auditor and its fingerprint ledger.
+
+The acceptance contract (ISSUE 6): the committed
+``GRAFTCHECK_FINGERPRINTS.json`` must match the live lowered programs
+(structural drift fails tier-1 with a pointed message); deliberately
+breaking a donation or forcing a recompile must FAIL the checks; a pure
+refactor that preserves program structure must pass without a ledger
+update; and the fingerprint is invariant across group extents and across
+the serial↔grouped paths (the PR-3/4 identity contract restated at the
+HLO level). All tests carry the ``graftcheck`` marker so
+``scripts/lint.sh`` hlocheck can run the subset standalone.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from graphdyn.analysis import graftcheck as gc
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.graftcheck
+
+
+@pytest.fixture(scope="module")
+def live_fps():
+    """Live fingerprints for every registered entry point, computed once
+    per module (each entry lowers + compiles a small canonical program)."""
+    return gc.collect_fingerprints()
+
+
+# ---------------------------------------------------------------------------
+# the ledger gate
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_matches_live(live_fps):
+    """THE tier-1 structural gate: live programs diff clean against the
+    committed ledger, and the ledger-free live rules (GC001–GC003) are
+    clean too. A failing diff here means a headline program's structure
+    changed — fix the regression, or (if deliberate) re-run
+    ``python -m graphdyn.analysis.graftcheck --update-ledger`` and commit
+    the reviewed ledger."""
+    findings = []
+    for name, fp in live_fps.items():
+        findings.extend(
+            gc.audit_fingerprint(name, fp, donates=gc.ENTRIES[name].donates)
+        )
+    ledger = gc.load_ledger()
+    assert ledger is not None, (
+        f"{gc.LEDGER_NAME} missing — run --update-ledger and commit it"
+    )
+    findings.extend(gc.check_ledger(live_fps, ledger))
+    assert findings == [], "\n".join(
+        f"{f.entry}: {f.code} {f.message}" for f in findings
+    )
+
+
+def test_ledger_covers_every_entry():
+    ledger = gc.load_ledger()
+    assert set(ledger["entries"]) == set(gc.ENTRIES)
+    assert ledger["backend"] == "cpu"   # the hardware-free contract
+
+
+def test_pure_refactor_passes(live_fps):
+    """A structure-preserving change (here: a different graph instance of
+    the same shape class — new values, same program) diffs clean against
+    the ledger WITHOUT a ledger update."""
+    from graphdyn.graphs import random_regular_graph
+    from graphdyn.ops.bdcm import BDCMData, lower_sweep
+
+    data = BDCMData(random_regular_graph(64, 3, seed=99), p=1, c=1)
+    fp = gc.fingerprint_lowered(lower_sweep(data, damp=0.9))
+    ledger = gc.load_ledger()
+    assert gc.diff_fingerprints(
+        "bdcm_sweep", ledger["entries"]["bdcm_sweep"], fp
+    ) == []
+
+
+def test_update_ledger_roundtrip(tmp_path, live_fps):
+    path = tmp_path / "ledger.json"
+    gc.write_ledger(live_fps, path)
+    assert gc.check_ledger(live_fps, gc.load_ledger(path)) == []
+
+
+def test_missing_ledger_is_a_finding(tmp_path, live_fps):
+    """Fail closed: no ledger file -> every entry is a GC100 finding, not
+    a silent pass."""
+    findings = gc.check_ledger(
+        live_fps, gc.load_ledger(tmp_path / "absent.json")
+    )
+    assert {f.code for f in findings} == {"GC100"}
+    assert len(findings) == len(live_fps)
+
+
+# ---------------------------------------------------------------------------
+# deliberate structural breaks MUST fail, with pointed messages
+# ---------------------------------------------------------------------------
+
+
+def test_broken_donation_fails(live_fps):
+    """Deliberately losing a donation in a headline entry point fails the
+    ledger diff with a message naming the double-buffering consequence."""
+    ledger = gc.load_ledger()
+    broken = dict(live_fps["sa_group_loop"])
+    broken["donated_params"] = []        # the donation is gone
+    findings = gc.diff_fingerprints(
+        "sa_group_loop", ledger["entries"]["sa_group_loop"], broken
+    )
+    assert any(f.code == "GC104" for f in findings)
+    msg = next(f.message for f in findings if f.code == "GC104")
+    assert "donation LOST" in msg and "double-buffered" in msg
+
+
+def test_new_op_category_fails(live_fps):
+    """A structurally new kind of op (e.g. a custom-call appearing in a
+    program that never had one) fails the diff."""
+    ledger = gc.load_ledger()
+    drifted = json.loads(json.dumps(live_fps["packed_rollout"]))
+    drifted["op_categories"]["custom-call"] = 2
+    findings = gc.diff_fingerprints(
+        "packed_rollout", ledger["entries"]["packed_rollout"], drifted
+    )
+    assert any(
+        f.code == "GC101" and "custom-call" in f.message for f in findings
+    )
+
+
+def test_while_loop_change_fails(live_fps):
+    ledger = gc.load_ledger()
+    drifted = dict(live_fps["entropy_cell_chunk"])
+    drifted["while_loop_count"] = drifted["while_loop_count"] + 1
+    findings = gc.diff_fingerprints(
+        "entropy_cell_chunk", ledger["entries"]["entropy_cell_chunk"],
+        drifted,
+    )
+    assert any(
+        f.code == "GC106" and "loop structure" in f.message for f in findings
+    )
+
+
+def test_constant_blowup_fails(live_fps):
+    ledger = gc.load_ledger()
+    drifted = dict(live_fps["bdcm_sweep"])
+    drifted["largest_constant_bytes"] = 8 << 20
+    findings = gc.diff_fingerprints(
+        "bdcm_sweep", ledger["entries"]["bdcm_sweep"], drifted
+    )
+    assert any(f.code == "GC105" for f in findings)
+
+
+def test_fusion_jump_fails_and_jitter_passes(live_fps):
+    ledger = gc.load_ledger()
+    fp = live_fps["hpr_group_loop"]
+    base = ledger["entries"]["hpr_group_loop"]
+    jitter = dict(fp, fusion_count=fp["fusion_count"] + 1)
+    assert gc.diff_fingerprints("hpr_group_loop", base, jitter) == []
+    jump = dict(fp, fusion_count=2 * fp["fusion_count"] + 4)
+    assert any(
+        f.code == "GC103"
+        for f in gc.diff_fingerprints("hpr_group_loop", base, jump)
+    )
+
+
+# ---------------------------------------------------------------------------
+# GC001–GC003: the live (ledger-free) rules
+# ---------------------------------------------------------------------------
+
+
+def test_gc001_unhonored_donation():
+    """A declared donation the compiler cannot use (no output shares the
+    input's shape/dtype) leaves no input/output alias — GC001."""
+    f = jax.jit(
+        lambda x: (x.astype(jnp.int32) * 2).sum(), donate_argnums=(0,)
+    )
+    fp = gc.fingerprint_lowered(f.lower(jnp.ones((64,), jnp.float32)))
+    assert fp["donated_params"] == []
+    findings = gc.audit_fingerprint("probe", fp, donates=True)
+    assert [f.code for f in findings] == ["GC001"]
+    assert "double-buffered" in findings[0].message
+
+
+def test_gc001_honored_donation_is_clean():
+    f = jax.jit(lambda x: x * 2, donate_argnums=(0,))
+    fp = gc.fingerprint_lowered(f.lower(jnp.ones((64,), jnp.float32)))
+    assert fp["donated_params"] == [0]
+    assert gc.audit_fingerprint("probe", fp, donates=True) == []
+
+
+def test_gc002_f64_promotion_caught():
+    """Under x64, a stray np.float64 scalar widens an f32 chain — caught
+    at the jaxpr level with the offending primitives named."""
+    from jax.experimental import enable_x64
+
+    with enable_x64():
+        def promoted(x):
+            return x * np.float64(2.0)  # graftlint: disable=GD004  the bad example under test
+
+        def clean(x):
+            return x * jnp.float32(2.0)
+
+        x = jnp.ones((8,), jnp.float32)
+        findings = gc.check_no_f64(promoted, x)
+        assert [f.code for f in findings] == ["GC002"]
+        assert "promotion" in findings[0].message
+        assert gc.check_no_f64(clean, x) == []
+
+
+def test_gc002_f64_inputs_are_legitimate():
+    """An entry point that takes f64 INPUTS (the reference-faithful x64
+    BDCM path) is not a promotion — no finding."""
+    from jax.experimental import enable_x64
+
+    with enable_x64():
+        # graftlint: disable-next-line=GD004  the f64-input case under test
+        x = jnp.ones((8,), jnp.float64)
+        assert gc.check_no_f64(lambda v: v * 2.0, x) == []
+
+
+def test_gc003_large_baked_constant():
+    # random values: an all-ones table would constant-fold into a
+    # broadcast(scalar) and never appear as a large literal
+    big = np.random.default_rng(0).random((600, 600)).astype(np.float32)
+    f = jax.jit(lambda x: x + jnp.asarray(big))
+    fp = gc.fingerprint_lowered(f.lower(jnp.ones((600, 600), jnp.float32)))
+    assert fp["largest_constant_bytes"] >= big.nbytes
+    findings = gc.audit_fingerprint("probe", fp, donates=False)
+    assert any(f.code == "GC003" for f in findings)
+
+
+def test_headline_entries_bake_no_large_constants(live_fps):
+    for name, fp in live_fps.items():
+        assert fp["largest_constant_bytes"] <= gc.LARGE_CONSTANT_BYTES, name
+
+
+# ---------------------------------------------------------------------------
+# GC004: the recompile guard
+# ---------------------------------------------------------------------------
+
+
+def test_gc004_forced_recompile_detected():
+    @jax.jit
+    def _gc004_probe(x):
+        return x * 3
+
+    with gc.RecompileWatch() as watch:
+        _gc004_probe(jnp.ones((16,)))
+        _gc004_probe(jnp.ones((16,)))       # cache hit: no event
+        _gc004_probe(jnp.ones((32,)))       # new signature
+    sigs = watch.signatures("_gc004_probe")
+    assert len(sigs) == 2
+    findings = gc.check_recompiles(watch, {"_gc004_probe": 1})
+    assert [f.code for f in findings] == ["GC004"]
+    assert "recompiles" in findings[0].message
+    # within budget (two legitimate shape classes): clean
+    assert gc.check_recompiles(watch, {"_gc004_probe": 2}) == []
+
+
+def test_gc004_grouped_driver_compiles_once_per_shape_class():
+    """The headline contract: a grouped driver run at ONE shape class
+    compiles its loop program at most once — a second run at the same
+    shapes (different seeds) adds no signature; a different group extent
+    is a new shape class and would."""
+    from graphdyn.config import DynamicsConfig, SAConfig
+    from graphdyn.graphs import random_regular_graph
+    from graphdyn.models.sa import prepare_sa_inputs
+    from graphdyn.pipeline.sa_group import run_sa_group
+
+    cfg = SAConfig(dynamics=DynamicsConfig(p=1, c=1))
+
+    def run(seed0):
+        graphs = [
+            random_regular_graph(32, 3, seed=seed0 + k) for k in range(2)
+        ]
+        preps = [
+            prepare_sa_inputs(g, cfg, n_replicas=1, seed=seed0 + k,
+                              max_steps=40)
+            for k, g in enumerate(graphs)
+        ]
+        run_sa_group(graphs, preps, [seed0, seed0 + 1], cfg, group_size=2,
+                     chunk_steps=20)
+
+    with gc.RecompileWatch() as watch:
+        run(0)
+        first = len(watch.signatures("_sa_group_loop"))
+        run(10)                              # same shape class
+    assert len(watch.signatures("_sa_group_loop")) == first <= 1
+    assert gc.check_recompiles(watch, {"_sa_group_loop": 1}) == []
+
+
+# ---------------------------------------------------------------------------
+# fingerprint invariance: the PR-3/4 identity contract at the HLO level
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("entry", ["entropy_cell_chunk", "hpr_group_loop"])
+def test_fingerprint_invariant_across_group_extents(entry):
+    """``entropy_sweep``/``hpr_solve`` run the G=1 instance of the same
+    group program the drivers run at G>1: the structural fingerprint must
+    diff clean across G ∈ {1, 2, 8} in every direction (shape-sensitive
+    fields like fusion root shapes are informational, not gated)."""
+    fps = {G: gc.fingerprint_lowered(gc.lower_entry(entry, G=G))
+           for G in (1, 2, 8)}
+    for a in (1, 2, 8):
+        for b in (1, 2, 8):
+            if a == b:
+                continue
+            findings = gc.diff_fingerprints(f"{entry}@G{a}->{b}",
+                                            fps[a], fps[b])
+            assert findings == [], "\n".join(
+                f"{f.entry}: {f.code} {f.message}" for f in findings
+            )
+
+
+def test_sa_fingerprint_invariant_across_group_extents():
+    """SA holds the same contract for G ∈ {2, 8}. (At G=1 XLA fully
+    unrolls the bounded chunk loop on CPU — a real structural difference
+    of the canonical G=2 ledger entry's shape class, which is why the
+    ledger pins G=2 and the serial driver path is the G=1 *instance*, not
+    a separate fingerprint row.)"""
+    fps = {G: gc.fingerprint_lowered(gc.lower_entry("sa_group_loop", G=G))
+           for G in (2, 8)}
+    assert gc.diff_fingerprints("sa@2->8", fps[2], fps[8]) == []
+    assert gc.diff_fingerprints("sa@8->2", fps[8], fps[2]) == []
+
+
+def test_serial_ladder_reuses_the_group_program():
+    """``entropy_sweep`` (the serial path) advances through the SAME
+    compiled chunk program a hand-built G=1 ``EntropyCellExec`` uses: the
+    second does not compile ``_cell_chunk_exec`` again — one program
+    family, serial == grouped at the compile-cache level, the recompile
+    guard's positive control."""
+    from graphdyn.config import DynamicsConfig, EntropyConfig
+    from graphdyn.graphs import random_regular_graph
+    from graphdyn.models.entropy import entropy_sweep
+    from graphdyn.ops.bdcm import BDCMData
+    from graphdyn.pipeline.entropy_group import EntropyCellExec
+
+    cfg = EntropyConfig(
+        dynamics=DynamicsConfig(p=1, c=1), lmbd_max=0.1, lmbd_step=0.1,
+        max_sweeps=60, eps=1e-3,
+    )
+    g = random_regular_graph(40, 3, seed=7)
+    with gc.RecompileWatch() as watch:
+        entropy_sweep(g, cfg, seed=0)
+        first = len(watch.signatures("_cell_chunk_exec"))
+        data = BDCMData(g, p=1, c=1, rule=cfg.dynamics.rule,
+                        tie=cfg.dynamics.tie)
+        ex = EntropyCellExec([(data, g.n, 0)], cfg, kernel="xla")
+        chi = ex.stack_chi([data.init_messages(0)])
+        ex.fixed_point_chunk(
+            chi, jnp.zeros(1, jnp.float32), jnp.ones(1, bool),
+            jnp.full(1, jnp.inf, jnp.float32), jnp.zeros(1, jnp.int32),
+        )
+    assert len(watch.signatures("_cell_chunk_exec")) == first
+    assert gc.check_recompiles(watch, {"_cell_chunk_exec": 1}) == []
+
+
+# ---------------------------------------------------------------------------
+# determinism + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_deterministic(live_fps):
+    """Two independent lowerings of the same entry fingerprint
+    identically (the property the committed ledger rests on)."""
+    again = gc.fingerprint_lowered(gc.lower_entry("bdcm_sweep"))
+    assert again == live_fps["bdcm_sweep"]
+
+
+def test_cli_json_is_one_document_stdout_only():
+    """``python -m graphdyn.analysis.graftcheck --format=json`` emits
+    exactly ONE JSON document on stdout (findings + fingerprints) with
+    every diagnostic on stderr — the CI pipe contract."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "graphdyn.analysis.graftcheck",
+         "--format=json", "--entries", "bdcm_sweep"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    doc = json.loads(proc.stdout)        # the whole stdout parses
+    assert proc.returncode == 0, doc["findings"]
+    assert doc["findings"] == []
+    assert set(doc["fingerprints"]) == {"bdcm_sweep"}
+    assert "graftcheck" in proc.stderr   # diagnostics went to stderr
+    assert "graftcheck" not in proc.stdout
+
+
+def test_cli_unknown_entry_rejected():
+    proc = subprocess.run(
+        [sys.executable, "-m", "graphdyn.analysis.graftcheck",
+         "--entries", "nope"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "unknown entries" in proc.stderr
+
+
+def test_bench_fingerprint_diff():
+    """The benchcheck hook: same-backend rows diff with the ledger bands;
+    cross-backend rows and pre-fingerprint rounds produce nothing."""
+    row = {"backend": "cpu", "entries": {
+        "packed_rollout": {
+            "op_categories": {"elementwise": 100, "layout": 120},
+            "fusion_count": 12, "while_loop_count": 0,
+            "donated_params": [], "largest_constant_bytes": 4,
+        },
+    }}
+    same = json.loads(json.dumps(row))
+    assert gc.diff_bench_fingerprints(row, same) == []
+    tpu_row = dict(same, backend="tpu")
+    assert gc.diff_bench_fingerprints(row, tpu_row) == []
+    assert gc.diff_bench_fingerprints(None, row) == []
+    assert gc.diff_bench_fingerprints({}, row) == []
+    drift = json.loads(json.dumps(row))
+    drift["entries"]["packed_rollout"]["while_loop_count"] = 3
+    findings = gc.diff_bench_fingerprints(row, drift)
+    assert [f.code for f in findings] == ["GC106"]
+
+
+def test_bench_drift_blessed_by_ledger(live_fps):
+    """benchcheck's update path: a row that drifted from the previous
+    ROUND but matches the committed LEDGER is a deliberate, blessed
+    change (round artifacts are immutable — without this, a blessed
+    restructure would leave the gate permanently red)."""
+    compact = {
+        name: {k: fp[k] for k in gc._COMPACT_FIELDS}
+        for name, fp in live_fps.items()
+    }
+    row = {"backend": "cpu", "entries": compact}
+    assert gc.bench_drift_blessed(row)                    # matches ledger
+    unblessed = json.loads(json.dumps(row))
+    unblessed["entries"]["bdcm_sweep"]["while_loop_count"] += 2
+    assert not gc.bench_drift_blessed(unblessed)          # ledger disagrees
+    assert not gc.bench_drift_blessed(dict(row, backend="tpu"))
+    assert not gc.bench_drift_blessed({})
+    assert not gc.bench_drift_blessed(row, ledger={})     # no ledger: red
+
+
+# ---------------------------------------------------------------------------
+# the runtime host-aliasing sanitizer
+# ---------------------------------------------------------------------------
+
+
+class TestAliasSanitizer:
+    def test_race_is_deterministic_failure(self):
+        from graphdyn.analysis.sanitize import AliasRaceError, alias_sanitizer
+
+        with pytest.raises(AliasRaceError) as exc:
+            with alias_sanitizer():
+                buf = np.zeros(128, np.float32)
+                dev = jnp.asarray(buf)
+                (dev + 1).block_until_ready()
+                buf[0] = 5.0              # mutation inside the alias window
+        assert "test_graftcheck.py" in str(exc.value)   # names the crossing
+        assert "jnp.array" in str(exc.value)            # and the fix
+
+    def test_copy_crossing_is_clean(self):
+        from graphdyn.analysis.sanitize import alias_sanitizer
+
+        with alias_sanitizer():
+            buf = np.zeros(128, np.float32)
+            jnp.array(buf)                # the PR-4 fix: explicit copy
+            buf[0] = 5.0
+
+    def test_drop_before_mutate_is_clean(self):
+        import gc as pygc
+
+        from graphdyn.analysis.sanitize import alias_sanitizer
+
+        with alias_sanitizer():
+            buf = np.zeros(64, np.float32)
+            dev = jnp.asarray(buf)
+            float(dev.sum())
+            del dev                       # alias window closed
+            pygc.collect()
+            buf[0] = 1.0
+
+    def test_provable_copy_crossing_not_tracked(self):
+        """A dtype-converting asarray ALWAYS copies — mutating the source
+        afterwards is legitimate buffer reuse, not a race (a false
+        AliasRaceError here would break every sanitized driver that ships
+        a converted staging buffer)."""
+        from graphdyn.analysis.sanitize import alias_sanitizer
+
+        with alias_sanitizer() as san:
+            buf = np.zeros(64, np.float32)
+            dev = jnp.asarray(buf, jnp.int32)     # conversion: copy
+            dev.block_until_ready()
+            buf[0] = 7.0
+            assert san.records == []
+
+    def test_dead_records_released(self):
+        """Verified records are pruned at array finalization (an
+        hours-long sanitized run must not pin every staging buffer it
+        ever crossed)."""
+        import gc as pygc
+
+        from graphdyn.analysis.sanitize import alias_sanitizer
+
+        with alias_sanitizer() as san:
+            for _ in range(5):
+                buf = np.zeros(256, np.float32)
+                dev = jnp.asarray(buf)
+                dev.block_until_ready()
+                del dev
+            pygc.collect()
+            assert san.records == []
+
+    def test_traced_crossing_not_tracked(self):
+        """Inside jit tracing the crossing yields a Tracer (which IS a
+        jax.Array instance) consumed at trace time — no alias survives
+        into execution, so it must not be tracked (per-closure-constant
+        digest cost for a window that closes before any mutation)."""
+        import jax
+
+        from graphdyn.analysis.sanitize import alias_sanitizer
+
+        with alias_sanitizer() as san:
+            host_table = np.arange(32, dtype=np.float32)
+
+            @jax.jit
+            def f(x):
+                return x + jnp.asarray(host_table)
+
+            f(jnp.ones(32, jnp.float32)).block_until_ready()
+            assert san.records == []
+
+    def test_readonly_buffer_not_tracked(self):
+        from graphdyn.analysis.sanitize import alias_sanitizer
+
+        with alias_sanitizer() as san:
+            buf = np.zeros(32, np.float32)
+            buf.setflags(write=False)
+            jnp.asarray(buf)
+            assert san.records == []
+
+    def test_env_gated(self, monkeypatch):
+        from graphdyn.analysis.sanitize import maybe_alias_sanitizer
+
+        monkeypatch.delenv("GRAPHDYN_SANITIZE", raising=False)
+        with maybe_alias_sanitizer() as san:
+            assert san is None
+        monkeypatch.setenv("GRAPHDYN_SANITIZE", "alias")
+        with maybe_alias_sanitizer() as san:
+            assert san is not None
+
+    def test_unpatched_after_exit(self):
+        from graphdyn.analysis.sanitize import alias_sanitizer
+
+        before = jnp.asarray
+        with alias_sanitizer():
+            assert jnp.asarray is not before
+        assert jnp.asarray is before
+
+    def test_not_reentrant(self):
+        from graphdyn.analysis.sanitize import alias_sanitizer
+
+        with alias_sanitizer():
+            with pytest.raises(RuntimeError, match="re-entrant"):
+                with alias_sanitizer():
+                    pass
+
+    def test_grouped_entropy_ladder_clean_under_sanitizer(self):
+        """The PR-4 fix regression: the grouped entropy grid's host→device
+        crossings all copy, so a full grouped ladder run is sanitizer-clean
+        (before the fix, run_cell_ladder's λ staging aliased a buffer it
+        then mutated — exactly what this would catch)."""
+        from graphdyn.analysis.sanitize import alias_sanitizer
+        from graphdyn.config import DynamicsConfig, EntropyConfig
+        from graphdyn.models.entropy import entropy_grid
+
+        cfg = EntropyConfig(
+            dynamics=DynamicsConfig(p=1, c=1), lmbd_max=0.2, lmbd_step=0.1,
+            num_rep=2, max_sweeps=100, eps=1e-3,
+        )
+        with alias_sanitizer():
+            entropy_grid(24, np.asarray([1.0]), cfg, seed=0, group_size=2)

@@ -17,17 +17,19 @@ REPO = Path(__file__).resolve().parent.parent
 
 def test_lint_sh_gate_passes():
     """scripts/lint.sh exits 0 on the repo (ruff/mypy skip gracefully when
-    absent; graftlint always gates). The faultcheck, pallascheck and
-    benchcheck steps are skipped here — the faultinject and
-    pallas_interpret subsets and the bench JSON contract all already run
-    in this very suite (tests/test_bench_contract.py); re-running them
-    nested would multiply the gate's cost for no extra coverage."""
+    absent; graftlint always gates). The faultcheck, pallascheck, hlocheck
+    and benchcheck steps are skipped here — the faultinject,
+    pallas_interpret and graftcheck subsets and the bench JSON contract
+    all already run in this very suite (tests/test_graftcheck.py,
+    tests/test_bench_contract.py); re-running them nested would multiply
+    the gate's cost for no extra coverage."""
     proc = subprocess.run(
         ["bash", str(REPO / "scripts" / "lint.sh")],
         cwd=REPO, capture_output=True, text=True, timeout=300,
         env={**os.environ, "GRAPHDYN_SKIP_FAULTCHECK": "1",
              "GRAPHDYN_SKIP_BENCHCHECK": "1",
-             "GRAPHDYN_SKIP_PALLASCHECK": "1"},
+             "GRAPHDYN_SKIP_PALLASCHECK": "1",
+             "GRAPHDYN_SKIP_HLOCHECK": "1"},
     )
     assert proc.returncode == 0, (
         f"lint gate failed:\n{proc.stdout}\n{proc.stderr}"
@@ -36,6 +38,7 @@ def test_lint_sh_gate_passes():
     assert "faultcheck" in proc.stdout    # the step exists and announced itself
     assert "benchcheck" in proc.stdout    # likewise for the bench contract
     assert "pallascheck" in proc.stdout   # likewise for the kernel parity set
+    assert "hlocheck" in proc.stdout      # likewise for the program auditor
 
 
 def test_graftlint_clean_on_package_json():
