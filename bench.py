@@ -43,6 +43,36 @@ def _mark(msg):
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
 
 
+def trend_gate(row):
+    """The cross-round rate gate (graphdyn.obs.trend): diff this round's
+    rows against the latest comparable committed ``BENCH_r*.json``. The
+    verdict rides IN the row (``obs_trend_status`` + findings) so benchcheck
+    can assert the gate ran — or was explicitly skipped — and fail on
+    unblessed drift. Never kills bench: a broken gate is a null status plus
+    a reason, not a lost round."""
+    import os
+
+    if os.environ.get("GRAPHDYN_SKIP_TRENDGATE") == "1":
+        return {"obs_trend_status": "skipped",
+                "obs_trend_skipped_reason": "GRAPHDYN_SKIP_TRENDGATE=1"}
+    try:
+        from graphdyn.obs.trend import check_trend
+
+        findings, status = check_trend(row, diag=_mark)
+        out = {"obs_trend_status": status}
+        if findings:
+            out["obs_trend_findings"] = [
+                {"row": f.row, "code": f.code, "message": f.message}
+                for f in findings
+            ]
+        return out
+    except Exception as e:  # noqa: BLE001 — telemetry must not kill bench
+        _mark(f"trend gate failed: {str(e)[:150]}")
+        return {"obs_trend_status": None,
+                "obs_trend_skipped_reason":
+                    f"trend gate failed: {str(e)[:150]}"}
+
+
 def packed_rate(g, R, steps, iters=3, kernel="xla"):
     import jax
     import jax.numpy as jnp
@@ -75,11 +105,17 @@ def packed_rate(g, R, steps, iters=3, kernel="xla"):
         sp = f(sp)                      # warmup consumes the drawn state
         _sync(sp)
     _mark("packed_rate: warm; timing")
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        sp = f(sp)                      # chained: each call consumes the last
-    _sync(sp)
-    return n * R * steps * iters / (time.perf_counter() - t0)
+    from graphdyn import obs
+
+    # the one timing idiom (obs.timed): always measures; when bench runs
+    # under a recorder the span + rate gauge land in the event ledger too
+    with obs.timed("bench.packed_rate", n=n, R=R, kernel=kernel) as sw:
+        for _ in range(iters):
+            sp = f(sp)                  # chained: each call consumes the last
+        _sync(sp)
+    rate = n * R * steps * iters / sw.wall_s
+    obs.gauge("ops.packed.rate", rate, n=n, R=R, kernel=kernel)
+    return rate
 
 
 def int8_rate(g, R, steps, iters=3):
@@ -98,11 +134,15 @@ def int8_rate(g, R, steps, iters=3):
                 donate_argnums=0)
     s = f(s)
     _sync(s)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        s = f(s)
-    _sync(s)
-    return g.n * R * steps * iters / (time.perf_counter() - t0)
+    from graphdyn import obs
+
+    with obs.timed("bench.int8_rate", n=g.n, R=R) as sw:
+        for _ in range(iters):
+            s = f(s)
+        _sync(s)
+    rate = g.n * R * steps * iters / sw.wall_s
+    obs.gauge("ops.int8.rate", rate, n=g.n, R=R)
+    return rate
 
 
 def ensemble_rate(smoke: bool):
@@ -124,15 +164,17 @@ def ensemble_rate(smoke: bool):
     cfg = SAConfig(dynamics=DynamicsConfig(p=1, c=1))
     kw = dict(n_stat=n_stat, seed=0, max_steps=max_steps)
 
+    from graphdyn import obs
+
     walls = {}
     updates = {}
     for label, gs in (("serial", 0), ("grouped", group)):
         _mark(f"ensemble_rate {label}: warmup (compile)")
         sa_ensemble(n, 3, cfg, group_size=gs, **kw)
         _mark(f"ensemble_rate {label}: timing")
-        t0 = time.perf_counter()
-        res = sa_ensemble(n, 3, cfg, group_size=gs, **kw)
-        walls[label] = time.perf_counter() - t0
+        with obs.timed("bench.ensemble_rate", path=label) as sw:
+            res = sa_ensemble(n, 3, cfg, group_size=gs, **kw)
+        walls[label] = sw.wall_s
         updates[label] = n * int(np.sum(res.num_steps))
     return {
         "ensemble_rate": updates["grouped"] / walls["grouped"],
@@ -182,15 +224,18 @@ def entropy_cell_rate(smoke: bool):
     legs = [("serial", 0, "xla"), ("grouped", group, "xla")]
     if on_chip:
         legs.append(("grouped_pallas", group, "pallas"))
+    from graphdyn import obs
+
     walls, points = {}, {}
     for label, gs, kern in legs:
         kw = dict(seed=0, group_size=gs, class_bucket=bucket, kernel=kern)
         _mark(f"entropy_cell_rate {label} [kernel={kern}]: warmup (compile)")
         entropy_grid(n, np.asarray(degs), cfg, **kw)
         _mark(f"entropy_cell_rate {label} [kernel={kern}]: timing")
-        t0 = time.perf_counter()
-        r = entropy_grid(n, np.asarray(degs), cfg, **kw)
-        walls[label] = time.perf_counter() - t0
+        with obs.timed("bench.entropy_cell_rate", path=label,
+                       kernel=kern) as sw:
+            r = entropy_grid(n, np.asarray(degs), cfg, **kw)
+        walls[label] = sw.wall_s
         points[label] = int(np.sum(r.n_lambda))
     speedup = walls["serial"] / walls["grouped"]
     workload = {"n": n, "deg": degs, "num_rep": reps, "group_size": group,
@@ -260,16 +305,18 @@ def fingerprint_rows():
 def torch_cpu_rate(g, steps=3):
     import torch
 
+    from graphdyn import obs
+
     nbr_t = torch.as_tensor(np.asarray(g.nbr).astype(np.int64))
     rng = np.random.default_rng(0)
     s = torch.as_tensor((2 * rng.integers(0, 2, size=g.n) - 1).astype(np.int64))
     sums = torch.sum(s[nbr_t], dim=1)
     _ = (1 - torch.abs(torch.sign(sums))) * s + torch.sign(sums)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        sums = torch.sum(s[nbr_t], dim=1)
-        s = (1 - torch.abs(torch.sign(sums))) * s + torch.sign(sums)
-    return g.n * steps / (time.perf_counter() - t0)
+    with obs.timed("bench.torch_cpu_rate", n=g.n) as sw:
+        for _ in range(steps):
+            sums = torch.sum(s[nbr_t], dim=1)
+            s = (1 - torch.abs(torch.sign(sums))) * s + torch.sign(sums)
+    return g.n * steps / sw.wall_s
 
 
 def main():
@@ -300,6 +347,43 @@ def main():
     import jax
 
     from graphdyn.graphs import random_regular_graph
+
+    # every round records its own obs event ledger (spans + rate gauges +
+    # compile counters); the row carries the path and the manifest hash so
+    # the round artifact names its telemetry. Failure to set one up is a
+    # null + reason in the row — never silent.
+    import atexit
+    import contextlib
+    import hashlib
+
+    from graphdyn import obs
+
+    obs_row = {}
+    _obs_stack = contextlib.ExitStack()
+    atexit.register(_obs_stack.close)
+    try:
+        import tempfile
+
+        obs_ledger = os.environ.get("GRAPHDYN_OBS") or os.path.join(
+            tempfile.gettempdir(), f"graphdyn_obs_bench_{os.getpid()}.jsonl"
+        )
+        _obs_stack.enter_context(obs.recording(obs_ledger))
+        run = obs.manifest(**obs.run_manifest_fields(
+            cmd="bench", smoke=bool(args.smoke),
+        ))
+        obs_row = {
+            "obs_ledger": obs_ledger,
+            "obs_manifest_sha": hashlib.sha1(
+                json.dumps(run, sort_keys=True, default=str).encode()
+            ).hexdigest()[:16],
+        }
+    except Exception as e:  # noqa: BLE001 — telemetry must not kill bench
+        _mark(f"obs recorder setup failed: {str(e)[:150]}")
+        obs_row = {
+            "obs_ledger": None,
+            "obs_ledger_skipped_reason":
+                f"obs recorder setup failed: {str(e)[:150]}",
+        }
 
     if args.smoke:
         n, R_packed, R_int8, steps = 100_000, 1024, 8, 5
@@ -343,7 +427,7 @@ def main():
 
     def _fail(e, stage="device"):
         best = max(v for v in partial.values())
-        print(json.dumps({
+        row = {
             "metric": "spin_updates_per_sec_per_chip_d3_rrg_n%d" % n,
             "value": best,
             "unit": "spin-updates/s",
@@ -352,9 +436,13 @@ def main():
             **_rows(),
             **extra,
             "packed_rate_wide_by_R": wide_by_R,
+            **obs_row,
             "backend": jax.default_backend(),
             **({"relay": relay_note} if relay_note else {}),
-        }))
+        }
+        row.update(trend_gate(row))
+        _obs_stack.close()      # uninstall the recorder (in-process callers)
+        print(json.dumps(row))
         return 0 if best > 0 else 2
 
     _mark(f"building d=3 RRG n={n}")
@@ -495,42 +583,47 @@ def main():
         base = torch_cpu_rate(g)
     except Exception as e:  # noqa: BLE001 — emit the device rates we have
         return _fail(e, stage="torch-cpu baseline")
-    print(
-        json.dumps(
-            {
-                "metric": "spin_updates_per_sec_per_chip_d3_rrg_n%d" % n,
-                "value": value,
-                "unit": "spin-updates/s",
-                # NOTE: the baseline divisor is the reference-style
-                # SINGLE-THREADED torch-CPU kernel on this host
-                "vs_baseline": value / base,
-                "baseline_kind": "torch_cpu_single_thread",
-                # skipped rows emit null + <row>_skipped_reason, never 0.0
-                **_rows(),
-                **extra,
-                "packed_rate_wide_by_R": wide_by_R,
-                # only when a rung actually ran — R_wide=0 otherwise (a
-                # never-measured configuration must not report a count)
-                **({"packed_replicas_wide": R_wide} if wide_by_R else {}),
-                "torch_cpu_rate": base,
-                "packed_replicas": R_packed,
-                "packed_replicas_best": packed_replicas_best,
-                "steps": steps,
-                # fraction of the kernel's own HBM-streaming bound on a
-                # v5e-class chip (~800 GB/s => ~1.6e12 packed spin-updates/s
-                # at n=1e6 d=3 — ARCHITECTURE.md roofline). The bound is
-                # derived for the FULL shape, so report it only there (and
-                # it is only meaningful when backend == tpu); smoke's n=1e5
-                # working set is partly cache-resident, not HBM-streaming
-                **(
-                    {"roofline_fraction_v5e": value / 1.6e12}
-                    if not args.smoke and on_chip else {}
-                ),
-                "backend": jax.default_backend(),
-                **({"relay": relay_note} if relay_note else {}),
-            }
-        )
-    )
+    row = {
+        "metric": "spin_updates_per_sec_per_chip_d3_rrg_n%d" % n,
+        "value": value,
+        "unit": "spin-updates/s",
+        # NOTE: the baseline divisor is the reference-style
+        # SINGLE-THREADED torch-CPU kernel on this host
+        "vs_baseline": value / base,
+        "baseline_kind": "torch_cpu_single_thread",
+        # skipped rows emit null + <row>_skipped_reason, never 0.0
+        **_rows(),
+        **extra,
+        "packed_rate_wide_by_R": wide_by_R,
+        # only when a rung actually ran — R_wide=0 otherwise (a
+        # never-measured configuration must not report a count)
+        **({"packed_replicas_wide": R_wide} if wide_by_R else {}),
+        **obs_row,
+        "torch_cpu_rate": base,
+        "packed_replicas": R_packed,
+        "packed_replicas_best": packed_replicas_best,
+        "steps": steps,
+        # fraction of the kernel's own HBM-streaming bound on a
+        # v5e-class chip (~800 GB/s => ~1.6e12 packed spin-updates/s
+        # at n=1e6 d=3 — ARCHITECTURE.md roofline). The bound is
+        # derived for the FULL shape, so report it only there (and
+        # it is only meaningful when backend == tpu); smoke's n=1e5
+        # working set is partly cache-resident, not HBM-streaming
+        **(
+            {"roofline_fraction_v5e": value / 1.6e12}
+            if not args.smoke and on_chip else {}
+        ),
+        "backend": jax.default_backend(),
+        **({"relay": relay_note} if relay_note else {}),
+    }
+    # the cross-round rate gate rides in the row (benchcheck asserts it
+    # ran or was explicitly skipped, and fails on unblessed drift)
+    row.update(trend_gate(row))
+    # uninstall the recorder now rather than at interpreter exit — an
+    # in-process caller (the contract tests) must not inherit a live
+    # ledger; the atexit close stays as the crash-path backstop
+    _obs_stack.close()
+    print(json.dumps(row))
     return 0
 
 

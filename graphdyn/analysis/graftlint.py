@@ -73,6 +73,18 @@ Rules (stable codes; each can be silenced per line with
   contract is the ``GRAPHDYN_SANITIZE=alias`` sanitizer
   (:mod:`graphdyn.analysis.sanitize`), which turns a surviving race into
   a deterministic failure.
+- **GD011** bare wall-clock timing (``time.time()`` /
+  ``time.perf_counter()``) in a driver module (``graphdyn/models/``,
+  ``graphdyn/pipeline/``, ``cli.py``, ``bench.py``) outside the obs API.
+  Ad-hoc brackets fragment the repo's timing into idioms the event ledger
+  never sees — a rate measured with a private ``perf_counter`` pair is
+  invisible to ``python -m graphdyn.obs report`` and to the bench trend
+  gate.  Use :func:`graphdyn.obs.timed` (always measures; emits a span
+  event when recording) or :func:`graphdyn.obs.span`; ``time.monotonic``
+  stays allowed — it is the bookkeeping clock (queue waits, deadlines),
+  not a measurement idiom.  ``graphdyn/obs/`` itself and
+  ``utils/profiling.py`` (the deprecated shim) are the implementation and
+  are out of scope by module.
 
 Escape hatches, all requiring an explicit code list (``all`` allowed):
 
@@ -108,7 +120,15 @@ RULES = {
     "GD008": "per-iteration host->device transfer (jnp.asarray/device_put) in a driver-module for-loop",
     "GD009": "jax.vmap over a pallas_call-backed callable (serial kernel-launch loop, not a batched grid)",
     "GD010": "jnp.asarray of a host buffer this function mutates (CPU alias race with async device reads)",
+    "GD011": "bare time.time()/time.perf_counter() timing in a driver module (use graphdyn.obs timed/span)",
 }
+
+# the wall-clock calls GD011 watches (time.monotonic is exempt: it is the
+# bookkeeping clock for queue waits and deadlines, not a timing idiom);
+# the bare names cover the `from time import ...` form — a zero-arg call
+# of a local named `time` in a driver module is overwhelmingly the clock,
+# and the disable hatch covers the exception
+_GD011_CALLS = {"time.time", "time.perf_counter", "perf_counter", "time"}
 
 # host->device crossings GD010 watches (the potentially-aliasing ones;
 # jnp.array copies and is the suggested fix)
@@ -284,6 +304,10 @@ class _FileLinter:
             "/models/" in norm or "/pipeline/" in norm
             or norm.endswith("cli.py")
         )
+        # GD011 scope: drivers plus the benchmark harness — everywhere a
+        # measurement should land in the obs event ledger. graphdyn/obs/
+        # and utils/profiling.py are the implementation/shim layer.
+        self.timing_strict = self.driver_mod or norm.endswith("bench.py")
 
     def emit(self, node: ast.AST, code: str, message: str) -> None:
         self.findings.append(
@@ -360,6 +384,7 @@ class _FileLinter:
         self._check_host_loop_transfers(tree, seen)
         self._check_vmap_pallas(tree)
         self._check_alias_crossings(tree)
+        self._check_bare_timing(tree)
         self.findings.sort(key=lambda f: (f.line, f.col, f.code))
         return self.findings
 
@@ -622,6 +647,29 @@ class _FileLinter:
                         f"jnp.array({node.args[0].id}) or drop the device "
                         f"array before mutating",
                     )
+
+    def _check_bare_timing(self, tree: ast.Module):
+        """GD011: bare ``time.time()``/``time.perf_counter()`` in a driver
+        module — timing outside the obs API never reaches the event ledger
+        (one timing idiom: :func:`graphdyn.obs.timed` /
+        :func:`graphdyn.obs.span`). ``time.monotonic`` is exempt
+        (bookkeeping clock, not a measurement idiom)."""
+        if not self.timing_strict:
+            return
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and _dotted(node.func) in _GD011_CALLS
+                and not node.args and not node.keywords
+            ):
+                self.emit(
+                    node, "GD011",
+                    f"bare {_dotted(node.func)}() timing in a driver "
+                    f"module bypasses the obs event ledger — use "
+                    f"graphdyn.obs.timed(name) (always measures; records "
+                    f"when a ledger is active) or obs.span(name); "
+                    f"time.monotonic is the allowed bookkeeping clock",
+                )
 
     def _check_vmap_pallas(self, tree: ast.Module):
         """GD009: ``jax.vmap`` over a ``pallas_call``-backed callable.

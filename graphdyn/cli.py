@@ -108,6 +108,14 @@ def build_parser() -> argparse.ArgumentParser:
              "the multi-second compile; also honored from the "
              "GRAPHDYN_COMPILE_CACHE environment variable (this flag wins)",
     )
+    ap.add_argument(
+        "--obs-ledger", default=None, metavar="PATH",
+        help="write a structured-telemetry event ledger (append-only "
+             "JSONL: run manifest, nested spans, counters, gauges — "
+             "ARCHITECTURE.md 'Runtime telemetry') for this run; also "
+             "honored from the GRAPHDYN_OBS environment variable (this "
+             "flag wins). Render with `python -m graphdyn.obs report PATH`",
+    )
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     sa = sub.add_parser("sa", help="SA initialization search (`SA_RRG.py`)")
@@ -327,9 +335,22 @@ def main(argv=None) -> int:
     # naming the crossing, instead of nondeterministic results
     from graphdyn.analysis.sanitize import maybe_alias_sanitizer
 
+    from graphdyn import obs
+
     try:
-        with graceful_shutdown(), maybe_alias_sanitizer():
-            return _run(args)
+        with graceful_shutdown(), maybe_alias_sanitizer(), \
+                obs.recording(args.obs_ledger) as rec:
+            if rec.enabled:
+                # the per-run manifest event: everything needed to read
+                # the rest of the ledger offline (backend, jax version,
+                # git sha, the full parsed config)
+                rec.manifest(**obs.run_manifest_fields(
+                    cmd=args.cmd, argv=list(argv) if argv is not None
+                    else sys.argv[1:],
+                    config={k: v for k, v in sorted(vars(args).items())},
+                ))
+            with rec.span("run", cmd=args.cmd):
+                return _run(args)
     except ShutdownRequested as e:
         print(f"graphdyn: {e} — exiting {EX_TEMPFAIL} (requeue me)",
               file=sys.stderr)

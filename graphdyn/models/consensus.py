@@ -92,13 +92,23 @@ def consensus_point(g, R: int, m0: float, max_steps: int, chunk: int = 10,
                 "(32 replicas each)"
             )
         out_shardings = NamedSharding(mesh, PartitionSpec(None, axis))
+    from graphdyn import obs
+
     sp = draw_packed_biased(seed, g.n, W, m0, out_shardings=out_shardings)
     nbr_dev = jnp.asarray(g.nbr) if nbr_dev is None else nbr_dev
     deg_dev = jnp.asarray(g.deg) if deg_dev is None else deg_dev
-    out = packed_consensus_scan(
-        nbr_dev, deg_dev, sp, R=W * 32, max_steps=max_steps, chunk=chunk,
-        near_eps=near_eps, rule=rule, tie=tie,
-    )
+    # per-segment rollout span: one m(0) point = one chunked scan; the
+    # gauge reports the same spin-updates/s unit bench.py's headline uses
+    with obs.timed("ops.packed.scan", m0=float(m0), R=W * 32) as sw:
+        out = packed_consensus_scan(
+            nbr_dev, deg_dev, sp, R=W * 32, max_steps=max_steps, chunk=chunk,
+            near_eps=near_eps, rule=rule, tie=tie,
+        )
+        steps_run = int(np.asarray(out["steps_run"]))
+    if obs.enabled():
+        obs.gauge("ops.rollout.rate",
+                  g.n * W * 32 * steps_run / max(sw.wall_s, 1e-9),
+                  solver="consensus", m0=float(m0), steps=steps_run)
     near = np.asarray(out["near"])[:R]
     near_step = np.asarray(out["near_step"])[:R]
     m_final = np.asarray(out["m_final"])[:R]

@@ -25,7 +25,6 @@ capabilities, and are not reproduced.
 
 from __future__ import annotations
 
-import time
 from typing import NamedTuple
 
 import numpy as np
@@ -34,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from graphdyn import obs
 from graphdyn.config import HPRConfig
 from graphdyn.graphs import Graph, build_edge_tables
 from graphdyn.ops.bdcm import BDCMData, make_marginals, make_sweep
@@ -164,86 +164,92 @@ def hpr_solve(
     qualifying classes into the grouped Pallas kernel at G=1, the same
     kernel the grouped driver runs; ARCHITECTURE.md "Kernel selection").
     """
-    t_start = time.perf_counter()
-    config = config or HPRConfig()
-    from graphdyn.pipeline.hpr_group import HPRGroupExec
+    # the one timing idiom (graftlint GD011): an always-measuring obs
+    # span — the wall clock feeds the result's elapsed_s, and the span
+    # event lands in the ledger when a recorder is active
+    _sw = obs.timed("solver.hpr").start()
+    try:
+        config = config or HPRConfig()
+        from graphdyn.pipeline.hpr_group import HPRGroupExec
 
-    dyn = config.dynamics
-    n = graph.n
-    dtype = jnp.dtype(config.dtype)
-    tables = build_edge_tables(graph)
-    data = BDCMData(
-        graph, tables, p=dyn.p, c=dyn.c, attr_value=dyn.attr_value,
-        rule=dyn.rule, tie=dyn.tie, dtype=dtype,
-    )
-    ex = HPRGroupExec([(graph, data)], config, kernel=kernel)
-    TT = int(config.max_sweeps)
-
-    ckpt = None
-    state = None
-    if checkpoint_path is not None:
-        from graphdyn.utils.io import ChainCheckpointer, run_fingerprint
-
-        if chunk_sweeps < 1:
-            raise ValueError(f"chunk_sweeps must be >= 1, got {chunk_sweeps}")
-        ckpt = ChainCheckpointer(
-            checkpoint_path, kind="hpr_chain", seed=seed,
-            fp=run_fingerprint(graph.edges, config),
-            interval_s=checkpoint_interval_s,
+        dyn = config.dynamics
+        n = graph.n
+        dtype = jnp.dtype(config.dtype)
+        tables = build_edge_tables(graph)
+        data = BDCMData(
+            graph, tables, p=dyn.p, c=dyn.c, attr_value=dyn.attr_value,
+            rule=dyn.rule, tie=dyn.tie, dtype=dtype,
         )
-        arrays = ckpt.load_state(
-            check=lambda a: a["s"].shape == (n,)
-            and a["chi"].shape == (data.num_directed, data.K, data.K)
-        )
-        if arrays is not None:
-            t_res = int(np.asarray(arrays["t"]))
-            state = ex.init_state(
-                [arrays["chi"]], [arrays["biases"]], [arrays["s"]],
-                [np.asarray(arrays["key"])], t=t_res,
-                m_final=[np.float32(arrays["m_final"])],
+        ex = HPRGroupExec([(graph, data)], config, kernel=kernel)
+        TT = int(config.max_sweeps)
+
+        ckpt = None
+        state = None
+        if checkpoint_path is not None:
+            from graphdyn.utils.io import ChainCheckpointer, run_fingerprint
+
+            if chunk_sweeps < 1:
+                raise ValueError(f"chunk_sweeps must be >= 1, got {chunk_sweeps}")
+            ckpt = ChainCheckpointer(
+                checkpoint_path, kind="hpr_chain", seed=seed,
+                fp=run_fingerprint(graph.edges, config),
+                interval_s=checkpoint_interval_s,
+            )
+            arrays = ckpt.load_state(
+                check=lambda a: a["s"].shape == (n,)
+                and a["chi"].shape == (data.num_directed, data.K, data.K)
+            )
+            if arrays is not None:
+                t_res = int(np.asarray(arrays["t"]))
+                state = ex.init_state(
+                    [arrays["chi"]], [arrays["biases"]], [arrays["s"]],
+                    [np.asarray(arrays["key"])], t=t_res,
+                    m_final=[np.float32(arrays["m_final"])],
+                )
+
+        if state is None:
+            rng = np.random.default_rng(seed)
+            if chi0 is None:
+                # one stream for both draws — keeps chi and biases independent
+                chi0 = data.init_messages(rng)
+            biases0 = rng.random((n, 2))
+            biases0 /= biases0.sum(axis=1, keepdims=True)
+            biases0 = np.asarray(biases0, dtype)
+            s0 = np.where(biases0[:, 0] > biases0[:, 1], 1, -1).astype(np.int8)
+            state = ex.init_state([np.asarray(chi0)], [biases0], [s0], [seed])
+
+        def payload(st):
+            return dict(zip(_HPR_CHAIN_FIELDS, (
+                np.asarray(st.chi[0]), np.asarray(st.biases[0]),
+                np.asarray(st.s[0]), np.asarray(st.keys[0]),
+                np.asarray(st.t), np.asarray(st.m_final[0]),
+            )))
+
+        if ckpt is None:
+            state = ex.run(state, chunk_sweeps=TT + 2)   # one device call
+        else:
+            state = ckpt.drive(
+                state,
+                advance=lambda st: ex.advance(
+                    st, min(int(st.t) + int(chunk_sweeps), TT + 2)
+                ),
+                active=lambda st: bool(np.asarray(st.active)[0]),
+                payload=payload,
             )
 
-    if state is None:
-        rng = np.random.default_rng(seed)
-        if chi0 is None:
-            # one stream for both draws — keeps chi and biases independent
-            chi0 = data.init_messages(rng)
-        biases0 = rng.random((n, 2))
-        biases0 /= biases0.sum(axis=1, keepdims=True)
-        biases0 = np.asarray(biases0, dtype)
-        s0 = np.where(biases0[:, 0] > biases0[:, 1], 1, -1).astype(np.int8)
-        state = ex.init_state([np.asarray(chi0)], [biases0], [s0], [seed])
-
-    def payload(st):
-        return dict(zip(_HPR_CHAIN_FIELDS, (
-            np.asarray(st.chi[0]), np.asarray(st.biases[0]),
-            np.asarray(st.s[0]), np.asarray(st.keys[0]),
-            np.asarray(st.t), np.asarray(st.m_final[0]),
-        )))
-
-    if ckpt is None:
-        state = ex.run(state, chunk_sweeps=TT + 2)   # one device call
-    else:
-        state = ckpt.drive(
-            state,
-            advance=lambda st: ex.advance(
-                st, min(int(st.t) + int(chunk_sweeps), TT + 2)
-            ),
-            active=lambda st: bool(np.asarray(st.active)[0]),
-            payload=payload,
+        s = np.asarray(state.s[0])
+        return HPRResult(
+            s=s,
+            # graftlint: disable-next-line=GD004  host observable, exact sum
+            mag_reached=np.float32(s.astype(np.float64).mean()),
+            num_steps=int(np.asarray(state.steps)[0]),
+            m_final=float(np.asarray(state.m_final)[0]),
+            biases=np.asarray(state.biases[0]),
+            chi=np.asarray(state.chi[0]),
+            elapsed_s=_sw.stop().wall_s,
         )
-
-    s = np.asarray(state.s[0])
-    return HPRResult(
-        s=s,
-        # graftlint: disable-next-line=GD004  host observable, exact sum
-        mag_reached=np.float32(s.astype(np.float64).mean()),
-        num_steps=int(np.asarray(state.steps)[0]),
-        m_final=float(np.asarray(state.m_final)[0]),
-        biases=np.asarray(state.biases[0]),
-        chi=np.asarray(state.chi[0]),
-        elapsed_s=time.perf_counter() - t_start,
-    )
+    finally:
+        _sw.stop()      # exception path: close + unwind the span
 
 
 class HPRBatchResult(NamedTuple):
@@ -510,173 +516,176 @@ def hpr_solve_batch(
     (host-sharded placement) and ``checkpoint_path`` (snapshots pull chi
     back to host every interval — the same link problem in reverse).
     """
-    t_start = time.perf_counter()
-    config = config or HPRConfig()
-    R = n_replicas if n_replicas is not None else config.n_replicas
-    n = graph.n
-    E = graph.num_edges
-    twoE = 2 * E
-    dyn = config.dynamics
-    T = dyn.p + dyn.c
-    K = 2**T
-    np_dt = np.dtype(config.dtype)
+    _sw = obs.timed("solver.hpr_batch").start()   # GD011: one timing idiom
+    try:
+        config = config or HPRConfig()
+        R = n_replicas if n_replicas is not None else config.n_replicas
+        n = graph.n
+        E = graph.num_edges
+        twoE = 2 * E
+        dyn = config.dynamics
+        T = dyn.p + dyn.c
+        K = 2**T
+        np_dt = np.dtype(config.dtype)
 
-    if device_init and mesh is not None:
-        raise ValueError("device_init=True is incompatible with mesh=")
-    if device_init and checkpoint_path is not None:
-        raise ValueError("device_init=True is incompatible with checkpoint_path=")
+        if device_init and mesh is not None:
+            raise ValueError("device_init=True is incompatible with mesh=")
+        if device_init and checkpoint_path is not None:
+            raise ValueError("device_init=True is incompatible with checkpoint_path=")
 
-    shards = int(mesh.shape[replica_axis]) if mesh is not None else 1
-    R_pad = (-R) % shards
-    Rtot = R + R_pad
+        shards = int(mesh.shape[replica_axis]) if mesh is not None else 1
+        R_pad = (-R) % shards
+        Rtot = R + R_pad
 
-    run_chunk, setup = make_hpr_batch_chunk(
-        graph, config, Rtot, mesh=mesh, replica_axis=replica_axis,
-        device_tables=device_init, kernel=kernel,
-    )
-    TT = setup.TT
-
-    ckpt = None
-    arrays = None
-    if checkpoint_path is not None:
-        from graphdyn.utils.io import ChainCheckpointer, run_fingerprint
-
-        if chunk_sweeps < 1:
-            raise ValueError(f"chunk_sweeps must be >= 1, got {chunk_sweeps}")
-        ckpt = ChainCheckpointer(
-            checkpoint_path, kind="hpr_batch_chain", seed=seed,
-            fp=run_fingerprint(graph.edges, config, R),
-            interval_s=checkpoint_interval_s,
+        run_chunk, setup = make_hpr_batch_chunk(
+            graph, config, Rtot, mesh=mesh, replica_axis=replica_axis,
+            device_tables=device_init, kernel=kernel,
         )
-        # t must be the all-equal [R] sweep-clock vector (scalar in pre-r4
-        # snapshots — those are refused by the fingerprint already, this
-        # keeps the refusal a clean ValueError rather than an index error)
-        arrays = ckpt.load_state(
-            check=lambda a: a["s"].shape == (R * n,) and a["t"].shape == (R,)
+        TT = setup.TT
+
+        ckpt = None
+        arrays = None
+        if checkpoint_path is not None:
+            from graphdyn.utils.io import ChainCheckpointer, run_fingerprint
+
+            if chunk_sweeps < 1:
+                raise ValueError(f"chunk_sweeps must be >= 1, got {chunk_sweeps}")
+            ckpt = ChainCheckpointer(
+                checkpoint_path, kind="hpr_batch_chain", seed=seed,
+                fp=run_fingerprint(graph.edges, config, R),
+                interval_s=checkpoint_interval_s,
+            )
+            # t must be the all-equal [R] sweep-clock vector (scalar in pre-r4
+            # snapshots — those are refused by the fingerprint already, this
+            # keeps the refusal a clean ValueError rather than an index error)
+            arrays = ckpt.load_state(
+                check=lambda a: a["s"].shape == (R * n,) and a["t"].shape == (R,)
+            )
+
+        if arrays is None:
+            if device_init:
+                dt = setup.dtype
+                # one root, three fold_in-derived purposes: chi, biases, and the
+                # per-chain update keys come from independent streams (sharing
+                # the root key across purposes would make the chains' key
+                # material a prefix of chi's bit stream)
+                from graphdyn.ops.bdcm import draw_chi_device
+
+                root = jax.random.key(seed)
+                chi0 = draw_chi_device(
+                    jax.random.fold_in(root, 0), R * twoE, K, dt
+                )
+                k_bias = jax.random.fold_in(root, 1)
+
+                @jax.jit
+                def _draw_bias():
+                    b = jax.random.uniform(k_bias, (R * n, 2), dt)
+                    b = b / b.sum(axis=1, keepdims=True)
+                    return b, jnp.where(b[:, 0] > b[:, 1], 1, -1).astype(jnp.int8)
+
+                biases0, s0 = _draw_bias()
+                keys0 = jax.random.split(
+                    jax.random.fold_in(jax.random.PRNGKey(seed), 2), R
+                )
+            else:
+                rng = np.random.default_rng(seed)
+                chi0 = _draw_union_chi(rng, R, twoE, K, np_dt)
+                biases0 = rng.random((R * n, 2))
+                biases0 /= biases0.sum(axis=1, keepdims=True)
+                biases0 = biases0.astype(np_dt)
+                # one root key per chain: distinct seeds give fully disjoint
+                # streams
+                keys0 = np.asarray(jax.random.split(jax.random.PRNGKey(seed), R))
+                s0 = np.where(biases0[:, 0] > biases0[:, 1], 1, -1).astype(np.int8)
+            arrays = {
+                "chi": chi0, "biases": biases0, "s": s0, "keys": keys0,
+                "t": np.zeros(R, np.int32), "m_final": None, "active": None,
+                "steps": np.zeros(R, np.int32),
+            }
+
+        def pad_rows(x, blk, fill):
+            """Append R_pad frozen-chain blocks of ``blk`` rows each."""
+            if not R_pad:
+                return x
+            pad = np.full((R_pad * blk,) + x.shape[1:], fill, x.dtype)
+            return np.concatenate([x, pad])
+
+        chi_h = pad_rows(arrays["chi"], twoE, 1.0 / (K * K))
+        biases_h = pad_rows(arrays["biases"], n, 0.5)
+        s_h = pad_rows(arrays["s"], n, 1)
+        keys_h = pad_rows(arrays["keys"], 1, 0)
+        # pad chains carry the REAL sweep clock: each shard's while-loop cond
+        # reads its local t[0], so a resumed run with t=0 pad rows would leave
+        # the pad shard looping past the others' exit — straight into a psum
+        # with no partners
+        t_h = pad_rows(arrays["t"], 1, int(arrays["t"][0]) if R else 0)
+        steps_h = pad_rows(arrays["steps"], 1, 0)
+
+        def place(x):
+            x = jnp.asarray(x)
+            if mesh is None:
+                return x
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            return jax.device_put(x, NamedSharding(mesh, P(replica_axis)))
+
+        if arrays["m_final"] is None:
+            # initial stop-test: the same base-graph batched rollout the body
+            # uses, run once host-driven on the unpadded chains. Only the [R]
+            # sum vector crosses device->host (the [R, n] end state stays on
+            # device); the f64 division happens on host, as always
+            R_coef, C_coef = rule_coefficients(dyn.rule, dyn.tie)
+            s_end = jax.jit(batched_rollout_impl, static_argnums=(2, 3, 4))(
+                jnp.asarray(graph.nbr),
+                jnp.asarray(arrays["s"]).reshape(R, n),
+                dyn.p + dyn.c - 1, R_coef, C_coef,
+            )
+            sums = np.asarray(
+                jax.jit(lambda se: se.astype(jnp.int32).sum(axis=1))(s_end)
+            )
+            m0 = (sums.astype(np.int64) / n).astype(np.float32)
+            arrays["m_final"] = m0
+            arrays["active"] = m0 < 1.0
+
+        m_final_h = pad_rows(arrays["m_final"].astype(np.float32), 1, 1.0)
+        active_h = pad_rows(arrays["active"].astype(bool), 1, False)
+
+        state = tuple(
+            place(x)
+            for x in (chi_h, biases_h, s_h, keys_h, t_h, m_final_h, active_h, steps_h)
         )
 
-    if arrays is None:
-        if device_init:
-            dt = setup.dtype
-            # one root, three fold_in-derived purposes: chi, biases, and the
-            # per-chain update keys come from independent streams (sharing
-            # the root key across purposes would make the chains' key
-            # material a prefix of chi's bit stream)
-            from graphdyn.ops.bdcm import draw_chi_device
+        def snapshot(st):
+            sl = {"chi": R * twoE, "biases": R * n, "s": R * n}
+            return {
+                k: np.asarray(v)[: sl.get(k, R)]
+                for k, v in zip(_HPR_BATCH_FIELDS, st)
+            }
 
-            root = jax.random.key(seed)
-            chi0 = draw_chi_device(
-                jax.random.fold_in(root, 0), R * twoE, K, dt
-            )
-            k_bias = jax.random.fold_in(root, 1)
-
-            @jax.jit
-            def _draw_bias():
-                b = jax.random.uniform(k_bias, (R * n, 2), dt)
-                b = b / b.sum(axis=1, keepdims=True)
-                return b, jnp.where(b[:, 0] > b[:, 1], 1, -1).astype(jnp.int8)
-
-            biases0, s0 = _draw_bias()
-            keys0 = jax.random.split(
-                jax.random.fold_in(jax.random.PRNGKey(seed), 2), R
-            )
+        if ckpt is None:
+            state = run_chunk(*state, jnp.int32(TT + 2))
         else:
-            rng = np.random.default_rng(seed)
-            chi0 = _draw_union_chi(rng, R, twoE, K, np_dt)
-            biases0 = rng.random((R * n, 2))
-            biases0 /= biases0.sum(axis=1, keepdims=True)
-            biases0 = biases0.astype(np_dt)
-            # one root key per chain: distinct seeds give fully disjoint
-            # streams
-            keys0 = np.asarray(jax.random.split(jax.random.PRNGKey(seed), R))
-            s0 = np.where(biases0[:, 0] > biases0[:, 1], 1, -1).astype(np.int8)
-        arrays = {
-            "chi": chi0, "biases": biases0, "s": s0, "keys": keys0,
-            "t": np.zeros(R, np.int32), "m_final": None, "active": None,
-            "steps": np.zeros(R, np.int32),
-        }
+            state = ckpt.drive(
+                state,
+                advance=lambda st: run_chunk(
+                    *st, jnp.minimum(st[4][0] + jnp.int32(chunk_sweeps), TT + 2)
+                ),
+                active=lambda st: bool(np.asarray(st[6])[:R].any()),
+                payload=snapshot,
+            )
 
-    def pad_rows(x, blk, fill):
-        """Append R_pad frozen-chain blocks of ``blk`` rows each."""
-        if not R_pad:
-            return x
-        pad = np.full((R_pad * blk,) + x.shape[1:], fill, x.dtype)
-        return np.concatenate([x, pad])
-
-    chi_h = pad_rows(arrays["chi"], twoE, 1.0 / (K * K))
-    biases_h = pad_rows(arrays["biases"], n, 0.5)
-    s_h = pad_rows(arrays["s"], n, 1)
-    keys_h = pad_rows(arrays["keys"], 1, 0)
-    # pad chains carry the REAL sweep clock: each shard's while-loop cond
-    # reads its local t[0], so a resumed run with t=0 pad rows would leave
-    # the pad shard looping past the others' exit — straight into a psum
-    # with no partners
-    t_h = pad_rows(arrays["t"], 1, int(arrays["t"][0]) if R else 0)
-    steps_h = pad_rows(arrays["steps"], 1, 0)
-
-    def place(x):
-        x = jnp.asarray(x)
-        if mesh is None:
-            return x
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        return jax.device_put(x, NamedSharding(mesh, P(replica_axis)))
-
-    if arrays["m_final"] is None:
-        # initial stop-test: the same base-graph batched rollout the body
-        # uses, run once host-driven on the unpadded chains. Only the [R]
-        # sum vector crosses device->host (the [R, n] end state stays on
-        # device); the f64 division happens on host, as always
-        R_coef, C_coef = rule_coefficients(dyn.rule, dyn.tie)
-        s_end = jax.jit(batched_rollout_impl, static_argnums=(2, 3, 4))(
-            jnp.asarray(graph.nbr),
-            jnp.asarray(arrays["s"]).reshape(R, n),
-            dyn.p + dyn.c - 1, R_coef, C_coef,
+        _, _, s_u, _, _, m_final, _, steps = state
+        s = np.asarray(s_u)[: R * n].reshape(R, n)
+        return HPRBatchResult(
+            s=s,
+            # graftlint: disable-next-line=GD004  host observable, exact sum
+            mag_reached=s.astype(np.float64).mean(axis=1).astype(np.float32),
+            num_steps=np.asarray(steps)[:R],
+            m_final=np.asarray(m_final)[:R],
+            elapsed_s=_sw.stop().wall_s,
         )
-        sums = np.asarray(
-            jax.jit(lambda se: se.astype(jnp.int32).sum(axis=1))(s_end)
-        )
-        m0 = (sums.astype(np.int64) / n).astype(np.float32)
-        arrays["m_final"] = m0
-        arrays["active"] = m0 < 1.0
-
-    m_final_h = pad_rows(arrays["m_final"].astype(np.float32), 1, 1.0)
-    active_h = pad_rows(arrays["active"].astype(bool), 1, False)
-
-    state = tuple(
-        place(x)
-        for x in (chi_h, biases_h, s_h, keys_h, t_h, m_final_h, active_h, steps_h)
-    )
-
-    def snapshot(st):
-        sl = {"chi": R * twoE, "biases": R * n, "s": R * n}
-        return {
-            k: np.asarray(v)[: sl.get(k, R)]
-            for k, v in zip(_HPR_BATCH_FIELDS, st)
-        }
-
-    if ckpt is None:
-        state = run_chunk(*state, jnp.int32(TT + 2))
-    else:
-        state = ckpt.drive(
-            state,
-            advance=lambda st: run_chunk(
-                *st, jnp.minimum(st[4][0] + jnp.int32(chunk_sweeps), TT + 2)
-            ),
-            active=lambda st: bool(np.asarray(st[6])[:R].any()),
-            payload=snapshot,
-        )
-
-    _, _, s_u, _, _, m_final, _, steps = state
-    s = np.asarray(s_u)[: R * n].reshape(R, n)
-    return HPRBatchResult(
-        s=s,
-        # graftlint: disable-next-line=GD004  host observable, exact sum
-        mag_reached=s.astype(np.float64).mean(axis=1).astype(np.float32),
-        num_steps=np.asarray(steps)[:R],
-        m_final=np.asarray(m_final)[:R],
-        elapsed_s=time.perf_counter() - t_start,
-    )
+    finally:
+        _sw.stop()      # exception path: close + unwind the span
 
 
 class HPREnsembleResult(NamedTuple):

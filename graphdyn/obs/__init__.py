@@ -1,0 +1,191 @@
+"""graphdyn.obs — structured runtime telemetry (ARCHITECTURE.md "Runtime
+telemetry").
+
+PR 6's graftcheck made *program structure* falsifiable off-chip; this
+subsystem does the same for *runtime behavior*: where time goes inside a
+run, whether measured CPU-proxy rates match the byte model
+(:mod:`graphdyn.obs.roofline`), and whether a bench round regressed against
+the last same-backend round (:mod:`graphdyn.obs.trend`). Zero third-party
+dependencies; one timing idiom for the whole repo (the old
+``utils.profiling.StepTimer``/``wall_clock`` and ``bench.py``'s inline
+``time.perf_counter`` brackets are shims over / callers of this API —
+graftlint GD011 keeps bare timing out of the driver modules).
+
+Surface (all module-level, delegating to the installed recorder):
+
+- :func:`span` — a recording span context manager (nested, monotonic
+  clock, wall + process-CPU time). On the default :data:`NULL` recorder it
+  returns one shared no-op object: **one attribute check, no allocation**.
+- :func:`timed` — an *always-measuring* span: callers that need the
+  duration for their own results (bench rates, solver ``elapsed_s``) get
+  real numbers whether or not a ledger is being written; the event is
+  emitted only when recording.
+- :func:`counter` / :func:`gauge` — occurrence counts and point-in-time
+  values.
+- :func:`manifest` — the per-run identity event (backend, jax version,
+  git sha, config).
+- :func:`recording` — install a :class:`Recorder` writing the JSONL event
+  ledger for a scope (CLI ``--obs-ledger PATH`` / ``GRAPHDYN_OBS=PATH``),
+  with compile-cache miss counters captured via the graftcheck
+  ``RecompileWatch`` machinery.
+
+Ledger schema and the span/counter taxonomy: :mod:`graphdyn.obs.recorder`
+docstring + ARCHITECTURE.md. Render a ledger with
+``python -m graphdyn.obs report LEDGER``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import subprocess
+
+from graphdyn.obs.recorder import (  # noqa: F401  (re-exports)
+    NULL,
+    NULL_SPAN,
+    SCHEMA,
+    NullRecorder,
+    Recorder,
+    Span,
+    read_ledger,
+)
+
+ENV_VAR = "GRAPHDYN_OBS"
+
+_REC = NULL
+
+
+def current():
+    """The installed recorder (:data:`NULL` unless inside
+    :func:`recording`)."""
+    return _REC
+
+
+def enabled() -> bool:
+    """True when a real recorder is installed — instrumentation sites gate
+    *expensive attribute computation* (device syncs, array reductions) on
+    this, never the span call itself."""
+    return _REC.enabled
+
+
+def span(name: str, **attrs):
+    """A recording span for the current recorder (no-op + no allocation on
+    :data:`NULL`)."""
+    return _REC.span(name, **attrs)
+
+
+def timed(name: str, **attrs) -> Span:
+    """An always-measuring span: ``with obs.timed("bench.x") as sp: ...``
+    then read ``sp.wall_s``/``sp.cpu_s`` — or imperative
+    ``sw = obs.timed(...).start(); ...; sw.stop()``. Emits a span event
+    only when a recorder is installed."""
+    return Span(_REC if _REC.enabled else None, name, attrs)
+
+
+def counter(name: str, inc: int = 1, **attrs) -> None:
+    _REC.counter(name, inc, **attrs)
+
+
+def gauge(name: str, value, **attrs) -> None:
+    _REC.gauge(name, value, **attrs)
+
+
+def manifest(**fields):
+    """Emit the per-run manifest event; returns the ``run`` dict (or None
+    on the null recorder)."""
+    return _REC.manifest(**fields)
+
+
+def git_sha() -> str | None:
+    """Best-effort repo sha for the manifest (None outside a checkout)."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and sha else None
+
+
+def run_manifest_fields(**extra) -> dict:
+    """The standard manifest payload: environment identity every driver
+    stamps (backend/jax imported lazily — the manifest is emitted after the
+    CLI has already chosen a platform)."""
+    import platform
+
+    import jax
+
+    return {
+        "backend": jax.default_backend(),
+        "jax": jax.__version__,
+        "python": platform.python_version(),
+        "git_sha": git_sha(),
+        **extra,
+    }
+
+
+@contextlib.contextmanager
+def recording(path: str | None = None):
+    """Install a :class:`Recorder` writing to ``path`` for the scope.
+
+    ``path=None`` falls back to the ``GRAPHDYN_OBS`` environment variable;
+    when that is unset too, the scope runs on the null recorder (the
+    common case — zero cost). Yields the active recorder either way.
+
+    While recording, XLA compile-cache **misses** are counted live: the
+    graftcheck ``RecompileWatch`` machinery (``jax_log_compiles`` capture —
+    cache hits log nothing, so misses are exact) feeds one
+    ``jax.compile`` counter event per compiled program, tagged with the
+    entry-point name. Nested ``recording`` scopes are an error only when
+    both would install a recorder; re-entering with no path inside an
+    active scope keeps the outer recorder.
+    """
+    global _REC
+    path = path or os.environ.get(ENV_VAR) or None
+    if path is None or _REC.enabled:
+        if path is not None and _REC.enabled:
+            raise RuntimeError(
+                "nested obs.recording() with an explicit path — one ledger "
+                f"per run (active: {getattr(_REC, 'path', '?')!r})"
+            )
+        yield _REC
+        return
+    rec = Recorder(path)
+    _REC = rec
+    try:
+        with _compile_counter(rec):
+            yield rec
+    finally:
+        _REC = NULL
+        rec.close()
+
+
+@contextlib.contextmanager
+def _compile_counter(rec: Recorder):
+    """Emit a ``jax.compile`` counter event per XLA compile-cache miss
+    inside the scope (RecompileWatch reuse — see :func:`recording`). Events
+    are emitted live, so a preempted run's ledger still carries the misses
+    that happened before the signal."""
+    try:
+        from graphdyn.analysis.graftcheck import RecompileWatch
+    except Exception:  # pragma: no cover — analysis layer absent/broken
+        yield
+        return
+
+    class _EmittingWatch(RecompileWatch):
+        class _List(list):
+            def append(self, item):
+                super().append(item)
+                name, _ = item
+                rec.counter("jax.compile", fn=name)
+
+        def __init__(self):
+            super().__init__()
+            self.events = self._List()
+
+    with _EmittingWatch():
+        yield

@@ -1,0 +1,113 @@
+"""CLI: ``python -m graphdyn.obs <report|check|trend> ...``.
+
+- ``report LEDGER`` — render a JSONL event ledger as a span-tree/counter
+  summary (``--format=text|json``).
+- ``check`` — the roofline obscheck: measure the headline CPU proxies
+  against the byte-model bands (:mod:`graphdyn.obs.roofline`). Exit code =
+  out-of-band programs. The ``scripts/lint.sh`` obscheck step.
+- ``trend ROW.json`` — the cross-round rate gate
+  (:mod:`graphdyn.obs.trend`): diff a bench row against the latest
+  comparable committed round; ``--bless`` commits the row's rates to
+  ``OBS_TREND.json`` instead. Exit code = unblessed drift findings.
+
+Output contract (PR-6, shared with graftlint/graftcheck): with
+``--format=json`` stdout carries exactly ONE JSON document; every
+diagnostic goes to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _diag(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m graphdyn.obs",
+        description="graphdyn runtime-telemetry tools "
+                    "(exit code = number of findings)",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    rep = sub.add_parser("report", help="render a JSONL event ledger")
+    rep.add_argument("ledger", help="path to the obs ledger (JSONL)")
+    rep.add_argument("--format", choices=("text", "json"), default="text")
+
+    chk = sub.add_parser("check", help="roofline obscheck (CPU proxy bands)")
+    chk.add_argument("--format", choices=("text", "json"), default="text")
+
+    trd = sub.add_parser("trend", help="cross-round bench rate gate")
+    trd.add_argument("row", help="bench row JSON file (one object)")
+    trd.add_argument("--format", choices=("text", "json"), default="text")
+    trd.add_argument("--bless", action="store_true",
+                     help="commit this row's rates to OBS_TREND.json as "
+                          "the deliberate baseline instead of diffing")
+    trd.add_argument("--ledger", default=None,
+                     help="trend-ledger path (default: repo-root "
+                          "OBS_TREND.json)")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "report":
+        from graphdyn.obs.report import load_summary, render_text
+
+        doc = load_summary(args.ledger, diag=_diag)
+        if args.format == "json":
+            print(json.dumps(doc, indent=2, default=str))
+        else:
+            render_text(doc)
+        return 0
+
+    if args.cmd == "check":
+        from graphdyn.obs.roofline import run_obscheck
+
+        rows = run_obscheck(diag=_diag)
+        bad = [r for r in rows if not r.ok]
+        if args.format == "json":
+            print(json.dumps([r._asdict() | {"ok": r.ok} for r in rows],
+                             indent=2))
+        else:
+            for r in rows:
+                print(f"{r.program}: frac={r.frac:.3f} "
+                      f"band=[{r.lo:g},{r.hi:g}] "
+                      f"{'ok' if r.ok else 'OUT OF BAND'}")
+        if bad:
+            _diag(f"obscheck: {len(bad)} program(s) out of band")
+        else:
+            _diag(f"obscheck: {len(rows)} program(s) within band")
+        return min(len(bad), 125)
+
+    # trend
+    from graphdyn.obs.trend import (
+        check_trend, load_trend_ledger, write_trend_ledger,
+    )
+
+    with open(args.row) as fh:
+        row = json.load(fh)
+    if args.bless:
+        path = write_trend_ledger(row, args.ledger)
+        _diag(f"obs trend: blessed rates for backend={row.get('backend')} "
+              f"metric={row.get('metric')} into {path}")
+        if args.format == "json":
+            print(json.dumps({"blessed": True, "ledger": path}))
+        return 0
+    ledger = load_trend_ledger(args.ledger) if args.ledger else None
+    findings, status = check_trend(row, ledger=ledger, diag=_diag)
+    if args.format == "json":
+        print(json.dumps({
+            "status": status,
+            "findings": [f._asdict() for f in findings],
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f"{f.row}: {f.code} {f.message}")
+        print(f"trend: {status}")
+    return min(len(findings), 125) if status == "drift" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

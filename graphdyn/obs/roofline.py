@@ -1,0 +1,244 @@
+"""Roofline-anchored CPU-proxy perf bands (ARCHITECTURE.md "Runtime
+telemetry" → roofline band table).
+
+Three of five bench rounds ran with no TPU (ROADMAP item 5): a runtime
+regression in a headline program — an extra HBM-sized copy, a gather
+falling out of its fused form, a kernel silently scalarizing — would be
+invisible most rounds. This module makes the *CPU container* carry an
+absolute perf anchor: for each headline program it derives an expected
+streaming rate from ARCHITECTURE.md's byte model and a **bandwidth proxy
+measured on the host at check time** (so the anchor moves with the machine,
+not with the calendar), measures the real program at a smoke shape, and
+asserts the measured/model fraction sits inside a committed band.
+
+The bands are deliberately **decade-wide** (table below): a CPU proxy
+cannot hold chip-grade tolerances across container load, but an
+order-of-magnitude collapse — the class of regression that silently ate
+rounds r01/r03/r04's signal — cannot hide inside a decade. The tight
+instrument is the round-over-round trend gate (:mod:`graphdyn.obs.trend`);
+this module is the absolute sanity anchor underneath it.
+
+Byte models (f32; K = 2**T, M = (d+1)**T):
+
+- **packed rollout** — the ARCHITECTURE.md streaming minimum: per
+  spin-update, ``d·4W`` gathered + ``4W`` written bytes across ``32·W``
+  replicas → ``(d+1)/8`` B/update (d=3 → 0.5 B).
+- **BDCM sweep core** (XLA path) — per directed edge per sweep the DP
+  lattice dominates: d accumulation rounds, each reading the ``[K, M]``
+  lattice K times (shifted) and writing it once → ``4·d·(K+1)·K·M``; plus
+  the factor contraction (``4·K²·M``) and the chi rows themselves
+  (``4·(d+2)·K²``). This is exactly the traffic the Pallas kernel keeps
+  in VMEM (ARCHITECTURE.md VMEM byte model) — on the CPU proxy it is also
+  FLOP-heavy, which the band's low anchor absorbs.
+- **entropy cell chunk** — the BDCM model per lane; the grouped executor
+  adds the per-lane freeze selects, absorbed by the same band.
+
+``run_obscheck`` is wired into ``scripts/lint.sh`` (the ``obscheck`` step,
+``GRAPHDYN_SKIP_OBSCHECK=1`` to skip); when a recorder is active each
+measured rate is also emitted as an ``obs.roofline.<program>`` gauge.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import NamedTuple
+
+import numpy as np
+
+# measured/model bands per program: (lo_frac, hi_frac). Calibrated on the
+# tier-1 CPU container (packed ≈ 0.29, bdcm ≈ 0.06, entropy ≈ bdcm) with
+# about a decade of margin on each side; update workflow in ARCHITECTURE.md
+# ("Runtime telemetry" → obscheck update workflow).
+BANDS: dict[str, tuple[float, float]] = {
+    "packed_rollout": (0.02, 4.0),
+    "bdcm_sweep": (0.004, 1.0),
+    "entropy_cell_chunk": (0.002, 1.0),
+}
+
+
+def packed_bytes_per_update(d: int) -> float:
+    """Streaming bytes per spin-update of the packed kernel (word width
+    cancels: ``(d·4W + 4W) / 32W``)."""
+    return (d + 1) / 8.0
+
+
+def bdcm_bytes_per_edge_sweep(d: int, T: int) -> float:
+    """CPU-proxy traffic per directed edge per sweep of the XLA sweep core
+    (module docstring; DP-lattice dominated)."""
+    K = 2 ** T
+    M = (d + 1) ** T
+    return 4.0 * (d * (K + 1) * K * M + K * K * M + (d + 2) * K * K)
+
+
+def host_stream_bandwidth(nbytes: int = 1 << 26, iters: int = 3) -> float:
+    """Measured host copy bandwidth (read+write B/s, best of ``iters``) —
+    the machine-local divisor that anchors every model rate, so the bands
+    track the container the check runs on instead of a hardcoded GB/s."""
+    # graftlint: disable-next-line=GD004  host-only bandwidth probe buffer, never shipped to a device
+    src = np.ones(nbytes // 8, np.float64)
+    dst = np.empty_like(src)
+    best = 0.0
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        np.copyto(dst, src)
+        dt = time.perf_counter() - t0
+        best = max(best, 2 * nbytes / max(dt, 1e-9))
+    return best
+
+
+class RooflineRow(NamedTuple):
+    program: str
+    measured: float         # updates/s (packed) or edge-sweeps/s (BDCM)
+    model: float            # bandwidth / bytes-per-unit
+    frac: float             # measured / model
+    lo: float
+    hi: float
+    unit: str
+
+    @property
+    def ok(self) -> bool:
+        return self.lo <= self.frac <= self.hi
+
+
+def _row(program: str, measured: float, model: float, unit: str) -> RooflineRow:
+    lo, hi = BANDS[program]
+    return RooflineRow(program, measured, model,
+                       measured / model if model else 0.0, lo, hi, unit)
+
+
+def measure_packed(bw: float, *, n: int = 32768, d: int = 3, W: int = 8,
+                   steps: int = 8, iters: int = 3) -> RooflineRow:
+    """The packed-rollout CPU proxy at a smoke shape (chained, donated —
+    the ``bench.py`` timing discipline)."""
+    import jax
+    import jax.numpy as jnp
+
+    from graphdyn.graphs import random_regular_graph
+    from graphdyn.ops.packed import packed_rollout
+
+    from graphdyn import obs
+
+    g = random_regular_graph(n, d, seed=0)
+    nbr = jnp.asarray(g.nbr)
+    deg = jnp.asarray(g.deg)
+    rng = np.random.default_rng(0)
+    sp = jnp.array(rng.integers(0, 2 ** 32, (n, W), dtype=np.uint32))
+    f = jax.jit(lambda s: packed_rollout(nbr, deg, s, steps),
+                donate_argnums=0)
+    sp = f(sp)
+    sp.block_until_ready()
+    with obs.timed("obs.roofline.packed_rollout", n=n, d=d, W=W) as sw:
+        for _ in range(iters):
+            sp = f(sp)
+        sp.block_until_ready()
+    rate = n * W * 32 * steps * iters / sw.wall_s
+    return _row("packed_rollout", rate, bw / packed_bytes_per_update(d),
+                "spin-updates/s")
+
+
+def _bdcm_instance(n: int, c: float, seed: int):
+    from graphdyn.models.entropy import remove_isolates
+    from graphdyn.graphs import erdos_renyi_graph
+    from graphdyn.ops.bdcm import BDCMData
+
+    g = erdos_renyi_graph(n, c / (n - 1), seed=seed)
+    sub, n_iso = remove_isolates(g)
+    return BDCMData(sub, p=1, c=1), n, n_iso
+
+
+def _bdcm_model_rate(data, bw: float) -> float:
+    """Model edge-sweeps/s: bandwidth over the class-population-weighted
+    per-edge byte cost."""
+    total = sum(
+        len(ec.idx) * bdcm_bytes_per_edge_sweep(ec.d, data.T)
+        for ec in data.edge_classes
+    )
+    return bw / (total / max(data.num_directed, 1))
+
+
+def measure_bdcm(bw: float, *, n: int = 2048, c: float = 3.0,
+                 sweeps: int = 20) -> RooflineRow:
+    """The serial XLA sweep core at a smoke ER instance."""
+    import jax.numpy as jnp
+
+    from graphdyn.ops.bdcm import make_sweep
+
+    from graphdyn import obs
+
+    data, _, _ = _bdcm_instance(n, c, seed=1)
+    sweep = make_sweep(data, damp=0.1, use_pallas=False)
+    chi = data.init_messages(0)
+    lm = jnp.asarray(0.3, data.dtype)
+    chi = sweep(chi, lm)
+    chi.block_until_ready()
+    with obs.timed("obs.roofline.bdcm_sweep", twoE=data.num_directed) as sw:
+        for _ in range(sweeps):
+            chi = sweep(chi, lm)
+        chi.block_until_ready()
+    rate = data.num_directed * sweeps / sw.wall_s
+    return _row("bdcm_sweep", rate, _bdcm_model_rate(data, bw),
+                "edge-sweeps/s")
+
+
+def measure_entropy_chunk(bw: float, *, n: int = 1024, c: float = 3.0,
+                          G: int = 4, chunk_sweeps: int = 16,
+                          chunks: int = 2) -> RooflineRow:
+    """The grouped entropy cell chunk (``EntropyCellExec``) at a smoke
+    cell group — the program the grouped ``entropy_grid`` default runs."""
+    import jax.numpy as jnp
+
+    from graphdyn.config import DynamicsConfig, EntropyConfig
+    from graphdyn.pipeline.entropy_group import EntropyCellExec
+
+    from graphdyn import obs
+
+    cfg = EntropyConfig(dynamics=DynamicsConfig(p=1, c=1), eps=0.0,
+                        max_sweeps=10 ** 9, damp=0.1)
+    cells = [_bdcm_instance(n, c, seed=10 + k) for k in range(G)]
+    ex = EntropyCellExec(cells, cfg, group_size=G,
+                         chunk_sweeps=chunk_sweeps, kernel="xla")
+    chi = ex.stack_chi([cell[0].init_messages(k) for k, cell in
+                        enumerate(cells)])
+    lm = jnp.full((G,), 0.3, ex.dtype)
+    active = jnp.ones((G,), bool)
+    delta = jnp.full((G,), jnp.inf, ex.dtype)
+    t = jnp.zeros((G,), jnp.int32)
+    chi, t, delta = ex.fixed_point_chunk(chi, lm, active, delta, t)  # warm
+    np.asarray(t)
+    t = jnp.zeros((G,), jnp.int32)
+    delta = jnp.full((G,), jnp.inf, ex.dtype)
+    with obs.timed("obs.roofline.entropy_cell_chunk", G=G,
+                   twoE_max=int(chi.shape[1])) as sw:
+        for _ in range(chunks):
+            chi, t, delta = ex.fixed_point_chunk(chi, lm, active, delta, t)
+        np.asarray(t)
+    # work = Σ_g (cell g's real edges) · (sweeps it advanced) — pad rows
+    # past a cell's own 2E are inert and must not count as work
+    work = float(np.sum(np.asarray(ex.stk.twoE)[:G] * np.asarray(t)))
+    rate = work / sw.wall_s
+    model = _bdcm_model_rate(cells[0][0], bw)
+    return _row("entropy_cell_chunk", rate, model, "edge-sweeps/s")
+
+
+def run_obscheck(*, diag=None) -> list[RooflineRow]:
+    """Measure every headline program against its band; emits one
+    ``obs.roofline.<program>`` gauge per row when recording. Returns the
+    rows — callers gate on ``row.ok``."""
+    from graphdyn import obs
+
+    bw = host_stream_bandwidth()
+    if diag:
+        diag(f"obscheck: host stream bandwidth {bw / 1e9:.2f} GB/s")
+    rows = [measure_packed(bw), measure_bdcm(bw), measure_entropy_chunk(bw)]
+    for row in rows:
+        obs.gauge(f"obs.roofline.{row.program}", row.measured,
+                  model=row.model, frac=row.frac, unit=row.unit,
+                  ok=row.ok)
+        if diag:
+            verdict = "ok" if row.ok else "OUT OF BAND"
+            diag(
+                f"obscheck: {row.program}: measured {row.measured:.3e} "
+                f"{row.unit}, model {row.model:.3e} → frac {row.frac:.3f} "
+                f"(band [{row.lo:g}, {row.hi:g}]) {verdict}"
+            )
+    return rows

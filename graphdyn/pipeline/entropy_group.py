@@ -64,6 +64,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from graphdyn import obs
 from graphdyn.resilience import faults as _faults
 from graphdyn.resilience.shutdown import raise_if_requested, shutdown_requested
 from graphdyn.ops.bdcm import (
@@ -577,6 +578,8 @@ def run_cell_ladder(
             for g in range(Gr) if active[g]
         ]
 
+    rec = obs.current()
+    chunk_i = 0
     while active[:Gr].any():
         # jnp.array (NOT asarray): on the CPU backend asarray may ALIAS the
         # numpy buffer, and these host arrays are mutated below while the
@@ -588,11 +591,25 @@ def run_cell_ladder(
             delta_h[need_leaf] = np.inf
             t_h[need_leaf] = 0
             need_leaf[:] = False
-        chi, t_v, delta_v = ex.fixed_point_chunk(
-            chi, lm_dev, jnp.array(active),
-            jnp.array(delta_h), jnp.array(t_h),
-        )
-        t_h_new, delta_h_new = np.asarray(t_v), np.asarray(delta_v)
+        t_before = t_h.copy() if rec.enabled else None
+        # per-chunk span: the np.asarray reads below are the device-sync
+        # boundary (they drain the whole chunk program), so wall_s is
+        # execute time; cold marks the compile-paying first chunk
+        with rec.span("pipeline.entropy.chunk", chunk=chunk_i,
+                      cold=chunk_i == 0) as sp:
+            chi, t_v, delta_v = ex.fixed_point_chunk(
+                chi, lm_dev, jnp.array(active),
+                jnp.array(delta_h), jnp.array(t_h),
+            )
+            t_h_new, delta_h_new = np.asarray(t_v), np.asarray(delta_v)
+            if rec.enabled:
+                sp.set(
+                    sweeps_advanced=int(
+                        np.sum(t_h_new[active] - t_before[active])
+                    ),
+                    active=int(np.sum(active[:Gr])),
+                )
+        chunk_i += 1
         t_h[active] = t_h_new[active]
         delta_h[active] = delta_h_new[active]
 
@@ -617,11 +634,11 @@ def run_cell_ladder(
         # blocking host read — the per-cell executors queue asynchronously,
         # so the boundary pays one pipeline drain instead of one sync per
         # cell
-        obs = {g: ex.observe(chi, g, lm_dev[g]) for g in crossed}
+        observed = {g: ex.observe(chi, g, lm_dev[g]) for g in crossed}
         fired = []
         for g in crossed:
             lmv = float(lambdas[k[g]])
-            phi, m0 = obs[g]
+            phi, m0 = observed[g]
             phi, m0 = np.asarray(phi), np.asarray(m0)
             e1 = phi + lmv * m0
             t_g = int(t_h[g])
@@ -638,8 +655,11 @@ def run_cell_ladder(
                     "delta=%r) — recording non-convergence and stopping "
                     "the cell's ladder", lmv, g, delta_h[g],
                 )
+                rec.counter("pipeline.sweep.nan", cell=g, lmbd=lmv)
             if failed:
                 nonconv[g] = lmv
+            rec.counter("pipeline.lambda.boundary", cell=g, lmbd=lmv,
+                        sweeps=t_g, failed=failed)
             rows_l[g].append(lmv)
             rows_e[g].append(phi)
             rows_m[g].append(m0)

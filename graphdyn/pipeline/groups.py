@@ -27,6 +27,7 @@ from __future__ import annotations
 
 from typing import Iterator
 
+from graphdyn import obs
 from graphdyn.resilience import faults as _faults
 from graphdyn.resilience.shutdown import raise_if_requested, shutdown_requested
 
@@ -90,6 +91,8 @@ class GroupDriver:
         graceful shutdown with a prefix snapshot (the group re-runs from
         ``next_rep`` on resume)."""
         if shutdown_requested():
+            obs.counter("resilience.shutdown", where="chunk",
+                        next_rep=next_rep)
             if self.pc is not None:
                 self.pc.save_now(self.payload(), {**self.run_id,
                                                   "next_rep": next_rep})
@@ -112,8 +115,10 @@ class GroupDriver:
         if self.pc is not None:
             self.pc.maybe_save(self.payload(), {**self.run_id,
                                                 "next_rep": k + 1})
+        obs.counter("pipeline.rep.boundary", rep=k)
         _faults.maybe_fail("rep.boundary", key=f"rep={k}")
         if shutdown_requested():
+            obs.counter("resilience.shutdown", where="rep", next_rep=k + 1)
             if self.pc is not None:
                 self.pc.save_now(self.payload(), {**self.run_id,
                                                   "next_rep": k + 1})

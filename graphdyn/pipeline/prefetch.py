@@ -20,7 +20,10 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Iterable
+
+from graphdyn import obs
 
 _SENTINEL = object()
 
@@ -51,6 +54,11 @@ class HostPrefetcher:
         self._keys = list(keys)
         self.depth = depth
         self._pos = 0
+        # overlap accounting (the obs utilization gauge): how long builds
+        # took on the worker vs how long the consumer actually blocked —
+        # a full pipeline hides the builds entirely (wait ≈ 0)
+        self._build_s = 0.0
+        self._wait_s = 0.0
         self._stop = threading.Event()
         self._q: queue.Queue | None = None
         self._thread: threading.Thread | None = None
@@ -65,10 +73,12 @@ class HostPrefetcher:
         for k in self._keys:
             if self._stop.is_set():
                 return
+            t0 = time.monotonic()
             try:
                 item = (k, self._build(k), None)
             except BaseException as e:  # noqa: BLE001 — re-raised in get()
                 item = (k, None, e)
+            self._build_s += time.monotonic() - t0
             # bounded put that stays responsive to close(): a consumer that
             # died mid-ensemble must not leave this thread blocked forever
             while not self._stop.is_set():
@@ -93,8 +103,14 @@ class HostPrefetcher:
             )
         self._pos += 1
         if self._q is None:
-            return self._build(k)
+            t0 = time.monotonic()
+            out = self._build(k)
+            self._build_s += time.monotonic() - t0
+            self._wait_s = self._build_s    # synchronous: no overlap
+            return out
+        t0 = time.monotonic()
         got_k, value, exc = self._q.get()
+        self._wait_s += time.monotonic() - t0
         assert got_k == k, f"prefetch stream desync: {got_k} != {k}"
         if exc is not None:
             raise RuntimeError(
@@ -103,7 +119,18 @@ class HostPrefetcher:
         return value
 
     def close(self) -> None:
-        """Stop the worker and release the queue. Idempotent."""
+        """Stop the worker and release the queue. Idempotent. When an obs
+        recorder is active, emits the overlap-utilization gauge: the
+        fraction of host build time hidden behind device compute
+        (1 − wait/build; 1.0 = fully overlapped, 0.0 = serial)."""
+        if obs.enabled() and self._build_s > 0 and not self._stop.is_set():
+            obs.gauge(
+                "pipeline.prefetch.overlap_util",
+                max(0.0, 1.0 - self._wait_s / self._build_s),
+                build_s=round(self._build_s, 6),
+                wait_s=round(self._wait_s, 6),
+                depth=self.depth, items=self._pos,
+            )
         self._stop.set()
         if self._q is not None:
             while True:                     # drain so a blocked put exits

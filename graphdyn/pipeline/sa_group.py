@@ -283,16 +283,32 @@ def run_sa_group(
     independent, so the partitioned program is communication-free except
     the stop test); results are bit-identical to the unsharded program.
     """
+    from graphdyn import obs
+
     G_real, nbr_dev, state, loop_args, static = _assemble_group(
         graphs, preps, rep_seeds, config,
         dtype=dtype, group_size=group_size, mesh=mesh, group_axis=group_axis,
     )
+    rec = obs.current()
+    chunk_i = 0
     while bool(jnp.any(state.active)):
-        state = _sa_group_loop(
-            nbr_dev, state._replace(chunk_t=jnp.zeros((), jnp.int32)),
-            *loop_args,
-            chunk_steps=int(chunk_steps), **static,
-        )
+        # per-chunk span: the first chunk pays the XLA compile (cold=True
+        # separates it from steady-state execute time); when recording, the
+        # chunk is fenced with a device sync so wall_s is execute time, not
+        # dispatch time — with the null recorder no sync happens and the
+        # loop's async dispatch behavior is untouched
+        with rec.span("pipeline.sa.chunk", chunk=chunk_i,
+                      cold=chunk_i == 0) as sp:
+            state = _sa_group_loop(
+                nbr_dev, state._replace(chunk_t=jnp.zeros((), jnp.int32)),
+                *loop_args,
+                chunk_steps=int(chunk_steps), **static,
+            )
+            if rec.enabled:
+                jax.block_until_ready(state)
+                sp.set(steps_advanced=int(state.chunk_t),
+                       active=int(np.sum(np.asarray(state.active))))
+        chunk_i += 1
         if on_chunk is not None:
             on_chunk()
 
@@ -362,16 +378,28 @@ def sa_ensemble_grouped(
         )
         return g, prep
 
+    from graphdyn import obs
+
     with HostPrefetcher(build, range(start_k, n_stat), depth=prefetch) as pf:
         for ks in group_ranges(start_k, n_stat, group_size):
-            items = [pf.get(i) for i in ks]
-            res = run_sa_group(
-                [it[0] for it in items], [it[1] for it in items],
-                [seed + i for i in ks], config,
-                group_size=group_size, chunk_steps=chunk_steps,
-                on_chunk=lambda k0=ks[0]: drv.chunk_poll(k0),
-                mesh=mesh, group_axis=group_axis,
-            )
+            with obs.timed("pipeline.sa.group", reps=len(ks)) as sw:
+                items = [pf.get(i) for i in ks]
+                res = run_sa_group(
+                    [it[0] for it in items], [it[1] for it in items],
+                    [seed + i for i in ks], config,
+                    group_size=group_size, chunk_steps=chunk_steps,
+                    on_chunk=lambda k0=ks[0]: drv.chunk_poll(k0),
+                    mesh=mesh, group_axis=group_axis,
+                )
+            if obs.enabled():
+                # spin-updates/s through the driver — the same number
+                # bench.py's ensemble_rate row reports (candidate rollouts
+                # re-roll the full graph: n spins per accepted step)
+                obs.gauge(
+                    "ops.rollout.rate",
+                    n * int(np.sum(res.num_steps)) / max(sw.wall_s, 1e-9),
+                    solver="sa_group", reps=len(ks),
+                )
             for j, i in enumerate(ks):
                 conf[i] = res.s[j]
                 # exact f64 sum, then the serial result's f32 cast — the

@@ -217,6 +217,12 @@ def check_fault(site: str, key: str = "") -> FaultSpec | None:
     if spec is not None:
         log.warning("fault injected at %s: %s (hit %d)", site, spec.action,
                     spec.hits)
+        # fault-site hits land in the event ledger too — a degraded run's
+        # post-mortem should not require re-running with the plan
+        from graphdyn import obs
+
+        obs.counter("resilience.fault", site=site, action=spec.action,
+                    hit=spec.hits, key=key)
         if spec.action == "signal":
             import signal as _signal
 

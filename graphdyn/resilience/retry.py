@@ -70,9 +70,12 @@ def retry(
     would cross it) — the ``init_multihost`` "retry with deadline"
     contract. Each retry logs a warning with the failure, so a run that
     survived transient trouble says so in its log."""
+    from graphdyn import obs
+
     policy = policy or RetryPolicy()
     t0 = time.monotonic()
     delays = list(policy.delays()) + [None]     # None = no sleep after last
+    backoff_total = 0.0
     for attempt, delay in enumerate(delays, start=1):
         try:
             return fn()
@@ -84,9 +87,23 @@ def retry(
             )
             if delay is None or out_of_time:
                 raise
+            backoff_total += delay
+            # a degraded run must be diagnosable post-hoc: the SITE (what),
+            # the attempt number, and the cumulative backoff ride in the
+            # log record's fields AND in the obs counter, not only in the
+            # formatted message
             log.warning(
-                "%s failed (attempt %d/%d): %s — retrying in %.2gs",
-                what, attempt, len(delays), e, delay,
+                "%s failed (attempt %d/%d, cumulative backoff %.2gs): %s "
+                "— retrying in %.2gs",
+                what, attempt, len(delays), backoff_total, e, delay,
+                extra={"retry_site": what, "retry_attempt": attempt,
+                       "retry_backoff_s": delay,
+                       "retry_cumulative_backoff_s": backoff_total},
+            )
+            obs.counter(
+                "resilience.retry", site=what, attempt=attempt,
+                backoff_s=delay, cumulative_backoff_s=round(backoff_total, 6),
+                error=f"{type(e).__name__}: {e}"[:200],
             )
             sleep(delay)
     raise AssertionError("unreachable")         # pragma: no cover

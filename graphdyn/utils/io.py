@@ -156,7 +156,12 @@ class Checkpoint:
                 with open(self.path + ".tmp.npz", "wb") as f:
                     f.write(b"PK\x03\x04 torn by injected preemption")
             raise _faults.InjectedWriteError(self.path)
-        _atomic_savez(self.path + ".npz", payload)
+        from graphdyn import obs
+
+        with obs.current().span("io.ckpt.write", path=self.path) as sp:
+            _atomic_savez(self.path + ".npz", payload)
+            if obs.enabled():
+                sp.set(bytes=int(os.path.getsize(self.path + ".npz")))
 
     def remove(self) -> None:
         """Delete the checkpoint file if present (end-of-run cleanup), plus
@@ -176,15 +181,22 @@ class Checkpoint:
                                       key=self.path)
         if spec is not None:
             _faults.truncate_file(path)          # torn flush / partial copy
+        from graphdyn import obs
+
         try:
-            with np.load(path) as f:
-                arrays = {k: f[k] for k in f.files if k != self._META_KEY}
-                if self._META_KEY in f.files:
-                    meta = json.loads(f[self._META_KEY].tobytes().decode())
-                else:
-                    # foreign/legacy npz (e.g. a reference-style results
-                    # file): still loadable, just with empty metadata
-                    meta = {}
+            with obs.current().span("io.ckpt.read", path=self.path):
+                with np.load(path) as f:
+                    arrays = {k: f[k] for k in f.files
+                              if k != self._META_KEY}
+                    if self._META_KEY in f.files:
+                        meta = json.loads(
+                            f[self._META_KEY].tobytes().decode()
+                        )
+                    else:
+                        # foreign/legacy npz (e.g. a reference-style
+                        # results file): still loadable, just with empty
+                        # metadata
+                        meta = {}
         # structural corruption ONLY — a transient read error (plain
         # OSError: EIO, EACCES, network blip) must propagate, not destroy a
         # perfectly good checkpoint by quarantining it
@@ -202,6 +214,9 @@ class Checkpoint:
                 "checkpoint at %s is corrupt (%s: %s) — quarantined to %s, "
                 "starting fresh", path, type(e).__name__, e, quarantine,
             )
+            obs.counter("io.ckpt.quarantine", path=self.path,
+                        quarantine=quarantine,
+                        error=f"{type(e).__name__}: {e}"[:200])
             return None
         return arrays, meta
 
@@ -352,6 +367,12 @@ def save_with_retry(ckpt: Checkpoint, arrays: dict, meta: dict) -> bool:
             "this snapshot and continuing the run: %s",
             ckpt.path, SAVE_RETRY.tries, e,
         )
+        from graphdyn import obs
+
+        obs.counter("resilience.retry.degrade", site=f"checkpoint save "
+                    f"({ckpt.path})", attempts=SAVE_RETRY.tries,
+                    decision="skip-save",
+                    error=f"{type(e).__name__}: {e}"[:200])
         return False
 
 

@@ -1,31 +1,53 @@
-"""Tracing/profiling hooks (SURVEY.md §5.1).
+"""Tracing/profiling hooks (SURVEY.md §5.1) — now thin shims.
 
-The reference's observability is a wall-clock bracket (`HPR:257,364`) and
-per-λ prints (`ipynb:433`). Here: a timing context that reports the headline
-spin-updates/sec metric, and a thin wrapper over ``jax.profiler`` traces for
-inspecting XLA/TPU execution in TensorBoard/Perfetto.
+The reference's observability was a wall-clock bracket (`HPR:257,364`) and
+per-λ prints (`ipynb:433`); this module's ``StepTimer``/``wall_clock``
+reproduced that idiom. Since the obs subsystem landed (ARCHITECTURE.md
+"Runtime telemetry") the ONE timing idiom is :func:`graphdyn.obs.timed` —
+an always-measuring span whose event also lands in the JSONL ledger when a
+recorder is active — and graftlint GD011 keeps bare ``time.time()``/
+``time.perf_counter()`` brackets out of the driver modules. ``StepTimer``
+and ``wall_clock`` remain as **deprecated shims over that API** so old call
+sites keep working and their measurements now reach the ledger too;
+``device_trace`` (the jax.profiler wrapper) is not a timing idiom and stays.
 """
 
 from __future__ import annotations
 
 import contextlib
-import time
+import warnings
 from dataclasses import dataclass, field
+
+from graphdyn import obs
+
+
+def _deprecated(name: str, replacement: str) -> None:
+    warnings.warn(
+        f"graphdyn.utils.profiling.{name} is deprecated — use {replacement} "
+        f"(the one timing idiom; ARCHITECTURE.md 'Runtime telemetry')",
+        DeprecationWarning, stacklevel=3,
+    )
 
 
 @dataclass
 class StepTimer:
-    """Accumulates wall time and work counts; reports updates/sec."""
+    """Deprecated shim: accumulates wall time and work counts via
+    :func:`graphdyn.obs.timed` spans (``profiling.step_timer`` events when
+    recording); reports updates/sec. New code should hold an
+    ``obs.timed(...)`` span and compute its own rate."""
 
     seconds: float = 0.0
     updates: int = 0
-    _t0: float = field(default=0.0, repr=False)
+    _warned: bool = field(default=False, repr=False)
 
     @contextlib.contextmanager
     def measure(self, n_updates: int):
-        t0 = time.perf_counter()
-        yield
-        self.seconds += time.perf_counter() - t0
+        if not self._warned:
+            _deprecated("StepTimer", "graphdyn.obs.timed")
+            self._warned = True
+        with obs.timed("profiling.step_timer", n_updates=n_updates) as sw:
+            yield
+        self.seconds += sw.wall_s
         self.updates += n_updates
 
     @property
@@ -48,11 +70,14 @@ def device_trace(logdir: str):
 
 @contextlib.contextmanager
 def wall_clock():
-    """Reference-style bracket (`HPR:257,364`): yields a dict filled with
-    ``seconds`` on exit."""
+    """Deprecated shim over :func:`graphdyn.obs.timed` (reference-style
+    bracket, `HPR:257,364`): yields a dict filled with ``seconds`` on exit.
+    The span event (``profiling.wall_clock``) reaches the ledger when a
+    recorder is active."""
+    _deprecated("wall_clock", "graphdyn.obs.timed")
     out = {}
-    t0 = time.time()
+    sw = obs.timed("profiling.wall_clock").start()
     try:
         yield out
     finally:
-        out["seconds"] = time.time() - t0
+        out["seconds"] = sw.stop().wall_s

@@ -109,7 +109,23 @@ else
     fi
 fi
 
-# 7. benchcheck — the benchmark's single-JSON-line contract, live (python
+# 7. obscheck — the roofline-anchored runtime perf bands (python -m
+#    graphdyn.obs check): measure the headline CPU proxies (packed
+#    rollout, BDCM sweep core, entropy cell chunk) against rates derived
+#    from ARCHITECTURE.md's byte model and a host bandwidth probe — an
+#    order-of-magnitude runtime collapse fails here even with the HLO
+#    fingerprint unchanged, hardware-free. Skipped with a notice when
+#    GRAPHDYN_SKIP_OBSCHECK=1 (set by the tier-1 lint-gate test: the same
+#    check runs in the suite proper via tests/test_obs.py — no double
+#    work; mirrors faultcheck/pallascheck/hlocheck).
+if [ "${GRAPHDYN_SKIP_OBSCHECK:-0}" = "1" ]; then
+    echo "== obscheck: GRAPHDYN_SKIP_OBSCHECK=1 — SKIPPED (check runs in tier-1) =="
+else
+    echo "== obscheck (roofline perf bands, python -m graphdyn.obs check) =="
+    JAX_PLATFORMS=cpu python -m graphdyn.obs check --format=text || fail=1
+fi
+
+# 8. benchcheck — the benchmark's single-JSON-line contract, live (python
 #    bench.py --smoke on the CPU backend): one line of JSON, a positive
 #    headline value, and a positive ensemble_rate row (the grouped-driver
 #    throughput the pipeline ships). A formatting regression here silently
@@ -200,6 +216,34 @@ else:
         else:
             print(f"benchcheck: fingerprints stable vs {path} "
                   f"({len(fp['entries'])} entries)")
+# the obs ledger columns: a path + manifest hash, or an explicit null +
+# reason — never silently absent
+assert "obs_ledger" in row, "obs_ledger column absent"
+if row["obs_ledger"] is None:
+    assert row.get("obs_ledger_skipped_reason"), \
+        "null obs_ledger needs obs_ledger_skipped_reason"
+else:
+    assert row.get("obs_manifest_sha"), "obs_ledger without obs_manifest_sha"
+# the cross-round RATE trend gate (graphdyn.obs.trend) must have RUN or
+# been explicitly skipped — and unblessed drift fails the gate here
+assert "obs_trend_status" in row, "trend gate did not run (no status)"
+status = row["obs_trend_status"]
+if status in (None, "skipped"):
+    assert row.get("obs_trend_skipped_reason"), \
+        f"trend status {status!r} needs obs_trend_skipped_reason"
+    print("benchcheck: trend gate skipped:", row["obs_trend_skipped_reason"])
+elif status == "drift":
+    for f in row.get("obs_trend_findings", []):
+        print(f"benchcheck: RATE DRIFT: {f['row']}: {f['code']} "
+              f"{f['message']}")
+    raise AssertionError(
+        "unblessed rate drift vs the previous comparable round — if "
+        "deliberate, bless with: python -m graphdyn.obs trend <row.json> "
+        "--bless"
+    )
+else:
+    assert status in ("stable", "blessed", "no_baseline"), status
+    print(f"benchcheck: trend gate {status}")
 print(f"benchcheck: value={row['value']:.3e} "
       f"ensemble_rate={row['ensemble_rate']:.3e} "
       f"ensemble_speedup={row.get('ensemble_speedup', 0):.2f}x "

@@ -563,6 +563,113 @@ class TestGD010AliasCrossing:
         assert "GD010" in RULES
 
 
+class TestGD011BareTiming:
+    """Bare ``time.time()``/``time.perf_counter()`` brackets in driver
+    modules bypass the obs event ledger — the one timing idiom is
+    ``graphdyn.obs.timed``/``obs.span`` (ARCHITECTURE.md "Runtime
+    telemetry")."""
+
+    DRIVER = "graphdyn/pipeline/driver.py"
+    BAD_PERF_COUNTER = (
+        "import time\n"
+        "def run(reps):\n"
+        "    t0 = time.perf_counter()\n"           # GD011
+        "    work(reps)\n"
+        "    return time.perf_counter() - t0\n"    # GD011
+    )
+    BAD_TIME_TIME = (
+        "import time\n"
+        "def run(reps):\n"
+        "    t0 = time.time()\n"                   # GD011
+        "    work(reps)\n"
+        "    return time.time() - t0\n"            # GD011
+    )
+    BAD_BARE_IMPORT = (
+        "from time import perf_counter\n"
+        "def run(reps):\n"
+        "    t0 = perf_counter()\n"                # GD011
+        "    work(reps)\n"
+        "    return perf_counter() - t0\n"         # GD011
+    )
+    GOOD_OBS = (
+        "from graphdyn import obs\n"
+        "def run(reps):\n"
+        "    with obs.timed('pipeline.group', reps=reps) as sw:\n"
+        "        work(reps)\n"
+        "    return sw.wall_s\n"
+    )
+    GOOD_MONOTONIC = (
+        "import time\n"
+        "def wait(q):\n"
+        "    t0 = time.monotonic()\n"    # bookkeeping clock: allowed
+        "    q.get()\n"
+        "    return time.monotonic() - t0\n"
+    )
+
+    def test_bad_perf_counter(self):
+        assert _codes(self.BAD_PERF_COUNTER, path=self.DRIVER).count(
+            "GD011") == 2
+
+    def test_bad_time_time(self):
+        assert "GD011" in _codes(self.BAD_TIME_TIME, path=self.DRIVER)
+
+    def test_bad_bare_from_import(self):
+        assert "GD011" in _codes(self.BAD_BARE_IMPORT, path=self.DRIVER)
+
+    def test_bad_bare_time_from_import(self):
+        src = (
+            "from time import time\n"
+            "def run(reps):\n"
+            "    t0 = time()\n"                   # GD011
+            "    work(reps)\n"
+            "    return time() - t0\n"            # GD011
+        )
+        assert _codes(src, path=self.DRIVER).count("GD011") == 2
+
+    def test_good_obs_timed(self):
+        assert _codes(self.GOOD_OBS, path=self.DRIVER) == []
+
+    def test_good_monotonic_exempt(self):
+        assert _codes(self.GOOD_MONOTONIC, path=self.DRIVER) == []
+
+    def test_models_and_cli_and_bench_in_scope(self):
+        for path in ("graphdyn/models/solver.py", "graphdyn/cli.py",
+                     "bench.py"):
+            assert "GD011" in _codes(self.BAD_PERF_COUNTER, path=path), path
+
+    def test_non_driver_module_exempt(self):
+        # the obs implementation and the deprecated profiling shim ARE the
+        # timing layer; ops/utils are out of the driver scope
+        for path in ("graphdyn/obs/roofline.py",
+                     "graphdyn/utils/profiling.py",
+                     "graphdyn/ops/bdcm.py"):
+            assert _codes(self.BAD_PERF_COUNTER, path=path) == [], path
+
+    def test_strftime_not_flagged(self):
+        # time.strftime / time.monotonic / time.process_time are not the
+        # wall-clock measurement idiom GD011 polices
+        src = (
+            "import time\n"
+            "def mark(msg):\n"
+            "    return time.strftime('%H:%M:%S') + msg\n"
+        )
+        assert _codes(src, path=self.DRIVER) == []
+
+    def test_disable_comment(self):
+        src = self.BAD_TIME_TIME.replace(
+            "    t0 = time.time()\n",
+            "    # graftlint: disable-next-line=GD011  epoch stamp for a filename, not a measurement\n"
+            "    t0 = time.time()\n",
+        ).replace(
+            "    return time.time() - t0\n",
+            "    return t0  # graftlint: disable=GD011  ditto\n",
+        )
+        assert _codes(src, path=self.DRIVER) == []
+
+    def test_catalogued(self):
+        assert "GD011" in RULES
+
+
 class TestGD007AtomicPersistence:
     BAD_SAVEZ = (
         "import numpy as np\n"
@@ -739,7 +846,7 @@ def test_unreadable_file_is_a_finding(tmp_path):
 
 
 def test_rules_registry_complete():
-    assert set(RULES) == {f"GD{i:03d}" for i in range(1, 11)}
+    assert set(RULES) == {f"GD{i:03d}" for i in range(1, 12)}
 
 
 def test_cli_json_is_one_document_stdout_only(tmp_path):

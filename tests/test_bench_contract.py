@@ -61,6 +61,31 @@ def test_bench_smoke_emits_one_json_line():
         assert set(fp["entries"]) == set(ENTRIES)
         for entry_fp in fp["entries"].values():
             assert set(entry_fp) == set(_COMPACT_FIELDS)
+    # the obs telemetry columns: every round names its event ledger (path +
+    # manifest hash) or carries an explicit null + reason — never silent
+    assert "obs_ledger" in row
+    if row["obs_ledger"] is None:
+        assert row["obs_ledger_skipped_reason"]
+    else:
+        assert row["obs_manifest_sha"]
+        from graphdyn.obs.recorder import read_ledger
+
+        events, torn = read_ledger(row["obs_ledger"])
+        assert torn == 0
+        man = next(e for e in events if e["ev"] == "manifest")
+        assert man["run"]["cmd"] == "bench" and man["run"]["backend"] == "cpu"
+        # the bench timing brackets are obs spans now — they land in the
+        # round's own ledger
+        spans = {e["name"] for e in events if e["ev"] == "span"}
+        assert "bench.packed_rate" in spans and "bench.int8_rate" in spans
+    # the cross-round rate trend gate RAN (or was explicitly skipped) and
+    # found no unblessed drift — the benchcheck contract
+    status = row.get("obs_trend_status")
+    if status in (None, "skipped"):
+        assert row.get("obs_trend_skipped_reason"), row
+    else:
+        assert status in ("stable", "blessed", "no_baseline"), (
+            status, row.get("obs_trend_findings"))
 
 
 def test_bench_emits_partials_on_midrun_failure(monkeypatch, capsys):

@@ -29,7 +29,8 @@ def test_lint_sh_gate_passes():
         env={**os.environ, "GRAPHDYN_SKIP_FAULTCHECK": "1",
              "GRAPHDYN_SKIP_BENCHCHECK": "1",
              "GRAPHDYN_SKIP_PALLASCHECK": "1",
-             "GRAPHDYN_SKIP_HLOCHECK": "1"},
+             "GRAPHDYN_SKIP_HLOCHECK": "1",
+             "GRAPHDYN_SKIP_OBSCHECK": "1"},
     )
     assert proc.returncode == 0, (
         f"lint gate failed:\n{proc.stdout}\n{proc.stderr}"
@@ -39,6 +40,7 @@ def test_lint_sh_gate_passes():
     assert "benchcheck" in proc.stdout    # likewise for the bench contract
     assert "pallascheck" in proc.stdout   # likewise for the kernel parity set
     assert "hlocheck" in proc.stdout      # likewise for the program auditor
+    assert "obscheck" in proc.stdout      # likewise for the roofline bands
 
 
 def test_graftlint_clean_on_package_json():

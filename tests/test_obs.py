@@ -1,0 +1,764 @@
+"""graphdyn.obs — structured runtime telemetry (ARCHITECTURE.md "Runtime
+telemetry").
+
+Covers the PR-7 acceptance criteria: clean AND fault-injected grouped
+entropy-grid runs produce schema-valid JSONL ledgers (including under
+SIGTERM mid-chunk → exit 75), the roofline obscheck passes on the CPU
+container, and the cross-round bench trend gate fails an artificially
+slowed headline row with a pointed message while a ledger-blessed
+deliberate change passes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import tracemalloc
+
+import numpy as np
+import pytest
+
+from graphdyn import obs
+from graphdyn.obs.recorder import (
+    EVENT_KINDS, NULL, NULL_SPAN, Recorder, read_ledger,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _assert_schema_valid(events):
+    """Every event is a complete object of a known kind with the kind's
+    required fields — the ledger schema contract."""
+    assert events, "empty ledger"
+    for e in events:
+        assert e["ev"] in EVENT_KINDS, e
+        assert isinstance(e["t"], (int, float)), e
+        if e["ev"] == "span":
+            assert {"name", "id", "t0", "wall_s", "cpu_s"} <= set(e), e
+            assert e["wall_s"] >= 0 and e["cpu_s"] >= 0
+        elif e["ev"] == "counter":
+            assert {"name", "inc"} <= set(e), e
+        elif e["ev"] == "gauge":
+            assert {"name", "value"} <= set(e), e
+        elif e["ev"] == "manifest":
+            assert e["run"]["schema"] == obs.SCHEMA
+
+
+# ---------------------------------------------------------------------------
+# recorder core
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_writes_jsonl_events(tmp_path):
+    p = str(tmp_path / "run.jsonl")
+    rec = Recorder(p)
+    rec.manifest(cmd="test", backend="cpu")
+    with rec.span("outer", stage="a"):
+        with rec.span("inner"):
+            pass
+        rec.counter("hits", 2, site="x")
+        rec.gauge("rate", 123.5, unit="u/s")
+    rec.close()
+    events, torn = read_ledger(p)
+    assert torn == 0
+    _assert_schema_valid(events)
+    kinds = [e["ev"] for e in events]
+    assert kinds[0] == "manifest"
+    assert kinds.count("span") == 2 and "counter" in kinds and "gauge" in kinds
+
+
+def test_span_nesting_parent_ids(tmp_path):
+    """Spans nest via a thread-local stack: the inner span's ``parent`` is
+    the outer's id; the outer is top-level (parent null). The inner CLOSES
+    first, so it appears first in the ledger."""
+    p = str(tmp_path / "run.jsonl")
+    rec = Recorder(p)
+    with rec.span("outer"):
+        with rec.span("inner"):
+            pass
+    rec.close()
+    events, _ = read_ledger(p)
+    inner, outer = events
+    assert inner["name"] == "inner" and outer["name"] == "outer"
+    assert inner["parent"] == outer["id"]
+    assert outer["parent"] is None
+
+
+def test_span_measures_wall_and_cpu(tmp_path):
+    rec = Recorder(str(tmp_path / "r.jsonl"))
+    with rec.span("sleepy") as sp:
+        time.sleep(0.02)
+    rec.close()
+    # a sleeping span waited (wall ≫ cpu) — the diagnostic the split exists
+    # for
+    assert sp.wall_s >= 0.015
+    assert sp.cpu_s < sp.wall_s
+
+
+def test_span_imperative_start_stop_idempotent(tmp_path):
+    p = str(tmp_path / "r.jsonl")
+    rec = Recorder(p)
+    sw = rec.span("imperative").start()
+    sw.stop()
+    w = sw.wall_s
+    sw.stop()                                    # idempotent: no re-emit
+    rec.close()
+    events, _ = read_ledger(p)
+    assert len(events) == 1 and sw.wall_s == w
+
+
+def test_abandoned_child_span_does_not_misparent_later_spans(tmp_path):
+    """An imperative start() whose stop() is skipped by an exception must
+    not leave its id on the thread-local stack: the enclosing span's close
+    unwinds it, so the next top-level span parents correctly."""
+    p = str(tmp_path / "r.jsonl")
+    rec = Recorder(p)
+    with pytest.raises(RuntimeError):
+        with rec.span("run"):
+            rec.span("solver.hpr").start()       # never stopped
+            raise RuntimeError("solver died")
+    with rec.span("next_run"):
+        pass
+    rec.close()
+    events, _ = read_ledger(p)
+    nxt = next(e for e in events if e["name"] == "next_run")
+    assert nxt["parent"] is None                 # not the leaked solver id
+
+
+def test_solver_exception_emits_span_and_unwinds(tmp_path):
+    """hpr_solve's imperative solver span closes on the exception path —
+    the try/finally contract: the span event is in the ledger and the
+    stack is clean."""
+    import jax.numpy as jnp
+
+    from graphdyn.config import HPRConfig
+    from graphdyn.graphs import random_regular_graph
+    from graphdyn.models.hpr import hpr_solve
+
+    g = random_regular_graph(20, 3, seed=0)
+    p = str(tmp_path / "r.jsonl")
+    with obs.recording(p) as rec:
+        with pytest.raises(TypeError):
+            # chi0 of a nonsense type dies inside the solver body
+            hpr_solve(g, config=HPRConfig(max_sweeps=2), chi0=object())
+        with rec.span("after"):
+            pass
+    events, _ = read_ledger(p)
+    assert any(e.get("name") == "solver.hpr" for e in events)
+    after = next(e for e in events if e.get("name") == "after")
+    assert after["parent"] is None
+
+
+def test_span_attrs_set_before_close(tmp_path):
+    p = str(tmp_path / "r.jsonl")
+    rec = Recorder(p)
+    with rec.span("chunk", chunk=0) as sp:
+        sp.set(sweeps_advanced=17)
+    rec.close()
+    (e,), _ = read_ledger(p)
+    assert e["attrs"] == {"chunk": 0, "sweeps_advanced": 17}
+
+
+def test_non_json_attrs_serialize_via_str(tmp_path):
+    """numpy scalars / Paths in attrs must not kill the emit."""
+    p = str(tmp_path / "r.jsonl")
+    rec = Recorder(p)
+    rec.gauge("g", np.float32(1.5), path=tmp_path)
+    rec.close()
+    events, torn = read_ledger(p)
+    assert torn == 0 and len(events) == 1
+
+
+def test_read_ledger_tolerates_torn_final_line(tmp_path):
+    p = tmp_path / "r.jsonl"
+    p.write_text('{"ev":"counter","t":0.1,"name":"a","inc":1}\n{"ev":"cou')
+    events, torn = read_ledger(str(p))
+    assert len(events) == 1 and torn == 1
+
+
+def test_requeue_reopen_seals_torn_tail(tmp_path):
+    """A requeued run reusing the same GRAPHDYN_OBS path after a hard kill:
+    the new recorder seals the torn fragment onto its own line, its first
+    event survives intact, and read_ledger tolerates the seam (torn line
+    followed by the new run's manifest)."""
+    p = str(tmp_path / "requeue.jsonl")
+    rec = Recorder(p)
+    rec.counter("before_kill")
+    rec.close()
+    with open(p, "a") as f:
+        f.write('{"ev":"counter","t":9')         # hard kill mid-write
+    rec2 = Recorder(p)                           # the requeue
+    rec2.manifest(cmd="entropy")
+    rec2.counter("after_requeue")
+    rec2.close()
+    events, torn = read_ledger(p)
+    assert torn == 1
+    assert [e.get("name", e["ev"]) for e in events] == [
+        "before_kill", "manifest", "after_requeue"]
+
+
+def test_read_ledger_rejects_torn_middle_line(tmp_path):
+    p = tmp_path / "r.jsonl"
+    p.write_text('{"ev":"cou\n{"ev":"counter","t":0.1,"name":"a","inc":1}\n')
+    with pytest.raises(ValueError, match="torn JSON line in the middle"):
+        read_ledger(str(p))
+
+
+# ---------------------------------------------------------------------------
+# null recorder: the default must cost (almost) nothing
+# ---------------------------------------------------------------------------
+
+
+def test_null_recorder_is_default_and_allocation_free():
+    assert obs.current() is NULL and not obs.enabled()
+    # one shared no-op span object per call — no per-site allocation
+    assert obs.span("pipeline.sa.chunk") is NULL_SPAN
+    assert obs.span("other") is NULL_SPAN
+    with obs.span("x") as sp:
+        assert sp is NULL_SPAN
+    obs.counter("c")
+    obs.gauge("g", 1.0)
+    assert obs.manifest(cmd="x") is None
+
+
+def test_null_recorder_no_measurable_per_chunk_allocation():
+    """The per-chunk instrumentation cost on an unrecorded run: net
+    retained allocation over many span cycles is ~zero (the satellite's
+    'no measurable per-chunk allocation' contract)."""
+    for _ in range(100):                         # warm caches
+        with obs.span("chunk"):
+            pass
+    tracemalloc.start()
+    base = tracemalloc.take_snapshot()
+    for _ in range(2000):
+        with obs.span("chunk"):
+            pass
+        obs.counter("c")
+    diff = tracemalloc.take_snapshot().compare_to(base, "filename")
+    tracemalloc.stop()
+    leaked = sum(d.size_diff for d in diff if d.size_diff > 0)
+    assert leaked < 16_384, f"null-recorder path retained {leaked} B"
+
+
+def test_timed_always_measures_even_unrecorded():
+    assert not obs.enabled()
+    with obs.timed("bench.row") as sw:
+        time.sleep(0.01)
+    assert sw.wall_s >= 0.008                    # real number, no ledger
+
+
+# ---------------------------------------------------------------------------
+# recording() scope
+# ---------------------------------------------------------------------------
+
+
+def test_recording_installs_and_restores(tmp_path):
+    p = str(tmp_path / "run.jsonl")
+    with obs.recording(p) as rec:
+        assert obs.enabled() and obs.current() is rec
+        obs.counter("inside")
+    assert not obs.enabled() and obs.current() is NULL
+    events, _ = read_ledger(p)
+    assert events[0]["name"] == "inside"
+
+
+def test_recording_env_var_fallback(tmp_path, monkeypatch):
+    p = str(tmp_path / "env.jsonl")
+    monkeypatch.setenv("GRAPHDYN_OBS", p)
+    with obs.recording() as rec:
+        assert rec.enabled
+        obs.gauge("g", 1)
+    events, _ = read_ledger(p)
+    assert events[0]["ev"] == "gauge"
+
+
+def test_recording_unset_is_noop(monkeypatch):
+    monkeypatch.delenv("GRAPHDYN_OBS", raising=False)
+    with obs.recording() as rec:
+        assert rec is NULL
+
+
+def test_nested_recording_with_path_is_an_error(tmp_path):
+    with obs.recording(str(tmp_path / "a.jsonl")) as rec:
+        with pytest.raises(RuntimeError, match="one ledger per run"):
+            with obs.recording(str(tmp_path / "b.jsonl")):
+                pass                             # pragma: no cover
+        # pathless re-entry keeps the outer recorder
+        with obs.recording() as inner:
+            assert inner is rec
+
+
+def test_recording_counts_compile_cache_misses(tmp_path):
+    """The RecompileWatch reuse: a fresh XLA compile inside the scope emits
+    one ``jax.compile`` counter event, live."""
+    import jax
+    import jax.numpy as jnp
+
+    p = str(tmp_path / "run.jsonl")
+    with obs.recording(p):
+        # a shape/function pair no other test compiles
+        jax.jit(lambda x: x * 3 + 11)(jnp.arange(53)).block_until_ready()
+    events, _ = read_ledger(p)
+    compiles = [e for e in events
+                if e["ev"] == "counter" and e["name"] == "jax.compile"]
+    assert compiles, "no jax.compile counter event for a fresh compile"
+
+
+def test_manifest_fields(tmp_path):
+    p = str(tmp_path / "run.jsonl")
+    with obs.recording(p):
+        run = obs.manifest(**obs.run_manifest_fields(cmd="test"))
+    assert run["backend"] and run["jax"] and run["python"]
+    assert run["git_sha"]                        # a checkout: sha resolves
+    events, _ = read_ledger(p)
+    man = [e for e in events if e["ev"] == "manifest"]
+    assert len(man) == 1 and man[0]["run"]["cmd"] == "test"
+
+
+# ---------------------------------------------------------------------------
+# instrumented stack: clean + fault-injected runs produce valid ledgers
+# ---------------------------------------------------------------------------
+
+ENTROPY_ARGS = [
+    "entropy", "--n", "50", "--deg", "1.5", "--num-rep", "1",
+    "--lmbd-max", "0.3", "--lmbd-step", "0.1", "--max-sweeps", "200",
+    "--eps", "1e-5", "--seed", "1",
+]
+
+
+def test_cli_entropy_grouped_clean_run_ledger(tmp_path, capsys):
+    """Acceptance: a clean grouped entropy-grid run through the CLI writes
+    a schema-valid ledger with the manifest, the run span, per-chunk
+    pipeline spans carrying sweeps-advanced, and per-λ boundary counters."""
+    from graphdyn.cli import main
+
+    ledger = str(tmp_path / "entropy.jsonl")
+    out = str(tmp_path / "res.npz")
+    rc = main(["--obs-ledger", ledger, *ENTROPY_ARGS, "--out", out])
+    capsys.readouterr()
+    assert rc == 0
+    events, torn = read_ledger(ledger)
+    assert torn == 0
+    _assert_schema_valid(events)
+    man = [e for e in events if e["ev"] == "manifest"]
+    assert len(man) == 1
+    assert man[0]["run"]["cmd"] == "entropy"
+    assert man[0]["run"]["backend"] and man[0]["run"]["jax"]
+    assert man[0]["run"]["config"]["n"] == 50    # full parsed config rides
+    spans = {e["name"] for e in events if e["ev"] == "span"}
+    assert "run" in spans and "pipeline.entropy.chunk" in spans
+    chunk = next(e for e in events if e.get("name") ==
+                 "pipeline.entropy.chunk")
+    assert "sweeps_advanced" in chunk["attrs"]
+    assert chunk["attrs"]["cold"] is True        # compile/execute separated
+    lam = [e for e in events if e.get("name") == "pipeline.lambda.boundary"]
+    assert len(lam) == 4                         # λ ∈ {0.0, 0.1, 0.2, 0.3}
+
+
+@pytest.mark.faultinject
+def test_cli_entropy_fault_injected_run_ledger(tmp_path, capsys):
+    """Acceptance: a seeded fault-injection run (sweep.nan) still produces
+    a schema-valid ledger, now carrying the fault-site hit and the degrade
+    decision — the post-mortem no longer needs the log text."""
+    from graphdyn.cli import main
+    from graphdyn.resilience.faults import FaultPlan, FaultSpec
+
+    ledger = str(tmp_path / "faulty.jsonl")
+    out = str(tmp_path / "res.npz")
+    with FaultPlan([FaultSpec("sweep.nan", "nan", at=1)]):
+        rc = main(["--obs-ledger", ledger, *ENTROPY_ARGS, "--out", out])
+    capsys.readouterr()
+    assert rc == 0                               # NaN degrades, not dies
+    events, torn = read_ledger(ledger)
+    assert torn == 0
+    _assert_schema_valid(events)
+    names = [e.get("name") for e in events if e["ev"] == "counter"]
+    assert "resilience.fault" in names           # the injection itself
+    assert "pipeline.sweep.nan" in names         # the degrade decision
+    fault = next(e for e in events if e.get("name") == "resilience.fault")
+    assert fault["attrs"]["site"] == "sweep.nan"
+
+
+@pytest.mark.faultinject
+def test_cli_sigterm_mid_chunk_leaves_parseable_ledger(tmp_path, capsys):
+    """Satellite: preemption (SIGTERM-equivalent signal fault mid-ladder →
+    exit 75) leaves a parseable, truncation-safe ledger — every line that
+    made it to disk is a complete event, the shutdown decision included."""
+    from graphdyn.cli import main
+    from graphdyn.resilience.faults import FaultPlan, FaultSpec
+
+    ledger = str(tmp_path / "preempted.jsonl")
+    ck = str(tmp_path / "ck")
+    out = str(tmp_path / "res.npz")
+    args = ["--obs-ledger", ledger, *ENTROPY_ARGS, "--checkpoint", ck,
+            "--checkpoint-interval", "0", "--out", out]
+    with FaultPlan([FaultSpec("lambda.boundary", "signal", at=2)]):
+        rc = main(args)
+    capsys.readouterr()
+    assert rc == 75
+    events, torn = read_ledger(ledger)           # parseable prefix, always
+    assert torn <= 1
+    _assert_schema_valid(events)
+    assert any(e["ev"] == "manifest" for e in events)
+    # the preemption decision itself is in the ledger (resilience taxonomy)
+    assert any(e.get("name") == "resilience.fault" for e in events)
+    # the checkpoint write latency span landed too
+    assert any(e.get("name") == "io.ckpt.write" for e in events)
+
+
+def test_retry_counter_and_log_fields(tmp_path, caplog):
+    """Satellite: a retried failure is diagnosable post-hoc — site key,
+    attempt number, and cumulative backoff ride in BOTH the log record's
+    fields and the obs counter."""
+    import logging
+
+    from graphdyn.resilience.retry import RetryPolicy, retry
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    p = str(tmp_path / "retry.jsonl")
+    with obs.recording(p):
+        with caplog.at_level(logging.WARNING, logger="graphdyn.resilience"):
+            out = retry(flaky, what="checkpoint save (/tmp/x)",
+                        policy=RetryPolicy(tries=4, base_delay_s=0.01),
+                        sleep=lambda s: None)
+    assert out == "ok"
+    recs = [r for r in caplog.records if hasattr(r, "retry_site")]
+    assert [r.retry_attempt for r in recs] == [1, 2]
+    assert recs[0].retry_site == "checkpoint save (/tmp/x)"
+    assert recs[1].retry_cumulative_backoff_s == pytest.approx(0.03)
+    events, _ = read_ledger(p)
+    counters = [e for e in events
+                if e["ev"] == "counter" and e["name"] == "resilience.retry"]
+    assert [c["attrs"]["attempt"] for c in counters] == [1, 2]
+    assert counters[1]["attrs"]["cumulative_backoff_s"] == pytest.approx(0.03)
+    assert "OSError" in counters[0]["attrs"]["error"]
+
+
+def test_prefetch_overlap_gauge(tmp_path):
+    from graphdyn.pipeline.prefetch import HostPrefetcher
+
+    p = str(tmp_path / "pf.jsonl")
+    with obs.recording(p):
+        with HostPrefetcher(lambda k: k * 2, range(6), depth=2) as pf:
+            got = [pf.get(k) for k in range(6)]
+    assert got == [k * 2 for k in range(6)]
+    events, _ = read_ledger(p)
+    g = next(e for e in events
+             if e["ev"] == "gauge"
+             and e["name"] == "pipeline.prefetch.overlap_util")
+    assert 0.0 <= g["value"] <= 1.0
+    assert g["attrs"]["items"] == 6
+
+
+def test_sa_group_chunk_spans_and_rollout_gauge(tmp_path):
+    """The grouped SA driver emits per-chunk spans (cold marks the
+    compile-paying first chunk) and the ops.rollout.rate gauge — the same
+    spin-updates/s unit bench.py reports."""
+    from graphdyn.config import DynamicsConfig, SAConfig
+    from graphdyn.models.sa import sa_ensemble
+
+    cfg = SAConfig(dynamics=DynamicsConfig(p=1, c=1))
+    p = str(tmp_path / "sa.jsonl")
+    with obs.recording(p):
+        sa_ensemble(30, 3, cfg, n_stat=2, seed=0, max_steps=5000)
+    events, torn = read_ledger(p)
+    assert torn == 0
+    _assert_schema_valid(events)
+    chunks = [e for e in events if e.get("name") == "pipeline.sa.chunk"]
+    assert chunks and chunks[0]["attrs"]["cold"] is True
+    assert all("steps_advanced" in c["attrs"] for c in chunks)
+    rates = [e for e in events if e.get("name") == "ops.rollout.rate"]
+    assert rates and rates[0]["value"] > 0
+    assert rates[0]["attrs"]["solver"] == "sa_group"
+
+
+# ---------------------------------------------------------------------------
+# one timing idiom: the deprecated shims delegate to obs
+# ---------------------------------------------------------------------------
+
+
+def test_step_timer_shim_deprecated_but_working(tmp_path):
+    from graphdyn.utils.profiling import StepTimer
+
+    t = StepTimer()
+    p = str(tmp_path / "shim.jsonl")
+    with obs.recording(p):
+        with pytest.warns(DeprecationWarning, match="obs.timed"):
+            with t.measure(100):
+                pass
+        with t.measure(50):                      # warns once per instance
+            pass
+    assert t.updates == 150 and t.updates_per_sec > 0
+    events, _ = read_ledger(p)
+    shim_spans = [e for e in events
+                  if e.get("name") == "profiling.step_timer"]
+    assert len(shim_spans) == 2                  # the shim reaches the ledger
+
+
+def test_wall_clock_shim_deprecated_but_working():
+    from graphdyn.utils.profiling import wall_clock
+
+    with pytest.warns(DeprecationWarning, match="obs.timed"):
+        with wall_clock() as w:
+            pass
+    assert w["seconds"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# roofline obscheck (the absolute CPU-proxy anchor)
+# ---------------------------------------------------------------------------
+
+
+def test_byte_models():
+    from graphdyn.obs.roofline import (
+        bdcm_bytes_per_edge_sweep, packed_bytes_per_update,
+    )
+
+    assert packed_bytes_per_update(3) == 0.5     # ARCHITECTURE.md: (d+1)/8
+    assert packed_bytes_per_update(7) == 1.0
+    # DP-lattice dominated: grows with both d and T
+    assert bdcm_bytes_per_edge_sweep(4, 2) > bdcm_bytes_per_edge_sweep(3, 2)
+    assert bdcm_bytes_per_edge_sweep(3, 3) > bdcm_bytes_per_edge_sweep(3, 2)
+
+
+def test_roofline_obscheck_passes_on_cpu(tmp_path):
+    """Acceptance: every headline program's measured CPU-proxy rate sits
+    inside its committed byte-model band on this container. Rows also land
+    as gauges when recording."""
+    from graphdyn.obs.roofline import run_obscheck
+
+    p = str(tmp_path / "roofline.jsonl")
+    with obs.recording(p):
+        rows = run_obscheck()
+    assert {r.program for r in rows} == {
+        "packed_rollout", "bdcm_sweep", "entropy_cell_chunk"}
+    for r in rows:
+        assert r.measured > 0 and r.model > 0
+        assert r.ok, (f"{r.program}: measured/model frac {r.frac:.4f} "
+                      f"outside [{r.lo}, {r.hi}]")
+    events, _ = read_ledger(p)
+    gauges = {e["name"] for e in events if e["ev"] == "gauge"}
+    assert {"obs.roofline.packed_rollout", "obs.roofline.bdcm_sweep",
+            "obs.roofline.entropy_cell_chunk"} <= gauges
+
+
+# ---------------------------------------------------------------------------
+# cross-round bench rate trend gate
+# ---------------------------------------------------------------------------
+
+PREV_ROW = {
+    "backend": "cpu", "metric": "spin_updates_per_sec_n100000",
+    "value": 2.0e9, "packed_rate_natural_order": 2.0e9,
+    "ensemble_rate": 1.0e7, "int8_rate": 8.0e7,
+}
+
+
+def _new_row(**over):
+    return {**PREV_ROW, **over}
+
+
+def test_trend_gate_fails_slowed_row_with_pointed_message():
+    """Acceptance: an artificially slowed headline row fails the gate with
+    a message naming the row, the ratio, the band, and the bless path."""
+    from graphdyn.obs.trend import diff_bench_rates
+
+    slowed = _new_row(value=4.0e8, packed_rate_natural_order=4.0e8)
+    findings = diff_bench_rates(PREV_ROW, slowed)
+    assert {f.row for f in findings} == {"value",
+                                         "packed_rate_natural_order"}
+    f = next(x for x in findings if x.row == "value")
+    assert f.code == "OBS201"
+    assert "regressed 5.00x" in f.message
+    assert "--bless" in f.message                # the update path is named
+
+
+def test_trend_gate_flags_implausible_jump():
+    from graphdyn.obs.trend import diff_bench_rates
+
+    jumped = _new_row(int8_rate=8.0e7 * 40)
+    (f,) = diff_bench_rates(PREV_ROW, jumped)
+    assert f.row == "int8_rate" and f.code == "OBS202"
+    assert "timing fence" in f.message
+
+
+def test_trend_gate_stable_and_incomparable_rows():
+    from graphdyn.obs.trend import comparable, diff_bench_rates
+
+    assert diff_bench_rates(PREV_ROW, _new_row(value=2.1e9)) == []
+    # different backend or metric: not comparable, no findings
+    assert not comparable(PREV_ROW, _new_row(backend="tpu"))
+    assert not comparable(PREV_ROW, _new_row(metric="other_n1000000"))
+    assert diff_bench_rates(PREV_ROW, _new_row(backend="tpu",
+                                               value=1.0)) == []
+    # a null rate (explicit backend skip) is not a regression
+    assert diff_bench_rates(PREV_ROW,
+                            _new_row(ensemble_rate=None)) == []
+    # an error round (value 0) is not a baseline
+    assert diff_bench_rates(_new_row(value=0.0), PREV_ROW) == []
+
+
+def test_check_trend_against_committed_rounds(tmp_path):
+    """The full gate against round artifacts on disk — including the
+    ``parsed`` wrapper the capture driver writes."""
+    from graphdyn.obs.trend import check_trend
+
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"cmd": "bench", "rc": 0, "parsed": PREV_ROW}))
+    empty = {"classes": {}}
+    _, status = check_trend(_new_row(value=2.2e9), root=str(tmp_path),
+                            ledger=empty)
+    assert status == "stable"
+    findings, status = check_trend(_new_row(value=4.0e8), root=str(tmp_path),
+                                   ledger=empty)
+    assert status == "drift" and findings
+    _, status = check_trend(_new_row(backend="tpu"), root=str(tmp_path),
+                            ledger=empty)
+    assert status == "no_baseline"
+
+
+def test_trend_blessing_passes_deliberate_change(tmp_path):
+    """Acceptance: a deliberate rate change committed to OBS_TREND.json
+    (``--bless``) passes the gate as ``blessed``; the committed classes are
+    (backend, metric)-scoped."""
+    from graphdyn.obs.trend import (
+        check_trend, load_trend_ledger, write_trend_ledger,
+    )
+
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"parsed": PREV_ROW}))
+    new = _new_row(value=4.0e8, packed_rate_natural_order=4.0e8)
+    lpath = str(tmp_path / "OBS_TREND.json")
+    write_trend_ledger(new, lpath)
+    ledger = load_trend_ledger(lpath)
+    assert set(ledger["classes"]) == {"cpu|spin_updates_per_sec_n100000"}
+    findings, status = check_trend(new, root=str(tmp_path), ledger=ledger)
+    assert status == "blessed"
+    assert findings                              # the drift is still named
+    # a DIFFERENT unexplained drift — outside the blessed band too — is
+    # not covered by the blessing
+    _, status = check_trend(_new_row(value=1.0e7,
+                                     packed_rate_natural_order=1.0e7),
+                            root=str(tmp_path), ledger=ledger)
+    assert status == "drift"
+
+
+def test_bench_trend_gate_drift_end_to_end(monkeypatch):
+    """Acceptance, through bench.py's own gate: a monkeypatched slowed
+    headline row comes back status=drift with the pointed finding in the
+    row — exactly what benchcheck fails on."""
+    import bench
+    from graphdyn.obs import trend as trend_mod
+
+    monkeypatch.setattr(
+        trend_mod, "latest_comparable_round",
+        lambda new_row, root=None, pattern="BENCH_r*.json":
+            ("BENCH_r99.json", dict(PREV_ROW)))
+    monkeypatch.setattr(trend_mod, "load_trend_ledger", lambda path=None: None)
+    out = bench.trend_gate(_new_row(value=4.0e8))
+    assert out["obs_trend_status"] == "drift"
+    (f,) = out["obs_trend_findings"]
+    assert f["row"] == "value" and f["code"] == "OBS201"
+    assert "regressed 5.00x" in f["message"] and "--bless" in f["message"]
+
+
+def test_bench_trend_gate_rides_in_row(monkeypatch):
+    """bench.py's helper: the verdict (or an explicit skip) rides in the
+    row so benchcheck can assert the gate ran."""
+    import bench
+
+    monkeypatch.setenv("GRAPHDYN_SKIP_TRENDGATE", "1")
+    out = bench.trend_gate({"backend": "cpu", "metric": "m", "value": 1.0})
+    assert out["obs_trend_status"] == "skipped"
+    assert "GRAPHDYN_SKIP_TRENDGATE" in out["obs_trend_skipped_reason"]
+    monkeypatch.delenv("GRAPHDYN_SKIP_TRENDGATE")
+    out = bench.trend_gate({"backend": "nowhere", "metric": "never",
+                            "value": 1.0})
+    assert out["obs_trend_status"] == "no_baseline"
+
+
+# ---------------------------------------------------------------------------
+# CLIs: report / check / trend (one JSON document on stdout — PR-6 contract)
+# ---------------------------------------------------------------------------
+
+
+def _make_ledger(path):
+    rec = Recorder(str(path))
+    rec.manifest(cmd="entropy", backend="cpu")
+    with rec.span("run", cmd="entropy"):
+        with rec.span("pipeline.entropy.chunk", chunk=0):
+            pass
+        with rec.span("pipeline.entropy.chunk", chunk=1):
+            pass
+        rec.counter("jax.compile", fn="chunk")
+        rec.gauge("ops.rollout.rate", 1.5e9, solver="sa_group")
+    rec.close()
+
+
+def test_report_summarize_span_tree(tmp_path):
+    from graphdyn.obs.report import load_summary
+
+    p = tmp_path / "run.jsonl"
+    _make_ledger(p)
+    doc = load_summary(str(p))
+    assert doc["manifest"]["cmd"] == "entropy"
+    # name-path aggregation: the chunk span reports under its parent chain
+    assert doc["spans"]["run > pipeline.entropy.chunk"]["count"] == 2
+    assert doc["spans"]["run"]["count"] == 1
+    assert doc["counters"]["jax.compile"]["total"] == 1
+    g = doc["gauges"]["ops.rollout.rate"]
+    assert g["last"] == g["max"] == pytest.approx(1.5e9)
+    assert doc["torn_lines"] == 0
+
+
+def test_report_cli_one_json_document(tmp_path):
+    p = tmp_path / "run.jsonl"
+    _make_ledger(p)
+    # torn final line: diagnostics must go to stderr, stdout stays ONE doc
+    with open(p, "a") as f:
+        f.write('{"ev":"cou')
+    proc = subprocess.run(
+        [sys.executable, "-m", "graphdyn.obs", "report", str(p),
+         "--format=json"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    doc = json.loads(proc.stdout)                # exactly one document
+    assert doc["torn_lines"] == 1
+    assert "torn line" in proc.stderr
+    text = subprocess.run(
+        [sys.executable, "-m", "graphdyn.obs", "report", str(p)],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert text.returncode == 0
+    assert "pipeline.entropy.chunk" in text.stdout
+
+
+def test_trend_cli_diff_and_bless(tmp_path):
+    rowfile = tmp_path / "row.json"
+    rowfile.write_text(json.dumps(_new_row(value=1.9e9)))
+    lpath = tmp_path / "OBS_TREND.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "graphdyn.obs", "trend", str(rowfile),
+         "--bless", "--ledger", str(lpath), "--format=json"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert json.loads(proc.stdout)["blessed"] is True
+    assert lpath.exists()
+    # the gate CLI: exit 0 on anything but unblessed drift
+    proc = subprocess.run(
+        [sys.executable, "-m", "graphdyn.obs", "trend", str(rowfile),
+         "--ledger", str(lpath), "--format=json"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    doc = json.loads(proc.stdout)
+    assert doc["status"] in ("stable", "no_baseline", "blessed")

@@ -18,9 +18,11 @@ def test_step_timer_accumulates_and_rates():
 
 
 def test_wall_clock_bracket():
+    import time
+
     with wall_clock() as w:
-        _ = np.arange(10).sum()
-    assert w["seconds"] >= 0.0
+        time.sleep(0.02)
+    assert w["seconds"] >= 0.015        # a real measurement, not a zero
 
 
 def test_device_trace_writes_profile(tmp_path):
