@@ -73,6 +73,24 @@ def trend_gate(row):
                     f"trend gate failed: {str(e)[:150]}"}
 
 
+def peak_hbm_row():
+    """The device-memory column (graphdyn.obs.memband): the process-peak
+    HBM bytes after the headline kernels ran — the occupancy number the
+    TPU Ising literature reports next to the step rate. Null + reason on
+    backends without usable memory_stats (CPU), never a silent absence or
+    a fake 0."""
+    try:
+        from graphdyn.obs.memband import peak_hbm_bytes
+
+        peak, reason = peak_hbm_bytes()
+    except Exception as e:  # noqa: BLE001 — telemetry must not kill bench
+        peak, reason = None, f"memory stats failed: {str(e)[:120]}"
+    if peak is None:
+        return {"peak_hbm_bytes": None,
+                "peak_hbm_bytes_skipped_reason": reason}
+    return {"peak_hbm_bytes": peak}
+
+
 def packed_rate(g, R, steps, iters=3, kernel="xla"):
     import jax
     import jax.numpy as jnp
@@ -368,6 +386,10 @@ def main():
             tempfile.gettempdir(), f"graphdyn_obs_bench_{os.getpid()}.jsonl"
         )
         _obs_stack.enter_context(obs.recording(obs_ledger))
+        # GRAPHDYN_PROFILE=DIR: capture an aligned jax.profiler trace of
+        # the whole bench run — every obs span doubles as a TraceAnnotation
+        # carrying its ledger name-path (no-op when the env var is unset)
+        _obs_stack.enter_context(obs.trace.profiling())
         run = obs.manifest(**obs.run_manifest_fields(
             cmd="bench", smoke=bool(args.smoke),
         ))
@@ -437,6 +459,7 @@ def main():
             **extra,
             "packed_rate_wide_by_R": wide_by_R,
             **obs_row,
+            **peak_hbm_row(),
             "backend": jax.default_backend(),
             **({"relay": relay_note} if relay_note else {}),
         }
@@ -599,6 +622,7 @@ def main():
         # never-measured configuration must not report a count)
         **({"packed_replicas_wide": R_wide} if wide_by_R else {}),
         **obs_row,
+        **peak_hbm_row(),
         "torch_cpu_rate": base,
         "packed_replicas": R_packed,
         "packed_replicas_best": packed_replicas_best,

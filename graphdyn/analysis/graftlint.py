@@ -85,6 +85,17 @@ Rules (stable codes; each can be silenced per line with
   not a measurement idiom.  ``graphdyn/obs/`` itself and
   ``utils/profiling.py`` (the deprecated shim) are the implementation and
   are out of scope by module.
+- **GD012** bare ``jax.profiler`` capture/annotation calls
+  (``start_trace``/``stop_trace``/``trace``/``TraceAnnotation``/
+  ``StepTraceAnnotation``/``annotate_function``) anywhere outside
+  ``graphdyn/obs/``.  A privately started trace misses the span-aligned
+  ``TraceAnnotation`` names the obs layer adds (the device timeline and
+  the JSONL ledger share one vocabulary — ARCHITECTURE.md "Runtime
+  telemetry"), and a stray ``start_trace`` inside a run that is already
+  profiling crashes the process-global profiler.  Use
+  :func:`graphdyn.obs.trace.profiling` (CLI ``--profile`` /
+  ``GRAPHDYN_PROFILE``); span annotations come for free from
+  ``obs.span``/``obs.timed``.
 
 Escape hatches, all requiring an explicit code list (``all`` allowed):
 
@@ -121,6 +132,7 @@ RULES = {
     "GD009": "jax.vmap over a pallas_call-backed callable (serial kernel-launch loop, not a batched grid)",
     "GD010": "jnp.asarray of a host buffer this function mutates (CPU alias race with async device reads)",
     "GD011": "bare time.time()/time.perf_counter() timing in a driver module (use graphdyn.obs timed/span)",
+    "GD012": "bare jax.profiler capture/annotation outside graphdyn/obs/ (use graphdyn.obs.trace profiling/span alignment)",
 }
 
 # the wall-clock calls GD011 watches (time.monotonic is exempt: it is the
@@ -129,6 +141,18 @@ RULES = {
 # of a local named `time` in a driver module is overwhelmingly the clock,
 # and the disable hatch covers the exception
 _GD011_CALLS = {"time.time", "time.perf_counter", "perf_counter", "time"}
+
+# the jax.profiler surface GD012 watches: matched as the FINAL attribute
+# under any parent (jax.profiler.start_trace, an aliased
+# `import jax.profiler as jp; jp.start_trace`, or the bare
+# `from jax.profiler import ...` names — distinctive enough to carry no
+# false-positive risk). `trace` is only matched dotted under `profiler` —
+# the bare name is far too common to police syntactically.
+_GD012_NAMES = {
+    "start_trace", "stop_trace", "TraceAnnotation", "StepTraceAnnotation",
+    "annotate_function",
+}
+_GD012_DOTTED_ONLY = {"trace"}
 
 # host->device crossings GD010 watches (the potentially-aliasing ones;
 # jnp.array copies and is the suggested fix)
@@ -308,6 +332,11 @@ class _FileLinter:
         # measurement should land in the obs event ledger. graphdyn/obs/
         # and utils/profiling.py are the implementation/shim layer.
         self.timing_strict = self.driver_mod or norm.endswith("bench.py")
+        # GD012 scope: everywhere EXCEPT graphdyn/obs/ — the obs layer IS
+        # the profiling implementation (aligned capture + span-named
+        # TraceAnnotations); a bare jax.profiler call anywhere else forks
+        # the device-timeline vocabulary away from the ledger's
+        self.profiler_strict = "/obs/" not in norm
 
     def emit(self, node: ast.AST, code: str, message: str) -> None:
         self.findings.append(
@@ -385,6 +414,7 @@ class _FileLinter:
         self._check_vmap_pallas(tree)
         self._check_alias_crossings(tree)
         self._check_bare_timing(tree)
+        self._check_bare_profiler(tree)
         self.findings.sort(key=lambda f: (f.line, f.col, f.code))
         return self.findings
 
@@ -670,6 +700,74 @@ class _FileLinter:
                     f"when a ledger is active) or obs.span(name); "
                     f"time.monotonic is the allowed bookkeeping clock",
                 )
+
+    def _check_bare_profiler(self, tree: ast.Module):
+        """GD012: bare ``jax.profiler`` capture/annotation calls outside
+        ``graphdyn/obs/``. One profiling idiom
+        (:func:`graphdyn.obs.trace.profiling` + span-named annotations) —
+        a privately started trace forks the device-timeline vocabulary
+        away from the event ledger's, and a second ``start_trace`` inside
+        an already-profiling run crashes the process-global profiler."""
+        if not self.profiler_strict:
+            return
+
+        def _profiler_name(expr: ast.expr) -> str | None:
+            d = _dotted(expr)
+            parts = d.split(".")
+            base = parts[-1]
+            # the capture/annotation names are distinctive enough to match
+            # as the final attribute under ANY parent — an aliased module
+            # (`import jax.profiler as jp; jp.start_trace(...)`) is the
+            # same private capture as the fully-dotted form
+            if base in _GD012_NAMES:
+                return d
+            if (base in _GD012_DOTTED_ONLY and len(parts) >= 2
+                    and parts[-2] == "profiler"):
+                return d
+            return None
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                # `from jax.profiler import trace` would make every later
+                # bare `trace(...)` call invisible to the name matching
+                # below (the bare name is deliberately not policed) — flag
+                # the import itself; module == 'jax.profiler' carries zero
+                # false-positive risk
+                if node.module == "jax.profiler" and any(
+                        a.name in _GD012_DOTTED_ONLY for a in node.names):
+                    self.emit(
+                        node, "GD012",
+                        "from jax.profiler import trace outside "
+                        "graphdyn/obs/ — use graphdyn.obs.trace.profiling"
+                        "(dir) (CLI --profile / GRAPHDYN_PROFILE); the "
+                        "bare `trace` name cannot be policed at call "
+                        "sites, so the import is the gate",
+                    )
+                continue
+            if isinstance(node, ast.Call):
+                d = _profiler_name(node.func)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # the bare decorator form: @jax.profiler.annotate_function
+                # (no parentheses) is an Attribute in decorator_list, not a
+                # Call — the called form is caught by the branch above
+                d = next(
+                    (n for n in map(_profiler_name, node.decorator_list)
+                     if n is not None),
+                    None,
+                )
+            else:
+                continue
+            if d is None:
+                continue
+            self.emit(
+                node, "GD012",
+                f"bare {d}() outside graphdyn/obs/ — use "
+                f"graphdyn.obs.trace.profiling(dir) (CLI --profile / "
+                f"GRAPHDYN_PROFILE) for capture; span-aligned "
+                f"TraceAnnotations come from obs.span/obs.timed, so the "
+                f"device timeline and the event ledger share one "
+                f"vocabulary",
+            )
 
     def _check_vmap_pallas(self, tree: ast.Module):
         """GD009: ``jax.vmap`` over a ``pallas_call``-backed callable.

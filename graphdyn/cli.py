@@ -116,6 +116,16 @@ def build_parser() -> argparse.ArgumentParser:
              "honored from the GRAPHDYN_OBS environment variable (this "
              "flag wins). Render with `python -m graphdyn.obs report PATH`",
     )
+    ap.add_argument(
+        "--profile", default=None, metavar="DIR",
+        help="capture a jax.profiler device trace of the run into DIR "
+             "(TensorBoard profile tab / Perfetto); while profiling, every "
+             "obs span also opens a TraceAnnotation named with its ledger "
+             "name-path, so the device timeline and --obs-ledger share one "
+             "vocabulary (ARCHITECTURE.md 'Runtime telemetry'); also "
+             "honored from the GRAPHDYN_PROFILE environment variable "
+             "(this flag wins)",
+    )
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     sa = sub.add_parser("sa", help="SA initialization search (`SA_RRG.py`)")
@@ -336,10 +346,12 @@ def main(argv=None) -> int:
     from graphdyn.analysis.sanitize import maybe_alias_sanitizer
 
     from graphdyn import obs
+    from graphdyn.obs import flight, trace
 
     try:
         with graceful_shutdown(), maybe_alias_sanitizer(), \
-                obs.recording(args.obs_ledger) as rec:
+                obs.recording(args.obs_ledger) as rec, \
+                trace.profiling(args.profile):
             if rec.enabled:
                 # the per-run manifest event: everything needed to read
                 # the rest of the ledger offline (backend, jax version,
@@ -349,8 +361,18 @@ def main(argv=None) -> int:
                     else sys.argv[1:],
                     config={k: v for k, v in sorted(vars(args).items())},
                 ))
-            with rec.span("run", cmd=args.cmd):
-                return _run(args)
+            # the dump sites live INSIDE the recording scope so flight.dump
+            # can route the evidence: live ledger -> obs.crash event lands
+            # there; no ledger -> obs_postmortem.jsonl in the workdir
+            try:
+                with rec.span("run", cmd=args.cmd):
+                    return _run(args)
+            except ShutdownRequested as e:
+                flight.dump("preempt", exc=e, site=e.where)
+                raise
+            except Exception as e:
+                flight.dump("exception", exc=e)
+                raise
     except ShutdownRequested as e:
         print(f"graphdyn: {e} — exiting {EX_TEMPFAIL} (requeue me)",
               file=sys.stderr)

@@ -109,6 +109,9 @@ def consensus_point(g, R: int, m0: float, max_steps: int, chunk: int = 10,
         obs.gauge("ops.rollout.rate",
                   g.n * W * 32 * steps_run / max(sw.wall_s, 1e-9),
                   solver="consensus", m0=float(m0), steps=steps_run)
+        # device-memory gauges after the (possibly mesh-sharded) rollout
+        # scan — the packed spin state is the byte model's packed_state row
+        obs.memband.emit_memory_gauges(loop="consensus.scan", m0=float(m0))
     near = np.asarray(out["near"])[:R]
     near_step = np.asarray(out["near_step"])[:R]
     m_final = np.asarray(out["m_final"])[:R]

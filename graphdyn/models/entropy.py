@@ -200,6 +200,14 @@ def _run_ladder(
                 "non-finite sweep state at lambda=%g (delta=%r) — recording "
                 "non-convergence and stopping the ladder", float(lmbd), delta,
             )
+            # the degrade is survivable (sentinel + stop), but the evidence
+            # is not: preserve the flight-recorder tail at the moment the
+            # poison was detected (post-mortem file, or the live ledger's
+            # obs.crash event when one is recording)
+            from graphdyn.obs import flight
+
+            flight.dump("sweep.nan",
+                        site=f"entropy ladder lambda={float(lmbd):g}")
         if failed:
             nonconverged = float(lmbd)
         if verbose:
@@ -224,7 +232,7 @@ def _run_ladder(
             else:
                 checkpointer.maybe_save(payload, meta)
         if stopping:
-            raise_if_requested()
+            raise_if_requested(where="lambda")
         _faults.maybe_fail("lambda.boundary", key=f"lmbd={float(lmbd):g}")
         if stop_fn(e1) or failed:
             break

@@ -817,7 +817,7 @@ def hpr_ensemble(
         if shutdown_requested():
             if pc is not None:
                 pc.save_now(driver_payload(), {**run_id, "next_rep": k + 1})
-            raise_if_requested()
+            raise_if_requested(where="rep")
     for k in range(start_k):
         graphs[k] = random_regular_graph(
             n, d, seed=seed + k, method=graph_method

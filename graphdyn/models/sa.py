@@ -709,7 +709,7 @@ def sa_ensemble(
         if shutdown_requested():
             if pc is not None:
                 pc.save_now(driver_payload(), {**run_id, "next_rep": k + 1})
-            raise_if_requested()
+            raise_if_requested(where="rep")
     # graphs for reps completed before a resume re-derive from seed + k
     for k in range(start_k):
         graphs[k] = random_regular_graph(

@@ -29,6 +29,22 @@ Surface (all module-level, delegating to the installed recorder):
   with compile-cache miss counters captured via the graftcheck
   ``RecompileWatch`` machinery.
 
+Device-side eyes (PR-8), layered on the same surface:
+
+- :mod:`graphdyn.obs.trace` — aligned ``jax.profiler`` capture (CLI
+  ``--profile DIR`` / ``GRAPHDYN_PROFILE=DIR``): while profiling, every
+  span additionally opens a ``TraceAnnotation`` named with its ledger
+  name-path, so the device timeline and the JSONL ledger share one
+  vocabulary.
+- :mod:`graphdyn.obs.memband` — ``Device.memory_stats()`` gauges
+  (``obs.mem.bytes_in_use``/``obs.mem.peak``) at the pipeline chunk
+  boundaries, plus the memcheck byte-model bands
+  (``python -m graphdyn.obs memcheck``).
+- :mod:`graphdyn.obs.flight` — the always-on bounded flight-recorder ring
+  behind the null recorder, dumped as ``obs_postmortem.jsonl`` on
+  unhandled exception / ``sweep.nan`` degrade / SIGTERM→exit-75, so a
+  crash without a ledger still leaves evidence.
+
 Ledger schema and the span/counter taxonomy: :mod:`graphdyn.obs.recorder`
 docstring + ARCHITECTURE.md. Render a ledger with
 ``python -m graphdyn.obs report LEDGER``.
@@ -49,6 +65,7 @@ from graphdyn.obs.recorder import (  # noqa: F401  (re-exports)
     Span,
     read_ledger,
 )
+from graphdyn.obs import flight, memband, trace  # noqa: F401  (device-side surface)
 
 ENV_VAR = "GRAPHDYN_OBS"
 

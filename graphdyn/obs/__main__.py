@@ -1,10 +1,15 @@
-"""CLI: ``python -m graphdyn.obs <report|check|trend> ...``.
+"""CLI: ``python -m graphdyn.obs <report|check|memcheck|trend> ...``.
 
 - ``report LEDGER`` — render a JSONL event ledger as a span-tree/counter
   summary (``--format=text|json``).
 - ``check`` — the roofline obscheck: measure the headline CPU proxies
   against the byte-model bands (:mod:`graphdyn.obs.roofline`). Exit code =
   out-of-band programs. The ``scripts/lint.sh`` obscheck step.
+- ``memcheck`` — the device-memory bands (:mod:`graphdyn.obs.memband`):
+  measured peak bytes against the ARCHITECTURE.md byte models; on a
+  backend without usable ``memory_stats`` every row is an explicit
+  null + reason and the gate passes structurally. Exit code = out-of-band
+  rows. The ``scripts/lint.sh`` memcheck step.
 - ``trend ROW.json`` — the cross-round rate gate
   (:mod:`graphdyn.obs.trend`): diff a bench row against the latest
   comparable committed round; ``--bless`` commits the row's rates to
@@ -40,6 +45,11 @@ def main(argv: list[str] | None = None) -> int:
 
     chk = sub.add_parser("check", help="roofline obscheck (CPU proxy bands)")
     chk.add_argument("--format", choices=("text", "json"), default="text")
+
+    mem = sub.add_parser(
+        "memcheck", help="device-memory bands (byte models vs measured "
+                         "peak; null+reason on stats-less backends)")
+    mem.add_argument("--format", choices=("text", "json"), default="text")
 
     trd = sub.add_parser("trend", help="cross-round bench rate gate")
     trd.add_argument("row", help="bench row JSON file (one object)")
@@ -79,6 +89,29 @@ def main(argv: list[str] | None = None) -> int:
             _diag(f"obscheck: {len(bad)} program(s) out of band")
         else:
             _diag(f"obscheck: {len(rows)} program(s) within band")
+        return min(len(bad), 125)
+
+    if args.cmd == "memcheck":
+        from graphdyn.obs.memband import run_memcheck
+
+        rows = run_memcheck(diag=_diag)
+        bad = [r for r in rows if not r.ok]
+        if args.format == "json":
+            print(json.dumps([r._asdict() | {"ok": r.ok} for r in rows],
+                             indent=2))
+        else:
+            for r in rows:
+                if r.measured is None:
+                    print(f"{r.program}: model={r.model:g}B measured=null "
+                          f"({r.reason}) structural-pass")
+                else:
+                    print(f"{r.program}: frac={r.frac:.3f} "
+                          f"band=[{r.lo:g},{r.hi:g}] "
+                          f"{'ok' if r.ok else 'OUT OF BAND'}")
+        if bad:
+            _diag(f"memcheck: {len(bad)} row(s) out of band")
+        else:
+            _diag(f"memcheck: {len(rows)} row(s) ok")
         return min(len(bad), 125)
 
     # trend

@@ -1,0 +1,296 @@
+"""Device-memory telemetry + model bands (ARCHITECTURE.md "Runtime
+telemetry" → memory bands).
+
+:mod:`graphdyn.obs.roofline` anchors *rates* to the byte model; this module
+does the same for *residency*. The TPU Ising literature (PAPERS.md
+arXiv:1903.11714, arXiv:2110.02481) reports device-memory occupancy as a
+first-class result next to the step rate — and our own ARCHITECTURE.md
+derives exact byte models for the packed spin state, the stacked-BDCM
+lattice (including the group-resident tilted ``A`` stack the Pallas kernel
+holds in VMEM), and the entropy chunk working set. Nothing in the repo
+previously *measured* any of them: a 2× residency regression (a lost
+donation, an accidental f64 promotion, a materialized gather intermediate)
+would surface only as an OOM at the full shape, in scarce chip time.
+
+Two consumers:
+
+- **Per-chunk gauges** (:func:`emit_memory_gauges`): the three grouped
+  pipeline loops and the sharded rollout drivers emit
+  ``obs.mem.bytes_in_use`` / ``obs.mem.peak`` gauges from
+  ``Device.memory_stats()`` at every chunk boundary while recording. On
+  backends whose devices expose no usable stats (the CPU container:
+  ``memory_stats()`` exists but returns None) ONE ``obs.mem.unavailable``
+  gauge per recording scope carries the reason — never silence, never a
+  fake 0.
+- **The memcheck gate** (:func:`run_memcheck`, ``python -m graphdyn.obs
+  memcheck``, the ``scripts/lint.sh`` memcheck step,
+  ``GRAPHDYN_SKIP_MEMCHECK=1`` to skip): measured peak bytes against the
+  byte models, the way roofline treats rates. On a stats-less backend
+  every row reports an explicit ``null`` + reason and the gate passes
+  *structurally* — the committed bands go live the first chip round, no
+  code change needed.
+
+Bands are deliberately wide (the measured peak includes XLA temp buffers,
+warmup double-buffering, and whatever else the process allocated first);
+like the roofline bands they catch multiples, not percents, and a
+deliberate model change updates :data:`MEM_BANDS` and the ARCHITECTURE.md
+table in the same reviewed PR.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+#: peak-bytes / model-bytes bands per program. PROVISIONAL seeds: the CPU
+#: container cannot calibrate them (no usable memory_stats), so lo/hi are
+#: set from the model's construction — the measured peak must at least
+#: cover the modeled resident state (lo) and a >16x blowup means a
+#: duplicated state class, not allocator slop (hi). The first chip round
+#: that runs memcheck re-centers them (update workflow: ARCHITECTURE.md).
+MEM_BANDS: dict[str, tuple[float, float]] = {
+    "packed_state": (0.5, 16.0),
+    "bdcm_stack": (0.5, 16.0),
+    "entropy_cell_chunk": (0.25, 16.0),
+}
+
+
+# ---------------------------------------------------------------------------
+# byte models (ARCHITECTURE.md derivations)
+# ---------------------------------------------------------------------------
+
+
+def packed_state_bytes(n: int, d: int, W: int) -> int:
+    """Resident device state of the packed rollout: the ``uint32[n, W]``
+    spin words (32 replicas/word), the ``int32[n, d]`` neighbor table, and
+    the ``int32[n]`` degree vector."""
+    return 4 * n * W + 4 * n * d + 4 * n
+
+
+def stacked_bdcm_bytes(stk) -> int:
+    """Resident bytes of a :class:`graphdyn.ops.bdcm.StackedBDCM` cell
+    group on device: the ``[G, 2E_max+1, K, K]`` chi stack (ghost row
+    included), the group-resident tilted ``A`` stack (``G·K²·M_d`` per
+    union degree class — the same term the VMEM model charges the Pallas
+    kernel, ``4·G·K²·M``), and the int64 index tables."""
+    import numpy as np
+
+    G, K = stk.G, stk.K
+    itemsize = np.dtype(stk.dtype).itemsize
+    chi = G * (stk.twoE_max + 1) * K * K * itemsize
+    a_stack = sum(
+        G * K * K * A.shape[-1] * itemsize
+        for (_, _, _, A) in stk.edge_classes
+    )
+    tables = sum(
+        8 * (idx.size + in_edges.size)
+        for (_, idx, in_edges, _) in stk.edge_classes
+    ) + 8 * stk.leaf_idx.size
+    return chi + a_stack + tables
+
+
+def entropy_chunk_bytes(stk) -> int:
+    """Working set of one grouped entropy chunk
+    (``EntropyCellExec.fixed_point_chunk``): the chi stack double-buffered
+    (the chunk donates its carry, so old + new are both live at the swap),
+    the resident stack above, plus the widest degree class's DP scratch
+    ``[G, Ed, K, M]`` (classes run sequentially inside a sweep, so the
+    scratch peak is the max over classes, not the sum)."""
+    import numpy as np
+
+    G, K = stk.G, stk.K
+    itemsize = np.dtype(stk.dtype).itemsize
+    chi = G * (stk.twoE_max + 1) * K * K * itemsize
+    scratch = max(
+        (G * idx.shape[1] * K * A.shape[-1] * itemsize
+         for (_, idx, _, A) in stk.edge_classes),
+        default=0,
+    )
+    return stacked_bdcm_bytes(stk) + chi + scratch
+
+
+# ---------------------------------------------------------------------------
+# device stats
+# ---------------------------------------------------------------------------
+
+
+def device_memory_stats(device=None) -> tuple[dict | None, str | None]:
+    """``(stats, None)`` from ``device.memory_stats()``, or ``(None,
+    reason)`` when the backend exposes none — the CPU container's devices
+    HAVE the method but return None, and both shapes get an explicit
+    reason (the null+reason contract: a skip must be unmistakable from a
+    measured 0)."""
+    import jax
+
+    device = device or jax.local_devices()[0]
+    fn = getattr(device, "memory_stats", None)
+    if fn is None:
+        return None, (
+            f"backend {device.platform!r} devices expose no memory_stats()"
+        )
+    try:
+        stats = fn()
+    except Exception as e:  # noqa: BLE001 — telemetry must not kill the run
+        return None, (
+            f"memory_stats() failed on backend {device.platform!r}: "
+            f"{str(e)[:120]}"
+        )
+    if not stats:
+        return None, (
+            f"backend {device.platform!r} memory_stats() returned none "
+            "(host-memory backend)"
+        )
+    return stats, None
+
+
+def emit_memory_gauges(**attrs) -> None:
+    """Emit ``obs.mem.bytes_in_use`` / ``obs.mem.peak`` gauges from the
+    default device's memory stats — the per-chunk call of the pipeline
+    loops and the sharded rollout drivers. Free when not recording (one
+    enabled check); on a stats-less backend emits ONE
+    ``obs.mem.unavailable`` gauge per recording scope carrying the
+    reason."""
+    from graphdyn import obs
+
+    if not obs.enabled():
+        return
+    stats, reason = device_memory_stats()
+    if stats is None:
+        # once per recording scope: the marker lives ON the recorder (an
+        # id()-keyed module global could alias a later scope's recorder at
+        # a recycled address and silently swallow its reason gauge)
+        rec = obs.current()
+        if not getattr(rec, "_memband_unavailable_warned", False):
+            rec._memband_unavailable_warned = True
+            obs.gauge("obs.mem.unavailable", 1, reason=reason, **attrs)
+        return
+    if "bytes_in_use" in stats:
+        obs.gauge("obs.mem.bytes_in_use", int(stats["bytes_in_use"]), **attrs)
+    if "peak_bytes_in_use" in stats:
+        obs.gauge("obs.mem.peak", int(stats["peak_bytes_in_use"]), **attrs)
+
+
+def peak_hbm_bytes() -> tuple[int | None, str | None]:
+    """``(peak_bytes_in_use, None)`` or ``(None, reason)`` — the bench.py
+    row column (null + reason on CPU, never silent)."""
+    stats, reason = device_memory_stats()
+    if stats is None:
+        return None, reason
+    peak = stats.get("peak_bytes_in_use")
+    if peak is None:
+        return None, "memory_stats() carries no peak_bytes_in_use"
+    return int(peak), None
+
+
+# ---------------------------------------------------------------------------
+# memcheck
+# ---------------------------------------------------------------------------
+
+
+class MemRow(NamedTuple):
+    program: str
+    measured: int | None    # peak bytes (None: stats unavailable + reason)
+    model: float            # modeled bytes
+    frac: float | None      # measured / model
+    lo: float
+    hi: float
+    reason: str | None      # why measured is None (the structural pass)
+
+    @property
+    def ok(self) -> bool:
+        # a stats-less backend passes STRUCTURALLY: the row exists, names
+        # its reason, and the band goes live the first round with stats
+        if self.frac is None:
+            return self.reason is not None
+        return self.lo <= self.frac <= self.hi
+
+
+def _row(program: str, measured: int | None, model: float,
+         reason: str | None = None) -> MemRow:
+    lo, hi = MEM_BANDS[program]
+    frac = (measured / model) if (measured is not None and model) else None
+    return MemRow(program, measured, model, frac, lo, hi, reason)
+
+
+def _smoke_exec(n: int = 1024, c: float = 3.0, G: int = 4):
+    """The grouped entropy smoke program, built by roofline's SHARED
+    builder (so the rate rows and these memory rows measure the same
+    program) and run for one chunk so the peak includes it."""
+    import numpy as np
+
+    from graphdyn.obs.roofline import _entropy_smoke_exec, _entropy_smoke_state
+
+    ex, cells = _entropy_smoke_exec(n=n, c=c, G=G, chunk_sweeps=8)
+    chi, lm, active, delta, t = _entropy_smoke_state(ex, cells, G)
+    chi, t, delta = ex.fixed_point_chunk(chi, lm, active, delta, t)
+    np.asarray(t)                       # drain: the peak includes the chunk
+    return ex
+
+
+def run_memcheck(*, diag=None) -> list[MemRow]:
+    """Measure every modeled program's device-memory peak against its band
+    — or, on a stats-less backend, emit the structural null+reason rows
+    without running anything (the models still evaluate, so a model-code
+    regression fails here even on CPU). Returns the rows; callers gate on
+    ``row.ok``."""
+    stats, reason = device_memory_stats()
+    if stats is None:
+        # structural pass: models evaluated at the smoke shapes, measured
+        # explicitly unavailable with the backend's reason
+        from graphdyn.ops.bdcm import stack_bdcm
+        from graphdyn.obs.roofline import _bdcm_instance
+
+        n, d, W = 32768, 3, 8
+        stk = stack_bdcm([
+            _bdcm_instance(1024, 3.0, seed=10 + k)[0] for k in range(4)
+        ])
+        rows = [
+            _row("packed_state", None, packed_state_bytes(n, d, W), reason),
+            _row("bdcm_stack", None, stacked_bdcm_bytes(stk), reason),
+            _row("entropy_cell_chunk", None, entropy_chunk_bytes(stk),
+                 reason),
+        ]
+    else:
+        rows = [_measure_packed(), *_measure_bdcm_rows()]
+    from graphdyn import obs
+
+    for row in rows:
+        obs.gauge(f"obs.memband.{row.program}", row.measured,
+                  model=row.model, frac=row.frac, ok=row.ok,
+                  **({"reason": row.reason} if row.reason else {}))
+        if diag:
+            if row.measured is None:
+                diag(f"memcheck: {row.program}: model {row.model:.3e} B, "
+                     f"measured null ({row.reason}) — structural pass")
+            else:
+                verdict = "ok" if row.ok else "OUT OF BAND"
+                diag(f"memcheck: {row.program}: measured peak "
+                     f"{row.measured:.3e} B, model {row.model:.3e} B -> "
+                     f"frac {row.frac:.3f} (band [{row.lo:g}, {row.hi:g}]) "
+                     f"{verdict}")
+    return rows
+
+
+def _measure_packed(*, n: int = 32768, d: int = 3, W: int = 8,
+                    steps: int = 8) -> MemRow:
+    """Peak bytes through the packed-rollout smoke (roofline's SHARED
+    builder — same program as the rate row)."""
+    from graphdyn.obs.roofline import _packed_smoke
+
+    f, sp = _packed_smoke(n=n, d=d, W=W, steps=steps)
+    sp = f(sp)
+    sp.block_until_ready()
+    peak, reason = peak_hbm_bytes()
+    return _row("packed_state", peak, packed_state_bytes(n, d, W), reason)
+
+
+def _measure_bdcm_rows() -> list[MemRow]:
+    """Peak bytes through the grouped entropy chunk, against both BDCM
+    models (resident stack floor AND chunk working set — one program, two
+    calibration anchors)."""
+    ex = _smoke_exec()
+    peak, reason = peak_hbm_bytes()
+    return [
+        _row("bdcm_stack", peak, stacked_bdcm_bytes(ex.stk), reason),
+        _row("entropy_cell_chunk", peak, entropy_chunk_bytes(ex.stk),
+             reason),
+    ]

@@ -48,6 +48,9 @@ import os
 import threading
 import time
 
+from graphdyn.obs import flight as _flight
+from graphdyn.obs import trace as _trace
+
 _MONO = time.monotonic
 _CPU = time.process_time
 
@@ -96,7 +99,7 @@ class Span:
     always-measuring :func:`graphdyn.obs.timed` handle."""
 
     __slots__ = ("rec", "name", "attrs", "id", "parent", "t0",
-                 "_c0", "wall_s", "cpu_s", "_open")
+                 "_c0", "wall_s", "cpu_s", "_open", "_ann")
 
     def __init__(self, rec, name: str, attrs: dict):
         self.rec = rec
@@ -109,6 +112,7 @@ class Span:
         self.wall_s = 0.0
         self.cpu_s = 0.0
         self._open = False
+        self._ann = None
 
     def set(self, **attrs) -> "Span":
         self.attrs.update(attrs)
@@ -117,6 +121,12 @@ class Span:
     def start(self) -> "Span":
         if self.rec is not None:
             self.id, self.parent = self.rec._push_span()
+        # device-profiler alignment: while a jax.profiler trace is being
+        # captured (obs.trace.profiling), the span also opens a
+        # TraceAnnotation named with its ledger name PATH, so the device
+        # timeline and the JSONL ledger share one vocabulary
+        if _trace.active():
+            self._ann = _trace.push(self.name)
         self._open = True
         self._c0 = _CPU()
         self.t0 = _MONO()
@@ -128,6 +138,9 @@ class Span:
         self.wall_s = _MONO() - self.t0
         self.cpu_s = _CPU() - self._c0
         self._open = False
+        if self._ann is not None:
+            _trace.pop(self._ann)
+            self._ann = None
         if self.rec is not None:
             self.rec._pop_span(self)
         return self
@@ -143,17 +156,34 @@ class Span:
 class NullRecorder:
     """The default: does nothing, costs (almost) nothing. Hot paths hold the
     module-level accessor and pay one attribute check (``rec.enabled``) plus
-    — for ``span`` — one shared-object return per site."""
+    — for ``span`` — one shared-object return per site.
+
+    Two always-on device-side hooks live *behind* the null object (both
+    off the hot path's allocation budget):
+
+    - while a :func:`graphdyn.obs.trace.profiling` capture is active,
+      ``span()`` returns a measuring (non-emitting) :class:`Span` so the
+      device timeline still gets the ledger-vocabulary trace annotations;
+    - counter/gauge events are forwarded into the bounded flight-recorder
+      ring (:mod:`graphdyn.obs.flight`) so a crash without a ledger is
+      still diagnosable post-mortem. ``GRAPHDYN_FLIGHT=0`` disarms it.
+    """
 
     enabled = False
 
     def span(self, name: str, **attrs):
+        if _trace.active():
+            return Span(None, name, attrs)
         return NULL_SPAN
 
     def counter(self, name: str, inc: int = 1, **attrs) -> None:
+        if _flight.armed():
+            _flight.record_counter(name, inc, attrs)
         return None
 
     def gauge(self, name: str, value, **attrs) -> None:
+        if _flight.armed():
+            _flight.record_gauge(name, value, attrs)
         return None
 
     def manifest(self, **fields):

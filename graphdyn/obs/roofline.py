@@ -36,6 +36,12 @@ Byte models (f32; K = 2**T, M = (d+1)**T):
 ``run_obscheck`` is wired into ``scripts/lint.sh`` (the ``obscheck`` step,
 ``GRAPHDYN_SKIP_OBSCHECK=1`` to skip); when a recorder is active each
 measured rate is also emitted as an ``obs.roofline.<program>`` gauge.
+
+On a TPU backend the check switches anchors: :data:`CHIP_BANDS` pins the
+chip's published HBM bandwidth (v5e: 819 GB/s) as the model divisor —
+fixed by the part number, not measured — against the same byte models,
+with its own committed bands. Inert on this container (CPU), live the
+first chip round, no code change in between (ROADMAP item 5 remainder).
 """
 
 from __future__ import annotations
@@ -54,6 +60,56 @@ BANDS: dict[str, tuple[float, float]] = {
     "bdcm_sweep": (0.004, 1.0),
     "entropy_cell_chunk": (0.002, 1.0),
 }
+
+#: chip-roofline anchors keyed by TPU device kind (substring match against
+#: ``Device.device_kind``) — the ROADMAP item 5 remainder: the per-segment
+#: rate gauges grow chip bands the moment a chip round runs this check,
+#: with no code change. Each entry pins the chip's published HBM stream
+#: bandwidth as the model divisor (v5e: 819 GB/s — the anchor does NOT
+#: move with the machine, unlike the CPU proxy's measured host bandwidth:
+#: on a chip the part number pins the roof) against the SAME byte models.
+#: PROVISIONAL seeds, inert until a chip round persists rows: lo is set
+#: where an HBM-streaming kernel cannot honestly fall below (the packed
+#: kernel measured 0.11 of the v4 HBM roof in round r02 — v5e lo keeps a
+#: decade under that), hi > 1 because the BDCM Pallas kernel holds its DP
+#: lattice in VMEM and legitimately beats the HBM streaming model. The
+#: first chip round re-centers them (update workflow: ARCHITECTURE.md).
+_V5E_PROFILE: dict = {
+    "hbm_bytes_per_s": 819e9,
+    "bands": {
+        "packed_rollout": (0.01, 2.0),
+        "bdcm_sweep": (0.002, 4.0),
+        "entropy_cell_chunk": (0.001, 4.0),
+    },
+}
+
+CHIP_BANDS: dict[str, dict] = {
+    "v5e": _V5E_PROFILE,
+    # v5 lite is the device_kind string some runtimes report for v5e —
+    # same physical part, ONE shared profile (a recalibration edit cannot
+    # fork the two keys)
+    "v5 lite": _V5E_PROFILE,
+}
+
+
+def chip_profile() -> tuple[str, float, dict] | None:
+    """``(kind_key, hbm_bytes_per_s, bands)`` for the current backend's
+    :data:`CHIP_BANDS` entry, or None when the backend has no chip anchor
+    (CPU container: the measured-host-bandwidth proxy bands apply). A TPU
+    backend whose device kind has no committed entry also returns None —
+    an uncalibrated chip must not borrow another part's roof, and
+    ``run_obscheck`` passes it STRUCTURALLY (the host-proxy bands are
+    calibrated for host rates; gating chip rates against them would go
+    red on every uncalibrated part with no blessing path)."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return None
+    kind = jax.local_devices()[0].device_kind.lower()
+    for key, prof in CHIP_BANDS.items():
+        if key in kind:
+            return key, prof["hbm_bytes_per_s"], prof["bands"]
+    return None
 
 
 def packed_bytes_per_update(d: int) -> float:
@@ -100,23 +156,24 @@ class RooflineRow(NamedTuple):
         return self.lo <= self.frac <= self.hi
 
 
-def _row(program: str, measured: float, model: float, unit: str) -> RooflineRow:
-    lo, hi = BANDS[program]
+def _row(program: str, measured: float, model: float, unit: str,
+         bands: dict | None = None) -> RooflineRow:
+    lo, hi = (bands or BANDS)[program]
     return RooflineRow(program, measured, model,
                        measured / model if model else 0.0, lo, hi, unit)
 
 
-def measure_packed(bw: float, *, n: int = 32768, d: int = 3, W: int = 8,
-                   steps: int = 8, iters: int = 3) -> RooflineRow:
-    """The packed-rollout CPU proxy at a smoke shape (chained, donated —
-    the ``bench.py`` timing discipline)."""
+def _packed_smoke(*, n: int = 32768, d: int = 3, W: int = 8,
+                  steps: int = 8):
+    """``(f, sp)``: the jit-donated packed-rollout smoke program + its
+    initial packed state — ONE builder, shared with
+    :mod:`graphdyn.obs.memband` so the rate rows and the memory rows
+    measure the same program."""
     import jax
     import jax.numpy as jnp
 
     from graphdyn.graphs import random_regular_graph
     from graphdyn.ops.packed import packed_rollout
-
-    from graphdyn import obs
 
     g = random_regular_graph(n, d, seed=0)
     nbr = jnp.asarray(g.nbr)
@@ -125,6 +182,17 @@ def measure_packed(bw: float, *, n: int = 32768, d: int = 3, W: int = 8,
     sp = jnp.array(rng.integers(0, 2 ** 32, (n, W), dtype=np.uint32))
     f = jax.jit(lambda s: packed_rollout(nbr, deg, s, steps),
                 donate_argnums=0)
+    return f, sp
+
+
+def measure_packed(bw: float, *, n: int = 32768, d: int = 3, W: int = 8,
+                   steps: int = 8, iters: int = 3,
+                   bands: dict | None = None) -> RooflineRow:
+    """The packed-rollout CPU proxy at a smoke shape (chained, donated —
+    the ``bench.py`` timing discipline)."""
+    from graphdyn import obs
+
+    f, sp = _packed_smoke(n=n, d=d, W=W, steps=steps)
     sp = f(sp)
     sp.block_until_ready()
     with obs.timed("obs.roofline.packed_rollout", n=n, d=d, W=W) as sw:
@@ -133,7 +201,7 @@ def measure_packed(bw: float, *, n: int = 32768, d: int = 3, W: int = 8,
         sp.block_until_ready()
     rate = n * W * 32 * steps * iters / sw.wall_s
     return _row("packed_rollout", rate, bw / packed_bytes_per_update(d),
-                "spin-updates/s")
+                "spin-updates/s", bands)
 
 
 def _bdcm_instance(n: int, c: float, seed: int):
@@ -157,7 +225,7 @@ def _bdcm_model_rate(data, bw: float) -> float:
 
 
 def measure_bdcm(bw: float, *, n: int = 2048, c: float = 3.0,
-                 sweeps: int = 20) -> RooflineRow:
+                 sweeps: int = 20, bands: dict | None = None) -> RooflineRow:
     """The serial XLA sweep core at a smoke ER instance."""
     import jax.numpy as jnp
 
@@ -177,32 +245,53 @@ def measure_bdcm(bw: float, *, n: int = 2048, c: float = 3.0,
         chi.block_until_ready()
     rate = data.num_directed * sweeps / sw.wall_s
     return _row("bdcm_sweep", rate, _bdcm_model_rate(data, bw),
-                "edge-sweeps/s")
+                "edge-sweeps/s", bands)
 
 
-def measure_entropy_chunk(bw: float, *, n: int = 1024, c: float = 3.0,
-                          G: int = 4, chunk_sweeps: int = 16,
-                          chunks: int = 2) -> RooflineRow:
-    """The grouped entropy cell chunk (``EntropyCellExec``) at a smoke
-    cell group — the program the grouped ``entropy_grid`` default runs."""
-    import jax.numpy as jnp
-
+def _entropy_smoke_exec(*, n: int = 1024, c: float = 3.0, G: int = 4,
+                        chunk_sweeps: int = 16):
+    """``(ex, cells)``: the grouped entropy smoke program
+    (``EntropyCellExec`` at the roofline shapes) — ONE builder, shared with
+    :mod:`graphdyn.obs.memband` so the rate rows and the memory rows
+    measure the same program."""
     from graphdyn.config import DynamicsConfig, EntropyConfig
     from graphdyn.pipeline.entropy_group import EntropyCellExec
-
-    from graphdyn import obs
 
     cfg = EntropyConfig(dynamics=DynamicsConfig(p=1, c=1), eps=0.0,
                         max_sweeps=10 ** 9, damp=0.1)
     cells = [_bdcm_instance(n, c, seed=10 + k) for k in range(G)]
     ex = EntropyCellExec(cells, cfg, group_size=G,
                          chunk_sweeps=chunk_sweeps, kernel="xla")
+    return ex, cells
+
+
+def _entropy_smoke_state(ex, cells, G: int):
+    """The chunk-loop initial carry for :func:`_entropy_smoke_exec`'s
+    program: ``(chi, lm, active, delta, t)``."""
+    import jax.numpy as jnp
+
     chi = ex.stack_chi([cell[0].init_messages(k) for k, cell in
                         enumerate(cells)])
     lm = jnp.full((G,), 0.3, ex.dtype)
     active = jnp.ones((G,), bool)
     delta = jnp.full((G,), jnp.inf, ex.dtype)
     t = jnp.zeros((G,), jnp.int32)
+    return chi, lm, active, delta, t
+
+
+def measure_entropy_chunk(bw: float, *, n: int = 1024, c: float = 3.0,
+                          G: int = 4, chunk_sweeps: int = 16,
+                          chunks: int = 2,
+                          bands: dict | None = None) -> RooflineRow:
+    """The grouped entropy cell chunk (``EntropyCellExec``) at a smoke
+    cell group — the program the grouped ``entropy_grid`` default runs."""
+    import jax.numpy as jnp
+
+    from graphdyn import obs
+
+    ex, cells = _entropy_smoke_exec(n=n, c=c, G=G,
+                                    chunk_sweeps=chunk_sweeps)
+    chi, lm, active, delta, t = _entropy_smoke_state(ex, cells, G)
     chi, t, delta = ex.fixed_point_chunk(chi, lm, active, delta, t)  # warm
     np.asarray(t)
     t = jnp.zeros((G,), jnp.int32)
@@ -217,23 +306,49 @@ def measure_entropy_chunk(bw: float, *, n: int = 1024, c: float = 3.0,
     work = float(np.sum(np.asarray(ex.stk.twoE)[:G] * np.asarray(t)))
     rate = work / sw.wall_s
     model = _bdcm_model_rate(cells[0][0], bw)
-    return _row("entropy_cell_chunk", rate, model, "edge-sweeps/s")
+    return _row("entropy_cell_chunk", rate, model, "edge-sweeps/s", bands)
 
 
 def run_obscheck(*, diag=None) -> list[RooflineRow]:
     """Measure every headline program against its band; emits one
     ``obs.roofline.<program>`` gauge per row when recording. Returns the
     rows — callers gate on ``row.ok``."""
+    import jax
+
     from graphdyn import obs
 
-    bw = host_stream_bandwidth()
-    if diag:
-        diag(f"obscheck: host stream bandwidth {bw / 1e9:.2f} GB/s")
-    rows = [measure_packed(bw), measure_bdcm(bw), measure_entropy_chunk(bw)]
+    chip = chip_profile()
+    if chip is not None:
+        kind, bw, bands = chip
+        anchor = f"chip:{kind}"
+        if diag:
+            diag(f"obscheck: chip roofline {kind}: HBM {bw / 1e9:.0f} GB/s "
+                 "(committed anchor)")
+    elif jax.default_backend() == "tpu":
+        # a TPU kind with no committed CHIP_BANDS entry: the host-proxy
+        # bandwidth + CPU-calibrated bands are meaningless for chip rates
+        # (frac would blow past hi on every uncalibrated part, red gate,
+        # no blessing path) — pass STRUCTURALLY with an explicit reason,
+        # the memcheck null+reason contract; seed CHIP_BANDS to go live
+        kind = jax.local_devices()[0].device_kind
+        obs.gauge("obs.roofline.uncalibrated", 1, device_kind=kind)
+        if diag:
+            diag(f"obscheck: TPU device_kind {kind!r} has no committed "
+                 "chip anchor (CHIP_BANDS) — structural pass; seed bands "
+                 "for this part to go live")
+        return []
+    else:
+        bands = None
+        anchor = "host-proxy"
+        bw = host_stream_bandwidth()
+        if diag:
+            diag(f"obscheck: host stream bandwidth {bw / 1e9:.2f} GB/s")
+    rows = [measure_packed(bw, bands=bands), measure_bdcm(bw, bands=bands),
+            measure_entropy_chunk(bw, bands=bands)]
     for row in rows:
         obs.gauge(f"obs.roofline.{row.program}", row.measured,
                   model=row.model, frac=row.frac, unit=row.unit,
-                  ok=row.ok)
+                  ok=row.ok, anchor=anchor)
         if diag:
             verdict = "ok" if row.ok else "OUT OF BAND"
             diag(

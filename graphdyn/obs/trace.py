@@ -1,0 +1,136 @@
+"""Aligned device-profiler capture (ARCHITECTURE.md "Runtime telemetry" →
+device-side eyes).
+
+The JSONL event ledger (:mod:`graphdyn.obs.recorder`) answers *where host
+time went*; a ``jax.profiler`` trace answers *what the device was doing*.
+Separately they cannot be joined — a chunk span whose ``wall_s ≫ cpu_s``
+says "the span waited on the device", and the device timeline says "some
+ops ran", but nothing ties the two together. This module makes them share
+ONE vocabulary:
+
+- :func:`profiling` starts/stops a ``jax.profiler`` trace around a scope
+  (CLI ``--profile DIR`` on every command, ``GRAPHDYN_PROFILE=DIR`` env —
+  mirroring ``--obs-ledger``/``GRAPHDYN_OBS``). The capture lands under
+  ``DIR/plugins/profile/<ts>/`` (TensorBoard profile tab / Perfetto /
+  the ``*.trace.json.gz`` chrome-trace dump).
+- While profiling is active, every :class:`graphdyn.obs.recorder.Span`
+  additionally opens a ``jax.profiler.TraceAnnotation`` named with the
+  span's ledger **name path** (the ``report.py`` vocabulary:
+  ``"run > pipeline.entropy.chunk"``) — so a ledger span and its slice of
+  the device timeline carry the SAME name, and a chunk's wall≫cpu gap can
+  be attributed to the actual device ops under the like-named annotation.
+- The name path comes from a thread-local name stack maintained here
+  (pushed/popped by ``Span.start``/``Span.stop``), so it works with or
+  without a recorder installed: profiling without a ledger still names
+  the timeline, and profiling + ledger yields matching vocabularies
+  (tested against the profiler's trace-event output).
+
+When profiling is OFF (the default), the hot path pays one module-global
+``is None`` check per span and allocates nothing — the null-recorder
+contract is untouched (regression-tested). graftlint **GD012** keeps bare
+``jax.profiler`` calls out of the rest of the repo so this alignment is
+the one profiling idiom.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+ENV_VAR = "GRAPHDYN_PROFILE"
+
+#: separator joining span names into a path — MUST match the ledger
+#: report's aggregation key (graphdyn.obs.report.summarize)
+PATH_SEP = " > "
+
+_DIR: str | None = None
+_local = threading.local()
+
+
+def active() -> bool:
+    """True while a :func:`profiling` scope is capturing — span sites open
+    trace annotations only then (one global check otherwise)."""
+    return _DIR is not None
+
+
+def trace_dir() -> str | None:
+    """The active capture directory (None when not profiling)."""
+    return _DIR
+
+
+def _stack() -> list:
+    st = getattr(_local, "names", None)
+    if st is None:
+        st = _local.names = []
+    return st
+
+
+def current_path(name: str) -> str:
+    """The annotation name ``name`` would get right now on this thread —
+    the enclosing span names joined the way the ledger report joins them."""
+    return PATH_SEP.join([*_stack(), name])
+
+
+def push(name: str):
+    """Open a ``TraceAnnotation`` for a span entering ``name`` (called by
+    ``Span.start`` when :func:`active`). Returns the annotation handle for
+    :func:`pop`."""
+    import jax
+
+    path = current_path(name)
+    _stack().append(name)
+    ann = jax.profiler.TraceAnnotation(path)
+    ann.__enter__()
+    return ann
+
+
+def pop(ann) -> None:
+    """Close a span's annotation (called by ``Span.stop``). LIFO by
+    construction for ``with``-block spans; an abandoned imperative child
+    (stop skipped by an exception) costs at worst a mislabeled path suffix
+    on this thread's remaining annotations, never a crash."""
+    st = _stack()
+    if st:
+        st.pop()
+    ann.__exit__(None, None, None)
+
+
+@contextlib.contextmanager
+def profiling(logdir: str | None = None):
+    """Capture a ``jax.profiler`` trace of the scope into ``logdir``.
+
+    ``logdir=None`` falls back to the ``GRAPHDYN_PROFILE`` environment
+    variable; when that is unset too the scope is a no-op (the common
+    case — zero cost). Yields the active directory or None.
+
+    Nested ``profiling`` scopes are an error only when both name a
+    directory (one device trace per run — the profiler is a process-global
+    singleton); re-entering with no directory inside an active scope keeps
+    the outer capture, mirroring :func:`graphdyn.obs.recording`.
+    """
+    global _DIR
+    if logdir is None and _DIR is None:
+        # the env fallback applies only when nothing is capturing yet: a
+        # dir-less re-entry inside an active scope must keep the outer
+        # capture even when GRAPHDYN_PROFILE is set (it named the OUTER
+        # trace), not trip the two-directory error below
+        logdir = os.environ.get(ENV_VAR) or None
+    if logdir is None or _DIR is not None:
+        if logdir is not None and _DIR is not None:
+            raise RuntimeError(
+                "nested obs.trace.profiling() with an explicit directory — "
+                f"one device trace per run (active: {_DIR!r})"
+            )
+        yield _DIR
+        return
+    import jax
+
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    _DIR = logdir
+    try:
+        yield logdir
+    finally:
+        _DIR = None
+        jax.profiler.stop_trace()

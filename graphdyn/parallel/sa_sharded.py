@@ -565,6 +565,18 @@ def sa_sharded(
 
     def advance(st):
         out = chunk_fn(nbr_dev, *st, *consts)   # (s|traj, mag, key, a, b, ...)
+        from graphdyn import obs
+
+        if obs.enabled():
+            # per-chunk device-memory gauges for the sharded rollout
+            # (obs.mem.*; explicit unavailable+reason on stats-less
+            # backends) — the mesh path's HBM occupancy row. Fenced like
+            # the grouped loops' sites: stats sampled while the chunk is
+            # still in flight would attribute residency one chunk late
+            import jax
+
+            jax.block_until_ready(out)
+            obs.memband.emit_memory_gauges(loop="sa_sharded.chunk")
         return (out[0], *out[2:])
 
     def still_active(st):

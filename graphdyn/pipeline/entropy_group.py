@@ -609,6 +609,10 @@ def run_cell_ladder(
                     ),
                     active=int(np.sum(active[:Gr])),
                 )
+        if rec.enabled:
+            # device-memory gauges at the chunk boundary (obs.mem.*)
+            obs.memband.emit_memory_gauges(loop="entropy.chunk",
+                                           chunk=chunk_i)
         chunk_i += 1
         t_h[active] = t_h_new[active]
         delta_h[active] = delta_h_new[active]
@@ -656,6 +660,12 @@ def run_cell_ladder(
                     "the cell's ladder", lmv, g, delta_h[g],
                 )
                 rec.counter("pipeline.sweep.nan", cell=g, lmbd=lmv)
+                # preserve the flight evidence at the poison (see the
+                # serial ladder's dump site in models/entropy.py)
+                from graphdyn.obs import flight
+
+                flight.dump("sweep.nan",
+                            site=f"entropy cell={g} lambda={lmv:g}")
             if failed:
                 nonconv[g] = lmv
             rec.counter("pipeline.lambda.boundary", cell=g, lmbd=lmv,
@@ -702,7 +712,7 @@ def run_cell_ladder(
         if boundary is not None and (fired or stopping):
             boundary(stopping, info_active())
         if stopping:
-            raise_if_requested()
+            raise_if_requested(where="lambda")
         for g, lmv in fired:
             _faults.maybe_fail("lambda.boundary", key=f"lmbd={lmv:g}")
 

@@ -96,7 +96,7 @@ class GroupDriver:
             if self.pc is not None:
                 self.pc.save_now(self.payload(), {**self.run_id,
                                                   "next_rep": next_rep})
-            raise_if_requested()
+            raise_if_requested(where="chunk")
 
     def rep_boundary(self, k: int) -> None:
         """After repetition ``k``'s results land in the driver arrays:
@@ -122,7 +122,7 @@ class GroupDriver:
             if self.pc is not None:
                 self.pc.save_now(self.payload(), {**self.run_id,
                                                   "next_rep": k + 1})
-            raise_if_requested()
+            raise_if_requested(where="rep")
 
     def finish(self) -> None:
         if self.ck is not None:

@@ -502,6 +502,10 @@ class HPRGroupExec:
                     jax.block_until_ready(state)
                     sp.set(sweeps_advanced=int(state.t) - t_start,
                            active=int(np.sum(np.asarray(state.active))))
+            if rec.enabled:
+                # device-memory gauges at the chunk boundary (obs.mem.*)
+                obs.memband.emit_memory_gauges(loop="hpr.chunk",
+                                               chunk=chunk_i)
             chunk_i += 1
             if on_chunk is not None:
                 on_chunk()

@@ -308,6 +308,10 @@ def run_sa_group(
                 jax.block_until_ready(state)
                 sp.set(steps_advanced=int(state.chunk_t),
                        active=int(np.sum(np.asarray(state.active))))
+        if rec.enabled:
+            # device-memory gauges at the chunk boundary (obs.mem.*;
+            # one explicit unavailable+reason gauge on stats-less backends)
+            obs.memband.emit_memory_gauges(loop="sa.chunk", chunk=chunk_i)
         chunk_i += 1
         if on_chunk is not None:
             on_chunk()

@@ -38,14 +38,18 @@ EX_TEMPFAIL = 75
 
 class ShutdownRequested(Exception):
     """Raised by a driver at its chunk boundary after the shutdown snapshot
-    is on disk. Carries ``signum`` for logging; the CLI maps it to exit
+    is on disk. Carries ``signum`` for logging and ``where`` (the boundary
+    that honored the signal — chunk/rep/λ) so the flight recorder's
+    post-mortem can name the preemption site; the CLI maps it to exit
     code :data:`EX_TEMPFAIL`."""
 
-    def __init__(self, signum: int | None = None):
+    def __init__(self, signum: int | None = None, where: str | None = None):
         self.signum = signum
+        self.where = where
         name = signal.Signals(signum).name if signum else "request"
         super().__init__(
-            f"graceful shutdown on {name}: checkpointed at chunk boundary"
+            f"graceful shutdown on {name}: checkpointed at "
+            f"{where or 'chunk'} boundary"
         )
 
 
@@ -76,12 +80,13 @@ def clear_shutdown() -> None:
     _signum[0] = None
 
 
-def raise_if_requested() -> None:
+def raise_if_requested(where: str | None = None) -> None:
     """Raise :class:`ShutdownRequested` if a shutdown is pending — for
     boundaries that have nothing to save (e.g. a driver whose in-flight
-    chain already snapshotted)."""
+    chain already snapshotted). ``where`` names the boundary for the
+    post-mortem (chunk/rep/lambda)."""
     if _flag.is_set():
-        raise ShutdownRequested(_signum[0])
+        raise ShutdownRequested(_signum[0], where=where)
 
 
 @contextlib.contextmanager

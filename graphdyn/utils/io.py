@@ -105,9 +105,17 @@ def write_json_atomic(path: str, doc, **dump_kwargs) -> None:
     """JSON result file via temp + ``os.replace`` — same torn-write
     discipline as the npz writers (GD007 flags direct ``open(…, "w")``
     persistence elsewhere in the package)."""
+    write_text_atomic(path, json.dumps(doc, **dump_kwargs))
+
+
+def write_text_atomic(path: str, text: str) -> None:
+    """Whole-file text write via temp + ``os.replace`` — one copy of the
+    atomic-write idiom: the JSON writer above delegates here, and the
+    flight recorder's post-mortem JSONL dump goes through this so a crash
+    *during the crash dump* can never leave a torn ledger."""
     tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(doc, f, **dump_kwargs)
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(text)
     os.replace(tmp, path)
 
 
@@ -339,7 +347,7 @@ class ChainCheckpointer:
                             "— resume will fall back to the last periodic "
                             "checkpoint (if any)", self.path,
                         )
-                    raise_if_requested()
+                    raise_if_requested(where="chunk")
                 elif self.due():
                     self.maybe_save(payload(state))
         self.remove()

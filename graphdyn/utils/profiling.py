@@ -8,9 +8,12 @@ an always-measuring span whose event also lands in the JSONL ledger when a
 recorder is active — and graftlint GD011 keeps bare ``time.time()``/
 ``time.perf_counter()`` brackets out of the driver modules. ``StepTimer``
 and ``wall_clock`` remain as **deprecated shims over that API** so old call
-sites keep working and their measurements now reach the ledger too;
-``device_trace`` (the jax.profiler wrapper) is not a timing idiom and stays.
-"""
+sites keep working and their measurements now reach the ledger too.
+``device_trace`` is now the same kind of shim over
+:func:`graphdyn.obs.trace.profiling` — the aligned capture additionally
+names the device timeline with the ledger's span paths, and graftlint
+GD012 keeps bare ``jax.profiler`` calls out of everything but the obs
+layer."""
 
 from __future__ import annotations
 
@@ -57,15 +60,17 @@ class StepTimer:
 
 @contextlib.contextmanager
 def device_trace(logdir: str):
-    """``with device_trace('/tmp/trace'):`` → jax.profiler trace of the block
-    (view in TensorBoard's profile tab or Perfetto)."""
-    import jax
+    """Deprecated shim over :func:`graphdyn.obs.trace.profiling`:
+    ``with device_trace('/tmp/trace'):`` still captures a jax.profiler
+    trace of the block (TensorBoard profile tab / Perfetto), and now any
+    obs span inside the block also opens a ledger-named TraceAnnotation —
+    the aligned-capture contract new code gets from
+    ``obs.trace.profiling`` / the CLI ``--profile`` flag directly."""
+    _deprecated("device_trace", "graphdyn.obs.trace.profiling")
+    from graphdyn.obs import trace
 
-    jax.profiler.start_trace(logdir)
-    try:
+    with trace.profiling(logdir):
         yield
-    finally:
-        jax.profiler.stop_trace()
 
 
 @contextlib.contextmanager

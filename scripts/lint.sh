@@ -125,7 +125,23 @@ else
     JAX_PLATFORMS=cpu python -m graphdyn.obs check --format=text || fail=1
 fi
 
-# 8. benchcheck — the benchmark's single-JSON-line contract, live (python
+# 8. memcheck — the device-memory bands (python -m graphdyn.obs memcheck):
+#    measured peak bytes against the ARCHITECTURE.md byte models (packed
+#    state, stacked-BDCM lattice incl. group-resident A, entropy chunk).
+#    On this CPU container memory_stats is unavailable, so every row is an
+#    explicit null + reason and the gate passes STRUCTURALLY — the bands
+#    go live the first chip round that runs it. Skipped with a notice when
+#    GRAPHDYN_SKIP_MEMCHECK=1 (set by the tier-1 lint-gate test: the same
+#    check runs in the suite proper via tests/test_obs_device.py — no
+#    double work; mirrors obscheck).
+if [ "${GRAPHDYN_SKIP_MEMCHECK:-0}" = "1" ]; then
+    echo "== memcheck: GRAPHDYN_SKIP_MEMCHECK=1 — SKIPPED (check runs in tier-1) =="
+else
+    echo "== memcheck (device-memory bands, python -m graphdyn.obs memcheck) =="
+    JAX_PLATFORMS=cpu python -m graphdyn.obs memcheck --format=text || fail=1
+fi
+
+# 9. benchcheck — the benchmark's single-JSON-line contract, live (python
 #    bench.py --smoke on the CPU backend): one line of JSON, a positive
 #    headline value, and a positive ensemble_rate row (the grouped-driver
 #    throughput the pipeline ships). A formatting regression here silently
@@ -216,6 +232,14 @@ else:
         else:
             print(f"benchcheck: fingerprints stable vs {path} "
                   f"({len(fp['entries'])} entries)")
+# the device-memory column: a positive peak, or an explicit null + reason
+# (CPU: no usable memory_stats) — never silently absent, never 0
+assert "peak_hbm_bytes" in row, "peak_hbm_bytes column absent"
+if row["peak_hbm_bytes"] is None:
+    assert row.get("peak_hbm_bytes_skipped_reason"), \
+        "null peak_hbm_bytes needs peak_hbm_bytes_skipped_reason"
+else:
+    assert row["peak_hbm_bytes"] > 0, row["peak_hbm_bytes"]
 # the obs ledger columns: a path + manifest hash, or an explicit null +
 # reason — never silently absent
 assert "obs_ledger" in row, "obs_ledger column absent"

@@ -670,6 +670,133 @@ class TestGD011BareTiming:
         assert "GD011" in RULES
 
 
+class TestGD012BareProfiler:
+    """Bare ``jax.profiler`` capture/annotation calls outside
+    ``graphdyn/obs/`` fork the device-timeline vocabulary away from the
+    event ledger's — the one profiling idiom is
+    ``graphdyn.obs.trace.profiling`` (CLI ``--profile``), whose span-named
+    ``TraceAnnotation``s keep the two aligned."""
+
+    DRIVER = "graphdyn/pipeline/driver.py"
+    BAD_START_STOP = (
+        "import jax\n"
+        "def run(logdir):\n"
+        "    jax.profiler.start_trace(logdir)\n"      # GD012
+        "    work()\n"
+        "    jax.profiler.stop_trace()\n"             # GD012
+    )
+    BAD_ANNOTATION = (
+        "import jax\n"
+        "def chunk(i):\n"
+        "    with jax.profiler.TraceAnnotation(f'chunk{i}'):\n"  # GD012
+        "        work(i)\n"
+    )
+    BAD_BARE_IMPORT = (
+        "from jax.profiler import start_trace, stop_trace\n"
+        "def run(logdir):\n"
+        "    start_trace(logdir)\n"                   # GD012
+        "    work()\n"
+        "    stop_trace()\n"                          # GD012
+    )
+    BAD_TRACE_CTX = (
+        "import jax\n"
+        "def run(logdir):\n"
+        "    with jax.profiler.trace(logdir):\n"      # GD012
+        "        work()\n"
+    )
+    BAD_BARE_DECORATOR = (
+        "import jax\n"
+        "@jax.profiler.annotate_function\n"           # GD012
+        "def step(x):\n"
+        "    return x + 1\n"
+    )
+    BAD_ALIASED_MODULE = (
+        "import jax.profiler as jp\n"
+        "def run(logdir):\n"
+        "    jp.start_trace(logdir)\n"                # GD012
+        "    work()\n"
+        "    jp.stop_trace()\n"                       # GD012
+    )
+    BAD_TRACE_FROM_IMPORT = (
+        "from jax.profiler import trace\n"            # GD012 (the import)
+        "def run(logdir):\n"
+        "    with trace(logdir):\n"
+        "        work()\n"
+    )
+    GOOD_OBS_TRACE = (
+        "from graphdyn import obs\n"
+        "def run(logdir):\n"
+        "    with obs.trace.profiling(logdir):\n"
+        "        with obs.span('run'):\n"
+        "            work()\n"
+    )
+
+    def test_bad_start_stop(self):
+        assert _codes(self.BAD_START_STOP, path=self.DRIVER).count(
+            "GD012") == 2
+
+    def test_bad_trace_annotation(self):
+        assert "GD012" in _codes(self.BAD_ANNOTATION, path=self.DRIVER)
+
+    def test_bad_bare_from_import(self):
+        assert _codes(self.BAD_BARE_IMPORT, path=self.DRIVER).count(
+            "GD012") == 2
+
+    def test_bad_trace_context_manager(self):
+        assert "GD012" in _codes(self.BAD_TRACE_CTX, path=self.DRIVER)
+
+    def test_bad_trace_from_import_flagged_at_import(self):
+        # the bare `trace` call can't be policed syntactically, so the
+        # `from jax.profiler import trace` statement itself is the gate
+        assert "GD012" in _codes(self.BAD_TRACE_FROM_IMPORT,
+                                 path=self.DRIVER)
+
+    def test_bad_aliased_module_import(self):
+        # `import jax.profiler as jp; jp.start_trace(...)` — the final
+        # attribute matches under any parent, so the alias can't hide it
+        assert _codes(self.BAD_ALIASED_MODULE, path=self.DRIVER).count(
+            "GD012") == 2
+
+    def test_bad_bare_decorator_form(self):
+        # @jax.profiler.annotate_function without parentheses is an
+        # Attribute in decorator_list, not a Call — must still be caught
+        assert "GD012" in _codes(self.BAD_BARE_DECORATOR, path=self.DRIVER)
+
+    def test_good_obs_trace_profiling(self):
+        assert _codes(self.GOOD_OBS_TRACE, path=self.DRIVER) == []
+
+    def test_bare_trace_name_not_flagged(self):
+        # `trace` is only matched dotted under `profiler` — the bare name
+        # is far too common (jaxprs, graph traces) to police syntactically
+        src = (
+            "def run(g):\n"
+            "    return trace(g)\n"
+        )
+        assert _codes(src, path=self.DRIVER) == []
+
+    def test_in_scope_everywhere_but_obs(self):
+        # unlike GD011's driver scope, GD012 polices ops/utils too: there
+        # is no legitimate private capture anywhere outside the obs layer
+        for path in ("graphdyn/ops/bdcm.py", "graphdyn/cli.py",
+                     "graphdyn/utils/helpers.py", "bench.py"):
+            assert "GD012" in _codes(self.BAD_START_STOP, path=path), path
+
+    def test_obs_layer_exempt(self):
+        for path in ("graphdyn/obs/trace.py", "graphdyn/obs/recorder.py"):
+            assert _codes(self.BAD_START_STOP, path=path) == [], path
+
+    def test_disable_comment(self):
+        src = self.BAD_ANNOTATION.replace(
+            "    with jax.profiler.TraceAnnotation(f'chunk{i}'):\n",
+            "    # graftlint: disable-next-line=GD012  profiler-internals test fixture\n"
+            "    with jax.profiler.TraceAnnotation(f'chunk{i}'):\n",
+        )
+        assert _codes(src, path=self.DRIVER) == []
+
+    def test_catalogued(self):
+        assert "GD012" in RULES
+
+
 class TestGD007AtomicPersistence:
     BAD_SAVEZ = (
         "import numpy as np\n"
@@ -846,7 +973,7 @@ def test_unreadable_file_is_a_finding(tmp_path):
 
 
 def test_rules_registry_complete():
-    assert set(RULES) == {f"GD{i:03d}" for i in range(1, 12)}
+    assert set(RULES) == {f"GD{i:03d}" for i in range(1, 13)}
 
 
 def test_cli_json_is_one_document_stdout_only(tmp_path):

@@ -78,6 +78,14 @@ def test_bench_smoke_emits_one_json_line():
         # round's own ledger
         spans = {e["name"] for e in events if e["ev"] == "span"}
         assert "bench.packed_rate" in spans and "bench.int8_rate" in spans
+    # the device-memory column: a positive peak, or an explicit null +
+    # reason (CPU: no usable memory_stats) — never silently absent,
+    # never a fake 0 (graphdyn.obs.memband.peak_hbm_bytes)
+    assert "peak_hbm_bytes" in row
+    if row["peak_hbm_bytes"] is None:
+        assert row["peak_hbm_bytes_skipped_reason"]
+    else:
+        assert row["peak_hbm_bytes"] > 0
     # the cross-round rate trend gate RAN (or was explicitly skipped) and
     # found no unblessed drift — the benchcheck contract
     status = row.get("obs_trend_status")

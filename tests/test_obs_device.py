@@ -1,0 +1,428 @@
+"""Device-side obs (PR-8): aligned profiler traces, memory bands, and the
+always-on flight recorder.
+
+Three contracts under test (ISSUE 8 acceptance criteria):
+
+- a profiled smoke run (``--profile`` + ``--obs-ledger``) produces a trace
+  directory whose annotation names match the ledger's span name-paths —
+  one vocabulary across the JSONL ledger and the device timeline;
+- ``python -m graphdyn.obs memcheck`` passes on this container with an
+  explicit null + reason per CPU-unavailable memory stat (the structural
+  pass that goes live the first chip round);
+- a crashed run with NO ``--obs-ledger`` leaves a parseable
+  ``obs_postmortem.jsonl`` whose last events name the failure site
+  (unhandled exception / ``sweep.nan`` degrade / SIGTERM→exit-75), while a
+  clean run leaves none and a recorded run keeps the evidence in its
+  ledger instead.
+"""
+
+import glob
+import gzip
+import json
+import os
+import tracemalloc
+
+import pytest
+
+from graphdyn import obs
+from graphdyn.cli import main
+from graphdyn.config import DynamicsConfig, EntropyConfig
+from graphdyn.graphs import erdos_renyi_graph
+from graphdyn.models.entropy import entropy_sweep
+from graphdyn.obs import flight, memband, trace
+from graphdyn.obs.recorder import read_ledger
+from graphdyn.obs.report import summarize
+from graphdyn.resilience import FaultPlan, FaultSpec
+from graphdyn.resilience.shutdown import EX_TEMPFAIL
+
+DYN11 = DynamicsConfig(p=1, c=1)
+
+SA_SMOKE = [
+    "sa", "--n", "40", "--d", "3", "--p", "1", "--c", "1",
+    "--n-stat", "1", "--seed", "0", "--max-steps", "2000",
+]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ring():
+    """Every test starts with an empty, default-capacity flight ring (the
+    ring is process-global by design — it must survive everything short of
+    the process)."""
+    flight.configure(flight.DEFAULT_CAPACITY)
+    flight.clear()
+    yield
+    flight.configure(flight.DEFAULT_CAPACITY)
+    flight.clear()
+
+
+def _postmortem_events(tmp_path):
+    path = tmp_path / flight.POSTMORTEM_NAME
+    assert path.exists(), "crash left no obs_postmortem.jsonl"
+    events, torn = read_ledger(str(path))
+    assert torn == 0                      # atomic dump: never a torn line
+    return events
+
+
+def _assert_crash_shape(events, reason):
+    """The post-mortem contract: manifest first (stamped postmortem),
+    ``obs.crash`` last, naming the failure."""
+    assert events[0]["ev"] == "manifest"
+    assert events[0]["run"]["postmortem"] is True
+    assert events[0]["run"]["reason"] == reason
+    last = events[-1]
+    assert last["ev"] == "counter" and last["name"] == "obs.crash"
+    assert last["attrs"]["reason"] == reason
+    return last["attrs"]
+
+
+# ---------------------------------------------------------------------------
+# aligned profiler capture: one vocabulary for ledger + device timeline
+# ---------------------------------------------------------------------------
+
+
+def test_profiled_smoke_annotations_match_ledger_paths(tmp_path, capsys):
+    """The acceptance smoke: ``--profile`` + ``--obs-ledger`` on a real CLI
+    run; every span name-path in the ledger appears verbatim as a trace
+    annotation in the profiler's trace-event output."""
+    pdir = str(tmp_path / "prof")
+    ledger = str(tmp_path / "run.jsonl")
+    out = str(tmp_path / "sa.npz")
+    rc = main(["--profile", pdir, "--obs-ledger", ledger,
+               *SA_SMOKE, "--out", out])
+    assert rc == 0
+    capsys.readouterr()
+
+    events, _ = read_ledger(ledger)
+    ledger_paths = set(summarize(events)["spans"])
+    assert "run" in ledger_paths          # at least the root span recorded
+
+    traces = glob.glob(os.path.join(pdir, "**", "*.trace.json.gz"),
+                       recursive=True)
+    assert traces, f"--profile produced no trace-event file under {pdir}"
+    annotation_names = set()
+    for t in traces:
+        doc = json.loads(gzip.open(t).read())
+        annotation_names |= {e.get("name") for e in doc.get("traceEvents", [])}
+    missing = ledger_paths - annotation_names
+    assert not missing, (
+        f"ledger span paths absent from the device trace: {missing} "
+        f"(vocabulary fork — obs.trace alignment broken)"
+    )
+
+
+def test_span_annotation_paths_via_capture_stub(monkeypatch, tmp_path):
+    """Unit-level alignment (no real profiler): nested spans open
+    annotations named with the ledger's ``" > "``-joined name paths, and
+    the name stack unwinds with the spans."""
+    import jax
+
+    captured = []
+
+    class StubAnnotation:
+        def __init__(self, name):
+            captured.append(name)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    monkeypatch.setattr(jax.profiler, "TraceAnnotation", StubAnnotation)
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+
+    with trace.profiling(str(tmp_path / "p")):
+        with obs.span("run"):
+            with obs.span("pipeline.sa.chunk"):
+                pass
+            with obs.span("pipeline.sa.chunk"):
+                pass
+    assert captured == [
+        "run",
+        "run > pipeline.sa.chunk",
+        "run > pipeline.sa.chunk",
+    ]
+    assert not trace.active()
+    # after the scope, spans are back to the one shared no-op object
+    from graphdyn.obs.recorder import NULL_SPAN
+
+    assert obs.span("x") is NULL_SPAN
+
+
+def test_profiling_scope_noop_without_dir(monkeypatch):
+    monkeypatch.delenv(trace.ENV_VAR, raising=False)
+    with trace.profiling() as d:
+        assert d is None and not trace.active()
+
+
+def test_nested_profiling_with_two_dirs_is_an_error(monkeypatch, tmp_path):
+    import jax
+
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    with trace.profiling(str(tmp_path / "a")):
+        with pytest.raises(RuntimeError, match="one device trace per run"):
+            with trace.profiling(str(tmp_path / "b")):
+                pass
+        # dir-less re-entry keeps the outer capture (recording() mirror)
+        with trace.profiling() as d:
+            assert d == str(tmp_path / "a")
+
+
+def test_dirless_reentry_keeps_outer_even_with_env_set(monkeypatch,
+                                                       tmp_path):
+    """The env fallback names the OUTER trace: a dir-less re-entry inside
+    an active scope keeps that capture even while GRAPHDYN_PROFILE is set
+    — it must not resolve the env var into a second directory and trip
+    the nesting error."""
+    import jax
+
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    monkeypatch.setenv(trace.ENV_VAR, str(tmp_path / "env"))
+    with trace.profiling(str(tmp_path / "a")):
+        with trace.profiling() as d:
+            assert d == str(tmp_path / "a")
+
+
+# ---------------------------------------------------------------------------
+# memory bands: memcheck structural pass + the bench column contract
+# ---------------------------------------------------------------------------
+
+
+def test_memcheck_structural_pass_on_cpu():
+    """On this container every row is measured=None with an explicit
+    backend reason, the model bytes still evaluate, and the gate passes —
+    the acceptance criterion's null+reason contract."""
+    rows = memband.run_memcheck()
+    assert {r.program for r in rows} == set(memband.MEM_BANDS)
+    for r in rows:
+        assert r.ok, r
+        assert r.measured is None and r.frac is None
+        assert r.reason and "memory_stats" in r.reason
+        assert r.model > 0                # the byte model itself evaluated
+
+
+def test_memrow_band_logic():
+    row = memband._row("packed_state", 10 ** 6, 10 ** 6 / 2)
+    assert row.frac == pytest.approx(2.0) and row.ok
+    lo, hi = memband.MEM_BANDS["packed_state"]
+    too_big = memband._row("packed_state", int(10 ** 6 * hi * 4), 10 ** 6)
+    assert not too_big.ok
+    # a null row WITHOUT a reason must not pass — a skip has to say why
+    silent = memband.MemRow("packed_state", None, 1.0, None, lo, hi, None)
+    assert not silent.ok
+
+
+def test_peak_hbm_bytes_null_plus_reason_on_cpu():
+    peak, reason = memband.peak_hbm_bytes()
+    assert peak is None and reason      # never a silent absence or fake 0
+
+
+def test_mem_gauges_unavailable_once_per_recording_scope(tmp_path):
+    p = str(tmp_path / "mem.jsonl")
+    with obs.recording(p):
+        memband.emit_memory_gauges(loop="t.chunk", chunk=0)
+        memband.emit_memory_gauges(loop="t.chunk", chunk=1)
+    events, _ = read_ledger(p)
+    unavailable = [e for e in events if e.get("name") == "obs.mem.unavailable"]
+    assert len(unavailable) == 1        # one reason per scope, not per chunk
+    assert "memory_stats" in unavailable[0]["attrs"]["reason"]
+
+
+def test_chip_bands_cover_the_proxy_programs():
+    """The v5e seeds (ROADMAP item 5 remainder) band the same programs as
+    the CPU proxy and stay inert on this backend."""
+    from graphdyn.obs import roofline
+
+    for prof in roofline.CHIP_BANDS.values():
+        assert set(prof["bands"]) == set(roofline.BANDS)
+        assert prof["hbm_bytes_per_s"] > 0
+    assert roofline.chip_profile() is None       # CPU: host-proxy anchor
+
+
+def test_uncalibrated_tpu_obscheck_passes_structurally(monkeypatch,
+                                                       tmp_path):
+    """A TPU whose device_kind has no CHIP_BANDS entry must not gate chip
+    rates against the host-proxy bands (guaranteed red, no blessing path):
+    run_obscheck returns no gated rows and emits an explicit
+    ``obs.roofline.uncalibrated`` gauge naming the part."""
+    import jax
+
+    from graphdyn.obs import roofline
+
+    class FakeDevice:
+        device_kind = "TPU v9 prototype"
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(jax, "local_devices", lambda: [FakeDevice()])
+    assert roofline.chip_profile() is None       # no committed anchor
+    notices = []
+    p = str(tmp_path / "led.jsonl")
+    with obs.recording(p):
+        rows = roofline.run_obscheck(diag=notices.append)
+    assert rows == []
+    assert any("structural pass" in n for n in notices)
+    events, _ = read_ledger(p)
+    unc = [e for e in events if e.get("name") == "obs.roofline.uncalibrated"]
+    assert len(unc) == 1
+    assert unc[0]["attrs"]["device_kind"] == "TPU v9 prototype"
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: the ring
+# ---------------------------------------------------------------------------
+
+
+def test_ring_bounded_fifo():
+    flight.configure(8)
+    for i in range(20):
+        obs.counter("tick", i=i)        # null recorder → ring
+    snap = flight.snapshot()
+    assert len(snap) == 8
+    assert [e["attrs"]["i"] for e in snap] == list(range(12, 20))
+
+
+def test_ring_allocation_bounded_tracemalloc():
+    """Ring churn retains only the ring itself (the 'allocation-bounded by
+    construction' contract, PR-7 tracemalloc style): after 60× capacity
+    worth of events, live allocations are bounded by the last-N event
+    dicts, not by the event count."""
+    flight.configure(64)
+    for i in range(flight.capacity() + 16):      # reach steady state
+        obs.counter("tick", i=i)
+    tracemalloc.start()
+    base = tracemalloc.take_snapshot()
+    for i in range(4000):
+        obs.counter("tick", i=i)
+        obs.gauge("level", i)
+    diff = tracemalloc.take_snapshot().compare_to(base, "filename")
+    tracemalloc.stop()
+    leaked = sum(d.size_diff for d in diff if d.size_diff > 0)
+    # 4000 unbounded ~150 B events would retain ~600 KB; 64 ring slots of
+    # replaced dicts sit well under 16 KB
+    assert leaked < 16_384, f"flight ring retained {leaked} B in steady state"
+
+
+def test_ring_disarmed_by_env(monkeypatch, tmp_path):
+    monkeypatch.setenv(flight.ENV_VAR, "0")
+    obs.counter("tick")
+    assert flight.snapshot() == []
+    assert flight.dump("exception", workdir=str(tmp_path)) is None
+    assert not (tmp_path / flight.POSTMORTEM_NAME).exists()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: the three dump paths
+# ---------------------------------------------------------------------------
+
+
+def test_unhandled_cli_exception_leaves_postmortem(tmp_path, monkeypatch,
+                                                   capsys):
+    """Crash path (c): an unhandled driver exception on a run with no
+    ledger dumps the ring tail + the failure site, then re-raises."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.delenv(obs.ENV_VAR, raising=False)
+    with FaultPlan([FaultSpec("rep.boundary", action="raise", at=1)]):
+        with pytest.raises(Exception, match="rep.boundary"):
+            main([*SA_SMOKE, "--out", str(tmp_path / "sa.npz")])
+    capsys.readouterr()
+    events = _postmortem_events(tmp_path)
+    attrs = _assert_crash_shape(events, "exception")
+    assert attrs["exc_type"] == "InjectedFault"
+    assert "site" in attrs              # innermost traceback frame named
+
+
+def test_sigterm_preempt_exits_75_and_leaves_postmortem(tmp_path,
+                                                        monkeypatch,
+                                                        capsys):
+    """Crash path (b): the graceful-shutdown preemption (the 'signal'
+    fault delivers the request exactly as the SIGTERM handler would) exits
+    EX_TEMPFAIL and the post-mortem names the boundary that honored it."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.delenv(obs.ENV_VAR, raising=False)
+    with FaultPlan([FaultSpec("rep.boundary", action="signal", at=1)]):
+        rc = main([*SA_SMOKE, "--out", str(tmp_path / "sa.npz")])
+    capsys.readouterr()
+    assert rc == EX_TEMPFAIL
+    events = _postmortem_events(tmp_path)
+    attrs = _assert_crash_shape(events, "preempt")
+    assert attrs["site"] == "rep"       # ShutdownRequested.where
+    assert attrs["exc_type"] == "ShutdownRequested"
+
+
+def test_sweep_nan_degrade_preserves_flight_evidence(tmp_path, monkeypatch):
+    """Crash path (a): the ``sweep.nan`` degrade is survivable (sentinel +
+    stop) but the evidence is dumped at the moment of the poison, ring
+    tail included."""
+    monkeypatch.chdir(tmp_path)
+    obs.counter("marker.before_poison", k=7)     # ring tail must survive
+    g = erdos_renyi_graph(60, 1.5 / 59, seed=0)
+    cfg = EntropyConfig(dynamics=DYN11, lmbd_max=0.3, lmbd_step=0.1,
+                        max_sweeps=300, eps=1e-5)
+    with FaultPlan([FaultSpec("sweep.nan", action="nan", at=2)]):
+        res = entropy_sweep(g, cfg, seed=0)      # degrades, no raise
+    assert res.nonconverged is not None
+    events = _postmortem_events(tmp_path)
+    attrs = _assert_crash_shape(events, "sweep.nan")
+    assert "lambda" in attrs["site"]
+    names = [e.get("name") for e in events]
+    assert "marker.before_poison" in names       # the ring's tail made it
+
+
+def test_clean_run_leaves_no_postmortem(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    rc = main([*SA_SMOKE, "--out", str(tmp_path / "sa.npz")])
+    capsys.readouterr()
+    assert rc == 0
+    assert not (tmp_path / flight.POSTMORTEM_NAME).exists()
+
+
+def test_cli_crash_with_ledger_records_obs_crash_in_ledger(tmp_path,
+                                                           monkeypatch,
+                                                           capsys):
+    """The other half of the routing contract, end to end through the CLI:
+    with ``--obs-ledger`` the crash evidence lands IN the ledger (the
+    ``obs.crash`` event, ShutdownRequested's boundary as ``site``) and no
+    post-mortem file is written."""
+    monkeypatch.chdir(tmp_path)
+    ledger = str(tmp_path / "run.jsonl")
+    with FaultPlan([FaultSpec("rep.boundary", action="signal", at=1)]):
+        rc = main(["--obs-ledger", ledger,
+                   *SA_SMOKE, "--out", str(tmp_path / "sa.npz")])
+    capsys.readouterr()
+    assert rc == EX_TEMPFAIL
+    assert not (tmp_path / flight.POSTMORTEM_NAME).exists()
+    events, _ = read_ledger(ledger)
+    crash = [e for e in events if e.get("name") == "obs.crash"]
+    assert len(crash) == 1
+    assert crash[0]["attrs"]["reason"] == "preempt"
+    assert crash[0]["attrs"]["site"] == "rep"
+
+
+def test_dump_with_live_recorder_goes_to_ledger_not_file(tmp_path):
+    """When a ledger IS being written it already carries the evidence: the
+    crash event lands there and no post-mortem file appears."""
+    p = str(tmp_path / "live.jsonl")
+    with obs.recording(p):
+        assert flight.dump("sweep.nan", site="cell=3",
+                           workdir=str(tmp_path)) is None
+    assert not (tmp_path / flight.POSTMORTEM_NAME).exists()
+    events, _ = read_ledger(p)
+    crash = [e for e in events if e.get("name") == "obs.crash"]
+    assert len(crash) == 1 and crash[0]["attrs"]["site"] == "cell=3"
+
+
+def test_postmortem_is_report_renderable(tmp_path, monkeypatch):
+    """The dump is a schema-valid ledger: ``summarize`` (the report
+    command's engine) aggregates it unchanged."""
+    monkeypatch.chdir(tmp_path)
+    obs.counter("tick", i=1)
+    obs.gauge("level", 0.5)
+    flight.dump("exception", exc=ValueError("boom"))
+    events = _postmortem_events(tmp_path)
+    doc = summarize(events)
+    assert doc["manifest"]["postmortem"] is True
+    assert doc["counters"]["obs.crash"]["total"] == 1
+    assert "tick" in doc["counters"] and "level" in doc["gauges"]

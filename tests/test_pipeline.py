@@ -242,7 +242,8 @@ def test_hpr_grouped_rep_preemption_resume_parity(tmp_path):
     assert not os.path.exists(ck + ".npz")
 
 
-def test_cli_grouped_sa_preemption_exits_75_and_resumes(tmp_path, capsys):
+def test_cli_grouped_sa_preemption_exits_75_and_resumes(tmp_path, capsys,
+                                                        monkeypatch):
     """The PR-2 CLI contract under batching, end to end: a shutdown request
     at a group boundary of the GROUPED sa driver exits EX_TEMPFAIL (75)
     with a loadable prefix snapshot; rerunning the same command resumes,
@@ -252,6 +253,10 @@ def test_cli_grouped_sa_preemption_exits_75_and_resumes(tmp_path, capsys):
 
     from graphdyn.cli import main
     from graphdyn.utils.io import load_results_npz
+
+    # a no-ledger preempt dumps the flight post-mortem into the workdir
+    # (PR-8 contract, asserted in tests/test_obs_device.py) — keep it here
+    monkeypatch.chdir(tmp_path)
 
     ck = str(tmp_path / "ck")
     out = str(tmp_path / "res.npz")

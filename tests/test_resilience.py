@@ -391,7 +391,11 @@ def test_non_lowering_failure_is_not_swallowed():
 # ---------------------------------------------------------------------------
 
 
-def test_nan_in_sweep_carry_degrades_to_nonconvergence(caplog):
+def test_nan_in_sweep_carry_degrades_to_nonconvergence(caplog, tmp_path,
+                                                       monkeypatch):
+    # the degrade dumps the flight-recorder post-mortem into the workdir
+    # (PR-8 contract, asserted in tests/test_obs_device.py) — keep it here
+    monkeypatch.chdir(tmp_path)
     g = erdos_renyi_graph(60, 1.5 / 59, seed=0)
     cfg = EntropyConfig(
         dynamics=DYN11, lmbd_max=0.3, lmbd_step=0.1, max_sweeps=300, eps=1e-5,
@@ -473,11 +477,15 @@ def test_sa_ensemble_shutdown_snapshots_prefix(tmp_path):
     assert not os.path.exists(ck + ".npz")
 
 
-def test_cli_preemption_exits_75_and_resumes(tmp_path, capsys):
+def test_cli_preemption_exits_75_and_resumes(tmp_path, capsys, monkeypatch):
     """End to end through the CLI: a shutdown request mid-λ-ladder exits
     EX_TEMPFAIL (75) with a loadable checkpoint; rerunning the same command
     resumes, completes with exit 0, and cleans the checkpoint up."""
     from graphdyn.cli import main
+
+    # a no-ledger preempt dumps the flight post-mortem into the workdir
+    # (PR-8 contract, asserted in tests/test_obs_device.py) — keep it here
+    monkeypatch.chdir(tmp_path)
 
     ck = str(tmp_path / "ck")
     out = str(tmp_path / "res.npz")
