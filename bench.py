@@ -299,6 +299,78 @@ def entropy_cell_rate(smoke: bool):
     }
 
 
+def ckpt_save_overhead(smoke: bool):
+    """The durable-store tax on the checkpoint hot path: p50/p99 save
+    latency of `graphdyn.resilience.store.DurableCheckpoint` (checksum
+    manifest + versioned promote + retention + journal) vs the raw
+    `Checkpoint.save` it wraps, on the entropy-chunk snapshot shape (the
+    repo's largest per-interval payload: warm-start chi + the grid row
+    arrays). Null + reason on failure, never silent — the row keeps the
+    durability tax honest round-over-round the way the rate rows keep
+    throughput honest."""
+    import os
+    import shutil
+    import tempfile
+
+    from graphdyn import obs
+    from graphdyn.resilience.store import DurableCheckpoint
+    from graphdyn.utils.io import Checkpoint
+
+    if smoke:
+        n, reps = 2_000, 15
+    else:
+        n, reps = 20_000, 40
+    E = int(n * 1.5 / 2)                # ER deg=1.5 edge count
+    K, L = 2, 121                       # p=c=1 alphabet; λ ladder length
+    rng = np.random.default_rng(0)
+    arrays = {
+        "chi": rng.random((2 * E, K, K)),
+        "grid_ent": rng.random((3, 8, L)),
+        "grid_m_init": rng.random((3, 8, L)),
+        "grid_ent1": rng.random((3, 8, L)),
+        "grid_sweeps": rng.integers(0, 1300, (3, 8, L)),
+        "lambdas": np.arange(L) * 0.1,
+    }
+    meta = {"grid_id": "bench", "next_cell": [0, 0]}
+    root = tempfile.mkdtemp(prefix="graphdyn_bench_ckpt_")
+    try:
+        # mirror/keep pinned: the A/B must measure the store itself, not
+        # whatever GRAPHDYN_CKPT_MIRROR/_KEEP happen to be in the caller's
+        # environment (a configured mirror would both skew the durable leg
+        # with replication work and litter the user's real mirror directory
+        # with throwaway bench files)
+        stores = (
+            ("raw", Checkpoint(os.path.join(root, "raw", "ck"))),
+            ("durable", DurableCheckpoint(os.path.join(root, "dur", "ck"),
+                                          mirror=None, keep=2)),
+        )
+        times: dict = {label: [] for label, _ in stores}
+        for _, ck in stores:
+            ck.save(arrays, meta)       # warmup: makedirs, first manifest
+        # INTERLEAVED A/B: back-to-back same-path batches read page-cache /
+        # frequency drift as a store difference (measured 2x swings);
+        # alternating saves give both stores the same ambient conditions
+        for _ in range(reps):
+            for label, ck in stores:
+                with obs.timed("bench.ckpt_save", path=label) as sw:
+                    ck.save(arrays, meta)
+                times[label].append(sw.wall_s)
+        out = {}
+        for label, _ in stores:
+            out[label + "_p50_s"] = float(np.percentile(times[label], 50))
+            out[label + "_p99_s"] = float(np.percentile(times[label], 99))
+        snapshot_bytes = os.path.getsize(os.path.join(root, "raw", "ck.npz"))
+        return {"ckpt_save_overhead": {
+            **out,
+            "overhead_p50_x": out["durable_p50_s"] / out["raw_p50_s"],
+            "overhead_p99_x": out["durable_p99_s"] / out["raw_p99_s"],
+            "snapshot_bytes": int(snapshot_bytes),
+            "saves": reps,
+        }}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def fingerprint_rows():
     """The graftcheck program-fingerprint summary persisted with every
     round (``BENCH_*.json``): per headline entry point, the ledger-gated
@@ -572,6 +644,16 @@ def main():
             "entropy_cell_rate_pallas": None,
             "entropy_cell_rate_pallas_skipped_reason":
                 f"entropy cell A/B failed: {str(e)[:150]}",
+        })
+    _mark("durable-store save overhead (ckpt_save_overhead)")
+    try:
+        extra.update(ckpt_save_overhead(args.smoke))
+    except Exception as e:  # noqa: BLE001 — optional row, never silent
+        _mark(f"ckpt save overhead row failed: {str(e)[:150]}")
+        extra.update({
+            "ckpt_save_overhead": None,
+            "ckpt_save_overhead_skipped_reason":
+                f"ckpt save A/B failed: {str(e)[:150]}",
         })
     _mark("program fingerprints (graftcheck structural summary)")
     try:

@@ -96,10 +96,36 @@ def _add_kernel_flag(ap: argparse.ArgumentParser) -> None:
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="graphdyn",
+        # abbreviation OFF at the top level: the subcommands' exact "--c"
+        # (cycle length / ER mean degree) would otherwise be *classified*
+        # as an ambiguous abbreviation of --ckpt-mirror/--ckpt-keep/
+        # --compile-cache during the main parser's argv scan, before the
+        # subparser ever sees it (subparsers keep their own abbreviation)
+        allow_abbrev=False,
         epilog="Exit codes: 0 success; 75 (EX_TEMPFAIL) graceful preemption "
                "shutdown — SIGTERM/SIGINT checkpointed at the next chunk "
-               "boundary, safe for a scheduler to requeue; anything else is "
-               "a real failure. See ARCHITECTURE.md 'Resilience'.",
+               "boundary, safe for a scheduler to requeue; 130 hard abort — "
+               "a SECOND signal during the grace window (the operator "
+               "asking twice outranks the checkpoint: nothing is written); "
+               "anything else is a real failure. See ARCHITECTURE.md "
+               "'Resilience'.",
+    )
+    ap.add_argument(
+        "--ckpt-mirror", default=None, metavar="DIR",
+        help="replicate every checkpoint save into a second directory "
+             "(write-behind — the hot path pays one extra atomic rename); "
+             "when the primary checkpoint directory is unreadable or fails "
+             "checksum verification, resume fails over to the mirror. Also "
+             "honored from the GRAPHDYN_CKPT_MIRROR environment variable "
+             "(this flag wins). ARCHITECTURE.md 'Durable checkpoint store'",
+    )
+    ap.add_argument(
+        "--ckpt-keep", type=int, default=None, metavar="K",
+        help="retain the last K checkpoint versions (<ckpt>.v<N>.npz) next "
+             "to the published snapshot, so a torn write or silent bit rot "
+             "falls back to the newest verifiable version instead of "
+             "restarting the run (default: 2; also honored from "
+             "GRAPHDYN_CKPT_KEEP, this flag wins)",
     )
     ap.add_argument(
         "--compile-cache", default=None, metavar="DIR",
@@ -321,7 +347,8 @@ def main(argv=None) -> int:
     ``EX_TEMPFAIL`` (75) so schedulers can requeue a preempted run instead
     of marking it failed."""
     from graphdyn.resilience import (
-        EX_TEMPFAIL, ShutdownRequested, graceful_shutdown, set_save_retry,
+        EX_ABORT, EX_TEMPFAIL, ShutdownRequested, graceful_shutdown,
+        set_save_retry,
     )
 
     args = build_parser().parse_args(argv)
@@ -338,6 +365,22 @@ def main(argv=None) -> int:
         jax.config.update("jax_enable_x64", True)
     if getattr(args, "max_save_retries", None) is not None:
         set_save_retry(args.max_save_retries)
+
+    # durable-store knobs (flag wins over env; set BOTH every run so one
+    # in-process invocation cannot leak its mirror into the next — the soak
+    # harness re-enters main() dozens of times per process)
+    import os as _os
+
+    from graphdyn.resilience.store import _env_keep, configure_store
+
+    # _env_keep is the ONE parser of GRAPHDYN_CKPT_KEEP (tolerates garbage
+    # by falling back to the default — a typo'd env var must not crash an
+    # otherwise-valid run before it starts)
+    configure_store(
+        mirror=args.ckpt_mirror or _os.environ.get("GRAPHDYN_CKPT_MIRROR")
+        or None,
+        keep=args.ckpt_keep if args.ckpt_keep is not None else _env_keep(),
+    )
 
     # GRAPHDYN_SANITIZE=alias: run the whole driver under the host-aliasing
     # sanitizer (graphdyn.analysis.sanitize) — a mutated host buffer whose
@@ -370,6 +413,13 @@ def main(argv=None) -> int:
             except ShutdownRequested as e:
                 flight.dump("preempt", exc=e, site=e.where)
                 raise
+            except KeyboardInterrupt as e:
+                # the second-signal hard abort (graceful_shutdown): the
+                # operator asking twice outranks the checkpoint — nothing
+                # is saved, but the flight ring still names where the run
+                # died (innermost frame as the site)
+                flight.dump("abort", exc=e)
+                raise
             except Exception as e:
                 flight.dump("exception", exc=e)
                 raise
@@ -377,6 +427,10 @@ def main(argv=None) -> int:
         print(f"graphdyn: {e} — exiting {EX_TEMPFAIL} (requeue me)",
               file=sys.stderr)
         return EX_TEMPFAIL
+    except KeyboardInterrupt:
+        print(f"graphdyn: second signal — hard abort, no snapshot written; "
+              f"exiting {EX_ABORT}", file=sys.stderr)
+        return EX_ABORT
 
 
 def _run(args) -> int:

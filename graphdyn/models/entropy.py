@@ -759,11 +759,11 @@ def entropy_ensemble_union(
         m0 = np.broadcast_to(n_iso_a / n_tot_a, (lam.size, G)).copy()
         K = 2 ** (dyn.p + dyn.c)
         if managed:
-            from graphdyn.utils.io import Checkpoint, load_validated
+            from graphdyn.utils.io import load_validated, open_checkpoint
 
             load_validated(checkpoint_path, "union_id", union_id,
                            "union-ensemble")
-            Checkpoint(checkpoint_path).remove()
+            open_checkpoint(checkpoint_path).remove()
         return UnionEnsembleEntropyResult(
             lambdas=lam,
             ent=ent,

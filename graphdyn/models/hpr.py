@@ -756,7 +756,8 @@ def hpr_ensemble(
         ShutdownRequested, raise_if_requested, shutdown_requested,
     )
     from graphdyn.utils.io import (
-        Checkpoint, PeriodicCheckpointer, load_resume_prefix, save_results_npz,
+        PeriodicCheckpointer, load_resume_prefix, open_checkpoint,
+        save_results_npz,
     )
 
     config = config or HPRConfig()
@@ -767,7 +768,7 @@ def hpr_ensemble(
     times = np.empty(n_rep, np.float64)  # graftlint: disable=GD004  host wall-clock
 
     start_k = 0
-    ck = Checkpoint(checkpoint_path) if checkpoint_path else None
+    ck = open_checkpoint(checkpoint_path) if checkpoint_path else None
     # driver snapshots share the chain checkpoint's interval (the conf array
     # is [n_rep, n]; unconditional per-rep writes would dominate fast reps)
     pc = (PeriodicCheckpointer(checkpoint_path, interval_s=checkpoint_interval_s)

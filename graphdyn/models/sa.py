@@ -635,7 +635,8 @@ def sa_ensemble(
         ShutdownRequested, raise_if_requested, shutdown_requested,
     )
     from graphdyn.utils.io import (
-        Checkpoint, PeriodicCheckpointer, load_resume_prefix, save_results_npz,
+        PeriodicCheckpointer, load_resume_prefix, open_checkpoint,
+        save_results_npz,
     )
 
     config = config or SAConfig()
@@ -646,7 +647,7 @@ def sa_ensemble(
     m_final = np.empty(n_stat, np.float64)  # graftlint: disable=GD004  host result buffer
 
     start_k = 0
-    ck = Checkpoint(checkpoint_path) if checkpoint_path else None
+    ck = open_checkpoint(checkpoint_path) if checkpoint_path else None
     # driver snapshots share the chain checkpoint's interval: the payload
     # includes the [n_stat, n] conf array, so unconditional per-rep writes
     # would dominate fast-rep runs; a lost tail of completed reps simply

@@ -133,12 +133,19 @@ def init_multihost(retry_deadline_s: float = 60.0, **kwargs) -> int:
             # tries=64 is a non-binding ceiling; retry()'s deadline_s stops
             # as soon as the next backoff sleep would cross the deadline,
             # so retry_deadline_s is the single binding limit.
+            # jitter=True + the rank in the site key: every rank of the pod
+            # hits the same not-yet-up coordinator, and synchronized
+            # exponential backoff would re-stampede it at t=0.5, 1, 2, …;
+            # seeded full-jitter de-correlates the ranks deterministically
+            rank = kwargs.get("process_id",
+                              os.environ.get("JAX_PROCESS_ID", os.getpid()))
             retry(
                 connect,
-                policy=RetryPolicy(tries=64, base_delay_s=0.5, max_delay_s=8.0),
+                policy=RetryPolicy(tries=64, base_delay_s=0.5,
+                                   max_delay_s=8.0, jitter=True),
                 retry_on=(RuntimeError,),
                 retry_if=transient,
-                what="jax.distributed.initialize",
+                what=f"jax.distributed.initialize(rank {rank})",
                 deadline_s=retry_deadline_s,
             )
         else:

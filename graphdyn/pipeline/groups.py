@@ -55,12 +55,12 @@ class GroupDriver:
 
     def __init__(self, checkpoint_path: str | None, interval_s: float,
                  run_id: dict, payload):
-        from graphdyn.utils.io import Checkpoint, PeriodicCheckpointer
+        from graphdyn.utils.io import PeriodicCheckpointer, open_checkpoint
 
         self.path = checkpoint_path
         self.run_id = run_id
         self.payload = payload
-        self.ck = Checkpoint(checkpoint_path) if checkpoint_path else None
+        self.ck = open_checkpoint(checkpoint_path) if checkpoint_path else None
         self.pc = (
             PeriodicCheckpointer(checkpoint_path, interval_s=interval_s)
             if checkpoint_path else None
@@ -109,9 +109,9 @@ class GroupDriver:
             # must go — a later serial run reusing this checkpoint path
             # would otherwise hit its fingerprint check and refuse to
             # resume, wedging mid-ensemble
-            from graphdyn.utils.io import Checkpoint
+            from graphdyn.utils.io import open_checkpoint
 
-            Checkpoint(f"{self.path}_chain{k}").remove()
+            open_checkpoint(f"{self.path}_chain{k}").remove()
         if self.pc is not None:
             self.pc.maybe_save(self.payload(), {**self.run_id,
                                                 "next_rep": k + 1})

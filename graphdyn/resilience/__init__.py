@@ -18,6 +18,18 @@ deliver. Three cooperating pieces (ARCHITECTURE.md "Resilience"):
   SIGTERM/SIGINT into "checkpoint at next chunk boundary, exit
   :data:`EX_TEMPFAIL` (75)", so schedulers can tell preemption from
   failure.
+- :mod:`graphdyn.resilience.store` — the durable checkpoint store every
+  consumer reaches via :func:`graphdyn.utils.io.open_checkpoint`:
+  SHA-256-verified loads, keep-last-K versioned retention with atomic
+  promote, write-behind mirror replication (``--ckpt-mirror``) with
+  checksum-verified failover, and the ``run_journal.jsonl`` evidence
+  trail. (Exported lazily below — the io↔resilience import order forbids
+  importing it here eagerly.)
+- :mod:`graphdyn.resilience.soak` — the chaos soak harness
+  (``python -m graphdyn.resilience.soak``): seeded, composed-fault
+  schedules over the instrumented sites driving real CLI workloads
+  through kill/requeue cycles, asserting bit-exact parity with a
+  fault-free oracle plus a clean journal story per episode.
 """
 
 from graphdyn.resilience.faults import (  # noqa: F401
@@ -42,6 +54,7 @@ from graphdyn.resilience.retry import (  # noqa: F401
     set_save_retry,
 )
 from graphdyn.resilience.shutdown import (  # noqa: F401
+    EX_ABORT,
     EX_TEMPFAIL,
     ShutdownRequested,
     clear_shutdown,
@@ -50,3 +63,25 @@ from graphdyn.resilience.shutdown import (  # noqa: F401
     request_shutdown,
     shutdown_requested,
 )
+
+# store.py imports graphdyn.utils.io at module level, and utils.io imports
+# THIS package — so the store surface is re-exported lazily (PEP 562): by
+# the time anyone asks for these attributes, utils.io is fully initialized.
+_STORE_EXPORTS = (
+    "ChecksumError",
+    "DurableCheckpoint",
+    "StoreConfig",
+    "configure_store",
+    "flush_mirror",
+    "validate_journal",
+)
+
+
+def __getattr__(name: str):
+    if name in _STORE_EXPORTS:
+        from graphdyn.resilience import store as _store
+
+        return getattr(_store, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )

@@ -24,6 +24,14 @@ site                 instrumented in
                      ``torn`` (partial temp file left behind, then raise)
 ``checkpoint.read``  ``utils.io.Checkpoint.load`` — ``truncate`` corrupts
                      the on-disk npz before the real loader reads it
+``checkpoint.bitrot`` ``resilience.store.DurableCheckpoint.load`` —
+                     ``bitrot`` flips bytes inside a completed checkpoint
+                     WITHOUT breaking the zip container (member CRCs are
+                     recomputed): ``np.load`` succeeds, only the durable
+                     store's SHA-256 manifest can catch it
+``mirror.write``     ``resilience.store.DurableCheckpoint._mirror_save`` —
+                     ``raise`` simulates mirror-path ENOSPC: the primary
+                     save proceeds, the journal records the degraded mirror
 ``chunk.boundary``   ``utils.io.ChainCheckpointer.drive`` — ``preempt``
                      raises at the ``at``-th chunk boundary
 ``rep.boundary``     ``models.sa.sa_ensemble`` / ``models.hpr.hpr_ensemble``
@@ -93,13 +101,13 @@ class FaultSpec:
     whose ``key`` context value contains it (e.g. a checkpoint path).
 
     Actions: ``raise`` (site-specific exception), ``preempt`` (hard kill —
-    :class:`InjectedPreemption`), ``torn``/``truncate``/``nan`` (data
-    transformations applied by the site), and ``signal`` (deliver a
+    :class:`InjectedPreemption`), ``torn``/``truncate``/``nan``/``bitrot``
+    (data transformations applied by the site), and ``signal`` (deliver a
     graceful-shutdown request exactly as a SIGTERM handler would — the
     deterministic, race-free way to test the preemption protocol)."""
 
     site: str
-    action: str = "raise"   # raise | preempt | torn | truncate | nan | signal
+    action: str = "raise"   # raise | preempt | torn | truncate | nan | bitrot | signal
     at: int = 1
     count: int = 1
     p: float = 1.0
@@ -286,6 +294,36 @@ def truncate_file(path: str, frac: float = 0.5) -> None:
     size = os.path.getsize(path)
     with open(path, "r+b") as f:
         f.truncate(max(0, int(size * frac)))
+
+
+def flip_npz_bytes(path: str, seed: int = 0) -> None:
+    """SILENT bit rot: flip bytes inside the largest array member of an npz
+    while keeping the zip container valid — the ``checkpoint.bitrot``
+    fault's payload.
+
+    The members are rewritten through ``zipfile.writestr``, which recomputes
+    each member's CRC-32, so ``np.load`` succeeds and returns wrong data —
+    the corruption class only a content checksum (the durable store's
+    SHA-256 manifest) can catch. Flips land past the 128-byte npy header so
+    the array parses; XOR 0xFF guarantees every flipped byte changes."""
+    import zipfile as _zipfile
+
+    rng = np.random.default_rng(seed)
+    with _zipfile.ZipFile(path) as z:
+        names = z.namelist()
+        blobs = {nm: z.read(nm) for nm in names}
+    arrays = [nm for nm in names if not nm.startswith("__")] or names
+    target = max(arrays, key=lambda nm: len(blobs[nm]))
+    b = bytearray(blobs[target])
+    lo = min(128, max(0, len(b) - 1))
+    for i in rng.integers(lo, len(b), size=min(8, max(1, len(b) - lo))):
+        b[i] ^= 0xFF
+    blobs[target] = bytes(b)
+    tmp = path + ".tmp-bitrot"
+    with _zipfile.ZipFile(tmp, "w", _zipfile.ZIP_STORED) as z:
+        for nm in names:
+            z.writestr(nm, blobs[nm])
+    os.replace(tmp, path)
 
 
 def is_lowering_failure(exc: BaseException) -> bool:

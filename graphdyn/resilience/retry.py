@@ -15,6 +15,7 @@ parameter through ten signatures.
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import time
 from dataclasses import dataclass
@@ -25,23 +26,48 @@ log = logging.getLogger("graphdyn.resilience")
 @dataclass
 class RetryPolicy:
     """``tries`` total attempts (1 = no retry), exponential backoff
-    ``base_delay_s * 2**k`` capped at ``max_delay_s``."""
+    ``base_delay_s * 2**k`` capped at ``max_delay_s``.
+
+    ``jitter=True`` switches to **seeded full-jitter**: each delay is drawn
+    uniformly from ``(0, bound]`` where ``bound`` is the exponential value
+    above, seeded from the retry-site ``key`` passed to :meth:`delays`.
+    Multihost ranks retrying the same operation (``multihost.init``, a
+    shared-filesystem save) carry distinct keys (rank/pid in the site
+    string), so their retries DE-correlate instead of synchronizing into
+    storms — while any one site's schedule stays deterministic for tests.
+    """
 
     tries: int = 3
     base_delay_s: float = 0.05
     max_delay_s: float = 2.0
+    jitter: bool = False
 
-    def delays(self):
+    def delays(self, key: str = ""):
+        rng = None
+        if self.jitter:
+            import numpy as _np
+
+            seed = int.from_bytes(
+                hashlib.sha256(key.encode()).digest()[:8], "big"
+            )
+            rng = _np.random.default_rng(seed)
         d = self.base_delay_s
         for _ in range(max(0, self.tries - 1)):
-            yield min(d, self.max_delay_s)
+            bound = min(d, self.max_delay_s)
+            if rng is None:
+                yield bound
+            else:
+                # full-jitter over (0, bound]: never exceeds the exponential
+                # bound, never a 0 that would hammer the resource
+                yield float(bound * (1.0 - rng.random()))
             d *= 2.0
 
 
-# the process-wide checkpoint-save budget (CLI: --max-save-retries). A
+# the process-wide checkpoint-save budget (CLI: --max-save-retries). Jittered:
+# many hosts retrying a shared-filesystem save must not fire in lockstep. A
 # mutable singleton, updated in place — importers hold the object, not a
 # snapshot of it.
-SAVE_RETRY = RetryPolicy()
+SAVE_RETRY = RetryPolicy(jitter=True)
 
 
 def set_save_retry(tries: int) -> None:
@@ -74,7 +100,10 @@ def retry(
 
     policy = policy or RetryPolicy()
     t0 = time.monotonic()
-    delays = list(policy.delays()) + [None]     # None = no sleep after last
+    # `what` doubles as the jitter seed key: distinct sites (and distinct
+    # ranks, when the caller puts the rank in the site string) draw
+    # de-correlated schedules
+    delays = list(policy.delays(key=what)) + [None]  # None = no sleep after last
     backoff_total = 0.0
     for attempt, delay in enumerate(delays, start=1):
         try:

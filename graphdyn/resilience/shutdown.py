@@ -35,6 +35,11 @@ log = logging.getLogger("graphdyn.resilience")
 #: sysexits.h EX_TEMPFAIL — "preempted, requeue me" (vs 1 = real failure)
 EX_TEMPFAIL = 75
 
+#: 128 + SIGINT, the shell convention for "killed by the operator": the
+#: second-signal hard abort (nothing saved — asking twice outranks the
+#: checkpoint). Distinct from 75 so schedulers do NOT requeue it.
+EX_ABORT = 130
+
 
 class ShutdownRequested(Exception):
     """Raised by a driver at its chunk boundary after the shutdown snapshot

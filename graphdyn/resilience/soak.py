@@ -1,0 +1,489 @@
+"""Chaos soak harness: composed-fault schedules over real CLI workloads.
+
+PR 2 proved each fault *site* is individually survivable; production
+preemption delivers *sequences* — a torn write, then a preemption, then a
+truncated read of the very snapshot the requeue needs, on a box whose
+checkpoint directory may be gone entirely. This module runs seeded,
+randomized compositions of the instrumented fault sites against the real
+CLI drivers (``graphdyn.cli.main`` — the same entry a scheduler requeues)
+through kill/requeue cycles, and holds every run to the durability
+contract:
+
+- the final results are **bit-exact** against a fault-free oracle run of
+  the same command line (never "close", never silently truncated);
+- the run journal (``run_journal.jsonl``, :func:`graphdyn.resilience.store
+  .validate_journal`) is schema-valid and tells the whole story — saves
+  with strictly increasing versions, the quarantine/failover the scenario
+  forced, one manifest per (simulated) process;
+- every preempted episode leaves a parseable flight-recorder post-mortem
+  (``obs_postmortem.jsonl`` with an ``obs.crash`` event naming the site),
+  and the final clean episode leaves none.
+
+Scenario catalogue (each randomized per seed — fault positions, counts and
+schedules come from the seed's RNG; ARCHITECTURE.md "Chaos soak"):
+
+==================== ======================================================
+scenario             composition
+==================== ======================================================
+``torn_write``       torn checkpoint temp file mid-run → preemption signal
+                     → requeue resumes bit-exactly
+``write_degrade``    a burst of save ENOSPC (retry → skip-save degrade) →
+                     preemption → requeue
+``truncated_read``   preempt → truncate the published snapshot (tears the
+                     promote hard link too) → requeue falls back to a
+                     retained version (quarantine + failover in journal)
+``bitrot``           preempt → flip bytes inside the snapshot WITHOUT
+                     breaking the zip container → the SHA-256 manifest
+                     catches it (100% — a wrong resume is never accepted),
+                     fallback to a retained version
+``mirror_failover``  preempt → the primary checkpoint directory dies
+                     entirely → requeue resumes from the ``--ckpt-mirror``
+                     replica
+``mirror_degraded``  mirror-path ENOSPC for the whole episode → primary
+                     proceeds, journal records the degraded mirror →
+                     preempt → requeue
+``requeue_storm``    repeated preemption signals at randomized boundaries,
+                     several requeues in a row, then a clean finish
+==================== ======================================================
+
+Run it: ``python -m graphdyn.resilience.soak [--bounded] [--seeds N]
+[--scenarios a,b,…] [--format text|json]``. ``--bounded`` is the tier-1 /
+``scripts/lint.sh`` soakcheck configuration (small workloads, 3 seeds,
+every scenario; ``GRAPHDYN_SKIP_SOAKCHECK=1`` skips the lint step when the
+same bounded soak already ran in the suite — ``tests/test_soak.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import dataclasses
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+import numpy as np
+
+from graphdyn.resilience import faults as _faults
+from graphdyn.resilience import store as _store
+
+#: exit codes the harness accepts from an episode
+EX_OK = 0
+EX_TEMPFAIL = 75
+
+#: default seeds of the bounded (tier-1) configuration
+BOUNDED_SEEDS = (0, 1, 2)
+
+
+@dataclasses.dataclass
+class Episode:
+    """One kill/requeue cycle: optional pre-op mutating on-disk state (the
+    "between processes" fault), a fault plan for the run, and the exit the
+    contract demands."""
+
+    specs: list
+    expect: int = EX_TEMPFAIL
+    pre: str | None = None          # "truncate_current" | "nuke_primary"
+
+
+@dataclasses.dataclass
+class Scenario:
+    name: str
+    workload: str                   # "sa" | "entropy"
+    summary: str
+    mirror: bool = False
+    #: journal ops that MUST appear for the scenario to count as exercised
+    require_ops: tuple = ()
+
+
+def _plan_episodes(name: str, rng: np.random.Generator) -> list[Episode]:
+    """The seeded composition for one scenario run — fault positions and
+    burst lengths are drawn from the seed's stream, so three seeds exercise
+    three different schedules of the same failure mode."""
+    sig = {"site": "rep.boundary", "action": "signal",
+           "at": int(rng.integers(1, 3))}
+    lam = {"site": "lambda.boundary", "action": "signal",
+           "at": int(rng.integers(1, 3))}
+    if name == "torn_write":
+        return [
+            Episode(specs=[
+                {"site": "checkpoint.write", "action": "torn",
+                 "at": int(rng.integers(1, 4))},
+                sig,
+            ]),
+            Episode(specs=[], expect=EX_OK),
+        ]
+    if name == "write_degrade":
+        return [
+            Episode(specs=[
+                {"site": "checkpoint.write", "action": "raise",
+                 "at": int(rng.integers(1, 3)),
+                 "count": int(rng.integers(3, 7))},
+                sig,
+            ]),
+            Episode(specs=[], expect=EX_OK),
+        ]
+    if name == "truncated_read":
+        return [
+            Episode(specs=[sig]),
+            Episode(specs=[], expect=EX_OK, pre="truncate_current"),
+        ]
+    if name == "bitrot":
+        return [
+            Episode(specs=[sig]),
+            Episode(specs=[
+                {"site": "checkpoint.bitrot", "action": "bitrot", "at": 1},
+            ], expect=EX_OK),
+        ]
+    if name == "mirror_failover":
+        return [
+            Episode(specs=[lam]),
+            Episode(specs=[], expect=EX_OK, pre="nuke_primary"),
+        ]
+    if name == "mirror_degraded":
+        return [
+            Episode(specs=[
+                {"site": "mirror.write", "action": "raise", "at": 1,
+                 "count": 99},
+                lam,
+            ]),
+            Episode(specs=[], expect=EX_OK),
+        ]
+    if name == "requeue_storm":
+        eps = [
+            Episode(specs=[{"site": "rep.boundary", "action": "signal",
+                            "at": int(rng.integers(1, 3))}])
+            for _ in range(int(rng.integers(2, 4)))
+        ]
+        return eps + [Episode(specs=[], expect=EX_OK)]
+    raise ValueError(f"unknown scenario {name!r}")
+
+
+SCENARIOS: dict[str, Scenario] = {
+    s.name: s for s in (
+        Scenario("torn_write", "sa",
+                 "torn save temp file, then preemption, then requeue",
+                 require_ops=("save", "load")),
+        Scenario("write_degrade", "sa",
+                 "save ENOSPC burst (retry→skip-save), preemption, requeue",
+                 require_ops=("save",)),
+        Scenario("truncated_read", "sa",
+                 "preempt, truncate the published snapshot, requeue falls "
+                 "back to a retained version",
+                 require_ops=("save", "quarantine", "failover")),
+        Scenario("bitrot", "sa",
+                 "preempt, silent byte flips in a valid container — the "
+                 "checksum manifest must catch it 100% of the time",
+                 require_ops=("save", "quarantine", "failover")),
+        Scenario("mirror_failover", "entropy",
+                 "preempt, primary checkpoint directory dies, requeue "
+                 "resumes from the mirror", mirror=True,
+                 require_ops=("save", "failover")),
+        Scenario("mirror_degraded", "entropy",
+                 "mirror ENOSPC: primary proceeds, journal records the "
+                 "degraded mirror", mirror=True,
+                 require_ops=("save", "mirror.degraded")),
+        Scenario("requeue_storm", "sa",
+                 "several preemptions at randomized boundaries in a row",
+                 require_ops=("save", "load")),
+    )
+}
+
+
+# ---------------------------------------------------------------------------
+# workloads (real CLI command lines)
+# ---------------------------------------------------------------------------
+
+
+def _workload_args(kind: str, out: str, ckpt: str | None,
+                   mirror: str | None) -> list[str]:
+    pre: list[str] = []
+    if mirror:
+        pre += ["--ckpt-mirror", mirror]
+    if kind == "sa":
+        args = ["sa", "--n", "40", "--d", "3", "--p", "1", "--c", "1",
+                "--n-stat", "2", "--max-steps", "20000", "--seed", "0",
+                "--out", out]
+    elif kind == "entropy":
+        args = ["entropy", "--n", "50", "--deg", "1.5", "--num-rep", "1",
+                "--lmbd-max", "0.3", "--lmbd-step", "0.1",
+                "--max-sweeps", "200", "--eps", "1e-5", "--seed", "1",
+                "--out", out]
+    else:
+        raise ValueError(f"unknown workload {kind!r}")
+    if ckpt is not None:
+        args += ["--checkpoint", ckpt, "--checkpoint-interval", "0"]
+    return pre + args
+
+
+def _silence_stdout():
+    """The CLI prints a result JSON line per run; dozens of soak episodes
+    must not flood the harness's own stdout contract."""
+    # graftlint: disable-next-line=GD007  os.devnull is not persistence — nothing can tear
+    return contextlib.redirect_stdout(open(os.devnull, "w"))
+
+
+def _run_cli(args: list[str], cwd: str) -> int | str:
+    """One episode process: run the real CLI entry in ``cwd`` (where the
+    flight recorder drops its post-mortem). Returns the exit code, or
+    ``"preempt"`` for an injected hard kill."""
+    from graphdyn.cli import main as cli_main
+
+    old = os.getcwd()
+    os.makedirs(cwd, exist_ok=True)
+    os.chdir(cwd)
+    try:
+        with _silence_stdout():
+            try:
+                return cli_main(args)
+            except _faults.InjectedPreemption:
+                return "preempt"
+    finally:
+        os.chdir(old)
+
+
+def _oracle(kind: str, root: str, cache: dict) -> dict[str, np.ndarray]:
+    """The fault-free reference run (no checkpointing, no faults), cached
+    per workload kind — parity target for every episode chain."""
+    if kind not in cache:
+        from graphdyn.utils.io import load_results_npz
+
+        odir = os.path.join(root, "oracle", kind)
+        out = os.path.join(odir, "res.npz")
+        rc = _run_cli(_workload_args(kind, out, None, None), odir)
+        if rc != 0:
+            raise RuntimeError(f"oracle run for {kind!r} failed: rc={rc}")
+        cache[kind] = load_results_npz(out)
+    return cache[kind]
+
+
+# ---------------------------------------------------------------------------
+# the soak loop
+# ---------------------------------------------------------------------------
+
+
+def _apply_pre(pre: str | None, primary_dir: str, ckpt: str) -> None:
+    if pre is None:
+        return
+    if pre == "truncate_current":
+        _faults.truncate_file(ckpt + ".npz", 0.4)
+    elif pre == "nuke_primary":
+        # the primary checkpoint directory dies wholesale — snapshots,
+        # versions, manifests AND the journal (a dead disk keeps nothing)
+        shutil.rmtree(primary_dir, ignore_errors=True)
+    else:
+        raise ValueError(f"unknown pre-op {pre!r}")
+
+
+def _postmortem_story(cwd: str, preempted: bool) -> str | None:
+    """The flight-recorder contract per episode: a preempted episode leaves
+    a parseable post-mortem naming the crash, a clean one leaves none.
+    Returns a problem string or None."""
+    from graphdyn.obs.flight import POSTMORTEM_NAME
+    from graphdyn.obs.recorder import read_ledger
+
+    path = os.path.join(cwd, POSTMORTEM_NAME)
+    if not preempted:
+        if os.path.exists(path):
+            return f"clean episode left a post-mortem at {path}"
+        return None
+    if not os.path.exists(path):
+        return "preempted episode left no flight post-mortem"
+    try:
+        events, _ = read_ledger(path)
+    except ValueError as e:
+        return f"unparseable post-mortem: {e}"
+    crash = [e for e in events
+             if e.get("ev") == "counter" and e.get("name") == "obs.crash"]
+    if not crash:
+        return "post-mortem carries no obs.crash event"
+    if not (crash[-1].get("attrs") or {}).get("site"):
+        return "obs.crash names no site"
+    return None
+
+
+def run_scenario(name: str, seed: int, root: str,
+                 oracle_cache: dict) -> dict:
+    """One (scenario, seed) soak run: the episode chain, then the three
+    contract checks (oracle parity, journal validity + required ops, flight
+    story). Returns a report dict with ``ok`` + per-check details."""
+    scn = SCENARIOS[name]
+    rng = np.random.default_rng(seed)
+    episodes = _plan_episodes(name, rng)
+    workdir = os.path.join(root, name, f"seed{seed}")
+    primary_dir = os.path.join(workdir, "primary")
+    mirror_dir = os.path.join(workdir, "mirror") if scn.mirror else None
+    ckpt = os.path.join(primary_dir, "ck")
+    out = os.path.join(workdir, "res.npz")
+    args = _workload_args(scn.workload, out, ckpt, mirror_dir)
+
+    problems: list[str] = []
+    ep_log: list[dict] = []
+    for i, ep in enumerate(episodes):
+        _apply_pre(ep.pre, primary_dir, ckpt)
+        # each episode simulates a fresh requeued process: the journal
+        # stamps a new manifest line (the exactly-once seam)
+        _store._reset_journal_state()
+        cwd = os.path.join(workdir, f"ep{i}")
+        plan_seed = int(rng.integers(0, 2**31 - 1))
+        plan = (_faults.FaultPlan(
+            [_faults.FaultSpec(**s) for s in ep.specs], seed=plan_seed)
+            if ep.specs else contextlib.nullcontext())
+        with plan:
+            rc = _run_cli(args, cwd)
+        ep_log.append({"episode": i, "rc": rc, "specs": ep.specs,
+                       "pre": ep.pre})
+        early = rc == EX_OK and ep.expect == EX_TEMPFAIL
+        if early:
+            # a randomized schedule may plan its kill past the work that
+            # remains after resume (e.g. the signal lands after the last
+            # repetition) — completing early is a legitimate outcome of a
+            # chaos chain, and the parity/journal checks below still hold
+            # it to the full contract
+            ep_log[-1]["early_finish"] = True
+        elif rc != ep.expect:
+            problems.append(
+                f"episode {i}: exit {rc!r}, expected {ep.expect} "
+                f"(specs {ep.specs}, pre {ep.pre})"
+            )
+            break
+        story = _postmortem_story(cwd, preempted=(rc == EX_TEMPFAIL))
+        if story:
+            problems.append(f"episode {i}: {story}")
+        if early:
+            break
+    if not problems and not any(e["rc"] == EX_TEMPFAIL for e in ep_log):
+        problems.append(
+            "no episode was actually preempted — the scenario never "
+            "exercised its fault composition"
+        )
+
+    # 1. bit-exact parity with the fault-free oracle
+    if not problems:
+        from graphdyn.utils.io import load_results_npz
+
+        oracle = _oracle(scn.workload, root, oracle_cache)
+        got = load_results_npz(out)
+        if set(got) != set(oracle):
+            problems.append(
+                f"result keys differ: {sorted(got)} vs {sorted(oracle)}")
+        else:
+            for k in oracle:
+                if not np.array_equal(got[k], oracle[k]):
+                    problems.append(f"result array {k!r} is not bit-exact")
+
+    # 2. the journal story (the one that survived — after a primary nuke
+    # that is the post-failover journal)
+    journal = os.path.join(primary_dir, _store.JOURNAL_NAME)
+    ops: list[str] = []
+    if os.path.exists(journal):
+        events, jproblems = _store.validate_journal(journal)
+        problems += [f"journal: {p}" for p in jproblems]
+        ops = [e.get("op") for e in events if e.get("ev") == "journal"]
+    else:
+        problems.append("no run journal was written")
+    for op in scn.require_ops:
+        if op not in ops:
+            problems.append(
+                f"journal never recorded the scenario's {op!r} op "
+                f"(saw {sorted(set(ops))})"
+            )
+    # bitrot acceptance: detection must be unconditional — the quarantine
+    # reason names the checksum layer, never an accepted wrong resume
+    if name == "bitrot" and not problems:
+        qs = [e for e in _store.validate_journal(journal)[0]
+              if e.get("op") == "quarantine"]
+        if not any("Checksum" in (q.get("reason") or "") for q in qs):
+            problems.append("bitrot was not caught by the checksum layer")
+
+    return {"scenario": name, "seed": seed, "workload": scn.workload,
+            "episodes": ep_log, "journal_ops": sorted(set(ops)),
+            "problems": problems, "ok": not problems}
+
+
+def run_soak(scenarios=None, seeds=BOUNDED_SEEDS, root: str | None = None,
+             diag=lambda s: None) -> dict:
+    """The full soak matrix. Returns ``{"runs": [...], "ok": bool,
+    "scenarios": N, "seeds": M, "failed": K}``."""
+    names = list(scenarios or SCENARIOS)
+    tmp = None
+    if root is None:
+        tmp = tempfile.mkdtemp(prefix="graphdyn_soak_")
+        root = tmp
+    oracle_cache: dict = {}
+    runs = []
+    try:
+        for name in names:
+            for seed in seeds:
+                diag(f"soak: {name} seed={seed}")
+                rep = run_scenario(name, int(seed), root, oracle_cache)
+                diag(f"soak: {name} seed={seed} -> "
+                     f"{'ok' if rep['ok'] else 'FAIL: ' + '; '.join(rep['problems'])}")
+                runs.append(rep)
+    finally:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+    failed = sum(1 for r in runs if not r["ok"])
+    return {"runs": runs, "ok": failed == 0, "scenarios": len(names),
+            "seeds": len(list(seeds)), "failed": failed}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m graphdyn.resilience.soak",
+        description="chaos soak: composed-fault kill/requeue cycles over "
+                    "real CLI workloads, bit-exact against a fault-free "
+                    "oracle (ARCHITECTURE.md 'Chaos soak')",
+    )
+    ap.add_argument("--bounded", action="store_true",
+                    help="the tier-1 / lint.sh soakcheck configuration "
+                    "(all scenarios, 3 seeds, small workloads)")
+    ap.add_argument("--seeds", type=int, default=None, metavar="N",
+                    help="number of seeds per scenario (default: 3 bounded, "
+                    "5 otherwise)")
+    ap.add_argument("--scenarios", default=None,
+                    help="comma-separated subset (default: all; see "
+                    "--list)")
+    ap.add_argument("--list", action="store_true",
+                    help="list the scenario catalogue and exit")
+    ap.add_argument("--root", default=None, metavar="DIR",
+                    help="keep the soak working tree here instead of a "
+                    "deleted temp dir (post-mortem debugging)")
+    ap.add_argument("--format", choices=["text", "json"], default="text")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for s in SCENARIOS.values():
+            print(f"{s.name:18s} [{s.workload}"
+                  f"{', mirror' if s.mirror else ''}] {s.summary}")
+        return 0
+    names = args.scenarios.split(",") if args.scenarios else None
+    if names:
+        unknown = [n for n in names if n not in SCENARIOS]
+        if unknown:
+            ap.error(f"unknown scenario(s): {unknown}; "
+                     f"known: {sorted(SCENARIOS)}")
+    n_seeds = args.seeds if args.seeds is not None else (
+        len(BOUNDED_SEEDS) if args.bounded else 5)
+    report = run_soak(
+        scenarios=names, seeds=range(n_seeds), root=args.root,
+        diag=lambda s: print(s, file=sys.stderr, flush=True),
+    )
+    if args.format == "json":
+        print(json.dumps(report))
+    else:
+        for r in report["runs"]:
+            status = "ok" if r["ok"] else "FAIL"
+            print(f"{r['scenario']:18s} seed={r['seed']} "
+                  f"episodes={len(r['episodes'])} {status}")
+            for p in r["problems"]:
+                print(f"    {p}")
+        print(f"soak: {report['scenarios']} scenario(s) x "
+              f"{report['seeds']} seed(s), {report['failed']} failed")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

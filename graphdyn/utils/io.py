@@ -126,15 +126,29 @@ class Checkpoint:
     JSON-encoded ``__meta__`` entry. The single file goes through a temp
     file + ``os.replace``, so arrays and metadata can never be torn apart by
     a preemption — a reader sees either the old checkpoint or the new one.
+
+    The durable store (:class:`graphdyn.resilience.store.DurableCheckpoint`,
+    reached through :func:`open_checkpoint`) subclasses this with checksums,
+    retention and mirroring — same file format, same fault sites.
     """
 
     _META_KEY = "__meta__"
 
+    #: structural-corruption exceptions (vs transient OSError, which must
+    #: propagate): what quarantine-and-fall-back is allowed to swallow
+    _STRUCTURAL = (zipfile.BadZipFile, zlib.error, EOFError, ValueError)
+
+    #: quarantined corruption evidence retained per checkpoint path (oldest
+    #: cleaned first) — bounded so an unattended requeue loop cannot fill
+    #: the disk with .corrupt files
+    _QUARANTINE_KEEP = 5
+
     def __init__(self, path: str):
         self.path = path
 
-    def save(self, arrays: dict[str, Any], meta: dict[str, Any]) -> None:
-        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+    def _payload(self, arrays: dict[str, Any],
+                 meta: dict[str, Any]) -> dict[str, np.ndarray]:
+        """Validate + assemble the npz payload (arrays + JSON meta entry)."""
         if self._META_KEY in arrays:
             raise ValueError(f"array key {self._META_KEY!r} is reserved")
         payload = {k: np.asarray(v) for k, v in arrays.items()}
@@ -152,6 +166,11 @@ class Checkpoint:
         payload[self._META_KEY] = np.frombuffer(
             json.dumps(meta).encode(), dtype=np.uint8
         )
+        return payload
+
+    def _write_fault_gate(self) -> None:
+        """The ``checkpoint.write`` fault site (raise-ENOSPC / torn temp
+        file / preempt), shared by the plain and durable save paths."""
         spec = _faults.check_fault("checkpoint.write", key=self.path)
         if spec is not None and spec.action != "signal":
             if spec.action == "preempt":
@@ -164,6 +183,21 @@ class Checkpoint:
                 with open(self.path + ".tmp.npz", "wb") as f:
                     f.write(b"PK\x03\x04 torn by injected preemption")
             raise _faults.InjectedWriteError(self.path)
+
+    def save(self, arrays: dict[str, Any], meta: dict[str, Any]) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        payload = self._payload(arrays, meta)
+        self._write_fault_gate()
+        self._persist(payload, meta)
+
+    def _persist(self, payload: dict[str, np.ndarray],
+                 meta: dict[str, Any]) -> None:
+        """One complete persistence of the assembled payload — the subclass
+        hook. The durable store overrides THIS, not :meth:`save`, so every
+        checkpoint write (plain or durable) flows through the one ``save``
+        entry point — wrappers patched onto ``Checkpoint.save`` (the test
+        suite's abort-after-save preemption fixture, retry shims) observe
+        durable saves too."""
         from graphdyn import obs
 
         with obs.current().span("io.ckpt.write", path=self.path) as sp:
@@ -181,6 +215,48 @@ class Checkpoint:
             except FileNotFoundError:
                 pass
 
+    def _read_npz(self, path: str) -> tuple[dict[str, np.ndarray], dict]:
+        """One structural npz read (arrays + decoded meta); raises the
+        :data:`_STRUCTURAL` exceptions on corruption, ``OSError`` on
+        transient trouble — classification is the caller's policy."""
+        with np.load(path) as f:
+            arrays = {k: f[k] for k in f.files if k != self._META_KEY}
+            if self._META_KEY in f.files:
+                meta = json.loads(f[self._META_KEY].tobytes().decode())
+            else:
+                # foreign/legacy npz (e.g. a reference-style results
+                # file): still loadable, just with empty metadata
+                meta = {}
+        return arrays, meta
+
+    def _quarantine_file(self, path: str) -> str:
+        """Move ``path`` aside as corruption evidence with a MONOTONIC
+        suffix (``.corrupt.1.npz``, ``.corrupt.2.npz``, …) so a second
+        corruption can never overwrite the first's evidence; at most
+        :data:`_QUARANTINE_KEEP` are retained (oldest removed first)."""
+        import glob as _glob
+        import re as _re
+
+        pat = _re.compile(_re.escape(self.path) + r"\.corrupt\.(\d+)\.npz$")
+        existing = sorted(
+            (int(m.group(1)), f)
+            for f in _glob.glob(_glob.escape(self.path) + ".corrupt.*.npz")
+            if (m := pat.match(f))
+        )
+        nxt = (existing[-1][0] + 1) if existing else 1
+        quarantine = f"{self.path}.corrupt.{nxt}.npz"
+        try:
+            os.replace(path, quarantine)
+        except OSError:
+            return "<unquarantined: rename failed>"
+        retained = existing + [(nxt, quarantine)]
+        for _, f in retained[:-self._QUARANTINE_KEEP]:
+            try:
+                os.remove(f)
+            except OSError:
+                pass
+        return quarantine
+
     def load(self) -> tuple[dict[str, np.ndarray], dict[str, Any]] | None:
         path = self.path + ".npz"
         if not os.path.exists(path):
@@ -193,31 +269,16 @@ class Checkpoint:
 
         try:
             with obs.current().span("io.ckpt.read", path=self.path):
-                with np.load(path) as f:
-                    arrays = {k: f[k] for k in f.files
-                              if k != self._META_KEY}
-                    if self._META_KEY in f.files:
-                        meta = json.loads(
-                            f[self._META_KEY].tobytes().decode()
-                        )
-                    else:
-                        # foreign/legacy npz (e.g. a reference-style
-                        # results file): still loadable, just with empty
-                        # metadata
-                        meta = {}
+                arrays, meta = self._read_npz(path)
         # structural corruption ONLY — a transient read error (plain
         # OSError: EIO, EACCES, network blip) must propagate, not destroy a
         # perfectly good checkpoint by quarantining it
-        except (zipfile.BadZipFile, zlib.error, EOFError, ValueError) as e:
+        except self._STRUCTURAL as e:
             # a corrupted/truncated checkpoint is a first-class condition
             # (torn write on a dying node, partial object-store copy), not
             # a crash: quarantine it for post-mortem and start fresh. The
             # quarantine file is deliberately NOT cleaned by remove().
-            quarantine = self.path + ".corrupt.npz"
-            try:
-                os.replace(path, quarantine)
-            except OSError:
-                quarantine = "<unquarantined: rename failed>"
+            quarantine = self._quarantine_file(path)
             log.warning(
                 "checkpoint at %s is corrupt (%s: %s) — quarantined to %s, "
                 "starting fresh", path, type(e).__name__, e, quarantine,
@@ -227,6 +288,19 @@ class Checkpoint:
                         error=f"{type(e).__name__}: {e}"[:200])
             return None
         return arrays, meta
+
+
+def open_checkpoint(path: str) -> Checkpoint:
+    """The checkpoint factory every consumer goes through
+    (:class:`ChainCheckpointer`, :class:`PeriodicCheckpointer`,
+    :func:`load_validated`, the grouped drivers): returns the durable store
+    (:class:`graphdyn.resilience.store.DurableCheckpoint` — checksum-verified
+    loads, keep-last-K retention, optional ``--ckpt-mirror`` replication,
+    run journal) wrapping the same on-disk snapshot format. Plain
+    :class:`Checkpoint` remains available for format-level tests."""
+    from graphdyn.resilience.store import DurableCheckpoint
+
+    return DurableCheckpoint(path)
 
 
 def load_resume_prefix(ck: Checkpoint, expect: dict[str, Any]):
@@ -255,7 +329,7 @@ def load_validated(path: str, id_key: str, id_value, what: str):
     caller's run identity — the shared load-or-refuse half of the λ-driver
     resume protocol (``entropy_grid``, ``entropy_ensemble_union``). Returns
     ``(arrays, meta)`` or None when no checkpoint exists."""
-    loaded = Checkpoint(path).load()
+    loaded = open_checkpoint(path).load()
     if loaded is None:
         return None
     arrays, meta = loaded
@@ -284,7 +358,7 @@ class ChainCheckpointer:
         self.path = path
         self._meta = {"kind": kind, "seed": int(seed), "fp": fp,
                       **(extra_meta or {})}
-        self.ckpt = Checkpoint(path)
+        self.ckpt = open_checkpoint(path)
         self._pc = PeriodicCheckpointer(path, interval_s=interval_s)
 
     def load_state(self, check=None) -> dict | None:
@@ -362,11 +436,16 @@ def save_with_retry(ckpt: Checkpoint, arrays: dict, meta: dict) -> bool:
     an hours-long chain — the snapshot is insurance, the chain is the
     value. Returns False (with a logged warning) on the degrade path."""
     try:
+        # the pid in the site key seeds SAVE_RETRY's full-jitter: N hosts
+        # retrying a save to the same shared-filesystem path must draw
+        # DE-correlated backoff schedules (path alone would give every
+        # rank the identical seed — the lockstep stampede the jitter
+        # exists to prevent)
         _retry_call(
             lambda: ckpt.save(arrays, meta),
             policy=SAVE_RETRY,
             retry_on=(OSError,),
-            what=f"checkpoint save ({ckpt.path})",
+            what=f"checkpoint save ({ckpt.path}, pid {os.getpid()})",
         )
         return True
     except OSError as e:
@@ -392,7 +471,7 @@ class PeriodicCheckpointer:
     skipped (logged) and the next one is attempted an interval later."""
 
     def __init__(self, path: str, interval_s: float = 30.0, max_saves: int | None = None):
-        self.ckpt = Checkpoint(path)
+        self.ckpt = open_checkpoint(path)
         self.interval_s = interval_s
         self.max_saves = max_saves
         self._last = time.monotonic()

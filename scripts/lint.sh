@@ -65,6 +65,22 @@ else
     echo "== faultcheck: pytest not installed — SKIPPED (pip install pytest to enable) =="
 fi
 
+# 4b. soakcheck — the bounded chaos-soak matrix standalone (python -m
+#     graphdyn.resilience.soak --bounded): composed-fault kill/requeue
+#     cycles over real CLI workloads — every scenario x seed must end in
+#     bit-exact parity with a fault-free oracle, a schema-valid run
+#     journal, and a parseable flight post-mortem per preemption. Skipped
+#     with a notice when GRAPHDYN_SKIP_SOAKCHECK=1 (set by the tier-1
+#     lint-gate test: the same bounded matrix runs in-suite via
+#     tests/test_soak.py — no double work; mirrors faultcheck).
+if [ "${GRAPHDYN_SKIP_SOAKCHECK:-0}" = "1" ]; then
+    echo "== soakcheck: GRAPHDYN_SKIP_SOAKCHECK=1 — SKIPPED (matrix runs in tier-1) =="
+else
+    echo "== soakcheck (python -m graphdyn.resilience.soak --bounded) =="
+    JAX_PLATFORMS=cpu python -m graphdyn.resilience.soak --bounded \
+        --format=text || fail=1
+fi
+
 # 5. pallascheck — the interpret-mode Pallas kernel parity subset
 #    standalone (pytest -m pallas_interpret): the fused BDCM kernel —
 #    serial and grouped — must reproduce the XLA sweep within the
@@ -232,6 +248,20 @@ else:
         else:
             print(f"benchcheck: fingerprints stable vs {path} "
                   f"({len(fp['entries'])} entries)")
+# the durable-store save-overhead column: an interleaved p50/p99 A/B of
+# DurableCheckpoint.save vs raw Checkpoint.save, or an explicit null +
+# reason — never silently absent
+assert "ckpt_save_overhead" in row, "ckpt_save_overhead column absent"
+cso = row["ckpt_save_overhead"]
+if cso is None:
+    assert row.get("ckpt_save_overhead_skipped_reason"), \
+        "null ckpt_save_overhead needs ckpt_save_overhead_skipped_reason"
+    print("benchcheck: ckpt_save_overhead skipped:",
+          row["ckpt_save_overhead_skipped_reason"])
+else:
+    assert cso.get("overhead_p50_x", 0) > 0, cso
+    assert cso.get("raw_p50_s", 0) > 0 and cso.get("durable_p50_s", 0) > 0
+    assert cso.get("snapshot_bytes", 0) > 0
 # the device-memory column: a positive peak, or an explicit null + reason
 # (CPU: no usable memory_stats) — never silently absent, never 0
 assert "peak_hbm_bytes" in row, "peak_hbm_bytes column absent"

@@ -78,6 +78,18 @@ def test_bench_smoke_emits_one_json_line():
         # round's own ledger
         spans = {e["name"] for e in events if e["ev"] == "span"}
         assert "bench.packed_rate" in spans and "bench.int8_rate" in spans
+    # the durable-store save-overhead column (interleaved p50/p99 A/B of
+    # DurableCheckpoint.save vs raw Checkpoint.save): a measured ratio or
+    # an explicit null + reason — never silently absent
+    assert "ckpt_save_overhead" in row
+    cso = row["ckpt_save_overhead"]
+    if cso is None:
+        assert row["ckpt_save_overhead_skipped_reason"]
+    else:
+        assert cso["overhead_p50_x"] > 0
+        assert cso["raw_p50_s"] > 0 and cso["durable_p50_s"] > 0
+        assert cso["raw_p99_s"] > 0 and cso["durable_p99_s"] > 0
+        assert cso["snapshot_bytes"] > 0 and cso["saves"] > 0
     # the device-memory column: a positive peak, or an explicit null +
     # reason (CPU: no usable memory_stats) — never silently absent,
     # never a fake 0 (graphdyn.obs.memband.peak_hbm_bytes)

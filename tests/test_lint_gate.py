@@ -31,13 +31,17 @@ def test_lint_sh_gate_passes():
              "GRAPHDYN_SKIP_PALLASCHECK": "1",
              "GRAPHDYN_SKIP_HLOCHECK": "1",
              "GRAPHDYN_SKIP_OBSCHECK": "1",
-             "GRAPHDYN_SKIP_MEMCHECK": "1"},
+             "GRAPHDYN_SKIP_MEMCHECK": "1",
+             "GRAPHDYN_SKIP_SOAKCHECK": "1"},
     )
     assert proc.returncode == 0, (
         f"lint gate failed:\n{proc.stdout}\n{proc.stderr}"
     )
     assert "lint gate: OK" in proc.stdout
     assert "faultcheck" in proc.stdout    # the step exists and announced itself
+    # the soakcheck hatch: the step exists, announced itself, and honored
+    # the skip variable (the bounded soak matrix runs in-suite instead)
+    assert "soakcheck: GRAPHDYN_SKIP_SOAKCHECK=1" in proc.stdout
     assert "benchcheck" in proc.stdout    # likewise for the bench contract
     assert "pallascheck" in proc.stdout   # likewise for the kernel parity set
     assert "hlocheck" in proc.stdout      # likewise for the program auditor

@@ -227,15 +227,17 @@ def test_corrupt_checkpoint_quarantined_not_raised(tmp_path, caplog):
     with caplog.at_level(logging.WARNING, logger="graphdyn.io"):
         with FaultPlan([FaultSpec("checkpoint.read", action="truncate")]):
             assert ck.load() is None             # never zipfile.BadZipFile
-    assert os.path.exists(str(tmp_path / "s.corrupt.npz"))
+    assert os.path.exists(str(tmp_path / "s.corrupt.1.npz"))  # monotonic suffix
     assert "quarantined" in caplog.text
     assert ck.load() is None                     # bad file moved aside
 
 
 def test_chain_resumes_fresh_after_corruption(tmp_path):
     """Preempt a chain, corrupt its snapshot on disk, rerun: the corrupt
-    file is quarantined, the chain restarts fresh and still lands on the
-    uninterrupted result."""
+    file is quarantined and the chain still lands on the uninterrupted
+    result (since the durable store, via a retained-version fallback when
+    one survives — the truncation travels through the promote hard link to
+    the newest version — else a fresh start; both are bit-exact)."""
     g = random_regular_graph(24, 3, seed=0)
     cfg = SAConfig(dynamics=DYN11)
     kw = dict(n_replicas=1, seed=0, max_steps=4000)
@@ -248,7 +250,7 @@ def test_chain_resumes_fresh_after_corruption(tmp_path):
     truncate_file(str(tmp_path / "ck.npz"), 0.4)
     res = simulated_annealing(g, cfg, **kw, **ckw)
     _assert_sa_equal(base, res)
-    assert os.path.exists(str(tmp_path / "ck.corrupt.npz"))
+    assert os.path.exists(str(tmp_path / "ck.corrupt.1.npz"))
     assert not os.path.exists(str(tmp_path / "ck.npz"))   # removed on success
 
 
@@ -569,6 +571,101 @@ def test_init_multihost_deterministic_runtime_error_not_retried():
                            retry_deadline_s=30.0)
     assert m.call_count == 1
     assert time.monotonic() - t0 < 2.0
+
+
+def test_retry_jitter_deterministic_per_key_and_spread_across_ranks():
+    """Seeded full-jitter (RetryPolicy.jitter): the same site key replays
+    the same schedule (tests stay deterministic), distinct rank keys draw
+    de-correlated schedules (no retry storms against a shared coordinator
+    or filesystem), and every delay stays within (0, exponential bound]."""
+    pol = RetryPolicy(tries=6, base_delay_s=0.5, max_delay_s=8.0, jitter=True)
+    a1 = list(pol.delays(key="jax.distributed.initialize(rank 0)"))
+    a2 = list(pol.delays(key="jax.distributed.initialize(rank 0)"))
+    b = list(pol.delays(key="jax.distributed.initialize(rank 1)"))
+    assert a1 == a2                              # deterministic per key
+    assert a1 != b                               # spread across ranks
+    bounds = [0.5, 1.0, 2.0, 4.0, 8.0]
+    for seq in (a1, b):
+        assert len(seq) == 5
+        assert all(0.0 < d <= hi for d, hi in zip(seq, bounds))
+    # jitter off (the default) keeps the exact exponential schedule
+    assert list(RetryPolicy(tries=4, base_delay_s=0.5).delays(key="x")) == \
+        [0.5, 1.0, 2.0]
+
+
+def test_retry_passes_site_key_to_jittered_policy():
+    """retry() seeds the jitter from its `what` site string — two sites
+    with the same policy sleep different schedules."""
+    slept = {}
+    for what in ("site-a", "site-b"):
+        seq = []
+        with pytest.raises(OSError):
+            retry(lambda: (_ for _ in ()).throw(OSError("dead")),
+                  policy=RetryPolicy(tries=4, base_delay_s=0.01, jitter=True),
+                  what=what, sleep=seq.append)
+        slept[what] = seq
+    assert len(slept["site-a"]) == 3
+    assert slept["site-a"] != slept["site-b"]
+
+
+def test_second_signal_hard_abort_exit_code_no_snapshot_flight_dump(
+        tmp_path, monkeypatch, capsys):
+    """The second-SIGTERM hard-abort path end to end through the CLI: the
+    first signal sets the flag, the second aborts immediately — exit 130
+    (EX_ABORT, never 75: schedulers must NOT requeue an operator abort), no
+    snapshot written by the abort, and the flight recorder's post-mortem
+    carries an obs.crash event naming the site where the run died."""
+    import threading
+
+    import graphdyn.cli as cli_mod
+    from graphdyn.obs.flight import POSTMORTEM_NAME
+    from graphdyn.obs.recorder import read_ledger
+    from graphdyn.resilience import EX_ABORT
+
+    monkeypatch.chdir(tmp_path)                  # the post-mortem's workdir
+    ck = str(tmp_path / "ck")
+
+    def fake_run(args):
+        # a long chunk that never reaches a save boundary: the abort, not
+        # the driver, decides how this ends
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            time.sleep(0.005)
+        raise AssertionError("signals never arrived")
+
+    monkeypatch.setattr(cli_mod, "_run", fake_run)
+
+    # the graceful handler is installed inside main(); firing before that
+    # would hit pytest's default SIGTERM disposition and kill the whole
+    # test process — wait until the handler visibly changes
+    before = signal.getsignal(signal.SIGTERM)
+
+    def killer():
+        deadline = time.monotonic() + 5.0
+        while (signal.getsignal(signal.SIGTERM) is before
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        os.kill(os.getpid(), signal.SIGTERM)     # 1st: flag
+        while not shutdown_requested() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        os.kill(os.getpid(), signal.SIGTERM)     # 2nd: immediate abort
+
+    t = threading.Thread(target=killer)
+    t.start()
+    rc = cli_mod.main(["sa", "--n", "40", "--checkpoint", ck])
+    t.join()
+    capsys.readouterr()
+    assert rc == EX_ABORT == 130
+    assert not os.path.exists(ck + ".npz")       # nothing saved by the abort
+    events, torn = read_ledger(str(tmp_path / POSTMORTEM_NAME))
+    assert torn == 0
+    crash = [e for e in events
+             if e.get("ev") == "counter" and e.get("name") == "obs.crash"]
+    assert crash, events
+    attrs = crash[-1]["attrs"]
+    assert attrs["reason"] == "abort"
+    assert attrs["exc_type"] == "KeyboardInterrupt"
+    assert "site" in attrs                       # innermost frame named
 
 
 def test_init_multihost_retries_coordinator_with_deadline():
