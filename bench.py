@@ -371,6 +371,60 @@ def ckpt_save_overhead(smoke: bool):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def heartbeat_overhead(smoke: bool):
+    """The liveness tax: interleaved A/B of the same entropy smoke workload
+    with the supervision watchdog ON (thread + beat-age polls; a generous
+    stall timeout so it never fires) vs OFF. Heartbeats themselves are
+    unconditional at every chunk/rep/λ boundary, so the row proves the
+    WHOLE liveness stack — beats + watchdog — is measurably near-free;
+    `beats_per_run` confirms the workload actually heartbeats. Null +
+    reason on failure, never silent (benchcheck asserts the contract)."""
+    import contextlib
+
+    from graphdyn import obs
+    from graphdyn.config import DynamicsConfig, EntropyConfig
+    from graphdyn.models.entropy import entropy_grid
+    from graphdyn.resilience import supervisor as _sup
+
+    cfg = EntropyConfig(
+        dynamics=DynamicsConfig(p=1, c=1),
+        lmbd_max=0.2, lmbd_step=0.1, eps=1e-5, damp=0.1,
+        max_sweeps=120, num_rep=1,
+    )
+    reps = 3 if smoke else 6
+
+    def run_once() -> int:
+        n0 = _sup.last_beat()[0]
+        entropy_grid(48, np.asarray([1.5]), cfg, seed=0)
+        return _sup.last_beat()[0] - n0
+
+    beats = run_once()                  # warmup: pays the compile
+    legs = (
+        ("off", contextlib.nullcontext),
+        # stall timeout far above the workload's runtime: the watchdog
+        # must RUN (poll loop reading beat ages) without ever escalating
+        ("on", lambda: _sup.supervision(stall_timeout_s=60.0)),
+    )
+    times: dict = {label: [] for label, _ in legs}
+    # INTERLEAVED legs for the same reason as ckpt_save_overhead: back-to-
+    # back batches read ambient drift as a watchdog difference
+    for _ in range(reps):
+        for label, cm in legs:
+            with cm():
+                with obs.timed("bench.heartbeat", leg=label) as sw:
+                    run_once()
+            times[label].append(sw.wall_s)
+    out = {}
+    for label, _ in legs:
+        out[label + "_p50_s"] = float(np.percentile(times[label], 50))
+    return {"heartbeat_overhead": {
+        **out,
+        "overhead_p50_x": out["on_p50_s"] / out["off_p50_s"],
+        "beats_per_run": int(beats),
+        "runs": reps,
+    }}
+
+
 def fingerprint_rows():
     """The graftcheck program-fingerprint summary persisted with every
     round (``BENCH_*.json``): per headline entry point, the ledger-gated
@@ -654,6 +708,16 @@ def main():
             "ckpt_save_overhead": None,
             "ckpt_save_overhead_skipped_reason":
                 f"ckpt save A/B failed: {str(e)[:150]}",
+        })
+    _mark("liveness watchdog overhead (heartbeat_overhead)")
+    try:
+        extra.update(heartbeat_overhead(args.smoke))
+    except Exception as e:  # noqa: BLE001 — optional row, never silent
+        _mark(f"heartbeat overhead row failed: {str(e)[:150]}")
+        extra.update({
+            "heartbeat_overhead": None,
+            "heartbeat_overhead_skipped_reason":
+                f"heartbeat A/B failed: {str(e)[:150]}",
         })
     _mark("program fingerprints (graftcheck structural summary)")
     try:

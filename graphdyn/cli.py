@@ -103,12 +103,15 @@ def build_parser() -> argparse.ArgumentParser:
         # subparser ever sees it (subparsers keep their own abbreviation)
         allow_abbrev=False,
         epilog="Exit codes: 0 success; 75 (EX_TEMPFAIL) graceful preemption "
-               "shutdown — SIGTERM/SIGINT checkpointed at the next chunk "
+               "shutdown — SIGTERM/SIGINT, a --deadline expiry, or the "
+               "--stall-timeout watchdog checkpointed at the next chunk "
                "boundary, safe for a scheduler to requeue; 130 hard abort — "
                "a SECOND signal during the grace window (the operator "
-               "asking twice outranks the checkpoint: nothing is written); "
-               "anything else is a real failure. See ARCHITECTURE.md "
-               "'Resilience'.",
+               "asking twice outranks the checkpoint: nothing is written) "
+               "or a wedged run the watchdog gave up on; 86 a supervised "
+               "run quarantined after a crash loop (run-supervised; do NOT "
+               "requeue); anything else is a real failure. See "
+               "ARCHITECTURE.md 'Resilience' + 'Supervised execution'.",
     )
     ap.add_argument(
         "--ckpt-mirror", default=None, metavar="DIR",
@@ -126,6 +129,24 @@ def build_parser() -> argparse.ArgumentParser:
              "falls back to the newest verifiable version instead of "
              "restarting the run (default: 2; also honored from "
              "GRAPHDYN_CKPT_KEEP, this flag wins)",
+    )
+    ap.add_argument(
+        "--stall-timeout", type=float, default=None, metavar="SECS",
+        help="liveness watchdog: when no chunk/rep/lambda boundary "
+             "heartbeat arrives for SECS, request a graceful shutdown "
+             "(snapshot at the next boundary, exit 75); a run that stays "
+             "wedged past the grace window is hard-aborted (exit 130) with "
+             "a flight-recorder post-mortem naming the stalled boundary. "
+             "Also honored from GRAPHDYN_STALL_TIMEOUT (this flag wins). "
+             "ARCHITECTURE.md 'Supervised execution'",
+    )
+    ap.add_argument(
+        "--deadline", type=float, default=None, metavar="SECS",
+        help="run time budget: after SECS, take the same graceful "
+             "snapshot + exit-75 path a SIGTERM takes — preemption "
+             "semantics on a timer, so a resumed/requeued run continues "
+             "from the snapshot. Also honored from GRAPHDYN_DEADLINE "
+             "(this flag wins)",
     )
     ap.add_argument(
         "--compile-cache", default=None, metavar="DIR",
@@ -337,6 +358,21 @@ def build_parser() -> argparse.ArgumentParser:
              "sums); npz keys gain a member axis",
     )
 
+    sup = sub.add_parser(
+        "run-supervised",
+        help="wrap a graphdyn command under the resilience supervisor "
+             "(python -m graphdyn.resilience.supervisor): heartbeat "
+             "watchdog, per-episode deadline, bounded auto-restart with "
+             "crash-loop quarantine — see that module's --help for the "
+             "policy flags",
+    )
+    sup.add_argument(
+        "command", nargs=argparse.REMAINDER,
+        help="supervisor flags, then the command to supervise "
+             "(conventionally after '--'): graphdyn run-supervised "
+             "--stall-timeout 300 -- sa --n 100000 --checkpoint ck/run",
+    )
+
     return ap
 
 
@@ -351,7 +387,49 @@ def main(argv=None) -> int:
         set_save_retry,
     )
 
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "run-supervised":
+        # delegate to the supervisor's own entry point BEFORE any parsing
+        # or run machinery (signal scope, recorder, watchdog): the
+        # supervisor is the parent of runs, never inside one — and
+        # argparse's REMAINDER cannot carry the supervisor's own leading
+        # flags, so the handoff happens on raw argv
+        from graphdyn.resilience.supervisor import main as supervisor_main
+
+        cmd = argv[1:]
+        if cmd and cmd[0] == "--":
+            cmd = cmd[1:]
+        return supervisor_main(cmd)
+
     args = build_parser().parse_args(argv)
+
+    if args.cmd == "run-supervised":
+        # the registered-subparser path: top-level flags preceded the
+        # subcommand, so they were parsed HERE — forward them instead of
+        # silently dropping them (a dropped --stall-timeout would run the
+        # child with no watchdog: the exact silent-liveness gap this
+        # subsystem exists to close). Watchdog knobs go to the supervisor,
+        # the other top-level flags back onto the child command line.
+        from graphdyn.resilience.supervisor import main as supervisor_main
+
+        cmd = list(args.command)
+        if cmd and cmd[0] == "--":
+            cmd = cmd[1:]
+        sup_flags: list = []
+        if args.stall_timeout is not None:
+            sup_flags += ["--stall-timeout", str(args.stall_timeout)]
+        if args.deadline is not None:
+            sup_flags += ["--deadline", str(args.deadline)]
+        child_pre: list = []
+        for flag, val in (("--ckpt-mirror", args.ckpt_mirror),
+                          ("--ckpt-keep", args.ckpt_keep),
+                          ("--compile-cache", args.compile_cache),
+                          ("--obs-ledger", args.obs_ledger),
+                          ("--profile", args.profile)):
+            if val is not None:
+                child_pre += [flag, str(val)]
+        return supervisor_main(sup_flags + ["--"] + child_pre + cmd)
 
     # opt-in persistent compile cache (flag wins over the env variable);
     # must apply before anything traces
@@ -391,10 +469,21 @@ def main(argv=None) -> int:
     from graphdyn import obs
     from graphdyn.obs import flight, trace
 
+    # supervised-execution knobs (flag wins over env): the watchdog thread
+    # exists only when one of them is set — an unsupervised run pays only
+    # the per-boundary heartbeat gauge
+    from graphdyn.resilience.supervisor import env_float, supervision
+
+    stall_timeout = (args.stall_timeout if args.stall_timeout is not None
+                     else env_float("GRAPHDYN_STALL_TIMEOUT"))
+    deadline = (args.deadline if args.deadline is not None
+                else env_float("GRAPHDYN_DEADLINE"))
+
     try:
         with graceful_shutdown(), maybe_alias_sanitizer(), \
                 obs.recording(args.obs_ledger) as rec, \
-                trace.profiling(args.profile):
+                trace.profiling(args.profile), \
+                supervision(stall_timeout, deadline):
             if rec.enabled:
                 # the per-run manifest event: everything needed to read
                 # the rest of the ledger offline (backend, jax version,

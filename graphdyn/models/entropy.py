@@ -31,6 +31,7 @@ from jax import lax
 from graphdyn.config import EntropyConfig
 from graphdyn.resilience import faults as _faults
 from graphdyn.resilience.shutdown import raise_if_requested, shutdown_requested
+from graphdyn.resilience.supervisor import beat as _heartbeat
 from graphdyn.graphs import Graph, erdos_renyi_graph, remove_isolates
 from graphdyn.ops.bdcm import BDCMData, make_leaf_setter
 
@@ -214,6 +215,7 @@ def _run_ladder(
             m_s = f"{m0:.5f}" if np.ndim(m0) == 0 else f"{np.mean(m0):.5f}(mean)"
             e_s = f"{e1:.5f}" if np.ndim(e1) == 0 else f"{np.mean(e1):.5f}(mean)"
             print(f"lambda={lmbd:.2f} t={t} m_init={m_s} ent1={e_s}")
+        _heartbeat("lambda")
         stopping = shutdown_requested()
         if checkpointer is not None and (stopping or checkpointer.due()):
             payload = {

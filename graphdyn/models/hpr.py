@@ -755,6 +755,7 @@ def hpr_ensemble(
     from graphdyn.resilience.shutdown import (
         ShutdownRequested, raise_if_requested, shutdown_requested,
     )
+    from graphdyn.resilience.supervisor import beat as _heartbeat
     from graphdyn.utils.io import (
         PeriodicCheckpointer, load_resume_prefix, open_checkpoint,
         save_results_npz,
@@ -812,6 +813,7 @@ def hpr_ensemble(
         steps[k] = res.num_steps
         graphs[k] = g.nbr
         times[k] = res.elapsed_s
+        _heartbeat("rep")
         if pc is not None:
             pc.maybe_save(driver_payload(), {**run_id, "next_rep": k + 1})
         _faults.maybe_fail("rep.boundary", key=f"rep={k}")

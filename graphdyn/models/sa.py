@@ -634,6 +634,7 @@ def sa_ensemble(
     from graphdyn.resilience.shutdown import (
         ShutdownRequested, raise_if_requested, shutdown_requested,
     )
+    from graphdyn.resilience.supervisor import beat as _heartbeat
     from graphdyn.utils.io import (
         PeriodicCheckpointer, load_resume_prefix, open_checkpoint,
         save_results_npz,
@@ -704,6 +705,7 @@ def sa_ensemble(
         conf[k] = res.s[0]
         graphs[k] = g.nbr
         m_final[k] = res.m_final[0]
+        _heartbeat("rep")
         if pc is not None:
             pc.maybe_save(driver_payload(), {**run_id, "next_rep": k + 1})
         _faults.maybe_fail("rep.boundary", key=f"rep={k}")

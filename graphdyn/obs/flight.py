@@ -98,6 +98,21 @@ def record_gauge(name: str, value, attrs: dict) -> None:
 
 def _crash_attrs(reason: str, exc, site) -> dict:
     attrs = {"reason": reason}
+    # the last liveness heartbeat (count / boundary / age): the ring holds
+    # only the newest 512 events, so a long tail of non-heartbeat noise
+    # could rotate the obs.heartbeat gauges out — the crash event itself
+    # names the last boundary the run crossed, unconditionally
+    try:
+        from graphdyn.resilience.supervisor import last_beat
+
+        n, t, where = last_beat()
+        if n > 0:
+            attrs["heartbeat_n"] = n
+            attrs["heartbeat_age_s"] = round(_MONO() - t, 3)
+            if where is not None:
+                attrs["heartbeat_where"] = where
+    except Exception:  # noqa: BLE001 — crash-path telemetry never raises
+        pass
     if exc is not None:
         attrs["exc_type"] = type(exc).__name__
         attrs["message"] = str(exc)[:500]

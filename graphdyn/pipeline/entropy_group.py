@@ -67,6 +67,7 @@ from jax import lax
 from graphdyn import obs
 from graphdyn.resilience import faults as _faults
 from graphdyn.resilience.shutdown import raise_if_requested, shutdown_requested
+from graphdyn.resilience.supervisor import beat as _heartbeat
 from graphdyn.ops.bdcm import (
     StackedBDCM,
     class_update,
@@ -708,6 +709,7 @@ def run_cell_ladder(
             lam_h[g] = lambdas[k[g]]
             need_leaf[g] = True
 
+        _heartbeat("lambda")
         stopping = shutdown_requested()
         if boundary is not None and (fired or stopping):
             boundary(stopping, info_active())

@@ -30,6 +30,7 @@ from typing import Iterator
 from graphdyn import obs
 from graphdyn.resilience import faults as _faults
 from graphdyn.resilience.shutdown import raise_if_requested, shutdown_requested
+from graphdyn.resilience.supervisor import beat as _beat
 
 
 def group_ranges(start: int, stop: int, size: int) -> Iterator[list[int]]:
@@ -87,9 +88,10 @@ class GroupDriver:
         return start_rep
 
     def chunk_poll(self, next_rep: int) -> None:
-        """Between device chunks of an in-flight group: honor a pending
-        graceful shutdown with a prefix snapshot (the group re-runs from
-        ``next_rep`` on resume)."""
+        """Between device chunks of an in-flight group: heartbeat, then
+        honor a pending graceful shutdown with a prefix snapshot (the group
+        re-runs from ``next_rep`` on resume)."""
+        _beat("chunk")
         if shutdown_requested():
             obs.counter("resilience.shutdown", where="chunk",
                         next_rep=next_rep)
@@ -100,8 +102,11 @@ class GroupDriver:
 
     def rep_boundary(self, k: int) -> None:
         """After repetition ``k``'s results land in the driver arrays:
-        interval-gated snapshot, the ``rep.boundary`` fault site, and the
-        shutdown poll — the serial drivers' exact per-repetition sequence."""
+        heartbeat, interval-gated snapshot, the ``rep.boundary`` fault
+        site, and the shutdown poll — the serial drivers' exact
+        per-repetition sequence. The heartbeat leads, so a snapshot that
+        hangs (dead NFS) is itself a detectable stall."""
+        _beat("rep")
         if self.path is not None:
             # a SERIAL-path run preempted mid-repetition leaves its
             # in-flight chain snapshot at <path>_chain<k>; this repetition

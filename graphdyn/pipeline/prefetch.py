@@ -18,12 +18,15 @@ on the host at once.
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
 from typing import Callable, Iterable
 
 from graphdyn import obs
+
+log = logging.getLogger("graphdyn.pipeline")
 
 _SENTINEL = object()
 
@@ -118,11 +121,22 @@ class HostPrefetcher:
             ) from exc
         return value
 
-    def close(self) -> None:
+    #: how long :meth:`close` waits for the worker before declaring it
+    #: hung (tests shrink this; a build stuck in C code ignores _stop)
+    JOIN_TIMEOUT_S = 5.0
+
+    def close(self, timeout_s: float | None = None) -> None:
         """Stop the worker and release the queue. Idempotent. When an obs
         recorder is active, emits the overlap-utilization gauge: the
         fraction of host build time hidden behind device compute
-        (1 − wait/build; 1.0 = fully overlapped, 0.0 = serial)."""
+        (1 − wait/build; 1.0 = fully overlapped, 0.0 = serial).
+
+        A worker that outlives the join window is a **wedged daemon
+        thread** (a build stuck in a syscall or native code cannot see
+        ``_stop``): it is reported loudly — warning + the
+        ``pipeline.prefetch.hung`` counter — instead of silently abandoned,
+        so the watchdog's flight post-mortem can name the stalled
+        prefetcher instead of an innocent device boundary."""
         if obs.enabled() and self._build_s > 0 and not self._stop.is_set():
             obs.gauge(
                 "pipeline.prefetch.overlap_util",
@@ -139,7 +153,20 @@ class HostPrefetcher:
                 except queue.Empty:
                     break
         if self._thread is not None:
-            self._thread.join(timeout=5.0)
+            timeout_s = self.JOIN_TIMEOUT_S if timeout_s is None else timeout_s
+            self._thread.join(timeout=timeout_s)
+            if self._thread.is_alive():
+                log.warning(
+                    "prefetch worker %s is HUNG: still alive %.3gs after "
+                    "close() (a build is stuck past the stop flag) — "
+                    "abandoning the daemon thread; built %d item(s), "
+                    "depth %d", self._thread.name, timeout_s, self._pos,
+                    self.depth,
+                )
+                obs.counter(
+                    "pipeline.prefetch.hung", depth=self.depth,
+                    items=self._pos, timeout_s=timeout_s,
+                )
             self._thread = None
 
     def __enter__(self) -> "HostPrefetcher":

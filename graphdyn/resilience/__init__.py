@@ -18,6 +18,14 @@ deliver. Three cooperating pieces (ARCHITECTURE.md "Resilience"):
   SIGTERM/SIGINT into "checkpoint at next chunk boundary, exit
   :data:`EX_TEMPFAIL` (75)", so schedulers can tell preemption from
   failure.
+- :mod:`graphdyn.resilience.supervisor` — supervised execution: every
+  driver boundary emits an ``obs.heartbeat`` (:func:`beat`), the
+  :class:`Watchdog` escalates stalls along the shutdown ladder (graceful
+  exit 75, then hard abort 130 with a flight post-mortem), ``--deadline``
+  preempts on a timer, and the :func:`supervise` restart loop
+  (``python -m graphdyn.resilience.supervisor`` /
+  ``graphdyn run-supervised``) maps child exit codes to bounded
+  auto-restart with crash-loop quarantine (exit :data:`EX_QUARANTINE`).
 - :mod:`graphdyn.resilience.store` — the durable checkpoint store every
   consumer reaches via :func:`graphdyn.utils.io.open_checkpoint`:
   SHA-256-verified loads, keep-last-K versioned retention with atomic
@@ -62,6 +70,15 @@ from graphdyn.resilience.shutdown import (  # noqa: F401
     raise_if_requested,
     request_shutdown,
     shutdown_requested,
+)
+from graphdyn.resilience.supervisor import (  # noqa: F401
+    EX_QUARANTINE,
+    RestartPolicy,
+    Watchdog,
+    beat,
+    last_beat,
+    supervise,
+    supervision,
 )
 
 # store.py imports graphdyn.utils.io at module level, and utils.io imports

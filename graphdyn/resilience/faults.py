@@ -59,6 +59,7 @@ import json
 import logging
 import os
 import threading
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -102,16 +103,20 @@ class FaultSpec:
 
     Actions: ``raise`` (site-specific exception), ``preempt`` (hard kill —
     :class:`InjectedPreemption`), ``torn``/``truncate``/``nan``/``bitrot``
-    (data transformations applied by the site), and ``signal`` (deliver a
+    (data transformations applied by the site), ``signal`` (deliver a
     graceful-shutdown request exactly as a SIGTERM handler would — the
-    deterministic, race-free way to test the preemption protocol)."""
+    deterministic, race-free way to test the preemption protocol), and
+    ``stall`` (sleep ``secs`` at the site then continue — a deterministic
+    injectable hang, the watchdog's test primitive: the site stops
+    heartbeating for exactly ``secs``)."""
 
     site: str
-    action: str = "raise"   # raise | preempt | torn | truncate | nan | bitrot | signal
+    action: str = "raise"   # raise | preempt | torn | truncate | nan | bitrot | signal | stall
     at: int = 1
     count: int = 1
     p: float = 1.0
     match: str | None = None
+    secs: float = 0.05      # stall only: how long the site sleeps
     hits: int = field(default=0, init=False)    # per-plan-activation counter
     fired: int = field(default=0, init=False)
 
@@ -237,6 +242,13 @@ def check_fault(site: str, key: str = "") -> FaultSpec | None:
             from graphdyn.resilience.shutdown import request_shutdown
 
             request_shutdown(_signal.SIGTERM)
+        elif spec.action == "stall":
+            # an injectable hang: the site simply stops making progress (and
+            # stops heartbeating) for spec.secs — what a wedged device call
+            # or a dead NFS mount looks like from the watchdog's seat. The
+            # sleep is the whole fault; execution then continues normally,
+            # so an UNsupervised run is perturbed only in wall-clock time.
+            time.sleep(spec.secs)
     return spec
 
 
@@ -246,10 +258,10 @@ def maybe_fail(site: str, key: str = "") -> None:
     EVERY site — never downgraded to a site-specific retryable error),
     ``raise`` → the site's specialized exception. Transform-type actions at
     a raise-only site also raise (a misconfigured plan must not silently
-    no-op); ``signal``'s side effect already happened in
+    no-op); ``signal``'s and ``stall``'s side effects already happened in
     :func:`check_fault`."""
     spec = check_fault(site, key)
-    if spec is None or spec.action == "signal":
+    if spec is None or spec.action in ("signal", "stall"):
         return
     if spec.action == "preempt":
         raise InjectedPreemption(
@@ -273,9 +285,10 @@ def transform_spec(site: str, expected: str, key: str = "") -> FaultSpec | None:
     when its action is ``expected``. ``preempt`` raises
     :class:`InjectedPreemption`, any other mismatched action raises
     :class:`InjectedFault` — a plan that names a site must never silently
-    no-op; ``signal`` returns None (its side effect already happened)."""
+    no-op; ``signal``/``stall`` return None (their side effects already
+    happened)."""
     spec = check_fault(site, key)
-    if spec is None or spec.action == "signal":
+    if spec is None or spec.action in ("signal", "stall"):
         return None
     if spec.action == expected:
         return spec

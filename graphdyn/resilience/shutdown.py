@@ -89,7 +89,13 @@ def raise_if_requested(where: str | None = None) -> None:
     """Raise :class:`ShutdownRequested` if a shutdown is pending — for
     boundaries that have nothing to save (e.g. a driver whose in-flight
     chain already snapshotted). ``where`` names the boundary for the
-    post-mortem (chunk/rep/lambda)."""
+    post-mortem (chunk/rep/lambda). Every call is also a liveness
+    heartbeat (:func:`graphdyn.resilience.supervisor.beat`): any
+    ``where=``-annotated boundary a driver reaches tells the watchdog the
+    run is alive."""
+    from graphdyn.resilience.supervisor import beat
+
+    beat(where)
     if _flag.is_set():
         raise ShutdownRequested(_signum[0], where=where)
 

@@ -44,6 +44,20 @@ scenario             composition
                      preempt → requeue
 ``requeue_storm``    repeated preemption signals at randomized boundaries,
                      several requeues in a row, then a clean finish
+``hang_detect``      injected mid-run ``stall`` (the run stops
+                     heartbeating) → the ``--stall-timeout`` watchdog
+                     requests a graceful shutdown (snapshot + exit 75) →
+                     the SUPERVISOR (:mod:`graphdyn.resilience.supervisor`)
+                     auto-restarts → bit-exact finish
+``deadline_preempt`` ``--deadline`` expires mid-run → the same graceful
+                     snapshot + exit-75 path → requeue without the
+                     deadline finishes bit-exactly
+``crash_loop_quarantine`` the run crashes at the SAME site on every
+                     restart → the supervisor retries with seeded-jitter
+                     backoff, then QUARANTINES after N same-site crashes
+                     (journal ``supervise.quarantine``, bundled
+                     post-mortems, exit 86) instead of restarting an
+                     N+1-th time
 ==================== ======================================================
 
 Run it: ``python -m graphdyn.resilience.soak [--bounded] [--seeds N]
@@ -80,12 +94,14 @@ BOUNDED_SEEDS = (0, 1, 2)
 @dataclasses.dataclass
 class Episode:
     """One kill/requeue cycle: optional pre-op mutating on-disk state (the
-    "between processes" fault), a fault plan for the run, and the exit the
+    "between processes" fault), a fault plan for the run, extra top-level
+    CLI flags for just this episode (e.g. ``--deadline``), and the exit the
     contract demands."""
 
     specs: list
     expect: int = EX_TEMPFAIL
     pre: str | None = None          # "truncate_current" | "nuke_primary"
+    extra_args: tuple = ()
 
 
 @dataclasses.dataclass
@@ -96,6 +112,13 @@ class Scenario:
     mirror: bool = False
     #: journal ops that MUST appear for the scenario to count as exercised
     require_ops: tuple = ()
+    #: flight events (counter/gauge names) that MUST appear in at least one
+    #: preempted episode's post-mortem — the watchdog/deadline detection
+    #: evidence is asserted, not hoped
+    require_flight: tuple = ()
+    #: "episodes" = the scheduler-requeue chain; "hang" / "crash_loop" =
+    #: the run goes through the supervisor's own restart loop
+    mode: str = "episodes"
 
 
 def _plan_episodes(name: str, rng: np.random.Generator) -> list[Episode]:
@@ -158,7 +181,21 @@ def _plan_episodes(name: str, rng: np.random.Generator) -> list[Episode]:
             for _ in range(int(rng.integers(2, 4)))
         ]
         return eps + [Episode(specs=[], expect=EX_OK)]
+    if name == "deadline_preempt":
+        # no injected fault at all: the preemption is the --deadline timer
+        # taking the SIGTERM path mid-run; the requeue runs without it
+        return [
+            Episode(specs=[], extra_args=("--deadline", "0.1")),
+            Episode(specs=[], expect=EX_OK),
+        ]
     raise ValueError(f"unknown scenario {name!r}")
+
+
+#: hang_detect tuning: the injected stall must dwarf the watchdog timeout
+#: (detection happens mid-sleep) while the timeout stays far above any
+#: legitimate inter-boundary gap of the warmed bounded workload
+STALL_SECS = 2.0
+STALL_TIMEOUT_S = 0.75
 
 
 SCENARIOS: dict[str, Scenario] = {
@@ -188,6 +225,31 @@ SCENARIOS: dict[str, Scenario] = {
         Scenario("requeue_storm", "sa",
                  "several preemptions at randomized boundaries in a row",
                  require_ops=("save", "load")),
+        Scenario("hang_detect", "sa",
+                 "injected mid-run stall: the watchdog detects the silent "
+                 "heartbeat, preempts gracefully, the supervisor "
+                 "auto-restarts, the finished run is bit-exact",
+                 require_ops=("save", "load", "supervise.start",
+                              "supervise.restart"),
+                 require_flight=("supervise.stall_detected",),
+                 mode="hang"),
+        # require_ops carries no "load": a deadline firing before the first
+        # λ completes leaves nothing resumable (cold starts re-derive — the
+        # boundary hook's documented skip), and the requeue legitimately
+        # starts fresh; the snapshot→load→resume proof under supervision is
+        # hang_detect's job
+        Scenario("deadline_preempt", "entropy",
+                 "--deadline expires mid-ladder: graceful snapshot + exit "
+                 "75 on a timer, requeue finishes bit-exactly",
+                 require_ops=("save",),
+                 require_flight=("supervise.deadline",)),
+        Scenario("crash_loop_quarantine", "sa",
+                 "same-site crash on every restart: the supervisor backs "
+                 "off, then quarantines with bundled post-mortems instead "
+                 "of restarting forever",
+                 require_ops=("supervise.start", "supervise.restart",
+                              "supervise.quarantine"),
+                 mode="crash_loop"),
     )
 }
 
@@ -277,6 +339,60 @@ def _apply_pre(pre: str | None, primary_dir: str, ckpt: str) -> None:
         raise ValueError(f"unknown pre-op {pre!r}")
 
 
+def _flight_names(cwd: str) -> set:
+    """Counter/gauge event names carried by the episode's flight
+    post-mortem (empty when none exists / unparseable) — the detection
+    evidence ``Scenario.require_flight`` asserts on."""
+    from graphdyn.obs.flight import POSTMORTEM_NAME
+    from graphdyn.obs.recorder import read_ledger
+
+    path = os.path.join(cwd, POSTMORTEM_NAME)
+    if not os.path.exists(path):
+        return set()
+    try:
+        events, _ = read_ledger(path)
+    except ValueError:
+        return set()
+    return {e.get("name") for e in events
+            if e.get("ev") in ("counter", "gauge")}
+
+
+def _check_journal(journal: str, require_ops: tuple,
+                   problems: list) -> list[str]:
+    """Validate the surviving run journal and assert the scenario's
+    required ops appeared; returns the op list (appends problems)."""
+    ops: list[str] = []
+    if os.path.exists(journal):
+        events, jproblems = _store.validate_journal(journal)
+        problems += [f"journal: {p}" for p in jproblems]
+        ops = [e.get("op") for e in events if e.get("ev") == "journal"]
+    else:
+        problems.append("no run journal was written")
+    for op in require_ops:
+        if op not in ops:
+            problems.append(
+                f"journal never recorded the scenario's {op!r} op "
+                f"(saw {sorted(set(ops))})"
+            )
+    return ops
+
+
+def _check_parity(kind: str, out: str, root: str, oracle_cache: dict,
+                  problems: list) -> None:
+    """Bit-exact result parity against the fault-free oracle."""
+    from graphdyn.utils.io import load_results_npz
+
+    oracle = _oracle(kind, root, oracle_cache)
+    got = load_results_npz(out)
+    if set(got) != set(oracle):
+        problems.append(
+            f"result keys differ: {sorted(got)} vs {sorted(oracle)}")
+    else:
+        for k in oracle:
+            if not np.array_equal(got[k], oracle[k]):
+                problems.append(f"result array {k!r} is not bit-exact")
+
+
 def _postmortem_story(cwd: str, preempted: bool) -> str | None:
     """The flight-recorder contract per episode: a preempted episode leaves
     a parseable post-mortem naming the crash, a clean one leaves none.
@@ -306,10 +422,17 @@ def _postmortem_story(cwd: str, preempted: bool) -> str | None:
 
 def run_scenario(name: str, seed: int, root: str,
                  oracle_cache: dict) -> dict:
-    """One (scenario, seed) soak run: the episode chain, then the three
-    contract checks (oracle parity, journal validity + required ops, flight
-    story). Returns a report dict with ``ok`` + per-check details."""
+    """One (scenario, seed) soak run: the episode chain, then the contract
+    checks (oracle parity, journal validity + required ops, flight story +
+    required detection events). Returns a report dict with ``ok`` +
+    per-check details. Supervised scenarios (``mode`` = "hang" /
+    "crash_loop") go through the supervisor's own restart loop instead of
+    the scheduler-requeue episode chain."""
     scn = SCENARIOS[name]
+    if scn.mode == "hang":
+        return _run_hang_detect(scn, seed, root, oracle_cache)
+    if scn.mode == "crash_loop":
+        return _run_crash_loop(scn, seed, root, oracle_cache)
     rng = np.random.default_rng(seed)
     episodes = _plan_episodes(name, rng)
     workdir = os.path.join(root, name, f"seed{seed}")
@@ -321,6 +444,7 @@ def run_scenario(name: str, seed: int, root: str,
 
     problems: list[str] = []
     ep_log: list[dict] = []
+    flight_seen: set = set()
     for i, ep in enumerate(episodes):
         _apply_pre(ep.pre, primary_dir, ckpt)
         # each episode simulates a fresh requeued process: the journal
@@ -332,9 +456,9 @@ def run_scenario(name: str, seed: int, root: str,
             [_faults.FaultSpec(**s) for s in ep.specs], seed=plan_seed)
             if ep.specs else contextlib.nullcontext())
         with plan:
-            rc = _run_cli(args, cwd)
+            rc = _run_cli(list(ep.extra_args) + args, cwd)
         ep_log.append({"episode": i, "rc": rc, "specs": ep.specs,
-                       "pre": ep.pre})
+                       "pre": ep.pre, "extra_args": list(ep.extra_args)})
         early = rc == EX_OK and ep.expect == EX_TEMPFAIL
         if early:
             # a randomized schedule may plan its kill past the work that
@@ -352,6 +476,8 @@ def run_scenario(name: str, seed: int, root: str,
         story = _postmortem_story(cwd, preempted=(rc == EX_TEMPFAIL))
         if story:
             problems.append(f"episode {i}: {story}")
+        if rc == EX_TEMPFAIL:
+            flight_seen |= _flight_names(cwd)
         if early:
             break
     if not problems and not any(e["rc"] == EX_TEMPFAIL for e in ep_log):
@@ -359,37 +485,24 @@ def run_scenario(name: str, seed: int, root: str,
             "no episode was actually preempted — the scenario never "
             "exercised its fault composition"
         )
+    # the detection evidence: e.g. deadline_preempt's post-mortem must
+    # carry the watchdog's supervise.deadline event — the preemption being
+    # CAUSED by the timer is asserted, not assumed
+    for want in scn.require_flight:
+        if not problems and want not in flight_seen:
+            problems.append(
+                f"no preempted episode's post-mortem carries the "
+                f"{want!r} event (saw {sorted(flight_seen)})"
+            )
 
     # 1. bit-exact parity with the fault-free oracle
     if not problems:
-        from graphdyn.utils.io import load_results_npz
-
-        oracle = _oracle(scn.workload, root, oracle_cache)
-        got = load_results_npz(out)
-        if set(got) != set(oracle):
-            problems.append(
-                f"result keys differ: {sorted(got)} vs {sorted(oracle)}")
-        else:
-            for k in oracle:
-                if not np.array_equal(got[k], oracle[k]):
-                    problems.append(f"result array {k!r} is not bit-exact")
+        _check_parity(scn.workload, out, root, oracle_cache, problems)
 
     # 2. the journal story (the one that survived — after a primary nuke
     # that is the post-failover journal)
     journal = os.path.join(primary_dir, _store.JOURNAL_NAME)
-    ops: list[str] = []
-    if os.path.exists(journal):
-        events, jproblems = _store.validate_journal(journal)
-        problems += [f"journal: {p}" for p in jproblems]
-        ops = [e.get("op") for e in events if e.get("ev") == "journal"]
-    else:
-        problems.append("no run journal was written")
-    for op in scn.require_ops:
-        if op not in ops:
-            problems.append(
-                f"journal never recorded the scenario's {op!r} op "
-                f"(saw {sorted(set(ops))})"
-            )
+    ops = _check_journal(journal, scn.require_ops, problems)
     # bitrot acceptance: detection must be unconditional — the quarantine
     # reason names the checksum layer, never an accepted wrong resume
     if name == "bitrot" and not problems:
@@ -400,6 +513,193 @@ def run_scenario(name: str, seed: int, root: str,
 
     return {"scenario": name, "seed": seed, "workload": scn.workload,
             "episodes": ep_log, "journal_ops": sorted(set(ops)),
+            "problems": problems, "ok": not problems}
+
+
+def _supervise_policy():
+    """The bounded-soak restart policy: tiny seeded-jitter backoffs (the
+    schedule's SHAPE is the contract; production uses the CLI defaults)."""
+    from graphdyn.resilience.retry import RetryPolicy
+    from graphdyn.resilience.supervisor import RestartPolicy
+
+    return RestartPolicy(
+        quarantine_after=3, max_crashes=6, max_episodes=10,
+        backoff=RetryPolicy(tries=8, base_delay_s=0.01, max_delay_s=0.05,
+                            jitter=True),
+    )
+
+
+def _run_hang_detect(scn: Scenario, seed: int, root: str,
+                     oracle_cache: dict) -> dict:
+    """The acceptance loop end to end: a mid-run ``stall`` fault stops the
+    heartbeats → the ``--stall-timeout`` watchdog detects it mid-sleep and
+    requests the graceful snapshot + exit-75 path → the SUPERVISOR
+    auto-restarts from the durable snapshot → the finished run is bit-exact
+    with the fault-free oracle, and journal + post-mortem tell the story."""
+    from graphdyn.resilience import supervisor as _sup
+
+    rng = np.random.default_rng(seed)
+    workdir = os.path.join(root, scn.name, f"seed{seed}")
+    primary_dir = os.path.join(workdir, "primary")
+    ckpt = os.path.join(primary_dir, "ck")
+    out = os.path.join(workdir, "res.npz")
+    args = _workload_args(scn.workload, out, ckpt, None)
+    problems: list[str] = []
+    # warm the oracle FIRST: it doubles as the compile warm-up, so the
+    # supervised run's watchdog times heartbeat gaps, never a cold trace
+    _oracle(scn.workload, root, oracle_cache)
+
+    plan = _faults.FaultPlan(
+        [_faults.FaultSpec("rep.boundary", "stall",
+                           at=int(rng.integers(1, 3)), secs=STALL_SECS)],
+        seed=seed,
+    )
+    _store._reset_journal_state()
+    with plan:
+        report = _sup.supervise(
+            args, workdir=workdir, policy=_supervise_policy(),
+            runner=_sup.run_inprocess, stall_timeout_s=STALL_TIMEOUT_S,
+            journal_dir=primary_dir,
+        )
+    eps = report["episodes"]
+    if report["exit"] != 0:
+        problems.append(
+            f"supervised run did not finish: exit {report['exit']} "
+            f"({report['reason']}; episodes {eps})"
+        )
+    if not eps or eps[0]["rc"] != EX_TEMPFAIL:
+        problems.append(
+            f"first episode was not preempted by the watchdog "
+            f"(episodes {eps})"
+        )
+    if len(eps) < 2:
+        problems.append("the supervisor never restarted the run")
+    # detection evidence: the preempted episode's post-mortem must carry
+    # the watchdog's stall_detected event, with the stall older than the
+    # timeout (i.e. the watchdog measured a real heartbeat gap)
+    flight_seen: set = set()
+    detected_ok = False
+    for ep in eps:
+        if ep["rc"] != EX_TEMPFAIL:
+            continue
+        cwd = ep["cwd"]
+        story = _postmortem_story(cwd, preempted=True)
+        if story:
+            problems.append(f"episode {ep['episode']}: {story}")
+        flight_seen |= _flight_names(cwd)
+        from graphdyn.obs.flight import POSTMORTEM_NAME
+        from graphdyn.obs.recorder import read_ledger
+
+        try:
+            events, _ = read_ledger(os.path.join(cwd, POSTMORTEM_NAME))
+        except (OSError, ValueError):
+            events = []
+        stalls = [e for e in events
+                  if e.get("name") == "supervise.stall_detected"]
+        if stalls and (stalls[-1].get("attrs") or {}).get(
+                "age_s", 0) >= STALL_TIMEOUT_S:
+            detected_ok = True
+    for want in scn.require_flight:
+        if want not in flight_seen:
+            problems.append(
+                f"no preempted episode's post-mortem carries the "
+                f"{want!r} event (saw {sorted(flight_seen)})"
+            )
+    if not detected_ok and not problems:
+        problems.append(
+            "stall_detected event carries no heartbeat age >= the timeout"
+        )
+    if not problems:
+        _check_parity(scn.workload, out, root, oracle_cache, problems)
+    journal = os.path.join(primary_dir, _store.JOURNAL_NAME)
+    ops = _check_journal(journal, scn.require_ops, problems)
+    return {"scenario": scn.name, "seed": seed, "workload": scn.workload,
+            "episodes": eps, "journal_ops": sorted(set(ops)),
+            "problems": problems, "ok": not problems}
+
+
+def _run_crash_loop(scn: Scenario, seed: int, root: str,
+                    oracle_cache: dict) -> dict:
+    """The quarantine half of the acceptance: a run crash-looping at ONE
+    site is restarted with backoff exactly ``quarantine_after - 1`` times,
+    then quarantined — journal ``supervise.quarantine`` + bundled
+    post-mortems present, exit :data:`~graphdyn.resilience.supervisor
+    .EX_QUARANTINE`, and NO results file (a quarantined run must not look
+    completed)."""
+    from graphdyn.resilience import supervisor as _sup
+
+    workdir = os.path.join(root, scn.name, f"seed{seed}")
+    primary_dir = os.path.join(workdir, "primary")
+    ckpt = os.path.join(primary_dir, "ck")
+    out = os.path.join(workdir, "res.npz")
+    # --group-size 0: the serial per-rep chain drives through
+    # ChainCheckpointer.drive, whose chunk.boundary fault site fires BEFORE
+    # the chunk's snapshot — so every restart re-crashes at the very same
+    # chunk with zero progress: the genuine crash-on-same-input loop
+    # (rep.boundary would fire after the prefix snapshot and "progress"
+    # its way out of the loop)
+    args = _workload_args(scn.workload, out, ckpt, None) + \
+        ["--group-size", "0"]
+    problems: list[str] = []
+    policy = _supervise_policy()
+    # the same organic crash on EVERY restart: a huge count keeps the spec
+    # firing at the first chunk boundary of each episode
+    plan = _faults.FaultPlan(
+        [_faults.FaultSpec("chunk.boundary", "raise", at=1, count=10_000)],
+        seed=seed,
+    )
+    _store._reset_journal_state()
+    with plan:
+        report = _sup.supervise(
+            args, workdir=workdir, policy=policy,
+            runner=_sup.run_inprocess, journal_dir=primary_dir,
+        )
+    eps = report["episodes"]
+    if report["exit"] != _sup.EX_QUARANTINE or not report.get("quarantined"):
+        problems.append(
+            f"run was not quarantined: exit {report['exit']} "
+            f"({report['reason']}; episodes {eps})"
+        )
+    if len(eps) != policy.quarantine_after:
+        problems.append(
+            f"expected exactly {policy.quarantine_after} crash episodes "
+            f"(no N+1-th restart), got {len(eps)}: {eps}"
+        )
+    sites = {ep.get("site") for ep in eps}
+    if len(sites) != 1:
+        problems.append(f"crash episodes disagree on the site: {sites}")
+    bundle = report.get("bundle")
+    if not bundle or not os.path.exists(bundle):
+        problems.append(f"no quarantine bundle was written ({bundle})")
+    else:
+        with open(bundle, encoding="utf-8") as f:
+            doc = json.load(f)
+        if doc.get("crashes") != policy.quarantine_after:
+            problems.append(f"bundle crash count wrong: {doc.get('crashes')}")
+        pms = doc.get("postmortems") or []
+        if len(pms) != policy.quarantine_after:
+            problems.append(
+                f"bundle should carry {policy.quarantine_after} "
+                f"post-mortems, has {len(pms)}"
+            )
+        for pm in pms:
+            if not os.path.exists(pm):
+                problems.append(f"bundled post-mortem missing: {pm}")
+    if os.path.exists(out):
+        problems.append(
+            "a quarantined run must not leave a results file — it never "
+            "completed"
+        )
+    journal = os.path.join(primary_dir, _store.JOURNAL_NAME)
+    ops = _check_journal(journal, scn.require_ops, problems)
+    restarts = ops.count("supervise.restart")
+    if restarts != policy.quarantine_after - 1:
+        problems.append(
+            f"journal records {restarts} supervise.restart event(s), "
+            f"expected {policy.quarantine_after - 1}"
+        )
+    return {"scenario": scn.name, "seed": seed, "workload": scn.workload,
+            "episodes": eps, "journal_ops": sorted(set(ops)),
             "problems": problems, "ok": not problems}
 
 

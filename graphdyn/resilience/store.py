@@ -59,6 +59,7 @@ flips) and ``mirror.write`` (mirror ENOSPC) exercise the two new layers;
 
 from __future__ import annotations
 
+import atexit
 import dataclasses
 import glob
 import hashlib
@@ -89,10 +90,12 @@ MANIFEST_SCHEMA = 1
 JOURNAL_NAME = "run_journal.jsonl"
 
 #: journal event ops (the taxonomy ARCHITECTURE.md documents; validators
-#: reject anything else)
+#: reject anything else). The ``supervise.*`` ops are appended by the
+#: restart loop in :mod:`graphdyn.resilience.supervisor`.
 JOURNAL_OPS = (
     "save", "load", "quarantine", "reject", "failover", "read-error",
     "mirror.save", "mirror.degraded", "remove",
+    "supervise.start", "supervise.restart", "supervise.quarantine",
 )
 
 _VERSION_RE = re.compile(r"\.v(\d+)\.npz$")
@@ -321,6 +324,11 @@ def validate_journal(path: str) -> tuple[list[dict], list[str]]:
         "mirror.save": ("path", "version"),
         "mirror.degraded": ("path", "error"),
         "remove": ("path",),
+        # the supervisor's restart-loop chapter (no checkpoint path: a
+        # supervised run may not checkpoint at all)
+        "supervise.start": ("argv",),
+        "supervise.restart": ("episode", "rc", "kind"),
+        "supervise.quarantine": ("site", "crashes"),
     }
     for i, ev in enumerate(events):
         kind = ev.get("ev")
@@ -378,11 +386,38 @@ def _ensure_mirror_worker() -> None:
             _mirror_thread.start()
 
 
-def flush_mirror() -> None:
+def flush_mirror(timeout_s: float | None = None) -> None:
     """Block until every enqueued mirror write has drained — called before
-    any failover read, on remove, and by tests that assert mirror state."""
-    if _mirror_thread is not None and _mirror_thread.is_alive():
+    any failover read, on remove, by tests that assert mirror state, and
+    at interpreter exit (a run that saves and then returns must not drop
+    its queued write-behind replicas on the floor — the whole point of the
+    mirror is to survive exactly the runs that end abruptly).
+
+    ``timeout_s`` bounds the wait (the atexit hook uses it: a mirror job
+    wedged on a dead filesystem must not hang process shutdown forever —
+    it is logged and abandoned instead)."""
+    if _mirror_thread is None or not _mirror_thread.is_alive():
+        return
+    if timeout_s is None:
         _mirror_q.join()
+        return
+    deadline = time.monotonic() + timeout_s
+    while _mirror_q.unfinished_tasks:
+        if time.monotonic() >= deadline:
+            log.warning(
+                "mirror flush timed out after %.3gs with %d write(s) still "
+                "queued — abandoning them (mirror may be stale)",
+                timeout_s, _mirror_q.unfinished_tasks,
+            )
+            return
+        time.sleep(0.02)
+
+
+# registered unconditionally at import: a no-op when no mirror worker ever
+# started, and the difference between "the mirror has every published save"
+# and "the last few replicas silently vanished" when a run exits right
+# after saving (regression-tested end to end in tests/test_store.py)
+atexit.register(flush_mirror, timeout_s=10.0)
 
 
 # ---------------------------------------------------------------------------

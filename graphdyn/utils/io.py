@@ -26,6 +26,7 @@ import numpy as np
 from graphdyn.resilience import faults as _faults
 from graphdyn.resilience.retry import SAVE_RETRY, retry as _retry_call
 from graphdyn.resilience.shutdown import raise_if_requested, shutdown_requested
+from graphdyn.resilience.supervisor import beat as _beat
 
 log = logging.getLogger("graphdyn.io")
 
@@ -412,6 +413,7 @@ class ChainCheckpointer:
         while active(state):
             state = advance(state)
             k += 1
+            _beat("chunk")
             _faults.maybe_fail("chunk.boundary", key=f"{self.path}#{k}")
             if active(state):
                 if shutdown_requested():

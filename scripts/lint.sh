@@ -262,6 +262,20 @@ else:
     assert cso.get("overhead_p50_x", 0) > 0, cso
     assert cso.get("raw_p50_s", 0) > 0 and cso.get("durable_p50_s", 0) > 0
     assert cso.get("snapshot_bytes", 0) > 0
+# the liveness-tax column: an interleaved watchdog-on/off A/B of the
+# entropy smoke workload, or an explicit null + reason — never silently
+# absent; beats_per_run > 0 proves the workload actually heartbeats
+assert "heartbeat_overhead" in row, "heartbeat_overhead column absent"
+hbo = row["heartbeat_overhead"]
+if hbo is None:
+    assert row.get("heartbeat_overhead_skipped_reason"), \
+        "null heartbeat_overhead needs heartbeat_overhead_skipped_reason"
+    print("benchcheck: heartbeat_overhead skipped:",
+          row["heartbeat_overhead_skipped_reason"])
+else:
+    assert hbo.get("overhead_p50_x", 0) > 0, hbo
+    assert hbo.get("off_p50_s", 0) > 0 and hbo.get("on_p50_s", 0) > 0, hbo
+    assert hbo.get("beats_per_run", 0) > 0, hbo
 # the device-memory column: a positive peak, or an explicit null + reason
 # (CPU: no usable memory_stats) — never silently absent, never 0
 assert "peak_hbm_bytes" in row, "peak_hbm_bytes column absent"

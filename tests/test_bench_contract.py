@@ -90,6 +90,17 @@ def test_bench_smoke_emits_one_json_line():
         assert cso["raw_p50_s"] > 0 and cso["durable_p50_s"] > 0
         assert cso["raw_p99_s"] > 0 and cso["durable_p99_s"] > 0
         assert cso["snapshot_bytes"] > 0 and cso["saves"] > 0
+    # the liveness-tax column (interleaved watchdog-on/off A/B of the
+    # entropy smoke workload): a measured ratio with a positive heartbeat
+    # count, or an explicit null + reason — never silently absent
+    assert "heartbeat_overhead" in row
+    hbo = row["heartbeat_overhead"]
+    if hbo is None:
+        assert row["heartbeat_overhead_skipped_reason"]
+    else:
+        assert hbo["overhead_p50_x"] > 0
+        assert hbo["off_p50_s"] > 0 and hbo["on_p50_s"] > 0
+        assert hbo["beats_per_run"] > 0 and hbo["runs"] > 0
     # the device-memory column: a positive peak, or an explicit null +
     # reason (CPU: no usable memory_stats) — never silently absent,
     # never a fake 0 (graphdyn.obs.memband.peak_hbm_bytes)
@@ -184,6 +195,28 @@ def test_bench_smoke_entropy_cell_row(monkeypatch, capsys):
         assert out["entropy_cell_pallas_speedup"] > 0
     kern = out["entropy_cell_workload"]["kernel"]
     assert kern["serial"] == "xla" and kern["grouped"] == "xla"
+
+
+def test_bench_heartbeat_overhead_contract():
+    """The liveness A/B in-process: the workload actually heartbeats
+    (beats_per_run > 0) and the watchdog-on leg measures a real, positive
+    ratio — supervision must be near-free, and the row is how a regression
+    in that claim would surface round-over-round."""
+    import bench
+
+    out = bench.heartbeat_overhead(smoke=True)
+    hbo = out["heartbeat_overhead"]
+    assert hbo["beats_per_run"] > 0
+    assert hbo["off_p50_s"] > 0 and hbo["on_p50_s"] > 0
+    assert hbo["overhead_p50_x"] > 0
+    # "near-free" with generous headroom for a noisy 2-core container: a
+    # watchdog that made the workload 1.5x slower is a real regression
+    assert hbo["overhead_p50_x"] < 1.5, hbo
+    # the A/B must leave no pending shutdown behind (the watchdog never
+    # fired with its 60s stall timeout)
+    from graphdyn.resilience.shutdown import shutdown_requested
+
+    assert not shutdown_requested()
 
 
 def test_probe_relay_plugin_presence_classification(monkeypatch):

@@ -134,6 +134,42 @@ def test_prefetcher_build_failure_surfaces_on_consumer():
             pf.get(2)
 
 
+def test_prefetcher_hung_worker_is_reported(caplog):
+    """A build stuck past the stop flag (syscall, native code) makes
+    close()'s join expire: the wedged daemon thread must be REPORTED — a
+    warning plus the pipeline.prefetch.hung counter in the flight ring —
+    not silently abandoned, so a watchdog post-mortem can name the stalled
+    prefetcher (ARCHITECTURE.md 'Supervised execution')."""
+    import logging
+    import threading
+
+    from graphdyn.obs import flight
+
+    release = threading.Event()
+
+    def build(k):
+        release.wait(20)                # ignores close()'s stop flag
+        return k
+
+    pf = HostPrefetcher(build, [0, 1], depth=1)
+    try:
+        flight.clear()
+        with caplog.at_level(logging.WARNING, logger="graphdyn.pipeline"):
+            pf.close(timeout_s=0.2)    # the worker cannot exit in time
+        assert any("HUNG" in r.message for r in caplog.records)
+        hung = [e for e in flight.snapshot()
+                if e.get("name") == "pipeline.prefetch.hung"]
+        assert hung and hung[-1]["attrs"]["timeout_s"] == 0.2
+    finally:
+        release.set()                   # let the daemon thread die
+    # a healthy close stays silent (no counter)
+    flight.clear()
+    with HostPrefetcher(lambda k: k, range(3), depth=1) as pf2:
+        assert pf2.get(0) == 0
+    assert not [e for e in flight.snapshot()
+                if e.get("name") == "pipeline.prefetch.hung"]
+
+
 def test_group_ranges_partition():
     assert list(group_ranges(0, 5, 2)) == [[0, 1], [2, 3], [4]]
     assert list(group_ranges(3, 5, 8)) == [[3, 4]]

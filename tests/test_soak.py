@@ -19,12 +19,23 @@ pytestmark = [pytest.mark.faultinject, pytest.mark.soak]
 
 def test_scenario_catalogue_shape():
     """The catalogue covers the acceptance surface: ≥ 6 scenarios, the
-    bitrot-detection and primary-loss-mirror stories among them, and at
-    least one mirror-configured workload."""
-    assert len(SCENARIOS) >= 6
+    bitrot-detection and primary-loss-mirror stories among them, at least
+    one mirror-configured workload, and the PR-10 supervision stories
+    (stall detection, deadline preemption, crash-loop quarantine)."""
+    assert len(SCENARIOS) >= 10
     assert {"bitrot", "mirror_failover", "mirror_degraded",
-            "truncated_read", "torn_write", "requeue_storm"} <= set(SCENARIOS)
+            "truncated_read", "torn_write", "requeue_storm",
+            "hang_detect", "deadline_preempt",
+            "crash_loop_quarantine"} <= set(SCENARIOS)
     assert SCENARIOS["mirror_failover"].mirror
+    assert SCENARIOS["hang_detect"].mode == "hang"
+    assert SCENARIOS["crash_loop_quarantine"].mode == "crash_loop"
+    assert ("supervise.stall_detected"
+            in SCENARIOS["hang_detect"].require_flight)
+    assert ("supervise.deadline"
+            in SCENARIOS["deadline_preempt"].require_flight)
+    assert ("supervise.quarantine"
+            in SCENARIOS["crash_loop_quarantine"].require_ops)
     assert len(BOUNDED_SEEDS) >= 3
 
 
@@ -35,7 +46,7 @@ def test_bounded_soak_matrix_is_green(tmp_path):
     flight-recorder story (post-mortem on preemption, none on a clean
     finish)."""
     report = run_soak(root=str(tmp_path / "soak"))
-    assert report["scenarios"] >= 6 and report["seeds"] >= 3
+    assert report["scenarios"] >= 10 and report["seeds"] >= 3
     bad = [(r["scenario"], r["seed"], r["problems"])
            for r in report["runs"] if not r["ok"]]
     assert not bad, bad
@@ -47,6 +58,12 @@ def test_bounded_soak_matrix_is_green(tmp_path):
         assert "quarantine" in r["journal_ops"], r
     for r in by_name["mirror_failover"]:
         assert "failover" in r["journal_ops"], r
+    # PR-10 supervision guarantees: the watchdog restarted a stalled run,
+    # and the crash loop was quarantined rather than retried forever
+    for r in by_name["hang_detect"]:
+        assert "supervise.restart" in r["journal_ops"], r
+    for r in by_name["crash_loop_quarantine"]:
+        assert "supervise.quarantine" in r["journal_ops"], r
 
 
 def test_soak_cli_list_and_unknown_scenario(capsys):

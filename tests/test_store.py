@@ -383,6 +383,68 @@ def test_validate_journal_flags_unknown_ops_and_replayed_versions(tmp_path):
 # ---------------------------------------------------------------------------
 
 
+def test_atexit_flushes_queued_mirror_writes(tmp_path):
+    """A run that saves and exits immediately must not drop its queued
+    write-behind replicas: the module registers an atexit flush_mirror, so
+    a normal interpreter exit drains the queue BEFORE daemon threads die.
+    Proven end to end in a subprocess — the exact save-then-exit shape the
+    write-behind race loses without the hook."""
+    import subprocess
+    import sys
+
+    mirror = tmp_path / "mirror"
+    primary = tmp_path / "primary"
+    script = (
+        "import numpy as np\n"
+        "from graphdyn.resilience.store import DurableCheckpoint, "
+        "configure_store\n"
+        f"configure_store(mirror={str(mirror)!r})\n"
+        f"ck = DurableCheckpoint({str(primary / 'ck')!r})\n"
+        # several sizable saves so the write-behind queue is realistically
+        # non-empty at exit — then fall off the end of the script
+        "for i in range(4):\n"
+        "    ck.save({'a': np.arange(200_000) + i}, {'v': i})\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=120, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # the mirror namespace is <mirror>/<dirhash8>/ck.npz
+    replicas = list(mirror.glob("*/ck.npz"))
+    assert replicas, (
+        "queued mirror writes were dropped at exit "
+        f"(mirror tree: {list(mirror.rglob('*'))})"
+    )
+    # the published replica is the LAST save (the queue drained fully)
+    with np.load(replicas[0]) as f:
+        assert f["a"][0] == 3
+
+
+def test_flush_mirror_timeout_abandons_wedged_queue(monkeypatch, caplog):
+    """The atexit flush is bounded: a mirror job wedged on a dead
+    filesystem is logged and abandoned, never a hung interpreter exit."""
+    import threading
+
+    from graphdyn.resilience import store as store_mod
+
+    release = threading.Event()
+    store_mod._ensure_mirror_worker()
+    store_mod._mirror_q.put(lambda: release.wait(20))
+    try:
+        with caplog.at_level(logging.WARNING, logger="graphdyn.resilience"):
+            t0 = __import__("time").monotonic()
+            flush_mirror(timeout_s=0.2)
+            assert __import__("time").monotonic() - t0 < 5.0
+        assert any("mirror flush timed out" in r.message
+                   for r in caplog.records)
+    finally:
+        release.set()
+        flush_mirror()                  # drain for the next test
+
+
 def test_cli_flags_configure_the_store(tmp_path, capsys):
     """--ckpt-mirror/--ckpt-keep reach the singleton on every invocation —
     and are RESET on the next one (no leakage between in-process runs)."""
