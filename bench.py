@@ -425,6 +425,107 @@ def heartbeat_overhead(smoke: bool):
     }}
 
 
+def halo_weak_scaling(smoke: bool, *, n_per=None, R=None, steps=None,
+                      iters=None):
+    """Weak scaling of the halo-exchange node sharding
+    (``graphdyn.parallel.halo``): FIXED nodes per shard, P ∈ {1, 2, 4, 8}
+    shards over however many devices this process sees (chips on a pod, a
+    forced host-device CPU mesh under the test harness), efficiency =
+    rate(P) / (P · rate(1)). The P=1 leg runs the unsharded packed program
+    — exactly the ``partition=`` path's identity — so the efficiency
+    column prices the exchange + shard bookkeeping and nothing else.
+    ``halo_bytes_per_step`` reports the measured partition's exchange
+    traffic (4·W·Σ ghosts — the edge cut in bytes). Fewer than 2 devices
+    emits null + reason, never 0.0."""
+    import jax
+    import jax.numpy as jnp
+
+    from graphdyn import obs
+    from graphdyn.graphs import partition_graph, random_regular_graph
+    from graphdyn.ops.packed import packed_rollout
+
+    # ONE device pool for every leg: the default platform when it can host
+    # a 2-shard mesh, else the (possibly simulated) CPU host platform for
+    # ALL of P=1..8 — mixing a chip-rate P=1 leg with CPU-fallback P>=2
+    # legs would emit a "measured" efficiency comparing different hardware
+    pool = jax.devices()
+    if len(pool) < 2:
+        try:
+            cpu = jax.devices("cpu")
+        except RuntimeError:
+            cpu = []
+        if len(cpu) >= 2:
+            pool = cpu
+    if len(pool) < 2:
+        reason = (
+            f"halo weak scaling needs >= 2 devices on one platform (have "
+            f"{len(pool)}); on CPU force a simulated host mesh: "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+        return {
+            "halo_weak_efficiency": None,
+            "halo_weak_efficiency_skipped_reason": reason,
+            "halo_bytes_per_step": None,
+            "halo_bytes_per_step_skipped_reason": reason,
+        }
+    avail = len(pool)
+    from graphdyn.parallel.halo import HaloProgram
+    from graphdyn.parallel.mesh import make_mesh
+
+    defaults = (2048, 256, 10, 2) if smoke else (65536, 1024, 20, 3)
+    # keyword overrides exist for the in-suite contract test (tiny shapes)
+    n_per = n_per if n_per is not None else defaults[0]
+    R = R if R is not None else defaults[1]
+    steps = steps if steps is not None else defaults[2]
+    iters = iters if iters is not None else defaults[3]
+    W = R // 32
+    from benchmarks.common import draw_u32
+
+    rates: dict[str, float] = {}
+    bytes_per_step = None
+    for Pn in (1, 2, 4, 8):
+        if Pn > avail:
+            break
+        g = random_regular_graph(Pn * n_per, 3, seed=0)
+        sp = draw_u32(0, (g.n, W))
+        if Pn == 1:
+            # the P=1 leg runs the unsharded program on the SAME pool's
+            # first device (operand placement pins the platform)
+            nbr = jax.device_put(jnp.asarray(g.nbr), pool[0])
+            deg = jax.device_put(jnp.asarray(g.deg), pool[0])
+            f = jax.jit(lambda x: packed_rollout(nbr, deg, x, steps),
+                        donate_argnums=0)
+            st = f(jax.device_put(jnp.asarray(sp), pool[0]))
+            _sync(st)
+            with obs.timed("bench.halo_weak", P=Pn) as sw:
+                for _ in range(iters):
+                    st = f(st)
+                _sync(st)
+        else:
+            part = partition_graph(g, Pn, seed=0)
+            mesh = make_mesh((Pn,), ("node",), devices=pool[:Pn])
+            prog = HaloProgram(g, part, steps=steps, mesh=mesh)
+            st = prog.advance(prog.place(np.asarray(sp)))
+            _sync(st)
+            with obs.timed("bench.halo_weak", P=Pn) as sw:
+                for _ in range(iters):
+                    st = prog.advance(st)
+                _sync(st)
+            bytes_per_step = int(prog.tables.halo_bytes_per_step(W))
+        rates[str(Pn)] = g.n * R * steps * iters / sw.wall_s
+        obs.gauge("ops.halo.rate", rates[str(Pn)], P=Pn, n=g.n, R=R)
+        _mark(f"halo weak scaling P={Pn}: n={g.n} rate {rates[str(Pn)]:.3e}")
+    p_max = max(int(k) for k in rates)
+    return {
+        "halo_weak_efficiency": rates[str(p_max)] / (p_max * rates["1"]),
+        "halo_rate_by_shards": rates,
+        "halo_bytes_per_step": bytes_per_step,
+        "halo_workload": {"n_per_shard": n_per, "d": 3, "R": R,
+                          "steps": steps, "iters": iters, "P_max": p_max,
+                          "platform": pool[0].platform},
+    }
+
+
 def fingerprint_rows():
     """The graftcheck program-fingerprint summary persisted with every
     round (``BENCH_*.json``): per headline entry point, the ledger-gated
@@ -718,6 +819,19 @@ def main():
             "heartbeat_overhead": None,
             "heartbeat_overhead_skipped_reason":
                 f"heartbeat A/B failed: {str(e)[:150]}",
+        })
+    _mark("halo weak scaling (node-axis sharding, fixed n/shard)")
+    try:
+        extra.update(halo_weak_scaling(args.smoke))
+    except Exception as e:  # noqa: BLE001 — optional row, never silent
+        _mark(f"halo weak scaling row failed: {str(e)[:150]}")
+        extra.update({
+            "halo_weak_efficiency": None,
+            "halo_weak_efficiency_skipped_reason":
+                f"halo weak scaling failed: {str(e)[:150]}",
+            "halo_bytes_per_step": None,
+            "halo_bytes_per_step_skipped_reason":
+                f"halo weak scaling failed: {str(e)[:150]}",
         })
     _mark("program fingerprints (graftcheck structural summary)")
     try:

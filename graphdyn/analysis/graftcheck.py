@@ -127,6 +127,17 @@ class Finding(NamedTuple):
     message: str
 
 
+class UnsupportedEntry(RuntimeError):
+    """An entry point whose canonical program cannot build in THIS
+    environment (e.g. the halo-exchange rollout needs a 2-device mesh and
+    the process sees one device). Distinct from a build *failure*: the
+    collector records ``{"unsupported": reason}`` for the entry and every
+    consumer skips it with a notice instead of reporting structural drift.
+    The gate environments (lint.sh hlocheck, the test harness) force an
+    8-device CPU host platform, so the skip only fires in genuinely
+    single-device processes (e.g. a 1-chip bench run)."""
+
+
 # ---------------------------------------------------------------------------
 # HLO text parsing
 # ---------------------------------------------------------------------------
@@ -379,6 +390,32 @@ def _build_sharded_rollout():
     return lower_sharded_rollout(mesh, _canon_rrg(64, 3, 0), 8, steps=2)
 
 
+def _build_halo_rollout():
+    from graphdyn.graphs import partition_graph
+    from graphdyn.parallel.halo import lower_halo_rollout
+    from graphdyn.parallel.mesh import device_pool, make_mesh
+
+    # the halo exchange only EXISTS at P >= 2 (a 1-device mesh has no
+    # ppermute to pin), so this entry needs two devices; the gate
+    # environments force an 8-device CPU host platform. The fingerprint
+    # pins the exchange structure: one collective-permute slab per
+    # schedule offset and NO all-gather — the regression this ledger row
+    # exists to catch is the exchange silently deoptimizing into a
+    # full-state gather.
+    try:
+        devices = device_pool(2)
+    except RuntimeError as e:
+        raise UnsupportedEntry(
+            f"halo_rollout needs a 2-device mesh: {e} (force a simulated "
+            "host platform: XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+        ) from e
+    mesh = make_mesh((2,), ("node",), devices=devices[:2])
+    g = _canon_rrg(128, 3, 0)
+    return lower_halo_rollout(
+        mesh, g, partition_graph(g, 2, seed=0), W=4, steps=2
+    )
+
+
 ENTRIES: dict[str, EntrySpec] = {
     "packed_rollout": EntrySpec(
         _build_packed_rollout, donates=False,
@@ -403,6 +440,11 @@ ENTRIES: dict[str, EntrySpec] = {
     "sharded_rollout": EntrySpec(
         _build_sharded_rollout, donates=False,
         canon="1-device (replica, node) mesh, RRG n=64 d=3, R=8, steps=2",
+    ),
+    "halo_rollout": EntrySpec(
+        _build_halo_rollout, donates=True,
+        canon="2-device node mesh, RRG n=128 d=3, P=2 partition, W=4, "
+              "steps=2",
     ),
 }
 
@@ -433,7 +475,16 @@ def collect_fingerprints(
         if diag:
             diag(f"graftcheck: lowering + compiling {name} "
                  f"({ENTRIES[name].canon})")
-        fp = fingerprint_lowered(lower_entry(name, **overrides))
+        try:
+            fp = fingerprint_lowered(lower_entry(name, **overrides))
+        except UnsupportedEntry as e:
+            # environment limitation, not drift: record the reason so
+            # every consumer (ledger diff, bench diff, audit) can skip
+            # the entry with a notice instead of mis-reading absence
+            if diag:
+                diag(f"graftcheck: {name} unsupported here: {e}")
+            out[name] = {"unsupported": str(e)}
+            continue
         if compact:
             fp = {k: fp[k] for k in _COMPACT_FIELDS}
         out[name] = fp
@@ -760,6 +811,15 @@ def check_ledger(
     findings = []
     entries = ledger.get("entries", {})
     for name in sorted(live):
+        if "unsupported" in live[name]:
+            # the entry could not build in THIS environment (e.g. a
+            # single-device process and the halo entry's 2-device mesh):
+            # a notice, not drift — the gate environments force enough
+            # simulated devices that this never silently hides a check
+            if diag:
+                diag(f"graftcheck: skipping {name} diff — "
+                     f"{live[name]['unsupported']}")
+            continue
         if name not in entries:
             findings.append(Finding(
                 name, "GC100",
@@ -786,6 +846,8 @@ def diff_bench_fingerprints(prev_row: dict, new_row: dict) -> list[Finding]:
     findings = []
     for name, new_fp in sorted(new["entries"].items()):
         old_fp = prev["entries"].get(name)
+        if "unsupported" in new_fp or (old_fp and "unsupported" in old_fp):
+            continue                      # environment skip, not drift
         if old_fp:
             findings.extend(diff_fingerprints(name, old_fp, new_fp))
     return findings
@@ -807,6 +869,8 @@ def bench_drift_blessed(new_row: dict, ledger: dict | None = None) -> bool:
         return False
     entries = ledger.get("entries", {})
     for name, fp in new_row["entries"].items():
+        if "unsupported" in fp:
+            continue                      # environment skip, not drift
         old = entries.get(name)
         if old is None or diff_fingerprints(name, old, fp):
             return False
@@ -850,6 +914,8 @@ def main(argv: list[str] | None = None) -> int:
     live = collect_fingerprints(names, diag=_diag)
     findings: list[Finding] = []
     for name in names:
+        if "unsupported" in live[name]:
+            continue                      # skipped with a diag by the collector
         findings.extend(
             audit_fingerprint(name, live[name], donates=ENTRIES[name].donates)
         )
@@ -857,6 +923,15 @@ def main(argv: list[str] | None = None) -> int:
         if set(names) != set(ENTRIES):
             ap.error("--update-ledger rewrites the WHOLE ledger; it cannot "
                      "be combined with --entries")
+        unsupported = sorted(
+            n for n, fp in live.items() if "unsupported" in fp
+        )
+        if unsupported:
+            ap.error(
+                "--update-ledger refuses to write a degraded ledger — "
+                f"unsupported here: {', '.join(unsupported)} (run under "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+            )
         path = write_ledger(live, args.ledger)
         _diag(f"graftcheck: wrote {len(live)} fingerprint(s) to {path}")
     else:

@@ -96,6 +96,18 @@ Rules (stable codes; each can be silenced per line with
   :func:`graphdyn.obs.trace.profiling` (CLI ``--profile`` /
   ``GRAPHDYN_PROFILE``); span annotations come for free from
   ``obs.span``/``obs.timed``.
+- **GD013** full-node-axis data movement inside a shard-mapped body in
+  ``graphdyn/parallel/``: a ``lax.all_gather`` call, or a ``jnp.take``
+  whose operand was assigned from one.  The halo exchange
+  (:mod:`graphdyn.parallel.halo`) exists so a node-sharded synchronous
+  step moves only the partition's BOUNDARY spin words (one ``ppermute``
+  slab per shard offset — per-step bytes scale with the edge cut); an
+  ``all_gather`` of the state re-ships every shard's words to every
+  device every step, the exact O(n) collective the node sharding is
+  supposed to remove.  Scope: functions passed to ``shard_map`` and the
+  module-local functions they call.  The legacy gather-mode solver keeps
+  reasoned per-line disables (it is the parity baseline the halo mode is
+  tested against, and the small-graph fallback).
 
 Escape hatches, all requiring an explicit code list (``all`` allowed):
 
@@ -133,6 +145,7 @@ RULES = {
     "GD010": "jnp.asarray of a host buffer this function mutates (CPU alias race with async device reads)",
     "GD011": "bare time.time()/time.perf_counter() timing in a driver module (use graphdyn.obs timed/span)",
     "GD012": "bare jax.profiler capture/annotation outside graphdyn/obs/ (use graphdyn.obs.trace profiling/span alignment)",
+    "GD013": "full-node-axis all_gather/jnp.take in a parallel/ shard-mapped body (halo exchange moves boundary words only)",
 }
 
 # the wall-clock calls GD011 watches (time.monotonic is exempt: it is the
@@ -337,6 +350,10 @@ class _FileLinter:
         # TraceAnnotations); a bare jax.profiler call anywhere else forks
         # the device-timeline vocabulary away from the ledger's
         self.profiler_strict = "/obs/" not in norm
+        # GD013 scope: the mesh-parallel layer — where a shard-mapped body
+        # gathering the full node axis silently reverts the halo exchange's
+        # boundary-words-only contract
+        self.parallel_mod = "/parallel/" in norm
 
     def emit(self, node: ast.AST, code: str, message: str) -> None:
         self.findings.append(
@@ -415,6 +432,7 @@ class _FileLinter:
         self._check_alias_crossings(tree)
         self._check_bare_timing(tree)
         self._check_bare_profiler(tree)
+        self._check_shardmap_full_gather(tree)
         self.findings.sort(key=lambda f: (f.line, f.col, f.code))
         return self.findings
 
@@ -768,6 +786,88 @@ class _FileLinter:
                 f"device timeline and the event ledger share one "
                 f"vocabulary",
             )
+
+    def _check_shardmap_full_gather(self, tree: ast.Module):
+        """GD013: ``lax.all_gather`` (or a ``jnp.take`` over its result)
+        inside a shard-mapped body of a ``graphdyn/parallel/`` module.  A
+        node-sharded synchronous step must move only BOUNDARY spin words
+        (the halo exchange's ``ppermute`` schedule); an ``all_gather``
+        re-ships the whole state to every device every step — O(n)
+        collective bytes where the partition's edge cut would do.  Scope is
+        resolved syntactically like GD009: the functions passed (by name)
+        as the first argument to ``shard_map``, plus module-local functions
+        they call, to a fixpoint; nested defs (loop bodies) are walked with
+        their enclosing scoped function."""
+        if not self.parallel_mod:
+            return
+
+        def base(expr: ast.expr) -> str:
+            return _dotted(expr).rsplit(".", 1)[-1]
+
+        # collect all functions by name + their module-local callee names
+        fn_nodes: dict[str, list] = {}
+        fn_calls: dict[str, set] = {}
+        roots: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn_nodes.setdefault(node.name, []).append(node)
+                called = {
+                    base(sub.func) for sub in ast.walk(node)
+                    if isinstance(sub, ast.Call)
+                }
+                fn_calls.setdefault(node.name, set()).update(called - {""})
+            elif isinstance(node, ast.Call) and base(node.func) == "shard_map":
+                if node.args and isinstance(node.args[0], ast.Name):
+                    roots.add(node.args[0].id)
+
+        scoped = set(roots)
+        changed = True
+        while changed:
+            changed = False
+            for name in list(scoped):
+                for callee in fn_calls.get(name, ()):
+                    if callee in fn_nodes and callee not in scoped:
+                        scoped.add(callee)
+                        changed = True
+
+        flagged: set[int] = set()
+        for name in sorted(scoped):
+            for fn in fn_nodes.get(name, []):
+                tainted: set[str] = set()
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Assign) and isinstance(
+                        node.value, ast.Call
+                    ) and base(node.value.func) == "all_gather":
+                        tainted.update(
+                            t.id for t in node.targets
+                            if isinstance(t, ast.Name)
+                        )
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call) or id(node) in flagged:
+                        continue
+                    d = _dotted(node.func)
+                    if base(node.func) == "all_gather":
+                        flagged.add(id(node))
+                        self.emit(
+                            node, "GD013",
+                            f"{d}(...) inside a shard-mapped body gathers "
+                            f"the FULL node axis every step — ship only the "
+                            f"partition's boundary words instead "
+                            f"(graphdyn.parallel.halo: ppermute over the "
+                            f"static shard-neighbor schedule)",
+                        )
+                    elif d in ("jnp.take", "jax.numpy.take") and node.args \
+                            and isinstance(node.args[0], ast.Name) \
+                            and node.args[0].id in tainted:
+                        flagged.add(id(node))
+                        self.emit(
+                            node, "GD013",
+                            f"jnp.take over {node.args[0].id!r} (an "
+                            f"all_gather result) reads the full node axis "
+                            f"inside a shard-mapped body — gather from the "
+                            f"local block + halo ghost rows instead "
+                            f"(graphdyn.parallel.halo)",
+                        )
 
     def _check_vmap_pallas(self, tree: ast.Module):
         """GD009: ``jax.vmap`` over a ``pallas_call``-backed callable.

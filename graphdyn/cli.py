@@ -216,6 +216,24 @@ def build_parser() -> argparse.ArgumentParser:
              "spans [a0-frac, ladder-max-frac] linearly across replicas",
     )
     sa.add_argument(
+        "--chunk-steps", type=int, default=100_000, metavar="K",
+        help="with --sharded and --checkpoint: advance at most K MCMC "
+             "steps per device call — the resume granularity (snapshots "
+             "and shutdown/heartbeat polls happen at chunk boundaries; "
+             "splitting the loop cannot change the chain)",
+    )
+    sa.add_argument(
+        "--shards", type=int, default=None, metavar="P",
+        help="with --sharded: partition the graph's NODE axis into P parts "
+             "(graphs.partition_graph: BFS-grow + boundary refinement) and "
+             "run the halo-exchange solver — each device owns one part and "
+             "per-step collective traffic is the partition's boundary "
+             "spin words, not the full state (parallel/halo.py; bit-exact "
+             "to the unsharded chains; P=1 keeps the single-shard node "
+             "axis). Snapshots stay global, so a run may resume under a "
+             "different --shards after a shard loss",
+    )
+    sa.add_argument(
         "--ladder-max-frac", type=float, default=None,
         help="enable a temperature ladder on the replica axis: per-replica "
              "a0 = linspace(a0-frac, this, n-replicas) * n",
@@ -530,6 +548,14 @@ def _run(args) -> int:
             par_a=args.par_a, par_b=args.par_b,
             a_cap_frac=args.a_cap_frac, b_cap_frac=args.b_cap_frac,
         )
+        if args.shards is not None and not args.sharded:
+            # a silently ignored sharding request would run the serial
+            # driver while the operator believes the pod job sharded
+            raise SystemExit(
+                "--shards partitions the node axis of the MESH solver; "
+                "pass --sharded as well (the per-repetition driver has no "
+                "node axis to shard)"
+            )
         if args.sharded:
             import jax
 
@@ -539,9 +565,26 @@ def _run(args) -> int:
             from graphdyn.utils.io import save_results_npz
 
             n_dev = len(jax.devices())
+            node_mode = "gather"
+            if args.shards is not None:
+                if args.rollout_mode == "lightcone":
+                    raise SystemExit(
+                        "--shards partitions the node axis; "
+                        "--rollout-mode lightcone keeps whole replicas per "
+                        "device and has none"
+                    )
+                if args.shards < 1:
+                    raise SystemExit("--shards must be >= 1")
+                if args.shards > n_dev:
+                    raise SystemExit(
+                        f"--shards {args.shards} > {n_dev} visible devices"
+                    )
+                node_shards = args.shards
+                if node_shards >= 2:
+                    node_mode = "halo"
             # lightcone needs whole replicas per device (replica-only mesh);
             # full mode splits the node axis when it can
-            if args.rollout_mode == "lightcone":
+            elif args.rollout_mode == "lightcone":
                 node_shards = 1
             else:
                 node_shards = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
@@ -562,6 +605,8 @@ def _run(args) -> int:
                 checkpoint_path=args.checkpoint,
                 checkpoint_interval_s=args.checkpoint_interval,
                 rollout_mode=args.rollout_mode,
+                node_mode=node_mode,
+                chunk_steps=args.chunk_steps,
             )
             if args.out:
                 save_results_npz(
@@ -571,6 +616,7 @@ def _run(args) -> int:
             print(json.dumps({
                 "solver": "sa_sharded",
                 "mesh": dict(mesh.shape),
+                "node_mode": node_mode,
                 "mag_reached": res.mag_reached.tolist(),
                 "num_steps": res.num_steps.tolist(),
                 "m_final": res.m_final.tolist(),

@@ -51,6 +51,11 @@ MEM_BANDS: dict[str, tuple[float, float]] = {
     "packed_state": (0.5, 16.0),
     "bdcm_stack": (0.5, 16.0),
     "entropy_cell_chunk": (0.25, 16.0),
+    # per-shard halo layout: the band divisor is the WIDEST shard's model
+    # (devices hold one shard each; the peak is per-device); lo is loose —
+    # the shard state is a fraction of the process peak when the P=1
+    # baseline ran first in the same process
+    "halo_shard": (0.25, 16.0),
 }
 
 
@@ -64,6 +69,18 @@ def packed_state_bytes(n: int, d: int, W: int) -> int:
     spin words (32 replicas/word), the ``int32[n, d]`` neighbor table, and
     the ``int32[n]`` degree vector."""
     return 4 * n * W + 4 * n * d + 4 * n
+
+
+def halo_shard_bytes(n_local: int, n_ghost: int, W: int) -> int:
+    """Resident packed spin words of ONE halo shard
+    (:mod:`graphdyn.parallel.halo`): the owned rows plus the ghost rows it
+    refreshes each step — ``4·n_local·W + 4·n_ghost·W`` bytes (the trash/
+    zero bookkeeping rows are two rows, noise at any real shape; the
+    neighbor table adds ``4·n_local·dmax`` exactly as in
+    :func:`packed_state_bytes` and is charged there). The GHOST term is
+    also the shard's per-step exchange traffic — residency and DCN bytes
+    share one model (``HaloTables.halo_bytes_per_step``)."""
+    return 4 * n_local * W + 4 * n_ghost * W
 
 
 def stacked_bdcm_bytes(stk) -> int:
@@ -248,9 +265,10 @@ def run_memcheck(*, diag=None) -> list[MemRow]:
             _row("bdcm_stack", None, stacked_bdcm_bytes(stk), reason),
             _row("entropy_cell_chunk", None, entropy_chunk_bytes(stk),
                  reason),
+            _row("halo_shard", None, _halo_smoke_model(W=W), reason),
         ]
     else:
-        rows = [_measure_packed(), *_measure_bdcm_rows()]
+        rows = [_measure_packed(), *_measure_bdcm_rows(), _measure_halo()]
     from graphdyn import obs
 
     for row in rows:
@@ -281,6 +299,56 @@ def _measure_packed(*, n: int = 32768, d: int = 3, W: int = 8,
     sp.block_until_ready()
     peak, reason = peak_hbm_bytes()
     return _row("packed_state", peak, packed_state_bytes(n, d, W), reason)
+
+
+def _halo_smoke_tables(n: int = 8192, P: int = 2):
+    """The halo smoke partition's tables (d=3 RRG — the headline degree)."""
+    from graphdyn.graphs import partition_graph, random_regular_graph
+    from graphdyn.parallel.halo import build_halo_tables
+
+    g = random_regular_graph(n, 3, seed=0)
+    part = partition_graph(g, P, seed=0)
+    return g, part, build_halo_tables(g, part)
+
+
+def _halo_smoke_model(*, W: int, n: int = 8192, P: int = 2) -> float:
+    """The widest shard's ``halo_shard`` model bytes at the smoke shape."""
+    _, _, tables = _halo_smoke_tables(n, P)
+    return float(max(
+        halo_shard_bytes(int(tables.counts[p]),
+                         int(tables.ghost_counts[p]), W)
+        for p in range(tables.P)
+    ))
+
+
+def _measure_halo(*, n: int = 8192, P: int = 2, W: int = 8,
+                  steps: int = 8) -> MemRow:
+    """Peak bytes through a 2-shard halo rollout against the widest
+    shard's model. Needs a 2-device mesh; a single-device process emits
+    the null+reason row (structural pass) instead of borrowing the packed
+    program's peak."""
+    from graphdyn.parallel.mesh import device_pool
+
+    try:
+        device_pool(P)
+    except RuntimeError as e:
+        return _row("halo_shard", None, _halo_smoke_model(W=W, n=n, P=P),
+                    f"halo_shard needs {P} devices: {e}")
+    import numpy as np
+
+    from graphdyn.parallel.halo import HaloProgram
+
+    g, part, tables = _halo_smoke_tables(n, P)
+    prog = HaloProgram(g, part, steps=steps, tables=tables)
+    out = prog.advance(prog.place(np.zeros((n, W), np.uint32)))
+    np.asarray(out)                     # drain: the peak includes the run
+    peak, reason = peak_hbm_bytes()
+    model = max(
+        halo_shard_bytes(int(tables.counts[p]),
+                         int(tables.ghost_counts[p]), W)
+        for p in range(tables.P)
+    )
+    return _row("halo_shard", peak, model, reason)
 
 
 def _measure_bdcm_rows() -> list[MemRow]:

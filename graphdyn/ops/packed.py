@@ -99,16 +99,35 @@ def _compare_planes(planes, thr_bits):
     return gt, eq
 
 
+def _rule_tie_combine(win, tie_mask, prev, rule: Rule, tie: TieBreak):
+    """Combine the comparator outputs into next-step spin bits — the ONE
+    implementation of the packed rule/tie word logic (``win`` = strictly
+    positive sum, ``tie_mask`` = sum == 0, ``prev`` = current bits; loss =
+    ``~(win | tie_mask)`` implicitly). The unsharded body and the halo
+    kernel (:mod:`graphdyn.parallel.halo`) both call this, so a semantics
+    fix propagates to every node-sharding mode and the bit-exactness
+    contract stays structural."""
+    tie_bit = prev if tie == TieBreak.STAY else ~prev
+    out = win | (tie_mask & tie_bit)
+    if rule == Rule.MINORITY:
+        # minority: +1 iff sum<0, tie -> (stay: s, change: ~s)
+        loss = ~(win | tie_mask)
+        out = loss | (tie_mask & tie_bit)
+    return out
+
+
 @partial(jax.jit, static_argnames=("rule", "tie", "steps", "gather"))
 @contract(nbr="int32[n,d]", deg="int32[n]", sp="uint32[n,w]",
           ret="uint32[n,w]")
 # the per_slot/fused A/B tests and benchmarks roll the SAME sp through both
 # schedules; donating it would invalidate their input buffer
 # graftlint: disable-next-line=GD006  A/B callers reuse the input state
-def packed_rollout(nbr, deg, sp, steps: int, rule: str = "majority",
-                   tie: str = "stay", gather: str = "per_slot"):
-    """Roll packed spins ``sp: uint32[n, W]`` for ``steps`` synchronous
-    updates. ``nbr: int32[n, dmax]`` ghost-padded with n; ``deg: int32[n]``.
+def _packed_rollout_device(nbr, deg, sp, steps: int, rule: str = "majority",
+                           tie: str = "stay", gather: str = "per_slot"):
+    """The single-device packed rollout program (the P=1 instance of the
+    partitioned path below; graftcheck fingerprints THIS program as the
+    ``packed_rollout`` ledger entry, so the dispatcher wrapper cannot
+    perturb the committed P=1 fingerprint).
 
     ``gather`` selects the HBM access pattern (bit-identical results):
 
@@ -161,24 +180,52 @@ def packed_rollout(nbr, deg, sp, steps: int, rule: str = "majority",
             )
             planes = _csa_planes(g, dmax, n_planes)
         gt, eq = _compare_planes(planes, thr_bits)
-        win = gt                                     # 2cnt > deg
-        tie_mask = eq & even_mask                    # 2cnt == deg
-        # loss = ~(win | tie_mask) implicitly
-        if tie == TieBreak.STAY:
-            tie_bit = sp_ext
-        else:
-            tie_bit = ~sp_ext
-        out = win | (tie_mask & tie_bit)
-        if rule == Rule.MINORITY:
-            # minority: +1 iff sum<0, tie -> (stay: s, change: ~s)
-            loss = ~(win | tie_mask)
-            out = loss | (tie_mask & tie_bit)
+        out = _rule_tie_combine(
+            gt, eq & even_mask, sp_ext, rule, tie    # 2cnt > / == deg
+        )
         return out.at[n].set(jnp.uint32(0))          # ghost word stays zero
 
     sp_ext0 = jnp.concatenate(
         [sp, jnp.zeros((1, sp.shape[1]), sp.dtype)], axis=0
     )
     return lax.fori_loop(0, steps, body, sp_ext0)[:n]
+
+
+def packed_rollout(nbr, deg, sp, steps: int, rule: str = "majority",
+                   tie: str = "stay", gather: str = "per_slot",
+                   partition=None, mesh=None):
+    """Roll packed spins ``sp: uint32[n, W]`` for ``steps`` synchronous
+    updates. ``nbr: int32[n, dmax]`` ghost-padded with n; ``deg: int32[n]``.
+
+    ``partition=None`` (or a P=1 :class:`graphdyn.graphs.Partition`) runs
+    the single-device program (:func:`_packed_rollout_device` — the
+    dispatcher adds nothing, so the P=1 instance IS the existing program,
+    per the grouped-executor identity precedent). A P>=2 partition routes
+    through the halo-exchange node sharding
+    (:func:`graphdyn.parallel.halo.halo_rollout`): per-shard packed state,
+    boundary-word ``ppermute`` per step, bit-exact to the P=1 program.
+    ``mesh`` (optional, P>=2 only) overrides the default 1-D node mesh.
+    See ``_packed_rollout_device`` for the ``gather`` schedule knob.
+    """
+    if partition is None or partition.P == 1:
+        return _packed_rollout_device(nbr, deg, sp, steps, rule, tie, gather)
+    if gather != "per_slot":
+        raise ValueError(
+            "the partitioned rollout implements only the per_slot gather "
+            f"schedule (got gather={gather!r})"
+        )
+    from graphdyn.parallel.halo import halo_rollout
+
+    return halo_rollout(
+        nbr, deg, sp, steps, partition=partition, rule=rule, tie=tie,
+        mesh=mesh,
+    )
+
+
+# the canonical lowering surface stays reachable through the public name
+# (graftcheck's ledger entry + the roofline smoke builder lower the P=1
+# program via `packed_rollout.lower`)
+packed_rollout.lower = _packed_rollout_device.lower
 
 
 @partial(jax.jit, static_argnames=("target",))

@@ -9,4 +9,11 @@ from graphdyn.parallel.mesh import (  # noqa: F401
     replicate,
     shard_batch,
 )
+from graphdyn.parallel.halo import (  # noqa: F401
+    HaloProgram,
+    HaloTables,
+    build_halo_tables,
+    halo_rollout,
+    make_halo_rollout,
+)
 from graphdyn.parallel.sa_sharded import make_sharded_sa_solver, sa_sharded  # noqa: F401

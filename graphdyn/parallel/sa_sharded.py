@@ -117,6 +117,7 @@ def make_sharded_sa_solver(
 
         def rollout(s_loc):
             def rbody(_, s):
+                # graftlint: disable-next-line=GD013  node_mode='gather': the parity baseline the halo mode is tested against, and the small-graph fallback
                 s_full = lax.all_gather(s, node_axis, axis=1, tiled=True)
                 return _local_step(nbr_local, s_full, s, mask, R_coef, C_coef)
 
@@ -207,6 +208,170 @@ def make_sharded_sa_solver(
             P(), P(), P(), P(),            # par_a, par_b, a_cap, b_cap
             P(replica_axis, None),         # proposals
             P(replica_axis, None),         # uniforms
+        ),
+        out_specs=(
+            P(replica_axis, node_axis),
+            rep, rep, rep, rep, rep, rep, rep, rep,
+        ),
+        check_vma=False,
+    ))
+    return init_fn, chunk_fn
+
+
+def make_halo_sa_solver(
+    mesh: Mesh,
+    tables,
+    *,
+    n_real: int,
+    rollout_steps: int,
+    max_steps: int,
+    rule: str = "majority",
+    tie: str = "stay",
+    injected: bool = False,
+    stream_len: int = 1,
+    replica_axis: str = "replica",
+    node_axis: str = "node",
+    chunk_steps: int | None = None,
+):
+    """The halo-exchange node-sharding solver pair (``node_mode='halo'`` of
+    :func:`sa_sharded`): same chain semantics and signatures as the full
+    mode of :func:`make_sharded_sa_solver`, but the candidate rollout
+    updates each shard's owned spin columns from purely local gathers and
+    ships only the partition's boundary columns per synchronous step
+    (:mod:`graphdyn.parallel.halo` — one ``ppermute`` slab per schedule
+    offset, never a full-state ``all_gather``), so per-step collective
+    traffic scales with the edge CUT instead of ``n``. ``tables`` is a
+    :class:`graphdyn.parallel.halo.HaloTables`; the extra leading args of
+    ``init_fn``/``chunk_fn`` are the placed layout tables, and ``chunk_fn``
+    takes the replicated ``loc_of`` owner map as its final argument (the
+    proposal flip must find node ``i``'s shard and column). Not lru-cached:
+    the host tables are unhashable — one build per driver call, which the
+    chunked drive loop amortizes exactly like the jit cache would."""
+    from graphdyn.parallel.halo import (
+        exchange_perms,
+        sa_halo_exchange,
+        sa_halo_local_step,
+    )
+
+    R_coef, C_coef = rule_coefficients(rule, tie)
+    nm = tables.n_local_max
+    perms = exchange_perms(tables)
+    k = len(tables.schedule)
+
+    def _tools(nbr_l, real_l, sends, recvs):
+        def rollout(s_loc):
+            def rbody(_, s):
+                s = sa_halo_local_step(nbr_l, s, real_l, R_coef, C_coef)
+                return sa_halo_exchange(s, sends, recvs, perms, node_axis)
+
+            return lax.fori_loop(0, rollout_steps, rbody, s_loc)
+
+        def block_sum(s_loc):
+            # pad-free Σ over this shard's OWNED real columns (ghosts and
+            # pads excluded — each node is counted once, on its owner)
+            return jnp.where(
+                real_l[None, :], s_loc[:, :nm].astype(jnp.int32), 0
+            ).sum(axis=1)
+
+        def end_sum(s_loc):
+            return lax.psum(block_sum(rollout(s_loc)), node_axis)
+
+        return rollout, block_sum, end_sum
+
+    def init(nbr_l, real_l, send_l, recv_l, s0):
+        sends = [x[0] for x in send_l]
+        recvs = [x[0] for x in recv_l]
+        _, _, end_sum = _tools(nbr_l, real_l, sends, recvs)
+        return end_sum(s0)
+
+    def chunk(nbr_l, real_l, send_l, recv_l, s_local, key, a, b, t,
+              m_final_in, active_in, sum_end_in, par_a, par_b, a_cap, b_cap,
+              proposals, uniforms, loc_of):
+        sends = [x[0] for x in send_l]
+        recvs = [x[0] for x in recv_l]
+        Rl = s_local.shape[0]
+        dt = a.dtype
+        node_idx = lax.axis_index(node_axis)
+        _, block_sum, end_sum = _tools(nbr_l, real_l, sends, recvs)
+
+        def cond(st: _State):
+            go = st.live > 0
+            if chunk_steps is not None:
+                go = go & (st.chunk_t < chunk_steps)
+            return go
+
+        def body(st: _State):
+            i, u = draw_sa_proposal(
+                st.key, st.t, proposals, uniforms,
+                injected=injected, stream_len=stream_len, n=n_real, dt=dt,
+            )
+            # flip proposal i on its owning shard's column (loc_of maps the
+            # global id to owner * n_local_max + row)
+            lg = jnp.take(loc_of, i)
+            col = lg % nm
+            owned = (lg // nm) == node_idx
+            ridx = jnp.arange(Rl, dtype=jnp.int32)
+            s_i_local = st.s[ridx, col].astype(jnp.int32)
+            flipped = st.s.at[ridx, col].set((-s_i_local).astype(jnp.int8))
+            s_flip = jnp.where(owned[:, None], flipped, st.s)
+            # propagate the flip into its ghost copies BEFORE the rollout:
+            # the all_gather solver re-gathers the full state every step,
+            # here the exchanged boundary columns are the only remote view
+            s_flip = sa_halo_exchange(s_flip, sends, recvs, perms, node_axis)
+            s_i = lax.psum(jnp.where(owned, s_i_local, 0), node_axis)
+
+            sum_end_flip = end_sum(s_flip)
+
+            do, sum_end_new, a_new, b_new, t_new, m_final, active = (
+                metropolis_anneal_update(
+                    st.active, st.a, st.b, st.t, st.m_final,
+                    st.sum_end, sum_end_flip, s_i, u,
+                    par_a=par_a, par_b=par_b, a_cap=a_cap, b_cap=b_cap,
+                    max_steps=max_steps, n=n_real,
+                )
+            )
+            s_new = jnp.where(do[:, None], s_flip, st.s)
+            live = lax.psum(jnp.any(active).astype(jnp.int32), replica_axis)
+            return _State(
+                s_new, sum_end_new, a_new, b_new, t_new, m_final, active,
+                st.key, live, st.chunk_t + 1,
+            )
+
+        live0 = lax.psum(jnp.any(active_in).astype(jnp.int32), replica_axis)
+        state0 = _State(
+            s_local, sum_end_in, a, b, t, m_final_in, active_in, key,
+            live0, jnp.zeros((), jnp.int32),
+        )
+        out = lax.while_loop(cond, body, state0)
+        mag = lax.psum(block_sum(out.s), node_axis).astype(dt) / n_real
+        return (out.s, mag, out.key, out.a, out.b, out.t, out.m_final,
+                out.active, out.sum_end)
+
+    rep = P(replica_axis)
+    tab_specs = (
+        P(node_axis, None),                # nbr_loc [P*nm, dmax]
+        P(node_axis),                      # real    [P*nm]
+        [P(node_axis, None)] * k,          # send_idx per offset [P, m]
+        [P(node_axis, None)] * k,          # recv_idx per offset [P, m]
+    )
+    init_fn = jax.jit(shard_map(
+        init,
+        mesh=mesh,
+        in_specs=(*tab_specs, P(replica_axis, node_axis)),
+        out_specs=rep,
+        check_vma=False,
+    ))
+    chunk_fn = jax.jit(shard_map(
+        chunk,
+        mesh=mesh,
+        in_specs=(
+            *tab_specs,
+            P(replica_axis, node_axis),    # s (halo column layout)
+            rep, rep, rep, rep, rep, rep, rep,  # key a b t m_final active sum_end
+            P(), P(), P(), P(),            # par_a, par_b, a_cap, b_cap
+            P(replica_axis, None),         # proposals
+            P(replica_axis, None),         # uniforms
+            P(),                           # loc_of
         ),
         out_specs=(
             P(replica_axis, node_axis),
@@ -355,6 +520,8 @@ def sa_sharded(
     chunk_steps: int = 100_000,
     rollout_mode: str = "full",
     lc_tables=None,
+    node_mode: str = "gather",
+    partition=None,
 ) -> SAResult:
     """Run batched SA chains to completion over a device mesh.
 
@@ -378,6 +545,22 @@ def sa_sharded(
     both full-rollout solvers (tested under injected streams). Pass
     ``lc_tables`` (:func:`graphdyn.ops.lightcone.build_lightcone_tables`)
     to amortize table construction across calls.
+
+    ``node_mode='halo'`` (full rollout mode, node axis >= 2) replaces the
+    per-step full-state ``all_gather`` with the halo exchange of
+    :mod:`graphdyn.parallel.halo`: the graph is partitioned
+    (``partition``, a :class:`graphdyn.graphs.Partition` with ``P`` equal
+    to the mesh's node-axis size; built with
+    :func:`graphdyn.graphs.partition_graph` when None), each shard owns
+    its part's spin columns plus ghost copies of remote boundary nodes,
+    and every synchronous step ships only boundary columns over the static
+    ``ppermute`` schedule — per-step collective bytes scale with the edge
+    cut, not with ``n`` (the pod-scale path; calls
+    :func:`graphdyn.parallel.mesh.init_multihost` up front, so the
+    ``multihost.init`` fault site and its coordinator-retry policy ride
+    this path). Chains, snapshots, and the resume contract are identical
+    to the gather mode (snapshots store the unpadded GLOBAL state, so runs
+    resume across node modes, mesh shapes, and shard counts — tested).
     """
     config = config or SAConfig()
     n = graph.n
@@ -398,6 +581,44 @@ def sa_sharded(
         raise ValueError(
             f"rollout_mode must be 'full' or 'lightcone', got {rollout_mode!r}"
         )
+    if node_mode not in ("gather", "halo"):
+        raise ValueError(
+            f"node_mode must be 'gather' or 'halo', got {node_mode!r}"
+        )
+    halo = node_mode == "halo"
+    if halo and rollout_mode != "full":
+        raise ValueError(
+            "node_mode='halo' shards the full-rollout node axis; "
+            "rollout_mode='lightcone' keeps whole replicas per device and "
+            "has no node axis to exchange"
+        )
+    if halo and node_shards < 2:
+        raise ValueError(
+            f"node_mode='halo' needs a node axis of size >= 2 (got "
+            f"{node_shards}): with one shard there is no halo to exchange "
+            "— use node_mode='gather'"
+        )
+    tables = None
+    if halo:
+        from graphdyn.graphs import partition_graph
+        from graphdyn.parallel.halo import build_halo_tables
+        from graphdyn.parallel.mesh import init_multihost
+
+        # the pod-scale path: bring up the multi-host runtime first (an
+        # idempotent no-op single-process) — a not-yet-up coordinator at
+        # requeue time retries with jittered backoff via the
+        # `multihost.init` fault site's policy instead of crashing the job
+        init_multihost()
+        if partition is None:
+            partition = partition_graph(graph, node_shards, seed=seed or 0)
+        if partition.P != node_shards:
+            raise ValueError(
+                f"partition has P={partition.P} parts but the mesh "
+                f"{node_axis!r} axis has size {node_shards}"
+            )
+        tables = build_halo_tables(graph, partition)
+    elif partition is not None:
+        raise ValueError("partition= requires node_mode='halo'")
     lightcone = rollout_mode == "lightcone"
     rollout = dyn.p + dyn.c - 1
     if lightcone:
@@ -452,7 +673,8 @@ def sa_sharded(
     proposals = pad_rep(proposals, 0)
     uniforms = pad_rep(uniforms, 0.0)
 
-    nbr_pad, n_pad = pad_nodes(graph, node_shards)
+    if not halo:
+        nbr_pad, n_pad = pad_nodes(graph, node_shards)
 
     if restored is None:
         s_h = np.asarray(s0, np.int8)
@@ -477,11 +699,22 @@ def sa_sharded(
 
     def place_state():
         """Pad the host state to mesh shapes and place it."""
-        s_pad = np.concatenate(          # frozen +1 pad rows and node columns
-            [np.concatenate([s_h, np.ones((R_pad, n), np.int8)])
-             if R_pad else s_h,
-             np.ones((Rtot, n_pad - n), np.int8)], axis=1,
+        s_full = (
+            np.concatenate([s_h, np.ones((R_pad, n), np.int8)])
+            if R_pad else s_h
         )
+        if halo:
+            # the halo column layout: owned + consistent ghost columns per
+            # shard; the all-+1 replica pad rows stay at consensus in any
+            # layout, and the zero column reads as spin 0 for ghost-padded
+            # neighbor slots
+            from graphdyn.parallel.halo import sa_halo_cols
+
+            s_pad = sa_halo_cols(tables, s_full)
+        else:
+            s_pad = np.concatenate(       # frozen node pad columns
+                [s_full, np.ones((Rtot, n_pad - n), np.int8)], axis=1,
+            )
         key_pad = np.concatenate(
             [key_h, np.asarray(jax.vmap(jax.random.PRNGKey)(
                 np.zeros(R_pad, np.uint32)))]
@@ -494,28 +727,59 @@ def sa_sharded(
             place_sharded(mesh, jnp.asarray(pad_rep(t_h, 0)), P(replica_axis)),
         )
 
-    init_fn, chunk_fn = make_sharded_sa_solver(
-        mesh,
-        n_real=n,
-        rollout_steps=dyn.p + dyn.c - 1,
-        max_steps=max_steps,
-        rule=dyn.rule,
-        tie=dyn.tie,
-        injected=injected,
-        stream_len=stream_len,
-        replica_axis=replica_axis,
-        node_axis=node_axis,
-        chunk_steps=int(chunk_steps) if ckpt is not None else None,
-        lightcone=lightcone,
-    )
-    nbr_dev = place_sharded(mesh, jnp.asarray(nbr_pad), P(node_axis, None))
+    if halo:
+        init_fn, chunk_fn = make_halo_sa_solver(
+            mesh, tables,
+            n_real=n,
+            rollout_steps=dyn.p + dyn.c - 1,
+            max_steps=max_steps,
+            rule=dyn.rule,
+            tie=dyn.tie,
+            injected=injected,
+            stream_len=stream_len,
+            replica_axis=replica_axis,
+            node_axis=node_axis,
+            chunk_steps=int(chunk_steps) if ckpt is not None else None,
+        )
+        spec2 = P(node_axis, None)
+        lead = (
+            place_sharded(
+                mesh,
+                jnp.asarray(tables.nbr_loc.reshape(-1, tables.dmax)),
+                spec2,
+            ),
+            place_sharded(mesh, jnp.asarray(tables.real.reshape(-1)),
+                          P(node_axis)),
+            [place_sharded(mesh, jnp.asarray(s), spec2)
+             for (_, s, _) in tables.schedule],
+            [place_sharded(mesh, jnp.asarray(r), spec2)
+             for (_, _, r) in tables.schedule],
+        )
+    else:
+        init_fn, chunk_fn = make_sharded_sa_solver(
+            mesh,
+            n_real=n,
+            rollout_steps=dyn.p + dyn.c - 1,
+            max_steps=max_steps,
+            rule=dyn.rule,
+            tie=dyn.tie,
+            injected=injected,
+            stream_len=stream_len,
+            replica_axis=replica_axis,
+            node_axis=node_axis,
+            chunk_steps=int(chunk_steps) if ckpt is not None else None,
+            lightcone=lightcone,
+        )
+        lead = (
+            place_sharded(mesh, jnp.asarray(nbr_pad), P(node_axis, None)),
+        )
     s_dev, key_dev, a_dev, b_dev, t_dev = place_state()
 
     if lightcone:
         # traj is a pure function of s — recomputed, never persisted (same
         # as the unsharded solver's resume); sum_end from the cache's last
         # frame equals the restored value by construction
-        traj_dev, sum_end_dev = init_fn(nbr_dev, s_dev)
+        traj_dev, sum_end_dev = init_fn(*lead, s_dev)
         if sum_end_h is None:
             sum_end_h = np.asarray(sum_end_dev)[:R]
             m_final_h = (sum_end_h.astype(np_dt) / np_dt(n)).astype(np_dt)
@@ -523,7 +787,7 @@ def sa_sharded(
         carried0 = traj_dev
     else:
         if sum_end_h is None:
-            sum_end_h = np.asarray(init_fn(nbr_dev, s_dev))[:R]
+            sum_end_h = np.asarray(init_fn(*lead, s_dev))[:R]
             m_final_h = (sum_end_h.astype(np_dt) / np_dt(n)).astype(np_dt)
             active_h = m_final_h < 1.0
         carried0 = s_dev
@@ -552,19 +816,30 @@ def sa_sharded(
             place_sharded(mesh, lc_tables.nbr_slot, repl),
             place_sharded(mesh, lc_tables.nbr_glob, repl),
         )
+    if halo:
+        consts = consts + (
+            place_sharded(mesh, jnp.asarray(tables.loc_of), P()),
+        )
 
     fields = ("s", "key", "a", "b", "t", "m_final", "active", "sum_end")
 
     def extract_s(carried):
-        """Current spins from the carried state — traj frame 0 in lightcone
-        mode (the cache IS the live state; `models.sa._sa_loop`). Slices on
-        DEVICE first: the full traj cache is [Rtot, T+1, n+2] int8 and a
-        checkpoint only needs the [R, n] spin frame on the host."""
+        """Current spins from the carried state, in the caller's GLOBAL
+        node order — traj frame 0 in lightcone mode (the cache IS the live
+        state; `models.sa._sa_loop`), the un-partitioned owned columns in
+        halo mode (snapshots are layout-agnostic, so runs resume across
+        node modes and shard counts). Slices on DEVICE first: the full
+        traj cache is [Rtot, T+1, n+2] int8 and a checkpoint only needs
+        the [R, n] spin frame on the host."""
+        if halo:
+            from graphdyn.parallel.halo import sa_halo_uncols
+
+            return sa_halo_uncols(tables, np.asarray(carried[:R]))
         sl = carried[:R, 0, :n] if lightcone else carried[:R, :n]
         return np.asarray(sl)
 
     def advance(st):
-        out = chunk_fn(nbr_dev, *st, *consts)   # (s|traj, mag, key, a, b, ...)
+        out = chunk_fn(*lead, *st, *consts)     # (s|traj, mag, key, a, b, ...)
         from graphdyn import obs
 
         if obs.enabled():

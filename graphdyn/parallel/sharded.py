@@ -111,6 +111,7 @@ def make_sharded_rollout(
         mask = _real_mask(node_axis, s_local.shape[1], n_real)
 
         def body(_, s_loc):
+            # graftlint: disable-next-line=GD013  legacy gather mode: the parity baseline the halo path (parallel/halo.py) is tested against, and the small-graph fallback where one ICI gather beats halo bookkeeping
             s_full = lax.all_gather(s_loc, node_axis, axis=1, tiled=True)
             return _local_step(nbr_local, s_full, s_loc, mask, R_coef, C_coef)
 
@@ -203,6 +204,7 @@ def make_sharded_sa_step(
 
         # candidate rollout (the single rollout per MCMC step; SURVEY §3.1)
         def body(_, s_loc):
+            # graftlint: disable-next-line=GD013  legacy gather mode (see make_sharded_rollout): parity baseline + small-graph fallback
             s_full = lax.all_gather(s_loc, node_axis, axis=1, tiled=True)
             return _local_step(nbr_local, s_full, s_loc, mask, R_coef, C_coef)
 

@@ -115,7 +115,18 @@ if [ "${GRAPHDYN_SKIP_HLOCHECK:-0}" = "1" ]; then
     echo "== hlocheck: GRAPHDYN_SKIP_HLOCHECK=1 — SKIPPED (subset runs in tier-1) =="
 else
     echo "== hlocheck (graftcheck fingerprint ledger) =="
-    JAX_PLATFORMS=cpu python -m graphdyn.analysis.graftcheck --format=text || fail=1
+    # the simulated 8-device host platform matches the test harness, so the
+    # multi-device entries (halo_rollout's 2-device ppermute program) are
+    # CHECKED here rather than skipped as unsupported; APPEND to any
+    # caller-provided XLA_FLAGS (mirroring tests/conftest.py) instead of
+    # replacing them
+    hlo_xla_flags="${XLA_FLAGS:-}"
+    case "$hlo_xla_flags" in
+        *xla_force_host_platform_device_count*) ;;
+        *) hlo_xla_flags="$hlo_xla_flags --xla_force_host_platform_device_count=8" ;;
+    esac
+    JAX_PLATFORMS=cpu XLA_FLAGS="${hlo_xla_flags# }" \
+        python -m graphdyn.analysis.graftcheck --format=text || fail=1
     if python -c 'import pytest' 2>/dev/null; then
         echo "== hlocheck (pytest -m graftcheck) =="
         JAX_PLATFORMS=cpu python -m pytest tests/ -q -m graftcheck \
@@ -248,6 +259,29 @@ else:
         else:
             print(f"benchcheck: fingerprints stable vs {path} "
                   f"({len(fp['entries'])} entries)")
+# the halo weak-scaling column (node-axis sharding): a measured efficiency
+# rate(P)/(P*rate(1)), or an explicit null + reason (fewer than 2 devices)
+# — NEVER 0.0; same null-or-positive contract as ensemble_rate
+assert "halo_weak_efficiency" in row, "halo_weak_efficiency column absent"
+hwe = row["halo_weak_efficiency"]
+if hwe is None:
+    assert row.get("halo_weak_efficiency_skipped_reason"), \
+        "null halo_weak_efficiency needs halo_weak_efficiency_skipped_reason"
+    print("benchcheck: halo_weak_efficiency skipped:",
+          row["halo_weak_efficiency_skipped_reason"])
+else:
+    assert hwe > 0, f"halo_weak_efficiency must be > 0 or null+reason: {hwe}"
+    assert row.get("halo_rate_by_shards", {}).get("1", 0) > 0, \
+        "measured halo row needs a positive P=1 rate"
+# the exchange-traffic column rides with it: 4*W*sum(ghosts) of the
+# measured partition, or null + the same reason
+assert "halo_bytes_per_step" in row, "halo_bytes_per_step column absent"
+hbs = row["halo_bytes_per_step"]
+if hbs is None:
+    assert row.get("halo_bytes_per_step_skipped_reason"), \
+        "null halo_bytes_per_step needs halo_bytes_per_step_skipped_reason"
+else:
+    assert hbs > 0, f"halo_bytes_per_step must be > 0 or null+reason: {hbs}"
 # the durable-store save-overhead column: an interleaved p50/p99 A/B of
 # DurableCheckpoint.save vs raw Checkpoint.save, or an explicit null +
 # reason — never silently absent

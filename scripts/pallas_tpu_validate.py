@@ -7,6 +7,27 @@ crossovers. Emits one JSON document (stdout + PALLAS_TPU.json) consumed by
 PALLAS_TPU.md.
 
 Usage: PYTHONPATH=/root/repo:/root/.axon_site python scripts/pallas_tpu_validate.py
+
+CHIP-ROUND CHECKLIST (run alongside this script the first session a real
+TPU answers — no chip round has landed since r05, and several committed
+bands are provisional until one does):
+
+1. ``python bench.py`` (full, not --smoke) — persists the round's
+   ``BENCH_r*.json`` with the fingerprint summary, ``peak_hbm_bytes``,
+   and the halo weak-scaling row (``halo_weak_efficiency`` measures for
+   real on >= 2 chips; the CPU container can only null+reason it).
+2. ``python -m graphdyn.obs memcheck`` — the FIRST run with usable
+   ``memory_stats()``: measured peaks land against the byte models and
+   the provisional ``MEM_BANDS`` (packed_state / bdcm_stack /
+   entropy_cell_chunk / halo_shard) re-center on data — update the bands
+   + the ARCHITECTURE.md table in the same reviewed PR.
+3. ``python -m graphdyn.obs check`` on-chip — ``CHIP_BANDS``
+   (obs/roofline.py, seeded from the published 819 GB/s v5e anchor)
+   re-center the same way; an uncalibrated device kind shows up as the
+   explicit ``obs.roofline.uncalibrated`` gauge.
+4. Bless deliberate rate shifts: ``python -m graphdyn.obs trend ROW.json
+   --bless`` (OBS_TREND.json), so the next round's trend gate diffs
+   against measured chip numbers instead of CPU smoke rows.
 """
 
 from __future__ import annotations

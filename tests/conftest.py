@@ -64,6 +64,39 @@ def abort_after_save():
     return patcher
 
 
+@pytest.fixture
+def multi_device_cpu():
+    """Run ``python -m graphdyn ...`` in a SUBPROCESS on a forced
+    multi-device CPU host platform (``JAX_PLATFORMS=cpu`` +
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8``) — the
+    fake-backend analogue of a multi-chip host for CLI-level sharded
+    tests. In-process tests inherit this harness's own 8 simulated
+    devices (header above), but subprocess episodes — kill/requeue
+    chains, supervisor runs, anything whose process boundary is the point
+    — previously saw 1 device on this CPU-only container and had to skip
+    their sharded legs. Returns ``run(argv, *, env=None, devices=8,
+    timeout=600, cwd=None) -> CompletedProcess`` (text mode, output
+    captured)."""
+    import subprocess
+    import sys
+
+    def run(argv, *, env=None, devices=8, timeout=600, cwd=None):
+        e = dict(os.environ)
+        e.update(env or {})
+        e["JAX_PLATFORMS"] = "cpu"
+        flags = e.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            e["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={devices}"
+            ).strip()
+        return subprocess.run(
+            [sys.executable, "-m", "graphdyn", *argv],
+            env=e, capture_output=True, text=True, timeout=timeout, cwd=cwd,
+        )
+
+    return run
+
+
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running correctness anchors")
 
@@ -97,6 +130,12 @@ _SLOW = {
     ("test_entropy.py", "test_golden_triples_tight_f64"),
     ("test_entropy.py", "test_golden_triples_tolerance"),
     ("test_entropy.py", "test_grid_driver_shapes"),
+    # the halo bit-parity matrix and resume interop compile several mesh
+    # programs each; the preempt/requeue JOURNAL proof (the acceptance
+    # centerpiece) deliberately stays tier-1 despite ~10 s
+    ("test_halo.py", "test_cli_sa_shards_halo"),
+    ("test_halo.py", "test_sa_halo_bit_parity_vs_unsharded_and_gather"),
+    ("test_halo.py", "test_sa_halo_resume_across_modes_and_shard_counts"),
     ("test_entropy.py", "test_union_ensemble_all_isolate_member"),
     ("test_entropy.py", "test_union_ensemble_checkpointing"),
     ("test_entropy.py", "test_union_ensemble_managed_resume_bit_exact"),

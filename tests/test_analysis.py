@@ -797,6 +797,100 @@ class TestGD012BareProfiler:
         assert "GD012" in RULES
 
 
+class TestGD013ShardMapFullGather:
+    """``lax.all_gather`` (or a ``jnp.take`` over its result) inside a
+    shard-mapped body of ``graphdyn/parallel/``: the halo exchange moves
+    only the partition's boundary spin words per step — a full-node-axis
+    gather is the O(n)-bytes collective the node sharding exists to
+    remove (ARCHITECTURE.md "Node-axis sharding & halo exchange")."""
+
+    PARALLEL = "graphdyn/parallel/solver.py"
+    BAD_GATHER = (
+        "from jax import lax\n"
+        "from graphdyn.parallel.mesh import shard_map\n"
+        "def make(mesh, steps):\n"
+        "    def rollout(nbr, s):\n"
+        "        def body(_, s_loc):\n"
+        "            s_full = lax.all_gather(s_loc, 'node', axis=1, tiled=True)\n"  # GD013
+        "            return step(nbr, s_full, s_loc)\n"
+        "        return lax.fori_loop(0, steps, body, s)\n"
+        "    return shard_map(rollout, mesh=mesh, in_specs=(), out_specs=())\n"
+    )
+    BAD_TAKE_OVER_GATHER = (
+        "import jax.numpy as jnp\n"
+        "from jax import lax\n"
+        "from graphdyn.parallel.mesh import shard_map\n"
+        "def make(mesh):\n"
+        "    def body(nbr, s_loc):\n"
+        "        s_full = lax.all_gather(s_loc, 'node', axis=1, tiled=True)\n"   # GD013
+        "        return jnp.take(s_full, nbr.reshape(-1), axis=1)\n"             # GD013
+        "    return shard_map(body, mesh=mesh, in_specs=(), out_specs=())\n"
+    )
+    BAD_TRANSITIVE_CALLEE = (
+        "from jax import lax\n"
+        "from graphdyn.parallel.mesh import shard_map\n"
+        "def helper(s_loc):\n"
+        "    return lax.all_gather(s_loc, 'node', axis=1, tiled=True)\n"  # GD013 (called from the body)
+        "def make(mesh):\n"
+        "    def body(nbr, s_loc):\n"
+        "        return helper(s_loc)\n"
+        "    return shard_map(body, mesh=mesh, in_specs=(), out_specs=())\n"
+    )
+    GOOD_HALO = (
+        "import jax.numpy as jnp\n"
+        "from jax import lax\n"
+        "from graphdyn.parallel.mesh import shard_map\n"
+        "def make(mesh, perms, steps):\n"
+        "    def rollout(nbr, send_idx, recv_idx, s):\n"
+        "        def body(_, s_loc):\n"
+        "            out = update(nbr, s_loc)\n"
+        "            buf = jnp.take(out, send_idx, axis=0)\n"     # boundary slab only
+        "            buf = lax.ppermute(buf, 'node', perms)\n"
+        "            return out.at[recv_idx].set(buf)\n"
+        "        return lax.fori_loop(0, steps, body, s)\n"
+        "    return shard_map(rollout, mesh=mesh, in_specs=(), out_specs=())\n"
+    )
+    GOOD_GATHER_OUTSIDE_SHARD_MAP = (
+        "from jax import lax\n"
+        "def host_helper(s):\n"
+        "    return lax.all_gather(s, 'node', axis=1, tiled=True)\n"
+    )
+
+    def test_bad_all_gather_in_body(self):
+        assert "GD013" in _codes(self.BAD_GATHER, path=self.PARALLEL)
+
+    def test_bad_take_over_gather_result(self):
+        assert _codes(self.BAD_TAKE_OVER_GATHER, path=self.PARALLEL).count(
+            "GD013") == 2
+
+    def test_bad_transitive_module_local_callee(self):
+        assert "GD013" in _codes(self.BAD_TRANSITIVE_CALLEE,
+                                 path=self.PARALLEL)
+
+    def test_good_halo_exchange(self):
+        assert _codes(self.GOOD_HALO, path=self.PARALLEL) == []
+
+    def test_good_gather_outside_shard_map_scope(self):
+        assert _codes(self.GOOD_GATHER_OUTSIDE_SHARD_MAP,
+                      path=self.PARALLEL) == []
+
+    def test_non_parallel_module_exempt(self):
+        for path in ("graphdyn/ops/packed.py", "graphdyn/models/sa.py",
+                     "graphdyn/pipeline/sa_group.py"):
+            assert _codes(self.BAD_GATHER, path=path) == [], path
+
+    def test_disable_comment(self):
+        src = self.BAD_GATHER.replace(
+            "            s_full = lax.all_gather",
+            "            # graftlint: disable-next-line=GD013  legacy gather mode: parity baseline\n"
+            "            s_full = lax.all_gather",
+        )
+        assert _codes(src, path=self.PARALLEL) == []
+
+    def test_catalogued(self):
+        assert "GD013" in RULES
+
+
 class TestGD007AtomicPersistence:
     BAD_SAVEZ = (
         "import numpy as np\n"
@@ -973,7 +1067,7 @@ def test_unreadable_file_is_a_finding(tmp_path):
 
 
 def test_rules_registry_complete():
-    assert set(RULES) == {f"GD{i:03d}" for i in range(1, 13)}
+    assert set(RULES) == {f"GD{i:03d}" for i in range(1, 14)}
 
 
 def test_cli_json_is_one_document_stdout_only(tmp_path):
