@@ -526,6 +526,138 @@ def halo_weak_scaling(smoke: bool, *, n_per=None, R=None, steps=None,
     }
 
 
+def tta_rows(smoke: bool):
+    """Time-to-target-magnetization A/B (ROADMAP item 3): device steps
+    until the rolled-out end-state magnetization first reaches the target,
+    for the serial reference SA chain vs the replica-exchange ladder
+    (``graphdyn.search.tempering``) and the chromatic block sweeps
+    (``graphdyn.search.chromatic``), on the SAME d=3 RRG at fixed seeds —
+    legs interleaved per seed. Device steps is the honest unit: the serial
+    chain pays one device step per proposal (one light cone), the ladder
+    pays one per lockstep lane step, the chromatic kernel one per color
+    class (~n/χ proposals). Counts are seed-deterministic, so the rows
+    reproduce exactly — this is an algorithmic A/B, not a timing one (the
+    obs spans still record the wall clock per leg).
+
+    ``swap_acceptance_rate`` rides as its own column: a DEAD ladder (0%
+    swaps accepted) would still look "fast" on easy seeds, so benchcheck
+    fails the round loudly when the measured row carries a zero rate. A
+    serial chain that exhausts its step budget before the target counts at
+    the budget (speedups become lower bounds; ``tta_serial_timeouts``
+    records how often)."""
+    from graphdyn import obs
+    from graphdyn.config import DynamicsConfig, SAConfig
+    from graphdyn.graphs import random_regular_graph
+    from graphdyn.search.chromatic import chromatic_anneal
+    from graphdyn.search.tempering import temper_search
+
+    if smoke:
+        n, seeds, max_steps, lanes, max_sweeps = 128, (0, 1), 400_000, 8, 4000
+    else:
+        n, seeds, max_steps, lanes, max_sweeps = (
+            512, (0, 1, 2), 2_000_000, 16, 20_000)
+    m_target = 0.9
+    cfg = SAConfig(dynamics=DynamicsConfig(p=1, c=1))
+    g = random_regular_graph(n, 3, seed=0)
+    serial, temper, chrom, swap_rates = [], [], [], []
+    serial_timeouts = 0
+    chi = None
+    chrom_hits = chrom_total = 0
+    for seed in seeds:                    # interleaved A/B per seed
+        _mark(f"tta seed={seed}: serial reference chain")
+        with obs.timed("bench.tta", leg="serial", seed=seed):
+            ser = temper_search(
+                g, cfg, betas=[1.0], seed=seed, max_steps=max_steps,
+                swap_moves=False, swap_interval=10_000,
+                m_target=m_target, stop_on_first=True,
+            )
+        if ser.steps_to_target < 0:       # budget exhausted: lower bound
+            serial_timeouts += 1
+            serial.append(max_steps)
+        else:
+            serial.append(ser.steps_to_target)
+        _mark(f"tta seed={seed}: tempering ladder (K={lanes})")
+        with obs.timed("bench.tta", leg="tempering", seed=seed):
+            lad = temper_search(
+                g, cfg, n_lanes=lanes, seed=seed, max_steps=max_steps,
+                swap_interval=250, m_target=m_target, stop_on_first=True,
+            )
+        temper.append(lad.steps_to_target)
+        swap_rates.append(lad.swap_acceptance_rate)
+        _mark(f"tta seed={seed}: chromatic sweeps")
+        with obs.timed("bench.tta", leg="chromatic", seed=seed):
+            ch = chromatic_anneal(
+                g, cfg, n_replicas=32, seed=seed, m_target=m_target,
+                max_sweeps=max_sweeps,
+            )
+        chi = ch.chi
+        hit = ch.steps_to_target >= 0
+        chrom_hits += int(hit.sum())
+        chrom_total += hit.size
+        # mean first-passage per chain (each packed replica is an
+        # independent chain; min would overclaim the parallel-draw bonus)
+        chrom.append(float(np.mean(ch.steps_to_target[hit])) if hit.any()
+                     else np.nan)
+    if any(t < 0 for t in temper):
+        return {
+            "tta_tempering": None,
+            "tta_tempering_skipped_reason":
+                "tempering ladder exhausted its step budget before the "
+                "target on at least one seed — no honest speedup to report",
+            "tta_chromatic": None,
+            "tta_chromatic_skipped_reason": "tempering leg failed",
+            "swap_acceptance_rate": None,
+        }
+    chrom_row: dict
+    if chrom_hits < chrom_total:
+        # a replica that never reached the target has TTA > the sweep
+        # budget: averaging only the hits (or substituting the budget)
+        # would UNDERSTATE the chromatic time and bench a miss as fast —
+        # null + reason instead, exactly like the tempering leg
+        chrom_row = {
+            "tta_chromatic": None,
+            "tta_chromatic_skipped_reason": (
+                f"only {chrom_hits}/{chrom_total} chromatic chains reached "
+                f"m_target={m_target} within {max_sweeps} sweeps — no "
+                "honest speedup to report"
+            ),
+        }
+    else:
+        chrom_row = {"tta_chromatic": {
+            "device_steps": float(np.mean(chrom)),
+            "speedup_x": float(np.sum(serial) / max(np.sum(chrom), 1e-9)),
+            "per_seed_speedup": [s / max(c, 1e-9)
+                                 for s, c in zip(serial, chrom)],
+            "chi": chi,
+            "target_hit_fraction": 1.0,
+        }}
+    row = {
+        "tta_workload": {
+            "n": n, "d": 3, "seeds": list(seeds), "m_target": m_target,
+            "max_steps": max_steps, "lanes": lanes,
+            "chromatic_replicas": 32,
+        },
+        "tta_serial_steps": float(np.mean(serial)),
+        "tta_serial_timeouts": serial_timeouts,
+        "tta_tempering": {
+            "device_steps": float(np.mean(temper)),
+            "speedup_x": float(np.sum(serial) / max(np.sum(temper), 1)),
+            "per_seed_speedup": [s / max(t, 1)
+                                 for s, t in zip(serial, temper)],
+            "lanes": lanes,
+        },
+        "swap_acceptance_rate": float(np.mean(swap_rates)),
+        **chrom_row,
+    }
+    obs.gauge("search.tta.speedup", row["tta_tempering"]["speedup_x"],
+              leg="tempering")
+    if row["tta_chromatic"] is not None:
+        obs.gauge("search.tta.speedup", row["tta_chromatic"]["speedup_x"],
+                  leg="chromatic")
+    obs.gauge("search.swap_acceptance_rate", row["swap_acceptance_rate"])
+    return row
+
+
 def fingerprint_rows():
     """The graftcheck program-fingerprint summary persisted with every
     round (``BENCH_*.json``): per headline entry point, the ledger-gated
@@ -832,6 +964,20 @@ def main():
             "halo_bytes_per_step": None,
             "halo_bytes_per_step_skipped_reason":
                 f"halo weak scaling failed: {str(e)[:150]}",
+        })
+    _mark("time-to-target search A/B (tta_tempering / tta_chromatic)")
+    try:
+        extra.update(tta_rows(args.smoke))
+    except Exception as e:  # noqa: BLE001 — optional row, never silent
+        _mark(f"tta rows failed: {str(e)[:150]}")
+        extra.update({
+            "tta_tempering": None,
+            "tta_tempering_skipped_reason":
+                f"tta A/B failed: {str(e)[:150]}",
+            "tta_chromatic": None,
+            "tta_chromatic_skipped_reason":
+                f"tta A/B failed: {str(e)[:150]}",
+            "swap_acceptance_rate": None,
         })
     _mark("program fingerprints (graftcheck structural summary)")
     try:

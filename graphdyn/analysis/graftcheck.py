@@ -416,6 +416,21 @@ def _build_halo_rollout():
     )
 
 
+def _temper_config():
+    from graphdyn.config import DynamicsConfig, SAConfig
+
+    return SAConfig(dynamics=DynamicsConfig(p=1, c=1))
+
+
+def _build_temper_chunk(K: int = 4):
+    from graphdyn.search.tempering import lower_temper_chunk
+
+    return lower_temper_chunk(
+        _canon_rrg(48, 3, 0), _temper_config(), n_lanes=K, seed=0,
+        max_steps=200, swap_interval=16,
+    )
+
+
 ENTRIES: dict[str, EntrySpec] = {
     "packed_rollout": EntrySpec(
         _build_packed_rollout, donates=False,
@@ -445,6 +460,15 @@ ENTRIES: dict[str, EntrySpec] = {
         _build_halo_rollout, donates=True,
         canon="2-device node mesh, RRG n=128 d=3, P=2 partition, W=4, "
               "steps=2",
+    ),
+    # the swap-move program: the while-count band pins "ONE Metropolis
+    # while-loop then the swap as straight-line ops" (a host round-trip or
+    # a second loop sneaking into the swap fails GC106), and donates=True
+    # pins the chunk-to-chunk in-place carry (GC001)
+    "tempering_ladder": EntrySpec(
+        _build_temper_chunk, donates=True,
+        canon="K=4 drive ladder, RRG n=48 d=3, p=c=1, max_steps=200, "
+              "swap_interval=16",
     ),
 }
 

@@ -108,6 +108,20 @@ Rules (stable codes; each can be silenced per line with
   module-local functions they call.  The legacy gather-mode solver keeps
   reasoned per-line disables (it is the parity baseline the halo mode is
   tested against, and the small-graph fallback).
+- **GD014** host round-trip inside a search drive loop: ``np.asarray``
+  (dotted or import-aliased), ``jax.device_get``, ``.item()``,
+  ``.block_until_ready()``, or an ``int()``/``float()`` coercion of a
+  non-literal, inside a host ``for``/``while`` loop of a
+  ``graphdyn/search/`` module.  The tempering
+  chunk+swap and the chromatic sweep are designed as ONE device program
+  per chunk boundary — the only sanctioned per-chunk sync is the
+  ``bool(jnp.any(…))`` stop test, and results read back ONCE after the
+  loop.  A per-chunk ``np.asarray`` (materializing swap statistics or
+  lane states every boundary) serializes the ladder on the host link
+  exactly the way the pre-pipeline serial drivers did.  Loops inside jit
+  contexts are exempt (they unroll at trace time); the checkpoint payload
+  goes through ``ChainCheckpointer`` (``utils/io`` — out of scope), which
+  only materializes when a snapshot is actually due.
 
 Escape hatches, all requiring an explicit code list (``all`` allowed):
 
@@ -146,7 +160,18 @@ RULES = {
     "GD011": "bare time.time()/time.perf_counter() timing in a driver module (use graphdyn.obs timed/span)",
     "GD012": "bare jax.profiler capture/annotation outside graphdyn/obs/ (use graphdyn.obs.trace profiling/span alignment)",
     "GD013": "full-node-axis all_gather/jnp.take in a parallel/ shard-mapped body (halo exchange moves boundary words only)",
+    "GD014": "host round-trip (np.asarray/device_get/.item()/block_until_ready/int()/float() coercion) inside a search/ drive loop (swap/sweep chunks stay on device)",
 }
+
+# device->host materializations GD014 watches inside search/ drive loops
+# (the bool(jnp.any(...)) stop test is deliberately NOT in this set — it
+# is the sanctioned one-scalar-per-chunk sync). The bare `asarray` name
+# covers `from numpy import asarray` aliasing; int()/float() on
+# non-literal args are flagged separately (a per-chunk int(state.sweeps)
+# is the same blocking readback with different spelling).
+_GD014_CALLS = {"np.asarray", "numpy.asarray", "asarray",
+                "jax.device_get", "device_get"}
+_GD014_METHODS = {"item", "block_until_ready"}
 
 # the wall-clock calls GD011 watches (time.monotonic is exempt: it is the
 # bookkeeping clock for queue waits and deadlines, not a timing idiom);
@@ -354,6 +379,9 @@ class _FileLinter:
         # gathering the full node axis silently reverts the halo exchange's
         # boundary-words-only contract
         self.parallel_mod = "/parallel/" in norm
+        # GD014 scope: the search drivers — where a per-chunk host
+        # materialization would serialize the ladder/sweep loop
+        self.search_mod = "/search/" in norm
 
     def emit(self, node: ast.AST, code: str, message: str) -> None:
         self.findings.append(
@@ -433,6 +461,7 @@ class _FileLinter:
         self._check_bare_timing(tree)
         self._check_bare_profiler(tree)
         self._check_shardmap_full_gather(tree)
+        self._check_search_loop_sync(tree, seen)
         self.findings.sort(key=lambda f: (f.line, f.col, f.code))
         return self.findings
 
@@ -868,6 +897,52 @@ class _FileLinter:
                             f"local block + halo ghost rows instead "
                             f"(graphdyn.parallel.halo)",
                         )
+
+    def _check_search_loop_sync(self, tree: ast.Module, jit_seen: set):
+        """GD014: device→host materialization inside a host ``for``/
+        ``while`` loop of a ``graphdyn/search/`` module — the swap/sweep
+        drive loop must stay one device program per chunk, with results
+        read back once after the loop.  ``jit_seen`` holds nodes already
+        visited inside jit contexts (loops there unroll at trace time)."""
+        if not self.search_mod:
+            return
+        flagged: set[int] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.For, ast.While)) \
+                    or id(node) in jit_seen:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call) or id(sub) in flagged:
+                    continue
+                d = _dotted(sub.func)
+                is_method = (
+                    isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _GD014_METHODS
+                )
+                # int()/float() of a non-literal in the drive loop is the
+                # same blocking readback with different spelling (e.g. a
+                # per-chunk int(state.sweeps) budget check — plan the
+                # chunk sizes host-side instead)
+                is_coerce = (
+                    isinstance(sub.func, ast.Name)
+                    and sub.func.id in ("int", "float")
+                    and sub.args
+                    and not isinstance(sub.args[0], ast.Constant)
+                )
+                if d in _GD014_CALLS or is_method or is_coerce:
+                    what = d or (sub.func.attr if isinstance(
+                        sub.func, ast.Attribute) else sub.func.id)
+                    flagged.add(id(sub))
+                    self.emit(
+                        sub, "GD014",
+                        f"{what}(...) inside a search drive loop "
+                        f"materializes device values every chunk — the "
+                        f"ladder/sweep must stay one device program per "
+                        f"chunk (the sanctioned per-chunk sync is the "
+                        f"bool(jnp.any(...)) stop test); read results "
+                        f"back once after the loop, and derive chunk "
+                        f"budgets host-side",
+                    )
 
     def _check_vmap_pallas(self, tree: ast.Module):
         """GD009: ``jax.vmap`` over a ``pallas_call``-backed callable.

@@ -62,6 +62,27 @@ def _add_resilience_flags(ap: argparse.ArgumentParser) -> None:
     )
 
 
+def _add_sa_schedule_flags(ap: argparse.ArgumentParser) -> None:
+    """The reference SA annealing schedule (`SA_RRG.py:44-52`) — one
+    definition shared by the serial search (``sa``), the tempering ladder
+    (``temper``) and the chromatic sweeps (``chromatic``)."""
+    ap.add_argument("--a0-frac", type=float, default=0.015)
+    ap.add_argument("--b0-frac", type=float, default=0.010)
+    ap.add_argument("--par-a", type=float, default=1.0005)
+    ap.add_argument("--par-b", type=float, default=1.0005)
+    ap.add_argument("--a-cap-frac", type=float, default=4.5)
+    ap.add_argument("--b-cap-frac", type=float, default=5.0)
+
+
+def _sa_config(args) -> SAConfig:
+    return SAConfig(
+        dynamics=_dynamics(args),
+        a0_frac=args.a0_frac, b0_frac=args.b0_frac,
+        par_a=args.par_a, par_b=args.par_b,
+        a_cap_frac=args.a_cap_frac, b_cap_frac=args.b_cap_frac,
+    )
+
+
 def _add_pipeline_flags(ap: argparse.ArgumentParser) -> None:
     """The shared ensemble-pipeline knobs (ARCHITECTURE.md "Ensemble
     pipeline")."""
@@ -111,7 +132,14 @@ def build_parser() -> argparse.ArgumentParser:
                "or a wedged run the watchdog gave up on; 86 a supervised "
                "run quarantined after a crash loop (run-supervised; do NOT "
                "requeue); anything else is a real failure. See "
-               "ARCHITECTURE.md 'Resilience' + 'Supervised execution'.",
+               "ARCHITECTURE.md 'Resilience' + 'Supervised execution'. "
+               "Search modes: `sa` is the reference serial chain, `temper` "
+               "runs a replica-exchange ladder on the batched replica axis "
+               "(lane-shardable, swap moves at chunk boundaries), "
+               "`chromatic` updates a whole color class per device step — "
+               "which modes compose with node sharding and lightcone is "
+               "the mode-selection table in ARCHITECTURE.md 'Node-axis "
+               "sharding & halo exchange' / 'Search acceleration'.",
     )
     ap.add_argument(
         "--ckpt-mirror", default=None, metavar="DIR",
@@ -179,12 +207,7 @@ def build_parser() -> argparse.ArgumentParser:
     sa.add_argument("--n", type=int, default=10_000)
     sa.add_argument("--d", type=int, default=4)
     _add_dynamics_flags(sa, p_default=3)
-    sa.add_argument("--a0-frac", type=float, default=0.015)
-    sa.add_argument("--b0-frac", type=float, default=0.010)
-    sa.add_argument("--par-a", type=float, default=1.0005)
-    sa.add_argument("--par-b", type=float, default=1.0005)
-    sa.add_argument("--a-cap-frac", type=float, default=4.5)
-    sa.add_argument("--b-cap-frac", type=float, default=5.0)
+    _add_sa_schedule_flags(sa)
     sa.add_argument("--n-stat", type=int, default=5)
     sa.add_argument("--max-steps", type=int, default=None)
     sa.add_argument("--seed", type=int, default=0)
@@ -203,7 +226,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--rollout-mode", choices=["full", "lightcone"], default="full",
         help="candidate evaluation: full graph re-roll (reference cost "
              "structure) or O(ball) light-cone roll vs a cached trajectory "
-             "(bit-identical chains)",
+             "(bit-identical chains). lightcone keeps whole replicas — and "
+             "tempering lanes, which ride the same replica axis — per "
+             "device, so it excludes --shards node partitioning; see the "
+             "mode-selection table in ARCHITECTURE.md 'Node-axis sharding "
+             "& halo exchange' and 'Search acceleration' for which "
+             "search/sharding modes compose",
     )
     sa.add_argument(
         "--sharded", action="store_true",
@@ -236,8 +264,98 @@ def build_parser() -> argparse.ArgumentParser:
     sa.add_argument(
         "--ladder-max-frac", type=float, default=None,
         help="enable a temperature ladder on the replica axis: per-replica "
-             "a0 = linspace(a0-frac, this, n-replicas) * n",
+             "a0 = linspace(a0-frac, this, n-replicas) * n (no swap moves "
+             "— for replica exchange use `graphdyn temper`)",
     )
+
+    tmp = sub.add_parser(
+        "temper",
+        help="replica-exchange (parallel tempering) SA search: K lanes on "
+             "the batched replica axis anneal in lockstep with seeded "
+             "even/odd swap moves at chunk boundaries "
+             "(graphdyn.search.tempering; ARCHITECTURE.md 'Search "
+             "acceleration')",
+    )
+    tmp.add_argument("--n", type=int, default=10_000)
+    tmp.add_argument("--d", type=int, default=3)
+    _add_dynamics_flags(tmp, p_default=1)
+    _add_sa_schedule_flags(tmp)
+    tmp.add_argument(
+        "--lanes", type=int, default=8,
+        help="temperature-ladder lanes K (one batched device program)",
+    )
+    tmp.add_argument(
+        "--beta-min", type=float, default=1.0,
+        help="drive ladder lower rung: lane k scales (b0, b-cap) by "
+             "beta_k in geomspace(beta-min, beta-max, lanes); beta=1 is "
+             "the reference chain",
+    )
+    tmp.add_argument("--beta-max", type=float, default=64.0)
+    tmp.add_argument(
+        "--swap-interval", type=int, default=1000, metavar="K",
+        help="MCMC steps between swap moves — also the chunk/snapshot/"
+             "heartbeat granularity; part of the chain law (rides in the "
+             "checkpoint fingerprint)",
+    )
+    tmp.add_argument(
+        "--no-swaps", action="store_true",
+        help="disable swap moves (a plain batched ladder — bit-identical "
+             "to `sa`'s replica ladder at the same a0/b0)",
+    )
+    tmp.add_argument(
+        "--m-target", type=float, default=1.0,
+        help="first-passage record: the step a lane's rolled-out end-state "
+             "magnetization first reaches this (1.0 = consensus)",
+    )
+    tmp.add_argument(
+        "--stop-on-first", action="store_true",
+        help="stop the whole ladder at the first lane reaching --m-target "
+             "(the time-to-target mode the tta_tempering bench row uses)",
+    )
+    tmp.add_argument("--max-steps", type=int, default=None)
+    tmp.add_argument("--seed", type=int, default=0)
+    tmp.add_argument(
+        "--lane-shards", type=int, default=None, metavar="P",
+        help="shard the K lanes over P devices (lane axis via shard_stack; "
+             "bit-identical to unsharded). Snapshots are GLOBAL, so a "
+             "preempted ladder may requeue under a different P after a "
+             "device loss",
+    )
+    tmp.add_argument(
+        "--checkpoint", default=None,
+        help="path prefix for chunk-granular durable snapshots (swap "
+             "boundaries; PR-9 store + run journal); SIGTERM checkpoints "
+             "at the next boundary and exits 75 (EX_TEMPFAIL)",
+    )
+    tmp.add_argument("--checkpoint-interval", type=float, default=30.0)
+    _add_resilience_flags(tmp)
+    tmp.add_argument("--out", default=None, help="npz path (per-lane arrays)")
+
+    chrom = sub.add_parser(
+        "chromatic",
+        help="chromatic block-sweep annealing: a distance-2 coloring "
+             "partitions the graph into chi classes and each device step "
+             "proposes/accepts a whole independent set — O(chi) device "
+             "steps per sweep instead of n (graphdyn.search.chromatic; "
+             "p=c=1 only)",
+    )
+    chrom.add_argument("--n", type=int, default=10_000)
+    chrom.add_argument("--d", type=int, default=3)
+    _add_dynamics_flags(chrom, p_default=1)
+    _add_sa_schedule_flags(chrom)
+    chrom.add_argument("--replicas", type=int, default=32,
+                       help="independent packed chains (32 per uint32 word)")
+    chrom.add_argument("--m-target", type=float, default=0.9)
+    chrom.add_argument("--max-sweeps", type=int, default=5000)
+    chrom.add_argument(
+        "--chunk-sweeps", type=int, default=64, metavar="S",
+        help="full sweeps per device call (the freeze/stop-poll and "
+             "heartbeat granularity)",
+    )
+    chrom.add_argument("--stop-on-first", action="store_true")
+    chrom.add_argument("--seed", type=int, default=0)
+    chrom.add_argument("--out", default=None,
+                       help="npz path (per-replica arrays)")
 
     hpr = sub.add_parser("hpr", help="HPr reinforced BP (`HPR_pytorch_RRG.py`)")
     hpr.add_argument("--n", type=int, default=10_000)
@@ -542,12 +660,7 @@ def main(argv=None) -> int:
 
 def _run(args) -> int:
     if args.cmd == "sa":
-        cfg = SAConfig(
-            dynamics=_dynamics(args),
-            a0_frac=args.a0_frac, b0_frac=args.b0_frac,
-            par_a=args.par_a, par_b=args.par_b,
-            a_cap_frac=args.a_cap_frac, b_cap_frac=args.b_cap_frac,
-        )
+        cfg = _sa_config(args)
         if args.shards is not None and not args.sharded:
             # a silently ignored sharding request would run the serial
             # driver while the operator believes the pod job sharded
@@ -569,9 +682,12 @@ def _run(args) -> int:
             if args.shards is not None:
                 if args.rollout_mode == "lightcone":
                     raise SystemExit(
-                        "--shards partitions the node axis; "
-                        "--rollout-mode lightcone keeps whole replicas per "
-                        "device and has none"
+                        "--shards partitions the node axis; --rollout-mode "
+                        "lightcone keeps whole replicas — and tempering "
+                        "lanes, which ride the same replica axis — per "
+                        "device, so there is no node axis to shard (mode-"
+                        "selection table: ARCHITECTURE.md 'Node-axis "
+                        "sharding & halo exchange' / 'Search acceleration')"
                     )
                 if args.shards < 1:
                     raise SystemExit("--shards must be >= 1")
@@ -638,6 +754,89 @@ def _run(args) -> int:
             "mag_reached": out.mag_reached.tolist(),
             "num_steps": out.num_steps.tolist(),
             "m_final": out.m_final.tolist(),
+            "out": args.out,
+        }))
+    elif args.cmd == "temper":
+        from graphdyn.search.tempering import ladder_betas, temper_search
+        from graphdyn.utils.io import save_results_npz
+
+        cfg = _sa_config(args)
+        mesh = None
+        if args.lane_shards is not None:
+            if args.lane_shards < 1:
+                raise SystemExit("--lane-shards must be >= 1")
+            if args.lanes % args.lane_shards:
+                raise SystemExit(
+                    f"--lane-shards {args.lane_shards} must divide "
+                    f"--lanes {args.lanes}"
+                )
+            from graphdyn.parallel.mesh import device_pool, make_mesh
+
+            mesh = make_mesh(
+                (args.lane_shards,), ("lane",),
+                devices=device_pool(args.lane_shards),
+            )
+        from graphdyn.graphs import random_regular_graph
+
+        g = random_regular_graph(args.n, args.d, seed=args.seed)
+        res = temper_search(
+            g, cfg,
+            betas=ladder_betas(args.lanes, args.beta_min, args.beta_max),
+            seed=args.seed, max_steps=args.max_steps,
+            swap_interval=args.swap_interval,
+            swap_moves=not args.no_swaps,
+            m_target=args.m_target, stop_on_first=args.stop_on_first,
+            checkpoint_path=args.checkpoint,
+            checkpoint_interval_s=args.checkpoint_interval,
+            mesh=mesh,
+        )
+        if args.out:
+            save_results_npz(
+                args.out, conf=res.s, mag_reached=res.mag_reached,
+                num_steps=res.num_steps, m_final=res.m_final,
+                t_target=res.t_target, betas=res.betas,
+            )
+        print(json.dumps({
+            "solver": "temper",
+            "lanes": int(res.betas.size),
+            "lane_shards": args.lane_shards,
+            "betas": res.betas.tolist(),
+            "num_steps": res.num_steps.tolist(),
+            "m_final": res.m_final.tolist(),
+            "t_target": res.t_target.tolist(),
+            "steps_to_target": res.steps_to_target,
+            "target_lane": res.target_lane,
+            "swap_attempts": res.swap_attempts,
+            "swap_accepts": res.swap_accepts,
+            "swap_acceptance_rate": res.swap_acceptance_rate,
+            "out": args.out,
+        }))
+    elif args.cmd == "chromatic":
+        from graphdyn.graphs import random_regular_graph
+        from graphdyn.search.chromatic import chromatic_anneal
+        from graphdyn.utils.io import save_results_npz
+
+        g = random_regular_graph(args.n, args.d, seed=args.seed)
+        res = chromatic_anneal(
+            g, _sa_config(args), n_replicas=args.replicas, seed=args.seed,
+            m_target=args.m_target, max_sweeps=args.max_sweeps,
+            chunk_sweeps=args.chunk_sweeps,
+            stop_on_first=args.stop_on_first,
+        )
+        if args.out:
+            save_results_npz(
+                args.out, conf=res.s, mag_reached=res.mag_reached,
+                m_end=res.m_end, steps_to_target=res.steps_to_target,
+            )
+        print(json.dumps({
+            "solver": "chromatic",
+            "chi": res.chi,
+            "sweeps": res.sweeps,
+            "device_steps": res.device_steps,
+            "accepted": res.accepted,
+            "m_end": res.m_end.tolist(),
+            "steps_to_target": res.steps_to_target.tolist(),
+            "sweeps_to_target": res.sweeps_to_target.tolist(),
             "out": args.out,
         }))
     elif args.cmd == "hpr":

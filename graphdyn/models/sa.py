@@ -354,8 +354,15 @@ def simulated_annealing(
       same graph.
 
     ``a0``/``b0`` may be per-replica arrays — that is the temperature-ladder
-    axis of BASELINE.json config 5. ``proposals``/``uniforms`` (``[R, L]``)
-    switch to injected-stream mode for parity testing. ``backend='cpu'`` runs
+    axis of BASELINE.json config 5. The replica-exchange upgrade of that
+    axis (seeded swap moves between rungs at chunk boundaries, an
+    order-of-magnitude fewer device steps to target — measured) is
+    :func:`graphdyn.search.temper_search`, whose swap-free mode is
+    bit-exact to this solver on the same ``a0``/``b0`` (tested); the
+    whole-independent-set alternative at p=c=1 is
+    :func:`graphdyn.search.chromatic_anneal` (ARCHITECTURE.md "Search
+    acceleration"). ``proposals``/``uniforms`` (``[R, L]``) switch to
+    injected-stream mode for parity testing. ``backend='cpu'`` runs
     the numpy oracle.
 
     ``checkpoint_path`` enables **exact chain resume** (SURVEY.md §5.4: the

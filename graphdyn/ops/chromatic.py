@@ -1,0 +1,340 @@
+"""Chromatic block Metropolis — a whole independent set per device step.
+
+The reference SA chain (`SA_RRG.py:58-88`) proposes ONE site per MCMC step;
+even the light-cone path (PR `ops/lightcone`) evaluates one radius-(p+c−1)
+ball per device step. The massively parallel sparse Ising machines
+(PAPERS.md arXiv:2110.02481) instead update an entire independent set per
+tick. This module is that idea for the SA search objective
+``E(s) = (a·Σs(0) − b·Σs_end)/n`` at ``p = c = 1`` (one-step rollout):
+
+- A **distance-2 coloring** (:func:`graphdyn.graphs.greedy_coloring` over
+  :func:`graphdyn.graphs.power_graph`\\ ``(g, 2)``) puts same-color sites at
+  pairwise distance ≥ 3, so their radius-1 update balls are disjoint: per-
+  site ΔE of a single flip stays EXACT when the whole class flips together,
+  and the per-site Metropolis accepts are a product of independent kernels
+  on non-interacting coordinates — detailed balance per class, a valid
+  chain per sweep (the standard chromatic Gibbs decomposition).
+- One device step proposes and accepts **every site of one color class at
+  once** via the packed popcount helpers (:mod:`graphdyn.ops.packed`:
+  carry-save bit-plane counters + the word comparator): ΔΣs_end of site
+  ``i`` is read off two packed one-step evaluations — ``end(s)`` and
+  ``end(s ⊕ class)`` — because each node ``j`` has at most ONE class member
+  in ``N(j) ∪ {j}``, so the all-class flip restricted to ``ball(i)`` IS the
+  single flip of ``i``. Disjoint balls also make the per-replica
+  ``Σs_end`` update additive, so the target-magnetization test costs one
+  masked reduction, not a re-evaluation.
+- A full sweep is **O(χ) device steps** instead of n: greedy coloring of
+  ``G²`` gives χ ≤ dmax²+1 (measured χ(G²)=7–11 on the d=3 RRG), replacing
+  one-light-cone-per-step serialism with ~n/χ proposals per device step.
+
+Annealing follows the reference schedule per proposal-equivalent: one class
+step of ``|class c|`` proposals multiplies ``a``/``b`` by ``par^|c|`` (cap
+checked once per class step, before the multiply, mirroring
+`SA_RRG.py:80-81` at class granularity). The chromatic chain is a different
+(parallel) Markov chain from the serial reference — sweeps are
+seed-deterministic and bit-reproducible, but not bit-equal to the serial
+walk; the A/B contract is the ``tta_*`` bench rows, not bit parity.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from graphdyn.ops.dynamics import Rule, TieBreak
+from graphdyn.ops.packed import (
+    WORD,
+    _FULL,
+    _compare_planes,
+    _csa_add_one,
+    _rule_tie_combine,
+)
+
+
+class ChromaticTables(NamedTuple):
+    """Host-side setup for the chromatic kernel (numpy arrays).
+
+    Attributes:
+      colors:      int32[n] distance-2 color per node (proper on ``G²``).
+      masks:       uint32[χ, n] word masks — all-ones where ``colors == c``.
+      class_sizes: int64[χ] proposals per class step (the anneal exponents).
+      nbr_self:    int32[n+1, dmax+1] ghost-extended ``{i} ∪ N(i)`` gather
+                   table (slot 0 = self), ghost row all-ghost.
+      nbr_ext:     int32[n+1, dmax] ghost-extended neighbor table.
+      deg_ext:     int32[n+1] degrees with the 0-degree ghost row.
+    """
+
+    colors: np.ndarray
+    masks: np.ndarray
+    class_sizes: np.ndarray
+    nbr_self: np.ndarray
+    nbr_ext: np.ndarray
+    deg_ext: np.ndarray
+
+    @property
+    def chi(self) -> int:
+        return self.masks.shape[0]
+
+    @property
+    def n(self) -> int:
+        return self.masks.shape[1]
+
+    @property
+    def dmax(self) -> int:
+        return self.nbr_ext.shape[1]
+
+
+def build_chromatic_tables(graph, *, seed: int = 0) -> ChromaticTables:
+    """Distance-2 coloring + gather tables for ``graph`` (deterministic per
+    ``seed``). Refuses an invalid coloring loudly: a monochromatic ``G²``
+    edge would make the whole-class update silently wrong."""
+    from graphdyn.graphs import (
+        greedy_coloring, power_graph, validate_coloring,
+    )
+
+    n = graph.n
+    g2 = power_graph(graph, 2)
+    colors = greedy_coloring(g2, seed=seed)
+    problems = validate_coloring(g2, colors)
+    if problems:
+        raise ValueError(
+            f"distance-2 coloring invalid for the chromatic kernel: "
+            f"{problems} (greedy_coloring(power_graph(g, 2)) is the "
+            f"supported construction)"
+        )
+    chi = int(colors.max(initial=-1)) + 1
+    masks = np.zeros((chi, n), np.uint32)
+    for c in range(chi):
+        masks[c, colors == c] = np.uint32(0xFFFFFFFF)
+    class_sizes = np.bincount(colors, minlength=chi).astype(np.int64)
+    nbr_ext = np.concatenate(
+        [graph.nbr.astype(np.int64),
+         np.full((1, graph.dmax), n, np.int64)], axis=0,
+    )
+    self_col = np.concatenate([np.arange(n, dtype=np.int64), [n]])[:, None]
+    nbr_self = np.concatenate([self_col, nbr_ext], axis=1)
+    deg_ext = np.concatenate([graph.deg.astype(np.int64), [0]])
+    return ChromaticTables(
+        colors=colors.astype(np.int32),
+        masks=masks,
+        class_sizes=class_sizes,
+        nbr_self=nbr_self.astype(np.int32),
+        nbr_ext=nbr_ext.astype(np.int32),
+        deg_ext=deg_ext.astype(np.int32),
+    )
+
+
+def _threshold_words(deg_ext, n_planes: int):
+    """Per-node comparator constants of the packed update (the same
+    derivation as ``ops.packed._packed_rollout_device``): threshold
+    bit-plane masks + the even-degree tie mask."""
+    thr = (deg_ext // 2).astype(jnp.uint32)
+    even_mask = jnp.where(deg_ext % 2 == 0, _FULL, jnp.uint32(0))[:, None]
+    thr_bits = [
+        jnp.where((thr >> k) & 1 == 1, _FULL, jnp.uint32(0))[:, None]
+        for k in range(n_planes)
+    ]
+    return thr_bits, even_mask
+
+
+def _one_step(sp_ext, nbr_ext, thr_bits, even_mask, n: int, dmax: int,
+              rule: Rule, tie: TieBreak):
+    """One synchronous packed update on the ghost-extended state — the
+    ``end(s)`` evaluation (p=c=1 rollout) built from the shared carry-save
+    + comparator helpers; the ghost word is forced back to zero."""
+    n_planes = len(thr_bits)
+    planes = [jnp.zeros_like(sp_ext) for _ in range(n_planes)]
+    for j in range(dmax):
+        _csa_add_one(planes, jnp.take(sp_ext, nbr_ext[:, j], axis=0))
+    gt, eq = _compare_planes(planes, thr_bits)
+    out = _rule_tie_combine(gt, eq & even_mask, sp_ext, rule, tie)
+    return out.at[n].set(jnp.uint32(0))
+
+
+def _ball_counts(bits_ext, nbr_self):
+    """Per-(node, replica) popcount of ``bits`` over ``{i} ∪ N(i)``:
+    carry-save planes over the self+neighbor gather, expanded to int32
+    ``[n+1, W·32]`` replica counts (counts ≤ dmax+1)."""
+    slots = nbr_self.shape[1]
+    n_planes = max(int(slots).bit_length(), 1)
+    planes = [jnp.zeros_like(bits_ext) for _ in range(n_planes)]
+    for j in range(slots):
+        _csa_add_one(planes, jnp.take(bits_ext, nbr_self[:, j], axis=0))
+    shifts = jnp.arange(WORD, dtype=jnp.uint32)
+    rows = bits_ext.shape[0]
+    tot = jnp.zeros((rows, bits_ext.shape[1] * WORD), jnp.int32)
+    for k, pl in enumerate(planes):
+        b = ((pl[:, :, None] >> shifts) & jnp.uint32(1)).astype(jnp.int32)
+        tot = tot + (b.reshape(rows, -1) << k)
+    return tot
+
+
+def _unpack_pm1(sp):
+    """uint32[n, W] -> int32[n, W·32] spins (±1) per replica column."""
+    shifts = jnp.arange(WORD, dtype=jnp.uint32)
+    bits = ((sp[:, :, None] >> shifts) & jnp.uint32(1)).astype(jnp.int32)
+    return 2 * bits.reshape(sp.shape[0], -1) - 1
+
+
+def _pack_bool(acc, W: int):
+    """bool[n, W·32] -> uint32[n, W] (bit r%32 of word r//32)."""
+    shifts = jnp.arange(WORD, dtype=jnp.uint32)
+    b = acc.reshape(acc.shape[0], W, WORD).astype(jnp.uint32) << shifts
+    return b.sum(axis=2).astype(jnp.uint32)
+
+
+def class_update(sp_ext, u, mask_row, anneal_pow, a, b, active,
+                 nbr_ext, nbr_self, thr_bits, even_mask, *,
+                 n: int, dmax: int, rule: Rule, tie: TieBreak,
+                 par_a: float, par_b: float, a_cap: float, b_cap: float):
+    """One chromatic class step: propose flipping EVERY site of the class,
+    accept per site with the exact single-flip ΔE, then anneal by the
+    class's proposal count. Pure function of its inputs (the jitted sweep
+    scans it; the oracle test calls it directly with injected ``u``).
+
+    Returns ``(sp_ext_new, dsend_tot, a_new, b_new, n_accepted)`` where
+    ``dsend_tot[r]`` is the exact per-replica change of ``Σs_end`` (the
+    disjoint-ball additivity the distance-2 coloring guarantees).
+    """
+    dt = a.dtype
+    end = _one_step(sp_ext, nbr_ext, thr_bits, even_mask, n, dmax, rule, tie)
+    flip_all = jnp.concatenate([mask_row, jnp.zeros((1,), jnp.uint32)])
+    end_all = _one_step(sp_ext ^ flip_all[:, None], nbr_ext, thr_bits,
+                        even_mask, n, dmax, rule, tie)
+    up = end_all & ~end                    # j: end −1 → +1 under the flip
+    dn = end & ~end_all
+    dsend = 2 * (_ball_counts(up, nbr_self)[:n]
+                 - _ball_counts(dn, nbr_self)[:n])      # int32 [n, Rp]
+    s_pm = _unpack_pm1(sp_ext[:n])                       # int32 [n, Rp]
+    delta_e = (
+        -2.0 * a[None, :] * s_pm.astype(dt)
+        - b[None, :] * dsend.astype(dt)
+    ) / n
+    in_class = (mask_row != 0)[:, None]
+    acc = (u < jnp.exp(-delta_e)) & in_class & active[None, :]
+    W = sp_ext.shape[1]
+    flips = _pack_bool(acc, W)
+    sp_new = sp_ext.at[:n].set(sp_ext[:n] ^ flips)
+    dsend_tot = jnp.sum(dsend * acc.astype(jnp.int32), axis=0)
+    # per-proposal-equivalent anneal at class granularity (cap checked
+    # before the multiply, as the reference does per step)
+    fac_a = jnp.asarray(par_a, dt) ** anneal_pow.astype(dt)
+    fac_b = jnp.asarray(par_b, dt) ** anneal_pow.astype(dt)
+    a_new = jnp.where(active & (a < a_cap), a * fac_a, a)
+    b_new = jnp.where(active & (b < b_cap), b * fac_b, b)
+    n_acc = jnp.sum(acc.astype(jnp.int32))
+    return sp_new, dsend_tot, a_new, b_new, n_acc
+
+
+class ChromState(NamedTuple):
+    """Device carry of the chromatic annealer (replica axis padded to
+    ``W·32``; pad replicas are frozen by ``active``)."""
+
+    sp: jnp.ndarray         # uint32[n, W]
+    sum_end: jnp.ndarray    # int32[Rp] — Σ s_end per replica (additive)
+    a: jnp.ndarray          # f32[Rp]
+    b: jnp.ndarray          # f32[Rp]
+    steps: jnp.ndarray      # int32[] — class (device) steps taken
+    sweeps: jnp.ndarray     # int32[] — full sweeps taken
+    t_target: jnp.ndarray   # int32[Rp] — first-passage class step, −1
+    active: jnp.ndarray     # bool[Rp]
+    accepted: jnp.ndarray   # int32[] — cumulative accepted flips
+    chunk_s: jnp.ndarray    # int32[] — sweeps advanced this chunk
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n", "dmax", "rule", "tie", "par_a", "par_b",
+                     "a_cap", "b_cap", "target_sum", "chunk_sweeps",
+                     "stop_on_first"),
+    donate_argnames=("state",),
+)
+def chromatic_chunk(
+    state: ChromState,
+    key,
+    masks,          # uint32[χ, n]
+    class_sizes,    # int32[χ]
+    nbr_ext, nbr_self, deg_ext,
+    *,
+    n: int, dmax: int, rule: str, tie: str,
+    par_a: float, par_b: float, a_cap: float, b_cap: float,
+    target_sum: int, chunk_sweeps: int, stop_on_first: bool = False,
+):
+    """Advance up to ``chunk_sweeps`` full sweeps (each = one scanned pass
+    over the χ color classes) in ONE device program: uniforms derive from
+    ``fold_in(key, global class-step index)`` so sweeps are bit-reproducible
+    per seed and resume-invariant across chunk boundaries. A replica whose
+    ``Σs_end`` reaches ``target_sum`` records its first-passage step and
+    freezes; with ``stop_on_first`` the chunk exits once any replica has."""
+    rule_e, tie_e = Rule(rule), TieBreak(tie)
+    n_planes = max(int(dmax).bit_length(), 1)
+    thr_bits, even_mask = _threshold_words(deg_ext, n_planes)
+
+    def class_body(carry, xs):
+        sp, sum_end, a, b, steps, t_tgt, active, accepted = carry
+        mask_row, n_c = xs
+        # one uniform block per 32-replica WORD, keyed (step, word): replica
+        # r's proposal stream depends only on its word index, so growing
+        # the replica set (more words) leaves existing replicas' sweeps
+        # bit-identical — reproducibility across replica counts at word
+        # granularity (tested)
+        step_key = jax.random.fold_in(key, steps.astype(jnp.uint32))
+        u = jnp.concatenate(
+            [jax.random.uniform(jax.random.fold_in(step_key, jnp.uint32(w)),
+                                (n, WORD), a.dtype)
+             for w in range(sp.shape[1])], axis=1,
+        )
+        sp_ext = jnp.concatenate(
+            [sp, jnp.zeros((1, sp.shape[1]), sp.dtype)], axis=0
+        )
+        sp_ext, dsend_tot, a, b, n_acc = class_update(
+            sp_ext, u, mask_row, n_c, a, b, active,
+            nbr_ext, nbr_self, thr_bits, even_mask,
+            n=n, dmax=dmax, rule=rule_e, tie=tie_e,
+            par_a=par_a, par_b=par_b, a_cap=a_cap, b_cap=b_cap,
+        )
+        sum_end = sum_end + dsend_tot
+        steps = steps + 1
+        hit = active & (sum_end >= target_sum)
+        t_tgt = jnp.where(hit, steps, t_tgt)
+        active = active & ~hit
+        return (sp_ext[:n], sum_end, a, b, steps, t_tgt, active,
+                accepted + n_acc), None
+
+    def sweep_body(st: ChromState):
+        carry = (st.sp, st.sum_end, st.a, st.b, st.steps, st.t_target,
+                 st.active, st.accepted)
+        carry, _ = lax.scan(class_body, carry, (masks, class_sizes))
+        sp, sum_end, a, b, steps, t_tgt, active, accepted = carry
+        return ChromState(sp, sum_end, a, b, steps, st.sweeps + 1, t_tgt,
+                          active, accepted, st.chunk_s + 1)
+
+    def cond(st: ChromState):
+        go = jnp.any(st.active) & (st.chunk_s < chunk_sweeps)
+        if stop_on_first:
+            go = go & ~jnp.any(st.t_target >= 0)
+        return go
+
+    return lax.while_loop(cond, sweep_body, state)
+
+
+def replica_end_sums(sp, nbr_ext, deg_ext, n: int, dmax: int,
+                     rule: str, tie: str):
+    """int32 per-replica ``Σ s_end`` of the packed state (one synchronous
+    step, then a column popcount) — the ``sum_end`` initializer."""
+    n_planes = max(int(dmax).bit_length(), 1)
+    thr_bits, even_mask = _threshold_words(jnp.asarray(deg_ext), n_planes)
+    sp_ext = jnp.concatenate(
+        [jnp.asarray(sp), jnp.zeros((1, np.shape(sp)[1]), jnp.uint32)],
+        axis=0,
+    )
+    end = _one_step(sp_ext, jnp.asarray(nbr_ext), thr_bits, even_mask,
+                    n, dmax, Rule(rule), TieBreak(tie))[:n]
+    bits = _unpack_pm1(end)          # ±1 per (node, replica)
+    return jnp.sum(bits, axis=0).astype(jnp.int32)

@@ -182,10 +182,19 @@ def _plan_episodes(name: str, rng: np.random.Generator) -> list[Episode]:
         ]
         return eps + [Episode(specs=[], expect=EX_OK)]
     if name == "deadline_preempt":
-        # no injected fault at all: the preemption is the --deadline timer
-        # taking the SIGTERM path mid-run; the requeue runs without it
+        # the preemption is the --deadline timer taking the SIGTERM path
+        # mid-run; the requeue runs without it. A side-effect-only `stall`
+        # at the first lambda boundary (this is the ENTROPY workload) pins
+        # the run PAST the deadline: the bounded workload warmed by an
+        # in-suite run can finish in under 0.1 s wall on a fast container,
+        # and a run that beats the timer exercises nothing (observed — the
+        # scenario went red exactly that way). The stall only holds the
+        # run alive while the timer fires; the preemption path under test
+        # is untouched.
         return [
-            Episode(specs=[], extra_args=("--deadline", "0.1")),
+            Episode(specs=[{"site": "lambda.boundary", "action": "stall",
+                            "secs": 0.3, "at": 1}],
+                    extra_args=("--deadline", "0.1")),
             Episode(specs=[], expect=EX_OK),
         ]
     raise ValueError(f"unknown scenario {name!r}")

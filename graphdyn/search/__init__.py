@@ -1,0 +1,20 @@
+"""graphdyn.search — faster SA search: replica-exchange tempering ladders
+and chromatic block sweeps (ROADMAP item 3; ARCHITECTURE.md "Search
+acceleration")."""
+
+from graphdyn.search.chromatic import ChromaticResult, chromatic_anneal
+from graphdyn.search.tempering import (
+    TemperResult,
+    ladder_betas,
+    lower_temper_chunk,
+    temper_search,
+)
+
+__all__ = [
+    "ChromaticResult",
+    "TemperResult",
+    "chromatic_anneal",
+    "ladder_betas",
+    "lower_temper_chunk",
+    "temper_search",
+]

@@ -1,0 +1,482 @@
+"""Replica-exchange (parallel tempering) for the SA initialization search.
+
+The reference search (`SA_RRG.py:58-88`) is ONE Metropolis chain at one
+annealing schedule; PRs 1–12 made its rollout wider and cheaper but never
+the search itself faster. Optimized-SA practice for spin glasses (PAPERS.md
+arXiv:1401.1084) runs a **temperature ladder**: K chains at scaled
+Hamiltonians ``H_k = β_k·E`` anneal side by side and periodically attempt
+to exchange configurations between adjacent rungs, so cold (greedy) rungs
+inherit the hot rungs' barrier crossings instead of waiting out the anneal.
+
+Layout: the K lanes ride the SAME batched replica axis the λ-ladder and
+the grouped drivers use (``run_cell_ladder``/``GroupDriver`` are the
+template) — one jitted chunk program advances every active lane in
+lockstep (the per-lane draw/accept/anneal arithmetic is literally
+:func:`graphdyn.models.sa.draw_sa_proposal` +
+:func:`graphdyn.models.sa.metropolis_anneal_update`, so a lane's chain law
+is the serial solver's by construction), and the **swap move runs at each
+chunk boundary inside the same program**: seeded even/odd pairing
+(round parity alternates the pairing), acceptance
+``u < exp(−Δ)`` with ``Δ = [(a_i−a_j)(S0_j−S0_i) − (b_i−b_j)(Se_j−Se_i)]/n``
+(the exact cross-energy difference of the linear objective — no rollout
+re-evaluation), configurations (``s``, ``Σs_end``) migrate while the
+ladder's (``a``, ``b``, PRNG keys, step counters) stay with their lanes.
+Inactive lanes (success or timeout) never swap; per-lane freeze is the
+replica-batched solver's existing ``active`` mask.
+
+Durability: chunk boundaries are swap boundaries, and the chunk boundary
+is also the snapshot/heartbeat/shutdown-poll site
+(:class:`graphdyn.utils.io.ChainCheckpointer` — the PR-9 durable store +
+run journal underneath). Snapshots are GLOBAL (lane-layout-agnostic), so a
+preempted ladder resumes **bit-exact across lane-shard counts**: a K=8
+ladder sharded one-lane-per-device requeues onto 4 devices (two lanes per
+device) and finishes identical to the fault-free run — the same
+shard-loss requeue contract the halo snapshots carry for the node axis.
+Lane sharding composes through :func:`graphdyn.parallel.mesh.shard_stack`
+(the lane axis is the group axis); node-axis modes stay with
+``sa_sharded`` — a tempering ladder per node-sharded rollout is the
+composition ARCHITECTURE.md's mode table routes through the mesh solver's
+per-replica ``a0`` ladder.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from graphdyn.config import SAConfig
+from graphdyn.ops.dynamics import rule_coefficients
+
+
+class TemperResult(NamedTuple):
+    """Per-lane results + ladder statistics."""
+
+    s: np.ndarray                  # int8[K, n] configuration at stop
+    mag_reached: np.ndarray        # f32[K] m(s(0)) at stop
+    num_steps: np.ndarray          # int64[K] MCMC steps per lane
+    m_final: np.ndarray            # f32[K] (2.0 timeout sentinel)
+    t_target: np.ndarray           # int64[K] first-passage step, −1
+    betas: np.ndarray              # f64[K] the ladder
+    swap_attempts: int
+    swap_accepts: int
+    swap_acceptance_rate: float    # accepts/attempts (0.0 when 0 attempts)
+    steps_to_target: int           # min positive first passage, −1 if none
+    target_lane: int               # lane that got there first, −1 if none
+
+
+class _TemperState(NamedTuple):
+    s: jnp.ndarray          # int8[K, n]
+    sum_end: jnp.ndarray    # int32[K]
+    a: jnp.ndarray          # f[K]
+    b: jnp.ndarray          # f[K]
+    t: jnp.ndarray          # int[K]
+    m_final: jnp.ndarray    # f[K]
+    active: jnp.ndarray     # bool[K]
+    key: jnp.ndarray        # per-lane PRNG keys [K]
+    t_target: jnp.ndarray   # int[K] first step with Σs_end ≥ target, −1
+    chunk_t: jnp.ndarray    # int32[]
+    swap_round: jnp.ndarray  # int32[]
+    swap_att: jnp.ndarray   # int32[] cumulative attempted pair swaps
+    swap_acc: jnp.ndarray   # int32[] cumulative accepted pair swaps
+
+
+def ladder_betas(n_lanes: int, beta_min: float = 1.0,
+                 beta_max: float = 64.0) -> np.ndarray:
+    """The default geometric **drive ladder**, reference → greedy. Lane
+    ``k``'s Hamiltonian is ``H_k = (a·Σs(0) − β_k·b·Σs_end)/n``: β scales
+    the end-state drive ``b`` (initial value AND cap) while the
+    initialization penalty ``a`` keeps the reference schedule — scaling
+    both uniformly cancels in the acceptance and buys nothing (measured),
+    whereas the b/a ratio is the knob that moves time-to-target by an
+    order of magnitude (the schedule-shape lever of arXiv:1401.1084).
+    β = 1 is the reference chain — careful, finds low-m(0) inits slowly;
+    large β climbs ``Σs_end`` greedily and reaches the target fast; swaps
+    hand the greedy rungs' configurations down the ladder. ``n_lanes == 1``
+    returns the reference's β = 1."""
+    if n_lanes < 1:
+        raise ValueError(f"n_lanes must be >= 1, got {n_lanes}")
+    if n_lanes == 1:
+        return np.ones(1)
+    return np.geomspace(beta_min, beta_max, n_lanes)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("rollout_steps", "R_coef", "C_coef", "max_steps",
+                     "swap_interval", "swap_moves", "target_sum",
+                     "stop_on_first"),
+    donate_argnames=("state",),
+)
+def _temper_chunk(
+    nbr,
+    state: _TemperState,
+    par_a,
+    par_b,
+    a_caps,
+    b_caps,
+    swap_key,
+    *,
+    rollout_steps: int,
+    R_coef: int,
+    C_coef: int,
+    max_steps: int,
+    swap_interval: int,
+    swap_moves: bool = True,
+    target_sum: int,
+    stop_on_first: bool = False,
+):
+    """One ladder chunk as ONE device program: ≤ ``swap_interval``
+    Metropolis steps for every active lane (the serial chain body on the
+    lane axis — shared draw/accept/anneal functions, per-lane β-scaled
+    caps), then the seeded even/odd swap move. The carry is donated
+    (chunk-to-chunk in-place update; graftcheck pins the donation and the
+    single-while-loop structure as the ``tempering_ladder`` ledger row)."""
+    from graphdyn.models.sa import (
+        _batched_end_sum, draw_sa_proposal, metropolis_anneal_update,
+    )
+
+    K, n = state.s.shape
+    dt = state.a.dtype
+
+    def cond(st: _TemperState):
+        go = jnp.any(st.active) & (st.chunk_t < swap_interval)
+        if stop_on_first:
+            go = go & ~jnp.any(st.t_target >= 0)
+        return go
+
+    def body(st: _TemperState):
+        i, u = draw_sa_proposal(
+            st.key, st.t, None, None,
+            injected=False, stream_len=1, n=n, dt=dt,
+        )
+        kidx = jnp.arange(K)
+        s_i = st.s[kidx, i].astype(jnp.int32)
+        s_flip = st.s.at[kidx, i].set((-s_i).astype(jnp.int8))
+        sum_end_flip = _batched_end_sum(
+            nbr, s_flip, rollout_steps, R_coef, C_coef
+        )
+        do, sum_end_new, a_new, b_new, t_new, m_final, active = (
+            metropolis_anneal_update(
+                st.active, st.a, st.b, st.t, st.m_final,
+                st.sum_end, sum_end_flip, s_i, u,
+                par_a=par_a, par_b=par_b, a_cap=a_caps, b_cap=b_caps,
+                max_steps=max_steps, n=n,
+            )
+        )
+        s_new = jnp.where(do[:, None], s_flip, st.s)
+        hit = st.active & (st.t_target < 0) & (sum_end_new >= target_sum)
+        t_target = jnp.where(hit, t_new, st.t_target)
+        return st._replace(
+            s=s_new, sum_end=sum_end_new, a=a_new, b=b_new, t=t_new,
+            m_final=m_final, active=active, t_target=t_target,
+            chunk_t=st.chunk_t + 1,
+        )
+
+    st = lax.while_loop(cond, body, state)
+
+    if not swap_moves:
+        return st._replace(swap_round=st.swap_round + 1)
+
+    # -- the swap move: even/odd adjacent pairing, round parity alternates.
+    # Swaps happen ONLY at full chunks (chunk_t == swap_interval): a chunk
+    # that exited early — stop_on_first fired mid-chunk, or every lane
+    # stopped — is an end-of-run boundary, not a swap boundary, and a swap
+    # there would migrate the winning configuration away from target_lane
+    # after the fact AND break the "every swap_interval device steps"
+    # chain law the checkpoint fingerprint pins.
+    full_chunk = st.chunk_t == swap_interval
+    parity = st.swap_round % 2
+    idx = jnp.arange(K)
+    low = (idx - parity) % 2 == 0           # lower member of its pair
+    partner = jnp.where(low, idx + 1, idx - 1)
+    valid = (partner >= 0) & (partner < K)
+    pidx = jnp.clip(partner, 0, K - 1)
+    eligible = valid & st.active & st.active[pidx] & full_chunk
+    s0_sum = st.s.astype(jnp.int32).sum(axis=1)
+    # Δ = [ (a_i−a_j)(S0_j−S0_i) − (b_i−b_j)(Se_j−Se_i) ] / n — symmetric
+    # under i↔j, so both pair members compute the identical decision
+    delta = (
+        (st.a - st.a[pidx]) * (s0_sum[pidx] - s0_sum).astype(dt)
+        - (st.b - st.b[pidx]) * (st.sum_end[pidx] - st.sum_end).astype(dt)
+    ) / n
+    u = jax.random.uniform(
+        jax.random.fold_in(swap_key, st.swap_round.astype(jnp.uint32)),
+        (K,), dt,
+    )
+    u_pair = u[jnp.minimum(idx, pidx)]      # one draw per PAIR
+    accept = eligible & (u_pair < jnp.exp(-delta))
+    perm = jnp.where(accept, pidx, idx)
+    s_sw = st.s[perm]
+    sum_end_sw = st.sum_end[perm]
+    m_final = jnp.where(accept, sum_end_sw.astype(dt) / n, st.m_final)
+    hit = st.active & (st.t_target < 0) & (sum_end_sw >= target_sum)
+    t_target = jnp.where(hit, st.t, st.t_target)
+    n_eligible = eligible.astype(jnp.int32).sum() // 2
+    n_accept = accept.astype(jnp.int32).sum() // 2
+    return st._replace(
+        s=s_sw, sum_end=sum_end_sw, m_final=m_final, t_target=t_target,
+        swap_round=st.swap_round + 1,
+        swap_att=st.swap_att + n_eligible,
+        swap_acc=st.swap_acc + n_accept,
+    )
+
+
+def _assemble_ladder(graph, config: SAConfig, betas, seed: int,
+                     max_steps, dtype, mesh, lane_axis: str):
+    """Shared assembly of the ladder chunk program's inputs — ONE assembly
+    for :func:`temper_search` and :func:`lower_temper_chunk`, so the
+    graftcheck-fingerprinted program and the executed program cannot drift
+    (the sa_group `_assemble_group` precedent)."""
+    from graphdyn.models.sa import _sa_init, prepare_sa_inputs
+
+    n = graph.n
+    K = len(betas)
+    dyn = config.dynamics
+    R_coef, C_coef = rule_coefficients(dyn.rule, dyn.tie)
+    rollout = dyn.p + dyn.c - 1
+    np_dt = np.float32 if dtype == jnp.float32 else np.float64  # graftlint: disable=GD004  dtype mirror for host staging
+    # the DRIVE ladder (see ladder_betas): β scales b and its cap; a keeps
+    # the reference schedule on every lane
+    a0 = np.ones_like(betas) * config.a0_frac * n
+    b0 = betas * config.b0_frac * n
+    prep = prepare_sa_inputs(
+        graph, config, n_replicas=K, seed=seed, a0=a0, b0=b0,
+        max_steps=max_steps,
+    )
+    (_, seed, s0, a0b, b0b, _, _, max_steps, _, _) = prep
+    keys = jax.vmap(jax.random.PRNGKey)(
+        np.arange(K, dtype=np.uint32) + np.uint32(seed)
+    )
+
+    def place(x):
+        x = jnp.asarray(x)
+        if mesh is None:
+            return x
+        from graphdyn.parallel.mesh import shard_stack
+
+        return shard_stack(mesh, x, lane_axis)
+
+    # the neighbor table's leading axis is the NODE axis, not the lane
+    # axis: it is shared by every lane and must REPLICATE over the mesh
+    # (sharding it would both scatter the table across lane devices and
+    # refuse any n not divisible by the shard count)
+    if mesh is None:
+        nbr_dev = jnp.asarray(graph.nbr)
+    else:
+        from graphdyn.parallel.mesh import replicate
+
+        nbr_dev = replicate(mesh, jnp.asarray(graph.nbr))
+    sa_state = _sa_init(
+        nbr_dev, place(s0), place(keys),
+        place(a0b.astype(np_dt)), place(b0b.astype(np_dt)),
+        rollout_steps=rollout, R_coef=R_coef, C_coef=C_coef,
+    )
+    state = _TemperState(
+        s=sa_state.s, sum_end=sa_state.sum_end, a=sa_state.a, b=sa_state.b,
+        t=sa_state.t, m_final=sa_state.m_final, active=sa_state.active,
+        key=sa_state.key,
+        t_target=place(np.full(K, -1, np.asarray(sa_state.t).dtype)),
+        chunk_t=jnp.zeros((), jnp.int32),
+        swap_round=jnp.zeros((), jnp.int32),
+        swap_att=jnp.zeros((), jnp.int32),
+        swap_acc=jnp.zeros((), jnp.int32),
+    )
+    loop_args = (
+        jnp.asarray(np_dt(config.par_a)),
+        jnp.asarray(np_dt(config.par_b)),
+        place((np.ones_like(betas) * config.a_cap_frac * n).astype(np_dt)),
+        place((betas * config.b_cap_frac * n).astype(np_dt)),
+        jax.random.fold_in(jax.random.PRNGKey(np.uint32(seed)),
+                           np.uint32(0x53574150)),   # b"SWAP"
+    )
+    static = dict(rollout_steps=rollout, R_coef=R_coef, C_coef=C_coef,
+                  max_steps=int(max_steps))
+    return nbr_dev, state, loop_args, static, np_dt, place
+
+
+def lower_temper_chunk(
+    graph, config: SAConfig, *, n_lanes: int = 4, seed: int = 0,
+    max_steps: int = 200, swap_interval: int = 16, dtype=jnp.float32,
+):
+    """Lower (without executing) the ladder chunk program — the exact
+    :func:`_temper_chunk` invocation :func:`temper_search` dispatches, as a
+    ``jax.stages.Lowered`` for graftcheck's ``tempering_ladder`` ledger
+    entry (donated carry + while-count band pin the swap-move program
+    structure). Shares :func:`_assemble_ladder` with the run path."""
+    betas = ladder_betas(n_lanes)
+    nbr_dev, state, loop_args, static, _, _ = _assemble_ladder(
+        graph, config, betas, seed, max_steps, dtype, None, "lane",
+    )
+    return _temper_chunk.lower(
+        nbr_dev, state, *loop_args,
+        swap_interval=int(swap_interval), swap_moves=True,
+        target_sum=graph.n, stop_on_first=False, **static,
+    )
+
+
+def temper_search(
+    graph,
+    config: SAConfig | None = None,
+    *,
+    n_lanes: int = 8,
+    betas=None,
+    beta_min: float = 1.0,
+    beta_max: float = 64.0,
+    seed: int = 0,
+    max_steps: int | None = None,
+    swap_interval: int = 1000,
+    swap_moves: bool = True,
+    m_target: float = 1.0,
+    stop_on_first: bool = False,
+    dtype=jnp.float32,
+    checkpoint_path: str | None = None,
+    checkpoint_interval_s: float = 30.0,
+    mesh=None,
+    lane_axis: str = "lane",
+) -> TemperResult:
+    """Run a K-lane replica-exchange annealing ladder on one graph.
+
+    ``betas`` (default :func:`ladder_betas`) is the **drive ladder**: lane
+    k scales the end-state drive — ``b0`` AND ``b_cap`` — by ``β_k`` while
+    ``a0``/``a_cap`` keep the reference schedule on every lane (scaling
+    both cancels in the acceptance and buys nothing; measured). β = 1 IS
+    the reference chain, and with ``swap_moves=False`` the program is
+    bit-identical to ``simulated_annealing(n_replicas=K)`` on the same
+    per-lane ``(a0, b0)`` in PRNG mode (tested). ``swap_interval`` is part of the chain law
+    (swaps happen every ``swap_interval`` device steps), so it rides in
+    the checkpoint fingerprint and a resume must keep it.
+
+    ``m_target`` defines the first-passage record ``t_target`` (the
+    ``tta_tempering`` bench measures it): the first step a lane's
+    rolled-out ``Σs_end ≥ ceil(m_target·n)``. ``stop_on_first`` ends the
+    run at the first passage (the time-to-target mode); otherwise lanes
+    run to the reference's own stop rule (consensus or timeout).
+
+    ``checkpoint_path`` gives chunk-granular durable snapshots through the
+    PR-9 store (journal, versioned retention, mirror) — snapshots are
+    global, so a preempted ladder resumes bit-exact under a different
+    ``mesh``/lane-shard count. ``mesh`` shards the lane axis via
+    ``shard_stack`` (bit-identical to unsharded; tested).
+    """
+    config = config or SAConfig()
+    n = graph.n
+    if betas is None:
+        betas = ladder_betas(n_lanes, beta_min, beta_max)
+    betas = np.asarray(betas, dtype=np.float64)  # graftlint: disable=GD004  host ladder staging; cast to solver dtype at placement
+    K = betas.size
+    if not (0.0 < m_target <= 1.0):
+        raise ValueError(f"m_target must be in (0, 1], got {m_target}")
+    if swap_interval < 1:
+        raise ValueError(f"swap_interval must be >= 1, got {swap_interval}")
+    target_sum = int(np.ceil(m_target * n))
+
+    nbr_dev, state, loop_args, static, np_dt, place = _assemble_ladder(
+        graph, config, betas, seed, max_steps, dtype, mesh, lane_axis,
+    )
+    # a lane whose INITIAL configuration already rolls out past the target
+    # records first passage at step 0 (the chromatic driver's convention)
+    t0 = np.asarray(state.t_target)
+    hit0 = np.asarray(state.sum_end) >= target_sum
+    if hit0.any():
+        state = state._replace(
+            t_target=place(np.where(hit0, 0, t0).astype(t0.dtype)))
+    chunk_kwargs = dict(
+        swap_interval=int(swap_interval), swap_moves=bool(swap_moves),
+        target_sum=target_sum, stop_on_first=bool(stop_on_first), **static,
+    )
+
+    def advance(st: _TemperState):
+        return _temper_chunk(
+            nbr_dev, st._replace(chunk_t=jnp.zeros((), jnp.int32)),
+            *loop_args, **chunk_kwargs,
+        )
+
+    def running(st: _TemperState) -> bool:
+        go = bool(jnp.any(st.active))
+        if stop_on_first:
+            go = go and not bool(jnp.any(st.t_target >= 0))
+        return go
+
+    def payload(st: _TemperState):
+        return {
+            k: np.asarray(v)
+            for k, v in st._asdict().items() if k != "chunk_t"
+        }
+
+    if checkpoint_path is not None:
+        from graphdyn.utils.io import ChainCheckpointer, run_fingerprint
+
+        ckpt = ChainCheckpointer(
+            checkpoint_path, kind="temper_ladder", seed=seed,
+            # full run identity incl. the swap law: ladder, swap interval
+            # and the target predicate are part of the chain, so a resume
+            # under different ones is refused, never spliced
+            fp=run_fingerprint(
+                graph.edges, config, betas, int(static["max_steps"]),
+                int(swap_interval), bool(swap_moves), target_sum,
+                bool(stop_on_first), np_dt,
+                bool(jax.config.jax_enable_x64),
+            ),
+            interval_s=checkpoint_interval_s,
+            extra_meta={"K": int(K)},
+        )
+        arrays = ckpt.load_state(check=lambda a: a["s"].shape == (K, n))
+        if arrays is not None:
+            state = _TemperState(
+                s=place(arrays["s"]),
+                sum_end=place(arrays["sum_end"]),
+                a=place(arrays["a"].astype(np_dt)),
+                b=place(arrays["b"].astype(np_dt)),
+                t=place(arrays["t"]),
+                m_final=place(arrays["m_final"].astype(np_dt)),
+                active=place(arrays["active"]),
+                key=place(arrays["key"]),
+                t_target=place(arrays["t_target"]),
+                chunk_t=jnp.zeros((), jnp.int32),
+                swap_round=jnp.asarray(arrays["swap_round"]),
+                swap_att=jnp.asarray(arrays["swap_att"]),
+                swap_acc=jnp.asarray(arrays["swap_acc"]),
+            )
+        state = ckpt.drive(
+            state, advance=advance, active=running, payload=payload,
+        )
+    else:
+        from graphdyn.resilience.shutdown import raise_if_requested
+
+        while running(state):
+            state = advance(state)
+            # heartbeat + honor SIGTERM/--deadline at the swap boundary
+            # (exit 75; without a checkpoint there is nothing to snapshot
+            # — chains re-derive from the seed on requeue)
+            raise_if_requested(where="chunk")
+
+    t_target = np.asarray(state.t_target)
+    reached = t_target >= 0
+    if reached.any():
+        target_lane = int(np.argmin(np.where(reached, t_target, np.iinfo(
+            t_target.dtype).max)))
+        steps_to_target = int(t_target[target_lane])
+    else:
+        target_lane, steps_to_target = -1, -1
+    att = int(state.swap_att)
+    acc = int(state.swap_acc)
+    s_final = np.asarray(state.s)
+    return TemperResult(
+        s=s_final,
+        mag_reached=(s_final.astype(np.float64).sum(axis=1) / n).astype(np_dt),  # graftlint: disable=GD004  host observable, exact sum
+        num_steps=np.asarray(state.t),
+        m_final=np.asarray(state.m_final),
+        t_target=t_target,
+        betas=betas,
+        swap_attempts=att,
+        swap_accepts=acc,
+        swap_acceptance_rate=(acc / att) if att else 0.0,
+        steps_to_target=steps_to_target,
+        target_lane=target_lane,
+    )

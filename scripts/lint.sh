@@ -168,6 +168,39 @@ else
     JAX_PLATFORMS=cpu python -m graphdyn.obs memcheck --format=text || fail=1
 fi
 
+# 8b. colorcheck — the chromatic-kernel coloring contract (graphdyn.graphs
+#     greedy_coloring): deterministic per seed, no monochromatic edge,
+#     chi <= dmax+1, and the distance-2 construction proper on G^2 — an
+#     invalid coloring would make the whole-independent-set device update
+#     silently wrong, so the gate proves it host-side on RRG + ragged ER
+#     samples. Skipped with a notice when GRAPHDYN_SKIP_COLORCHECK=1 (set
+#     by the tier-1 lint-gate test: the same contract runs in-suite via
+#     tests/test_graphs.py — no double work; mirrors obscheck).
+if [ "${GRAPHDYN_SKIP_COLORCHECK:-0}" = "1" ]; then
+    echo "== colorcheck: GRAPHDYN_SKIP_COLORCHECK=1 — SKIPPED (contract runs in tier-1) =="
+else
+    echo "== colorcheck (greedy-coloring validity, host numpy) =="
+    JAX_PLATFORMS=cpu python - <<'PYEOF' || fail=1
+import numpy as np
+from graphdyn.graphs import (erdos_renyi_graph, greedy_coloring,
+                             power_graph, random_regular_graph,
+                             validate_coloring)
+for name, g in (("rrg", random_regular_graph(512, 3, seed=0)),
+                ("er", erdos_renyi_graph(400, 5.0 / 399, seed=1))):
+    c = greedy_coloring(g, seed=0)
+    problems = validate_coloring(g, c)
+    assert problems == [], (name, problems)
+    assert np.array_equal(c, greedy_coloring(g, seed=0)), \
+        f"{name}: coloring not deterministic per seed"
+    g2 = power_graph(g, 2)
+    c2 = greedy_coloring(g2, seed=0)
+    problems2 = validate_coloring(g2, c2)
+    assert problems2 == [], (name, problems2)
+    print(f"colorcheck: {name} chi={int(c.max()) + 1} (dmax={g.dmax}) "
+          f"chi2={int(c2.max()) + 1} (dmax2={g2.dmax}) OK")
+PYEOF
+fi
+
 # 9. benchcheck — the benchmark's single-JSON-line contract, live (python
 #    bench.py --smoke on the CPU backend): one line of JSON, a positive
 #    headline value, and a positive ensemble_rate row (the grouped-driver
@@ -282,6 +315,26 @@ if hbs is None:
         "null halo_bytes_per_step needs halo_bytes_per_step_skipped_reason"
 else:
     assert hbs > 0, f"halo_bytes_per_step must be > 0 or null+reason: {hbs}"
+# the time-to-target search rows (tta_tempering / tta_chromatic): a
+# measured speedup over the serial SA chain, or an explicit null + reason
+# — NEVER 0.0; a measured tempering row additionally needs a NONZERO
+# swap_acceptance_rate (a dead ladder — 0% swaps — must fail loudly
+# instead of benching as "fast")
+for key in ("tta_tempering", "tta_chromatic"):
+    assert key in row, f"{key} row absent"
+    v = row[key]
+    if v is None:
+        assert row.get(key + "_skipped_reason"), \
+            f"null {key} needs {key}_skipped_reason"
+        print(f"benchcheck: {key} skipped:", row[key + "_skipped_reason"])
+    else:
+        assert v.get("speedup_x", 0) > 0, (key, v)
+        assert v.get("device_steps", 0) > 0, (key, v)
+assert "swap_acceptance_rate" in row, "swap_acceptance_rate column absent"
+if row["tta_tempering"] is not None:
+    assert (row["swap_acceptance_rate"] or 0) > 0, \
+        "measured tta_tempering with a DEAD ladder (swap_acceptance_rate " \
+        f"= {row['swap_acceptance_rate']}) — swaps never accepted"
 # the durable-store save-overhead column: an interleaved p50/p99 A/B of
 # DurableCheckpoint.save vs raw Checkpoint.save, or an explicit null +
 # reason — never silently absent

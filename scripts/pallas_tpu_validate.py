@@ -28,6 +28,14 @@ bands are provisional until one does):
 4. Bless deliberate rate shifts: ``python -m graphdyn.obs trend ROW.json
    --bless`` (OBS_TREND.json), so the next round's trend gate diffs
    against measured chip numbers instead of CPU smoke rows.
+5. Search-acceleration A/B on chip: the ``tta_tempering`` /
+   ``tta_chromatic`` rows of step 1's full bench run measure on real
+   lanes (device-step counts are seed-deterministic, so they must MATCH
+   the CPU rows bit-for-bit — a mismatch means a backend-dependent
+   search-chain divergence, which is a bug, not noise); confirm
+   ``swap_acceptance_rate`` lands in the committed 0.2–0.9 healthy band
+   at the full shape and record the measured wall-clock per leg from the
+   round's obs ledger (``bench.tta`` spans) next to the step counts.
 """
 
 from __future__ import annotations

@@ -191,6 +191,10 @@ _SLOW = {
     ("test_sa_sharded.py", "test_lightcone_sharded_bit_parity_and_resume"),
     ("test_sa_sharded.py", "test_prng_mode_bit_parity"),
     ("test_sa_sharded.py", "test_sharded_checkpoint_resume_bit_exact"),
+    # the lane-shard parity matrix compiles three mesh programs; the
+    # preempt/requeue JOURNAL proof and the tta speedup bar (the ISSUE-13
+    # acceptance criteria) deliberately stay tier-1 at ~6 s each
+    ("test_search.py", "test_temper_lane_shard_bit_parity"),
 }
 
 

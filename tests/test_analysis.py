@@ -891,6 +891,124 @@ class TestGD013ShardMapFullGather:
         assert "GD013" in RULES
 
 
+class TestGD014SearchLoopSync:
+    """Host round-trips inside a ``graphdyn/search/`` drive loop: the
+    tempering chunk+swap and chromatic sweep loops stay one device program
+    per chunk — a per-chunk ``np.asarray``/``.item()`` materialization
+    serializes the ladder on the host link (ARCHITECTURE.md "Search
+    acceleration")."""
+
+    SEARCH = "graphdyn/search/driver.py"
+    BAD_ASARRAY = (
+        "import numpy as np\n"
+        "def drive(state, advance):\n"
+        "    rates = []\n"
+        "    while bool(state.active.any()):\n"
+        "        state = advance(state)\n"
+        "        rates.append(np.asarray(state.swap_acc))\n"   # GD014
+        "    return state, rates\n"
+    )
+    BAD_ITEM = (
+        "def drive(state, advance, chunks):\n"
+        "    for _ in range(chunks):\n"
+        "        state = advance(state)\n"
+        "        if state.swap_acc.item() == 0:\n"             # GD014
+        "            break\n"
+        "    return state\n"
+    )
+    BAD_DEVICE_GET = (
+        "import jax\n"
+        "def drive(state, advance, chunks):\n"
+        "    for _ in range(chunks):\n"
+        "        state = advance(state)\n"
+        "        log(jax.device_get(state.m_final))\n"         # GD014
+        "    return state\n"
+    )
+    GOOD_STOP_TEST = (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def drive(state, advance):\n"
+        "    while bool(jnp.any(state.active)):\n"   # the sanctioned sync
+        "        state = advance(state)\n"
+        "    return np.asarray(state.s)\n"           # ONE post-loop readback
+    )
+    GOOD_JIT_LOOP = (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def body(x):\n"
+        "    for j in range(4):\n"                   # unrolls at trace time
+        "        x = x + np.float32(j)\n"
+        "    return x\n"
+    )
+
+    def test_bad_asarray_in_while(self):
+        assert "GD014" in _codes(self.BAD_ASARRAY, path=self.SEARCH)
+
+    def test_bad_item_in_for(self):
+        assert "GD014" in _codes(self.BAD_ITEM, path=self.SEARCH)
+
+    def test_bad_device_get(self):
+        assert "GD014" in _codes(self.BAD_DEVICE_GET, path=self.SEARCH)
+
+    BAD_INT_COERCE = (
+        "def drive(state, advance, max_sweeps):\n"
+        "    while bool(state.active.any()):\n"
+        "        if int(state.sweeps) >= max_sweeps:\n"   # GD014
+        "            break\n"
+        "        state = advance(state)\n"
+        "    return state\n"
+    )
+    BAD_BARE_ASARRAY = (
+        "from numpy import asarray\n"
+        "def drive(state, advance, chunks):\n"
+        "    logs = []\n"
+        "    for _ in range(chunks):\n"
+        "        state = advance(state)\n"
+        "        logs.append(asarray(state.m_final))\n"   # GD014
+        "    return state\n"
+    )
+
+    def test_bad_int_coercion(self):
+        assert "GD014" in _codes(self.BAD_INT_COERCE, path=self.SEARCH)
+
+    def test_bad_bare_asarray_import_alias(self):
+        assert "GD014" in _codes(self.BAD_BARE_ASARRAY, path=self.SEARCH)
+
+    def test_good_stop_test_and_post_loop_readback(self):
+        assert _codes(self.GOOD_STOP_TEST, path=self.SEARCH) == []
+
+    def test_good_jit_loop_exempt(self):
+        assert "GD014" not in _codes(self.GOOD_JIT_LOOP, path=self.SEARCH)
+
+    def test_non_search_module_exempt(self):
+        for path in ("graphdyn/models/sa.py", "graphdyn/pipeline/groups.py",
+                     "bench.py"):
+            assert "GD014" not in _codes(self.BAD_ASARRAY, path=path), path
+
+    def test_disable_comment(self):
+        src = self.BAD_ASARRAY.replace(
+            "        rates.append(np.asarray(state.swap_acc))",
+            "        # graftlint: disable-next-line=GD014  debug probe\n"
+            "        rates.append(np.asarray(state.swap_acc))",
+        )
+        assert _codes(src, path=self.SEARCH) == []
+
+    def test_catalogued(self):
+        assert "GD014" in RULES
+
+    def test_search_drivers_clean(self):
+        """The shipped drivers honor their own rule (no disables needed)."""
+        from pathlib import Path
+
+        root = Path(__file__).resolve().parent.parent
+        sources = [
+            (str(p), p.read_text())
+            for p in sorted((root / "graphdyn" / "search").glob("*.py"))
+        ]
+        assert [f for f in lint_sources(sources) if f.code == "GD014"] == []
+
+
 class TestGD007AtomicPersistence:
     BAD_SAVEZ = (
         "import numpy as np\n"
@@ -1067,7 +1185,7 @@ def test_unreadable_file_is_a_finding(tmp_path):
 
 
 def test_rules_registry_complete():
-    assert set(RULES) == {f"GD{i:03d}" for i in range(1, 14)}
+    assert set(RULES) == {f"GD{i:03d}" for i in range(1, 15)}
 
 
 def test_cli_json_is_one_document_stdout_only(tmp_path):

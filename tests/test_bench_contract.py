@@ -17,7 +17,7 @@ def test_bench_smoke_emits_one_json_line():
     env["GRAPHDYN_FORCE_PLATFORM"] = "cpu"
     proc = subprocess.run(
         [sys.executable, os.path.join(ROOT, "bench.py"), "--smoke"],
-        capture_output=True, text=True, timeout=560, cwd=ROOT, env=env,
+        capture_output=True, text=True, timeout=720, cwd=ROOT, env=env,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
@@ -109,6 +109,19 @@ def test_bench_smoke_emits_one_json_line():
         assert row["peak_hbm_bytes_skipped_reason"]
     else:
         assert row["peak_hbm_bytes"] > 0
+    # the time-to-target search rows (tta_tempering / tta_chromatic): a
+    # measured speedup + a NONZERO swap acceptance rate, or an explicit
+    # null + reason — never 0.0, and never a dead ladder benched as fast
+    for key in ("tta_tempering", "tta_chromatic"):
+        assert key in row, key
+        if row[key] is None:
+            assert row[key + "_skipped_reason"], key
+        else:
+            assert row[key]["speedup_x"] > 0
+            assert row[key]["device_steps"] > 0
+    assert "swap_acceptance_rate" in row
+    if row["tta_tempering"] is not None:
+        assert row["swap_acceptance_rate"] > 0
     # the cross-round rate trend gate RAN (or was explicitly skipped) and
     # found no unblessed drift — the benchcheck contract
     status = row.get("obs_trend_status")

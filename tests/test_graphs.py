@@ -221,3 +221,80 @@ def test_replicate_disjoint_equals_graph_from_edges():
         np.testing.assert_array_equal(gu.nbr, want.nbr)
         np.testing.assert_array_equal(gu.deg, want.deg)
         np.testing.assert_array_equal(gu.edges, want.edges)
+
+
+# ---------------------------------------------------------------------------
+# greedy coloring + power graph (the chromatic-kernel contract)
+# ---------------------------------------------------------------------------
+
+
+class TestGreedyColoring:
+    """The colorcheck contract (scripts/lint.sh): no monochromatic edge,
+    chi <= dmax+1, deterministic per seed — and the distance-2 variant
+    (power_graph(g, 2)) proper on G^2, which is what licenses the
+    chromatic kernel's whole-class parallel update."""
+
+    def test_valid_and_bounded_rrg_er(self):
+        from graphdyn.graphs import (
+            erdos_renyi_graph, greedy_coloring, random_regular_graph,
+            validate_coloring,
+        )
+
+        for g in (random_regular_graph(256, 3, seed=0),
+                  erdos_renyi_graph(200, 5.0 / 199, seed=1)):
+            c = greedy_coloring(g, seed=0)
+            assert validate_coloring(g, c) == []
+            assert int(c.max()) + 1 <= g.dmax + 1
+
+    def test_deterministic_per_seed(self):
+        from graphdyn.graphs import greedy_coloring, random_regular_graph
+
+        g = random_regular_graph(512, 4, seed=2)
+        a = greedy_coloring(g, seed=7)
+        b = greedy_coloring(g, seed=7)
+        np.testing.assert_array_equal(a, b)
+
+    def test_distance2_coloring_proper_on_square(self):
+        from graphdyn.graphs import (
+            erdos_renyi_graph, greedy_coloring, power_graph,
+            random_regular_graph, validate_coloring,
+        )
+
+        for g in (random_regular_graph(128, 3, seed=0),
+                  erdos_renyi_graph(100, 4.0 / 99, seed=3)):
+            g2 = power_graph(g, 2)
+            c2 = greedy_coloring(g2, seed=0)
+            assert validate_coloring(g2, c2) == []
+            # same-class nodes at pairwise distance >= 3: no class member
+            # inside another member's radius-2 ball
+            nbr_ext = np.concatenate(
+                [g.nbr.astype(np.int64),
+                 np.full((1, g.dmax), g.n, np.int64)], axis=0)
+            for i in range(g.n):
+                ball = nbr_ext[i]
+                ball = np.concatenate([ball, nbr_ext[ball].reshape(-1)])
+                ball = np.unique(ball[(ball != g.n) & (ball != i)])
+                assert (c2[ball] != c2[i]).all(), i
+
+    def test_power_graph_radius1_identity_and_path_distances(self):
+        from graphdyn.graphs import graph_from_edges, power_graph
+
+        path = graph_from_edges(5, np.array([[0, 1], [1, 2], [2, 3], [3, 4]]))
+        assert power_graph(path, 1) is path
+        p2 = power_graph(path, 2)
+        want = {(0, 1), (0, 2), (1, 2), (1, 3), (2, 3), (2, 4), (3, 4)}
+        got = {tuple(sorted(e)) for e in p2.edges.tolist()}
+        assert got == want
+        with pytest.raises(ValueError, match="radius"):
+            power_graph(path, 0)
+
+    def test_validate_coloring_catches_problems(self):
+        from graphdyn.graphs import random_regular_graph, validate_coloring
+
+        g = random_regular_graph(32, 3, seed=0)
+        assert any("monochromatic" in p
+                   for p in validate_coloring(g, np.zeros(g.n, np.int32)))
+        assert any("shape" in p
+                   for p in validate_coloring(g, np.zeros(3, np.int32)))
+        bad_chi = np.arange(g.n, dtype=np.int32) % (g.dmax + 9)
+        assert validate_coloring(g, bad_chi) != []

@@ -32,6 +32,7 @@ def test_lint_sh_gate_passes():
              "GRAPHDYN_SKIP_HLOCHECK": "1",
              "GRAPHDYN_SKIP_OBSCHECK": "1",
              "GRAPHDYN_SKIP_MEMCHECK": "1",
+             "GRAPHDYN_SKIP_COLORCHECK": "1",
              "GRAPHDYN_SKIP_SOAKCHECK": "1"},
     )
     assert proc.returncode == 0, (
@@ -49,6 +50,9 @@ def test_lint_sh_gate_passes():
     # the memcheck hatch: the step exists, announced itself, and honored
     # the skip variable (the device-memory check runs in-suite instead)
     assert "memcheck: GRAPHDYN_SKIP_MEMCHECK=1" in proc.stdout
+    # the colorcheck hatch: likewise (the greedy-coloring validity
+    # contract runs in-suite via tests/test_graphs.py)
+    assert "colorcheck: GRAPHDYN_SKIP_COLORCHECK=1" in proc.stdout
 
 
 def test_graftlint_clean_on_package_json():

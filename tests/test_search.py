@@ -1,0 +1,482 @@
+"""graphdyn.search: replica-exchange tempering + chromatic block sweeps.
+
+The contract (ISSUE 13 / ROADMAP item 3): a swap-free ladder IS the serial
+reference chain (bit-exact vs ``simulated_annealing`` on the same a0/b0);
+swap moves and color sweeps are seed-deterministic and bit-reproducible
+across lane-shard counts; a preempted ladder requeues onto a different
+shard count bit-exact to the fault-free oracle with the PR-9 journal
+carrying the save + load; the chromatic class update equals the
+brute-force single-flip Metropolis oracle exactly (the distance-2
+disjoint-ball argument, tested on RRG and ragged ER); and both searches
+reach the target magnetization ≥ 5× faster than the serial chain at fixed
+seeds (the tta_* bench acceptance bar, pinned in-suite)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from graphdyn.config import DynamicsConfig, SAConfig
+from graphdyn.graphs import erdos_renyi_graph, random_regular_graph
+from graphdyn.parallel.mesh import device_pool, make_mesh
+from graphdyn.search.chromatic import chromatic_anneal
+from graphdyn.search.tempering import ladder_betas, temper_search
+
+
+def _cfg():
+    return SAConfig(dynamics=DynamicsConfig(p=1, c=1))
+
+
+def _lane_mesh(P):
+    return make_mesh((P,), ("lane",), devices=device_pool(P))
+
+
+# ---------------------------------------------------------------------------
+# tempering: identity, swap law, determinism, lane sharding
+# ---------------------------------------------------------------------------
+
+
+def test_temper_no_swaps_is_serial_sa_bit_exact():
+    """A swap-free ladder is the replica-batched serial solver: same draw,
+    same accept/anneal arithmetic, same key derivation — bit-exact against
+    ``simulated_annealing`` on the same per-lane (a0, b0)."""
+    from graphdyn.models.sa import simulated_annealing
+
+    g = random_regular_graph(64, 3, seed=0)
+    cfg = _cfg()
+    K, n = 4, g.n
+    betas = np.ones(K)
+    a0 = betas * cfg.a0_frac * n
+    b0 = betas * cfg.b0_frac * n
+    ref = simulated_annealing(g, cfg, n_replicas=K, seed=3, a0=a0, b0=b0,
+                              max_steps=5000)
+    got = temper_search(g, cfg, betas=betas, seed=3, max_steps=5000,
+                        swap_moves=False, swap_interval=137)
+    np.testing.assert_array_equal(ref.s, got.s)
+    np.testing.assert_array_equal(ref.num_steps, got.num_steps)
+    np.testing.assert_array_equal(ref.m_final, got.m_final)
+
+
+def test_temper_equal_temperature_swaps_all_accept():
+    """At equal temperatures the swap energy difference is exactly zero, so
+    every eligible even/odd pair swap accepts (u < exp(0) = 1 for u in
+    [0,1)) — the detailed-balance sanity anchor for the swap arithmetic."""
+    g = random_regular_graph(48, 3, seed=1)
+    res = temper_search(g, _cfg(), betas=np.ones(4), seed=0,
+                        max_steps=1000, swap_interval=100)
+    assert res.swap_attempts > 0
+    assert res.swap_accepts == res.swap_attempts
+    assert res.swap_acceptance_rate == 1.0
+
+
+def test_temper_bit_reproducible_and_swap_stats():
+    g = random_regular_graph(96, 3, seed=0)
+    kw = dict(n_lanes=6, seed=5, max_steps=30_000, swap_interval=200,
+              m_target=0.9, stop_on_first=True)
+    a = temper_search(g, _cfg(), **kw)
+    b = temper_search(g, _cfg(), **kw)
+    np.testing.assert_array_equal(a.s, b.s)
+    np.testing.assert_array_equal(a.t_target, b.t_target)
+    assert a.swap_attempts == b.swap_attempts
+    assert a.swap_accepts == b.swap_accepts
+    # a real ladder at distinct temperatures accepts SOME but not all
+    assert 0 < a.swap_accepts <= a.swap_attempts
+    assert a.steps_to_target >= 0 and a.target_lane >= 0
+
+
+def test_temper_validations():
+    g = random_regular_graph(32, 3, seed=0)
+    with pytest.raises(ValueError, match="m_target"):
+        temper_search(g, _cfg(), n_lanes=2, m_target=0.0)
+    with pytest.raises(ValueError, match="swap_interval"):
+        temper_search(g, _cfg(), n_lanes=2, swap_interval=0)
+    with pytest.raises(ValueError, match="n_lanes"):
+        ladder_betas(0)
+    assert ladder_betas(1).tolist() == [1.0]
+
+
+def test_temper_lane_shards_with_indivisible_n():
+    """The neighbor table replicates over the lane mesh (its leading axis
+    is the NODE axis): a graph size not divisible by the shard count must
+    run — and stay bit-identical to the unsharded ladder."""
+    g = random_regular_graph(95, 4, seed=1)          # 95 % 2 != 0
+    kw = dict(n_lanes=4, seed=0, max_steps=3000, swap_interval=137,
+              m_target=0.95)
+    base = temper_search(g, _cfg(), **kw)
+    got = temper_search(g, _cfg(), mesh=_lane_mesh(2), **kw)
+    np.testing.assert_array_equal(base.s, got.s)
+    np.testing.assert_array_equal(base.num_steps, got.num_steps)
+
+
+def test_temper_lane_shard_bit_parity():
+    """Lane sharding via shard_stack is bit-identical to the unsharded
+    ladder at P ∈ {2, 4, 8} — integer rollout sums + elementwise float
+    acceptance + a lane permutation are reassociation-immune (the PR-3
+    grouped-driver precedent restated on the lane axis)."""
+    g = random_regular_graph(96, 3, seed=0)
+    kw = dict(n_lanes=8, seed=2, max_steps=50_000, swap_interval=111,
+              m_target=0.95)
+    base = temper_search(g, _cfg(), **kw)
+    for P in (2, 4, 8):
+        got = temper_search(g, _cfg(), mesh=_lane_mesh(P), **kw)
+        np.testing.assert_array_equal(base.s, got.s, err_msg=f"P={P}")
+        np.testing.assert_array_equal(base.num_steps, got.num_steps)
+        np.testing.assert_array_equal(base.t_target, got.t_target)
+        assert base.swap_accepts == got.swap_accepts
+
+
+# ---------------------------------------------------------------------------
+# tempering: durable resume across lane-shard counts (the requeue contract)
+# ---------------------------------------------------------------------------
+
+
+def test_temper_preempt_requeue_shard_change_journal(tmp_path):
+    """The acceptance centerpiece: a K=8 ladder sharded one-lane-per-device
+    is preempted by an injected SIGTERM-equivalent at a chunk (= swap)
+    boundary and snapshots through the durable store; the REQUEUED episode
+    comes up on a SHRUNK pool (4 lane-shards, two lanes per device),
+    resumes from the GLOBAL snapshot and finishes bit-exact to the
+    fault-free oracle — with the PR-9 run journal validating and carrying
+    both the preempted episode's save and the requeue's load."""
+    from graphdyn.resilience import ShutdownRequested
+    from graphdyn.resilience.faults import FaultPlan, FaultSpec
+    from graphdyn.resilience.store import journal_path_for, validate_journal
+
+    g = random_regular_graph(96, 3, seed=0)
+    kw = dict(n_lanes=8, seed=2, max_steps=50_000, swap_interval=111,
+              m_target=0.95)
+    oracle = temper_search(g, _cfg(), **kw)
+
+    ck = str(tmp_path / "lad" / "ck")
+    with FaultPlan([FaultSpec("chunk.boundary", "signal", at=2)]):
+        with pytest.raises(ShutdownRequested):
+            temper_search(g, _cfg(), mesh=_lane_mesh(8), checkpoint_path=ck,
+                          checkpoint_interval_s=0.0, **kw)
+    assert os.path.exists(ck + ".npz")           # the preemption snapshot
+
+    resumed = temper_search(g, _cfg(), mesh=_lane_mesh(4),
+                            checkpoint_path=ck, **kw)
+    np.testing.assert_array_equal(oracle.s, resumed.s)
+    np.testing.assert_array_equal(oracle.num_steps, resumed.num_steps)
+    np.testing.assert_array_equal(oracle.t_target, resumed.t_target)
+    assert oracle.swap_accepts == resumed.swap_accepts
+    assert not os.path.exists(ck + ".npz")       # removed on completion
+
+    events, problems = validate_journal(journal_path_for(ck))
+    assert problems == [], problems
+    ops = [e.get("op") for e in events if e.get("ev") == "journal"]
+    assert "save" in ops and "load" in ops       # preempt saved, requeue loaded
+
+
+def test_temper_resume_refuses_different_ladder(tmp_path, abort_after_save):
+    """The swap law is part of the chain: a snapshot written under one
+    (betas, swap_interval) must refuse a resume under another — a spliced
+    chimera ladder would silently change every chain."""
+    from conftest import CheckpointAbort
+
+    g = random_regular_graph(48, 3, seed=0)
+    ck = str(tmp_path / "ck")
+    kw = dict(n_lanes=4, seed=1, max_steps=20_000, m_target=0.95)
+    with abort_after_save(n=1):
+        with pytest.raises(CheckpointAbort):
+            temper_search(g, _cfg(), swap_interval=100, checkpoint_path=ck,
+                          checkpoint_interval_s=0.0, **kw)
+    with pytest.raises(ValueError, match="refusing to resume"):
+        temper_search(g, _cfg(), swap_interval=200, checkpoint_path=ck, **kw)
+    with pytest.raises(ValueError, match="refusing to resume"):
+        temper_search(g, _cfg(), swap_interval=100, betas=ladder_betas(4, 1, 8),
+                      checkpoint_path=ck, seed=1, max_steps=20_000,
+                      m_target=0.95)
+
+
+# ---------------------------------------------------------------------------
+# chromatic: kernel exactness (brute-force oracle), chain behavior
+# ---------------------------------------------------------------------------
+
+
+def _end_sum_np(nbr, s):
+    """One synchronous majority step (tie stay), per replica: the numpy
+    oracle of the p=c=1 rollout's end-state sum."""
+    s_ext = np.concatenate(
+        [s.astype(np.int64), np.zeros((s.shape[0], 1), np.int64)], axis=1
+    )
+    sums = s_ext[:, nbr].sum(axis=2)
+    return np.sign(2 * sums + s.astype(np.int64)).sum(axis=1)
+
+
+@pytest.mark.parametrize("gname", ["rrg", "er"])
+def test_chromatic_class_update_matches_bruteforce_oracle(gname):
+    """One class step equals the product of per-site single-flip Metropolis
+    kernels computed by brute force (full end-state re-evaluation per
+    flip), under shared injected uniforms — including the additive
+    ``Σs_end`` update the disjoint-ball (distance-2) argument licenses."""
+    from graphdyn.ops.chromatic import (
+        _threshold_words, build_chromatic_tables, class_update,
+    )
+    from graphdyn.ops.dynamics import Rule, TieBreak
+    from graphdyn.ops.packed import WORD, pack_spins, unpack_spins
+
+    g = (random_regular_graph(60, 3, seed=1) if gname == "rrg"
+         else erdos_renyi_graph(50, 4.0 / 49, seed=2))
+    tables = build_chromatic_tables(g, seed=0)
+    n, dmax = g.n, tables.dmax
+    R = 5
+    W = -(-R // WORD)
+    Rp = W * WORD
+    rng = np.random.default_rng(3)
+    s = (2 * rng.integers(0, 2, size=(R, n)) - 1).astype(np.int8)
+    a = np.full(Rp, 0.7, np.float32)
+    b = np.full(Rp, 1.3, np.float32)
+    active = np.zeros(Rp, bool)
+    active[:R] = True
+    u = rng.random((n, Rp)).astype(np.float32)
+    thr_bits, even_mask = _threshold_words(
+        jnp.asarray(tables.deg_ext), max(dmax.bit_length(), 1)
+    )
+    sp_ext = jnp.concatenate(
+        [jnp.asarray(pack_spins(s)), jnp.zeros((1, W), jnp.uint32)], axis=0
+    )
+    c = 1
+    sp_new, dsend_tot, _, _, n_acc = class_update(
+        sp_ext, jnp.asarray(u), jnp.asarray(tables.masks[c]),
+        jnp.int32(tables.class_sizes[c]), jnp.asarray(a), jnp.asarray(b),
+        jnp.asarray(active), jnp.asarray(tables.nbr_ext),
+        jnp.asarray(tables.nbr_self), thr_bits, even_mask,
+        n=n, dmax=dmax, rule=Rule("majority"), tie=TieBreak("stay"),
+        par_a=1.0005, par_b=1.0005, a_cap=1e9, b_cap=1e9,
+    )
+    got_s = unpack_spins(np.asarray(sp_new[:n]), R)
+
+    nbr = np.asarray(g.nbr)
+    class_sites = np.where(tables.colors == c)[0]
+    exp_s = s.copy()
+    exp_dsend = np.zeros(R, np.int64)
+    se0 = _end_sum_np(nbr, s)
+    for r in range(R):
+        for i in class_sites:
+            s_flip = s[r:r + 1].copy()
+            s_flip[0, i] = -s_flip[0, i]
+            dsend = _end_sum_np(nbr, s_flip)[0] - se0[r]
+            de = (np.float32(-2.0) * a[r] * np.float32(s[r, i])
+                  - b[r] * np.float32(dsend)) / np.float32(n)
+            if u[i, r] < np.exp(-de):
+                exp_s[r, i] = -exp_s[r, i]
+                exp_dsend[r] += dsend
+    np.testing.assert_array_equal(got_s, exp_s)
+    np.testing.assert_array_equal(np.asarray(dsend_tot)[:R], exp_dsend)
+    # the additivity claim itself: recomputing Σs_end from the flipped
+    # state matches the sum of single-flip deltas
+    np.testing.assert_array_equal(_end_sum_np(nbr, exp_s), se0 + exp_dsend)
+    assert int(n_acc) == int((exp_s != s).sum())
+
+
+def test_chromatic_anneal_reaches_target_and_reproducible():
+    g = random_regular_graph(128, 3, seed=0)
+    kw = dict(n_replicas=8, seed=0, m_target=0.9, max_sweeps=2000)
+    r = chromatic_anneal(g, _cfg(), **kw)
+    assert (r.steps_to_target >= 0).all()        # every chain got there
+    assert (r.m_end >= 0.9).all()
+    assert r.chi >= 2 and r.device_steps == r.sweeps * r.chi
+    assert r.accepted > 0
+    r2 = chromatic_anneal(g, _cfg(), **kw)
+    np.testing.assert_array_equal(r.s, r2.s)
+    np.testing.assert_array_equal(r.steps_to_target, r2.steps_to_target)
+
+
+def test_chromatic_reproducible_across_replica_counts():
+    """The proposal stream is keyed per (class step, 32-replica WORD):
+    growing the replica set adds words without perturbing existing ones,
+    so replicas 0..31 of an R=64 run are bit-identical to the R=32 run —
+    the 'bit-reproducible across lane counts' half of the acceptance
+    criterion for color sweeps (word granularity)."""
+    g = random_regular_graph(96, 3, seed=0)
+    kw = dict(seed=4, m_target=0.9, max_sweeps=600)
+    small = chromatic_anneal(g, _cfg(), n_replicas=32, **kw)
+    big = chromatic_anneal(g, _cfg(), n_replicas=64, **kw)
+    np.testing.assert_array_equal(small.s, big.s[:32])
+    np.testing.assert_array_equal(small.steps_to_target,
+                                  big.steps_to_target[:32])
+
+
+def test_chromatic_first_passage_freezes():
+    """A replica freezes at its first passage: its recorded step count is
+    final and its configuration stops changing afterwards (run longer —
+    identical first-passage records)."""
+    g = random_regular_graph(96, 3, seed=1)
+    short = chromatic_anneal(g, _cfg(), n_replicas=8, seed=3, m_target=0.9,
+                             max_sweeps=400)
+    longer = chromatic_anneal(g, _cfg(), n_replicas=8, seed=3, m_target=0.9,
+                              max_sweeps=800)
+    hit = short.steps_to_target >= 0
+    assert hit.any()
+    np.testing.assert_array_equal(short.steps_to_target[hit],
+                                  longer.steps_to_target[hit])
+    np.testing.assert_array_equal(short.s[hit], longer.s[hit])
+
+
+def test_chromatic_validations():
+    g = random_regular_graph(32, 3, seed=0)
+    with pytest.raises(ValueError, match="p = c = 1"):
+        chromatic_anneal(
+            g, SAConfig(dynamics=DynamicsConfig(p=3, c=1)), n_replicas=2
+        )
+    with pytest.raises(ValueError, match="m_target"):
+        chromatic_anneal(g, _cfg(), n_replicas=2, m_target=1.5)
+    with pytest.raises(ValueError, match="chunk_sweeps"):
+        chromatic_anneal(g, _cfg(), n_replicas=2, chunk_sweeps=0)
+    with pytest.raises(ValueError, match="max_sweeps"):
+        chromatic_anneal(g, _cfg(), n_replicas=2, max_sweeps=0)
+
+
+def test_chromatic_exact_sweep_budget():
+    """max_sweeps is honored to the sweep (host-side chunk plan): a budget
+    that is not a chunk_sweeps multiple never overshoots."""
+    g = random_regular_graph(64, 3, seed=0)
+    r = chromatic_anneal(g, _cfg(), n_replicas=4, seed=9, m_target=1.0,
+                         max_sweeps=100, chunk_sweeps=64)
+    assert r.sweeps <= 100
+    assert r.device_steps == r.sweeps * r.chi
+
+
+def test_chromatic_tables_refuse_invalid_coloring():
+    from graphdyn.ops.chromatic import ChromaticTables, build_chromatic_tables
+
+    g = random_regular_graph(48, 3, seed=0)
+    t = build_chromatic_tables(g, seed=0)
+    assert t.chi <= g.dmax ** 2 + 1
+    # a deliberately monochromatic coloring is refused at validation
+    from graphdyn.graphs import power_graph, validate_coloring
+
+    bad = np.zeros(g.n, np.int32)
+    assert validate_coloring(power_graph(g, 2), bad) != []
+    assert isinstance(t, ChromaticTables)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance bar: >= 5x fewer device steps to target at fixed seeds
+# ---------------------------------------------------------------------------
+
+
+def test_tta_bench_contract_and_speedup_bar():
+    """The ISSUE-13 acceptance criterion pinned in-suite: on the d=3 RRG
+    smoke workload at fixed seeds, BOTH accelerated searches reach the
+    target magnetization in ≥ 5× fewer device steps than the serial SA
+    chain (per seed, not just on average), the ladder's swap acceptance is
+    nonzero (a dead ladder must not bench as "fast"), and every chromatic
+    chain actually hits the target. Counts are seed-deterministic, so this
+    is a stable algorithmic assertion, not a flaky timing one."""
+    import bench
+
+    row = bench.tta_rows(smoke=True)
+    assert row["tta_tempering"] is not None, row
+    assert row["tta_chromatic"] is not None, row
+    assert min(row["tta_tempering"]["per_seed_speedup"]) >= 5.0, row
+    assert min(row["tta_chromatic"]["per_seed_speedup"]) >= 5.0, row
+    assert row["swap_acceptance_rate"] > 0, row
+    assert row["tta_chromatic"]["target_hit_fraction"] == 1.0, row
+    assert row["tta_serial_timeouts"] == 0, row
+    assert row["tta_chromatic"]["chi"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_temper(tmp_path, capsys):
+    from graphdyn.cli import main
+
+    out = str(tmp_path / "t.npz")
+    rc = main([
+        "temper", "--n", "96", "--d", "3", "--lanes", "4",
+        "--swap-interval", "200", "--m-target", "0.9", "--stop-on-first",
+        "--max-steps", "100000", "--seed", "1", "--out", out,
+    ])
+    assert rc == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["solver"] == "temper" and line["lanes"] == 4
+    assert line["steps_to_target"] >= 0
+    assert 0.0 <= line["swap_acceptance_rate"] <= 1.0
+    assert os.path.exists(out)
+    with pytest.raises(SystemExit, match="lane-shards"):
+        main(["temper", "--n", "32", "--lanes", "8", "--lane-shards", "3"])
+
+
+def test_cli_temper_lane_shards(tmp_path, capsys):
+    from graphdyn.cli import main
+
+    rc = main([
+        "temper", "--n", "96", "--d", "3", "--lanes", "4",
+        "--lane-shards", "2", "--swap-interval", "200", "--m-target", "0.9",
+        "--stop-on-first", "--max-steps", "100000", "--seed", "1",
+    ])
+    assert rc == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["lane_shards"] == 2 and line["steps_to_target"] >= 0
+
+
+def test_cli_chromatic(tmp_path, capsys, monkeypatch):
+    from graphdyn.cli import main
+
+    out = str(tmp_path / "c.npz")
+    rc = main([
+        "chromatic", "--n", "96", "--d", "3", "--replicas", "8",
+        "--m-target", "0.9", "--max-sweeps", "1500", "--seed", "1",
+        "--out", out,
+    ])
+    assert rc == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["solver"] == "chromatic" and line["chi"] >= 2
+    assert all(t >= 0 for t in line["steps_to_target"])
+    assert os.path.exists(out)
+    # p != 1 is refused loudly (the distance-2 coloring covers radius 2
+    # exactly); the crash path dumps a flight post-mortem into cwd
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(ValueError, match="p = c = 1"):
+        main(["chromatic", "--n", "32", "--p", "3"])
+
+
+@pytest.mark.slow
+def test_cli_temper_preempt_requeue_subprocess(tmp_path, multi_device_cpu):
+    """The requeue contract across REAL process boundaries on the forced
+    8-device CPU platform (the multi_device_cpu fixture): a --lane-shards 8
+    ladder preempted by an injected signal exits 75 with a snapshot;
+    rerunning the same command line on FEWER shards (4 — what a
+    scheduler's requeue after a device loss does) resumes and produces the
+    oracle's exact per-lane results."""
+    from graphdyn.utils.io import load_results_npz
+
+    ck = str(tmp_path / "ck" / "run")
+    argv = ["temper", "--n", "96", "--d", "3", "--lanes", "8",
+            "--swap-interval", "111", "--m-target", "0.95",
+            "--max-steps", "50000", "--seed", "2"]
+    ckpt = ["--checkpoint", ck, "--checkpoint-interval", "0"]
+
+    oracle = multi_device_cpu(
+        argv + ["--lane-shards", "8", "--out", str(tmp_path / "oracle.npz")],
+    )
+    assert oracle.returncode == 0, oracle.stderr[-2000:]
+
+    plan = json.dumps(
+        [{"site": "chunk.boundary", "action": "signal", "at": 2}]
+    )
+    ep1 = multi_device_cpu(
+        argv + ckpt + ["--lane-shards", "8"],
+        env={"GRAPHDYN_FAULT_PLAN": plan},
+    )
+    assert ep1.returncode == 75, (ep1.returncode, ep1.stderr[-2000:])
+    assert os.path.exists(ck + ".npz")
+
+    ep2 = multi_device_cpu(
+        argv + ckpt + ["--lane-shards", "4",
+                       "--out", str(tmp_path / "requeued.npz")],
+    )
+    assert ep2.returncode == 0, ep2.stderr[-2000:]
+    a = load_results_npz(str(tmp_path / "oracle.npz"))
+    b = load_results_npz(str(tmp_path / "requeued.npz"))
+    np.testing.assert_array_equal(a["conf"], b["conf"])
+    np.testing.assert_array_equal(a["num_steps"], b["num_steps"])
+    np.testing.assert_array_equal(a["t_target"], b["t_target"])
