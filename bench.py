@@ -549,6 +549,7 @@ def tta_rows(smoke: bool):
     from graphdyn.config import DynamicsConfig, SAConfig
     from graphdyn.graphs import random_regular_graph
     from graphdyn.search.chromatic import chromatic_anneal
+    from graphdyn.search.fused import fused_anneal
     from graphdyn.search.tempering import temper_search
 
     if smoke:
@@ -563,6 +564,9 @@ def tta_rows(smoke: bool):
     serial_timeouts = 0
     chi = None
     chrom_hits = chrom_total = 0
+    fused, fused_hits, fused_total = [], 0, 0
+    fused_chi = None
+    fused_kernel = None
     for seed in seeds:                    # interleaved A/B per seed
         _mark(f"tta seed={seed}: serial reference chain")
         with obs.timed("bench.tta", leg="serial", seed=seed):
@@ -598,6 +602,19 @@ def tta_rows(smoke: bool):
         # independent chain; min would overclaim the parallel-draw bonus)
         chrom.append(float(np.mean(ch.steps_to_target[hit])) if hit.any()
                      else np.nan)
+        _mark(f"tta seed={seed}: fused one-kernel annealer")
+        with obs.timed("bench.tta", leg="fused", seed=seed):
+            fr = fused_anneal(
+                g, cfg, n_replicas=32, seed=seed, m_target=m_target,
+                max_sweeps=max_sweeps,
+            )
+        fused_chi = fr.chi
+        fused_kernel = fr.kernel_used
+        fhit = fr.steps_to_target >= 0
+        fused_hits += int(fhit.sum())
+        fused_total += fhit.size
+        fused.append(float(np.mean(fr.steps_to_target[fhit]))
+                     if fhit.any() else np.nan)
     if any(t < 0 for t in temper):
         return {
             "tta_tempering": None,
@@ -606,6 +623,8 @@ def tta_rows(smoke: bool):
                 "target on at least one seed — no honest speedup to report",
             "tta_chromatic": None,
             "tta_chromatic_skipped_reason": "tempering leg failed",
+            "tta_fused": None,
+            "tta_fused_skipped_reason": "tempering leg failed",
             "swap_acceptance_rate": None,
         }
     chrom_row: dict
@@ -631,11 +650,46 @@ def tta_rows(smoke: bool):
             "chi": chi,
             "target_hit_fraction": 1.0,
         }}
+    fused_row: dict
+    if fused_hits < fused_total:
+        # same honesty rule as the chromatic leg: a replica that never
+        # reached the target has TTA > the sweep budget — null + reason,
+        # never a flattering average over the hits
+        fused_row = {
+            "tta_fused": None,
+            "tta_fused_skipped_reason": (
+                f"only {fused_hits}/{fused_total} fused chains reached "
+                f"m_target={m_target} within {max_sweeps} sweeps — no "
+                "honest speedup to report"
+            ),
+        }
+    else:
+        fused_row = {"tta_fused": {
+            "device_steps": float(np.mean(fused)),
+            "speedup_x": float(np.sum(serial) / max(np.sum(fused), 1e-9)),
+            "per_seed_speedup": [s / max(f, 1e-9)
+                                 for s, f in zip(serial, fused)],
+            "chi": fused_chi,
+            "kernel": fused_kernel,
+            "target_hit_fraction": 1.0,
+        }}
+    # the rider A/B: what the per-chunk bool(jnp.any) stop test costs a
+    # fixed-budget ladder (sync_stop True vs False — results bit-identical,
+    # tested; this measures only the drive-loop sync). Interleaved after a
+    # shared warm-up so both legs run the same compiled program.
+    ab_kw = dict(n_lanes=4, seed=0, max_steps=4000, swap_interval=250,
+                 m_target=m_target)
+    temper_search(g, cfg, sync_stop=True, **ab_kw)      # compile + warm
+    ab = {}
+    for label, sync in (("sync", True), ("nosync", False)):
+        with obs.timed("bench.tta_sync_ab", leg=label) as sw:
+            temper_search(g, cfg, sync_stop=sync, **ab_kw)
+        ab[label] = sw.wall_s
     row = {
         "tta_workload": {
             "n": n, "d": 3, "seeds": list(seeds), "m_target": m_target,
             "max_steps": max_steps, "lanes": lanes,
-            "chromatic_replicas": 32,
+            "chromatic_replicas": 32, "fused_replicas": 32,
         },
         "tta_serial_steps": float(np.mean(serial)),
         "tta_serial_timeouts": serial_timeouts,
@@ -647,15 +701,73 @@ def tta_rows(smoke: bool):
             "lanes": lanes,
         },
         "swap_acceptance_rate": float(np.mean(swap_rates)),
+        "tta_fixed_budget_sync": {
+            "sync_s": ab["sync"], "nosync_s": ab["nosync"],
+            "sync_saved_x": ab["sync"] / max(ab["nosync"], 1e-9),
+        },
         **chrom_row,
+        **fused_row,
     }
     obs.gauge("search.tta.speedup", row["tta_tempering"]["speedup_x"],
               leg="tempering")
     if row["tta_chromatic"] is not None:
         obs.gauge("search.tta.speedup", row["tta_chromatic"]["speedup_x"],
                   leg="chromatic")
+    if row["tta_fused"] is not None:
+        obs.gauge("search.tta.speedup", row["tta_fused"]["speedup_x"],
+                  leg="fused")
     obs.gauge("search.swap_acceptance_rate", row["swap_acceptance_rate"])
     return row
+
+
+def fused_sa_rate_row(smoke: bool):
+    """Proposal throughput of the fused one-kernel annealer
+    (``graphdyn.ops.pallas_anneal`` via ``search.fused_anneal``):
+    spin-update proposals/s — every site of every replica is proposed once
+    per sweep, so the count is ``n·R·sweeps`` over the measured wall. The
+    RATE is chip-only (null + reason on CPU: interpret mode measures the
+    interpreter, and the XLA twin on a 2-core host measures the host);
+    the CPU container instead proves interpret-vs-XLA bit parity in
+    tier-1. Device-step counts stay seed-deterministic, so a chip round's
+    ``tta_fused`` row must match the CPU rows bit-for-bit (checklist
+    item 6 in scripts/pallas_tpu_validate.py)."""
+    import jax
+
+    backend = jax.default_backend()
+    if backend not in ("tpu", "axon"):
+        return {
+            "fused_sa_rate": None,
+            "fused_sa_rate_skipped_reason": (
+                "fused-annealer rate is chip-only (backend=%s); the CPU "
+                "container proves interpret-mode parity, not throughput"
+                % backend
+            ),
+        }
+    from graphdyn import obs
+    from graphdyn.config import DynamicsConfig, SAConfig
+    from graphdyn.graphs import random_regular_graph
+    from graphdyn.ops.pallas_anneal import build_fused_tables
+    from graphdyn.search.fused import fused_anneal
+
+    n, R, sweeps = (4096, 64, 64) if smoke else (16384, 256, 256)
+    cfg = SAConfig(dynamics=DynamicsConfig(p=1, c=1))
+    g = random_regular_graph(n, 3, seed=0)
+    tables = build_fused_tables(g, cfg, seed=0)   # amortized, host-side
+    kw = dict(n_replicas=R, seed=0, m_target=1.0, tables=tables,
+              chunk_sweeps=sweeps)
+    _mark(f"fused_sa_rate n={n} R={R}: warmup (compile)")
+    fused_anneal(g, cfg, max_sweeps=sweeps, **kw)
+    _mark("fused_sa_rate: timing")
+    with obs.timed("bench.fused_sa_rate", n=n, R=R) as sw:
+        res = fused_anneal(g, cfg, max_sweeps=sweeps, **kw)
+    rate = float(n) * R * res.sweeps / sw.wall_s
+    obs.gauge("search.fused.rate", rate, n=n, R=R)
+    return {
+        "fused_sa_rate": rate,
+        "fused_sa_workload": {"n": n, "d": 3, "R": R,
+                              "sweeps": res.sweeps, "chi": res.chi,
+                              "kernel": res.kernel_used},
+    }
 
 
 def fingerprint_rows():
@@ -977,7 +1089,20 @@ def main():
             "tta_chromatic": None,
             "tta_chromatic_skipped_reason":
                 f"tta A/B failed: {str(e)[:150]}",
+            "tta_fused": None,
+            "tta_fused_skipped_reason":
+                f"tta A/B failed: {str(e)[:150]}",
             "swap_acceptance_rate": None,
+        })
+    _mark("fused one-kernel annealer rate (fused_sa_rate)")
+    try:
+        extra.update(fused_sa_rate_row(args.smoke))
+    except Exception as e:  # noqa: BLE001 — optional row, never silent
+        _mark(f"fused sa rate row failed: {str(e)[:150]}")
+        extra.update({
+            "fused_sa_rate": None,
+            "fused_sa_rate_skipped_reason":
+                f"fused rate row failed: {str(e)[:150]}",
         })
     _mark("program fingerprints (graftcheck structural summary)")
     try:

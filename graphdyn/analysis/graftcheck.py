@@ -143,9 +143,20 @@ class UnsupportedEntry(RuntimeError):
 # ---------------------------------------------------------------------------
 
 # `%name = <shape> opcode(...)` — shape is either an array type
-# (`f32[2,3]{1,0}`) or a tuple type (`(f32[2]{0}, s32[])`)
+# (`f32[2,3]{1,0}`) or a tuple type (`(f32[2]{0}, s32[])`). A tuple type
+# never contains parentheses, but past ~5 elements XLA interleaves
+# `/*index=N*/` comments (which contain `=`), so the tuple alternative
+# matches on paren balance, NOT on `=`-freedom — the old `\([^=]*?\)`
+# silently missed every op whose result tuple carried such a comment,
+# which is exactly the big-carry while loops GC106 exists to pin (a
+# 14-field chunk carry was invisible; found by the fused-anneal row,
+# whose ONE while loop fingerprinted as zero). One nesting level is
+# allowed (a tuple element that is itself a flat tuple) so a future
+# nested-tuple result type degrades the count visibly rather than
+# silently re-opening the same gap.
 _OP_RE = re.compile(
-    r"=\s+((?:[a-z0-9]+\[[^\]]*\](?:\{[^}]*\})?|\([^=]*?\)))\s+"
+    r"=\s+((?:[a-z0-9]+\[[^\]]*\](?:\{[^}]*\})?"
+    r"|\((?:[^()]|\([^()]*\))*\)))\s+"
     r"([a-z][a-z0-9-]*)\("
 )
 _CONST_RE = re.compile(
@@ -431,6 +442,15 @@ def _build_temper_chunk(K: int = 4):
     )
 
 
+def _build_fused_chunk(R: int = 32):
+    from graphdyn.search.fused import lower_fused_chunk
+
+    return lower_fused_chunk(
+        _canon_rrg(48, 3, 0), _temper_config(), n_replicas=R, seed=0,
+        m_target=0.9, chunk_sweeps=4,
+    )
+
+
 ENTRIES: dict[str, EntrySpec] = {
     "packed_rollout": EntrySpec(
         _build_packed_rollout, donates=False,
@@ -469,6 +489,18 @@ ENTRIES: dict[str, EntrySpec] = {
         _build_temper_chunk, donates=True,
         canon="K=4 drive ladder, RRG n=48 d=3, p=c=1, max_steps=200, "
               "swap_interval=16",
+    ),
+    # the one-kernel annealer's XLA twin (the CPU-container contract; the
+    # Pallas kernel shares the loop body verbatim): the while-count band
+    # pins ONE while loop over flat class steps — a scan over classes, a
+    # second loop, or a host round-trip sneaking into the schedule advance
+    # fails GC106 — donates=True pins the chunk-to-chunk in-place carry
+    # (GC001), and the constant bands keep the LUT/coloring tables
+    # arriving as arguments, never baked in (GC003/GC105)
+    "fused_anneal": EntrySpec(
+        _build_fused_chunk, donates=True,
+        canon="R=32 packed replicas (W=1), RRG n=48 d=3, p=c=1, "
+              "m_target=0.9, chunk_sweeps=4",
     ),
 }
 

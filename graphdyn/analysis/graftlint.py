@@ -122,6 +122,17 @@ Rules (stable codes; each can be silenced per line with
   contexts are exempt (they unroll at trace time); the checkpoint payload
   goes through ``ChainCheckpointer`` (``utils/io`` — out of scope), which
   only materializes when a snapshot is actually due.
+- **GD015** per-temperature-step host sync in a ``graphdyn/models/``
+  anneal drive loop: ``.item()``, ``.block_until_ready()``,
+  ``jax.device_get``, or a ``bool()``/``int()``/``float()`` coercion of
+  a ``jnp.``/``jax.``-rooted call, inside a host ``for``/``while`` loop.  Every solver's anneal schedule
+  advances INSIDE its device loop (``metropolis_anneal_update``; the
+  fused annealer ``ops/pallas_anneal`` keeps an entire run on device
+  between snapshot boundaries), so a drive loop that reads a device
+  value back per schedule step serializes the anneal on the host link —
+  the exact round-trip class ROADMAP item 7 removes.  search/ chunk
+  loops get the coarser GD014 with its sanctioned per-chunk stop test;
+  models/ loops are per-rep/per-λ/per-step and get no such sanction.
 
 Escape hatches, all requiring an explicit code list (``all`` allowed):
 
@@ -161,6 +172,7 @@ RULES = {
     "GD012": "bare jax.profiler capture/annotation outside graphdyn/obs/ (use graphdyn.obs.trace profiling/span alignment)",
     "GD013": "full-node-axis all_gather/jnp.take in a parallel/ shard-mapped body (halo exchange moves boundary words only)",
     "GD014": "host round-trip (np.asarray/device_get/.item()/block_until_ready/int()/float() coercion) inside a search/ drive loop (swap/sweep chunks stay on device)",
+    "GD015": "per-temperature-step host sync (.item()/device_get/block_until_ready/bool()/int()/float() of a jnp.- or jax.-rooted call) in a models/ anneal drive loop (advance the schedule on device — ops/pallas_anneal)",
 }
 
 # device->host materializations GD014 watches inside search/ drive loops
@@ -172,6 +184,19 @@ RULES = {
 _GD014_CALLS = {"np.asarray", "numpy.asarray", "asarray",
                 "jax.device_get", "device_get"}
 _GD014_METHODS = {"item", "block_until_ready"}
+
+# GD015: the per-temperature-step sync surface in models/ anneal drive
+# loops. Same method set as GD014; the coercion watched is bool() of a
+# device-rooted call (`bool(jnp.any(x))` per schedule step — the classic
+# slow-SA drive shape), resolved syntactically by the jnp./jax. root so
+# host-side bool(meta["failed"]) bookkeeping stays out of scope. models/
+# loops are per-rep/per-λ/per-step, so ANY device readback there
+# serializes every schedule step on the host link; the chunk-granularity
+# sync search/ drivers are allowed (GD014's sanction) has no models/
+# analogue — the solvers' schedules advance inside their device loops.
+_GD015_CALLS = {"jax.device_get", "device_get"}
+_GD015_METHODS = {"item", "block_until_ready"}
+_GD015_DEVICE_ROOTS = ("jnp", "jax")
 
 # the wall-clock calls GD011 watches (time.monotonic is exempt: it is the
 # bookkeeping clock for queue waits and deadlines, not a timing idiom);
@@ -382,6 +407,11 @@ class _FileLinter:
         # GD014 scope: the search drivers — where a per-chunk host
         # materialization would serialize the ladder/sweep loop
         self.search_mod = "/search/" in norm
+        # GD015 scope: the solver layer — where an anneal/sweep drive loop
+        # reading a device value back per temperature step caps
+        # time-to-target regardless of kernel speed (the fused annealer
+        # exists to remove exactly this round-trip)
+        self.models_mod = "/models/" in norm
 
     def emit(self, node: ast.AST, code: str, message: str) -> None:
         self.findings.append(
@@ -462,6 +492,7 @@ class _FileLinter:
         self._check_bare_profiler(tree)
         self._check_shardmap_full_gather(tree)
         self._check_search_loop_sync(tree, seen)
+        self._check_anneal_loop_sync(tree, seen)
         self.findings.sort(key=lambda f: (f.line, f.col, f.code))
         return self.findings
 
@@ -942,6 +973,64 @@ class _FileLinter:
                         f"bool(jnp.any(...)) stop test); read results "
                         f"back once after the loop, and derive chunk "
                         f"budgets host-side",
+                    )
+
+    def _check_anneal_loop_sync(self, tree: ast.Module, jit_seen: set):
+        """GD015: device→host materialization per temperature step — a
+        host ``for``/``while`` loop in a ``graphdyn/models/`` module that
+        calls ``.item()``/``.block_until_ready()``/``jax.device_get`` or
+        coerces a ``jnp.``/``jax.``-rooted call through ``bool()``. The
+        anneal schedules of every solver advance INSIDE their device
+        loops (``metropolis_anneal_update``; the fused annealer pins the
+        whole run on device), so a per-step readback in the drive loop
+        caps time-to-target on the host link no matter how fast the
+        kernel runs. Loops inside jit contexts unroll at trace time and
+        are exempt (``jit_seen``)."""
+        if not self.models_mod:
+            return
+        flagged: set[int] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.For, ast.While)) \
+                    or id(node) in jit_seen:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call) or id(sub) in flagged:
+                    continue
+                d = _dotted(sub.func)
+                is_method = (
+                    isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _GD015_METHODS
+                )
+                # bool(jnp.any(x)) / int(jnp.sum(x)) / float(jnp.max(x)):
+                # per-step coercions reading the device back. Matched
+                # only on jnp./jax.-rooted CALL arguments — models/ drive
+                # loops are full of host bookkeeping (`float(lmbd)`,
+                # `bool(meta["failed"])`) that a GD014-style
+                # any-non-literal net would drown in disables; the direct
+                # device-attribute form (`float(state.m_final)`) is
+                # uncheckable syntactically and `.item()` covers its
+                # common spelling
+                is_bool_sync = (
+                    isinstance(sub.func, ast.Name)
+                    and sub.func.id in ("bool", "int", "float")
+                    and sub.args
+                    and isinstance(sub.args[0], ast.Call)
+                    and _dotted(sub.args[0].func).split(".")[0]
+                    in _GD015_DEVICE_ROOTS
+                )
+                if d in _GD015_CALLS or is_method or is_bool_sync:
+                    what = d or (sub.func.attr if isinstance(
+                        sub.func, ast.Attribute) else sub.func.id)
+                    flagged.add(id(sub))
+                    self.emit(
+                        sub, "GD015",
+                        f"{what}(...) inside a models/ anneal drive loop "
+                        f"reads the device back every temperature step — "
+                        f"the schedule advances inside the device program "
+                        f"(metropolis_anneal_update; the fused annealer, "
+                        f"graphdyn.ops.pallas_anneal, keeps the whole run "
+                        f"on device); poll at chunk boundaries only and "
+                        f"read results back once after the loop",
                     )
 
     def _check_vmap_pallas(self, tree: ast.Module):

@@ -136,10 +136,13 @@ def build_parser() -> argparse.ArgumentParser:
                "Search modes: `sa` is the reference serial chain, `temper` "
                "runs a replica-exchange ladder on the batched replica axis "
                "(lane-shardable, swap moves at chunk boundaries), "
-               "`chromatic` updates a whole color class per device step — "
-               "which modes compose with node sharding and lightcone is "
-               "the mode-selection table in ARCHITECTURE.md 'Node-axis "
-               "sharding & halo exchange' / 'Search acceleration'.",
+               "`chromatic` updates a whole color class per device step, "
+               "`fused` is the one-kernel annealer (LUT update + "
+               "counter RNG + schedule in ONE device program, "
+               "--kernel auto|xla|pallas) — which modes compose with node "
+               "sharding and lightcone is the mode-selection table in "
+               "ARCHITECTURE.md 'Node-axis sharding & halo exchange' / "
+               "'Search acceleration' / 'One-kernel annealing'.",
     )
     ap.add_argument(
         "--ckpt-mirror", default=None, metavar="DIR",
@@ -356,6 +359,53 @@ def build_parser() -> argparse.ArgumentParser:
     chrom.add_argument("--seed", type=int, default=0)
     chrom.add_argument("--out", default=None,
                        help="npz path (per-replica arrays)")
+
+    fus = sub.add_parser(
+        "fused",
+        help="one-kernel annealing: the chromatic class-at-a-time chain "
+             "with the rule compiled to a popcount LUT, counter-based "
+             "in-kernel RNG, and the anneal schedule advanced inside ONE "
+             "device program — a fixed-budget run performs zero host "
+             "round-trips between snapshot boundaries "
+             "(graphdyn.search.fused; ARCHITECTURE.md 'One-kernel "
+             "annealing'; p=c=1 only)",
+    )
+    fus.add_argument("--n", type=int, default=10_000)
+    fus.add_argument("--d", type=int, default=3)
+    _add_dynamics_flags(fus, p_default=1)
+    _add_sa_schedule_flags(fus)
+    fus.add_argument("--replicas", type=int, default=32,
+                     help="independent packed chains (32 per uint32 word)")
+    fus.add_argument("--m-target", type=float, default=0.9)
+    fus.add_argument("--max-sweeps", type=int, default=5000)
+    fus.add_argument(
+        "--chunk-sweeps", type=int, default=256, metavar="S",
+        help="full sweeps per device call — the heartbeat/shutdown "
+             "granularity ONLY (the chunk plan is host-side; no device "
+             "readback between chunks, and the counter RNG makes splits "
+             "chain-invariant)",
+    )
+    fus.add_argument("--stop-on-first", action="store_true",
+                     help="stop at the first replica reaching --m-target "
+                          "(adds the sanctioned per-chunk stop test)")
+    fus.add_argument(
+        "--kernel", choices=["auto", "xla", "pallas"], default="auto",
+        help="fused-annealer engine: 'auto' runs the single-pallas_call "
+             "kernel on TPU backends when the VMEM model admits the "
+             "shape, else the XLA twin; 'pallas' forces the kernel "
+             "(interpret mode off-TPU — for tests); 'xla' forces the "
+             "twin. Both engines run the SAME chain bit-for-bit (tested) "
+             "— the knob moves throughput, never results",
+    )
+    fus.add_argument(
+        "--ladder-beta-max", type=float, default=None, metavar="B",
+        help="per-replica drive ladder riding the packed replica axis: "
+             "replica r scales (b0, b-cap) by geomspace(1, B, replicas)[r] "
+             "(no swap moves — for replica exchange use `graphdyn temper`)",
+    )
+    fus.add_argument("--seed", type=int, default=0)
+    fus.add_argument("--out", default=None,
+                     help="npz path (per-replica arrays)")
 
     hpr = sub.add_parser("hpr", help="HPr reinforced BP (`HPR_pytorch_RRG.py`)")
     hpr.add_argument("--n", type=int, default=10_000)
@@ -830,6 +880,43 @@ def _run(args) -> int:
             )
         print(json.dumps({
             "solver": "chromatic",
+            "chi": res.chi,
+            "sweeps": res.sweeps,
+            "device_steps": res.device_steps,
+            "accepted": res.accepted,
+            "m_end": res.m_end.tolist(),
+            "steps_to_target": res.steps_to_target.tolist(),
+            "sweeps_to_target": res.sweeps_to_target.tolist(),
+            "out": args.out,
+        }))
+    elif args.cmd == "fused":
+        import numpy as _np
+
+        from graphdyn.graphs import random_regular_graph
+        from graphdyn.search.fused import fused_anneal
+        from graphdyn.utils.io import save_results_npz
+
+        betas = None
+        if args.ladder_beta_max is not None:
+            if args.ladder_beta_max < 1.0:
+                raise SystemExit("--ladder-beta-max must be >= 1.0")
+            betas = _np.geomspace(1.0, args.ladder_beta_max, args.replicas)
+        g = random_regular_graph(args.n, args.d, seed=args.seed)
+        res = fused_anneal(
+            g, _sa_config(args), n_replicas=args.replicas, seed=args.seed,
+            m_target=args.m_target, max_sweeps=args.max_sweeps,
+            chunk_sweeps=args.chunk_sweeps,
+            stop_on_first=args.stop_on_first,
+            kernel=args.kernel, betas=betas,
+        )
+        if args.out:
+            save_results_npz(
+                args.out, conf=res.s, mag_reached=res.mag_reached,
+                m_end=res.m_end, steps_to_target=res.steps_to_target,
+            )
+        print(json.dumps({
+            "solver": "fused",
+            "kernel": res.kernel_used,
             "chi": res.chi,
             "sweeps": res.sweeps,
             "device_steps": res.device_steps,

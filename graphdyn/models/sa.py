@@ -336,8 +336,20 @@ def simulated_annealing(
     chunk_steps: int = 100_000,
     rollout_mode: str = "full",
     lc_tables=None,
+    kernel: str = "auto",
 ) -> SAResult:
     """Run batched SA chains.
+
+    ``kernel`` selects the anneal execution engine (the PR-5 kernel-knob
+    convention, ARCHITECTURE.md "Kernel selection"): ``'auto'`` and
+    ``'xla'`` both run THIS solver's XLA while-loop program — the serial
+    single-flip chain law, whose schedule already advances inside the
+    device loop. ``'pallas'`` is REFUSED here and routes to
+    :func:`graphdyn.search.fused_anneal`: the fused one-kernel annealer
+    runs a class-parallel chain (a whole distance-2 color class per step),
+    which is a *different Markov chain* — silently swapping it in under
+    the serial solver's name would change results, and kernel choice in
+    this repo moves throughput, never results.
 
     ``rollout_mode``:
 
@@ -375,6 +387,18 @@ def simulated_annealing(
     is step-index-driven, so splitting it across while-loops cannot change
     the chain. The file is deleted on successful completion.
     """
+    if kernel not in ("auto", "xla"):
+        if kernel == "pallas":
+            raise ValueError(
+                "kernel='pallas' on the serial SA solver: the fused "
+                "one-kernel annealer is a class-parallel chain, not this "
+                "chain — run graphdyn.search.fused_anneal (CLI `graphdyn "
+                "fused`) for the LUT-popcount kernel, or keep "
+                "kernel='auto'/'xla' here"
+            )
+        raise ValueError(
+            f"kernel must be 'auto', 'xla' or 'pallas', got {kernel!r}"
+        )
     config = config or SAConfig()
     n = graph.n
     dyn = config.dynamics

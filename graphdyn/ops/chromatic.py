@@ -189,6 +189,36 @@ def _pack_bool(acc, W: int):
     return b.sum(axis=2).astype(jnp.uint32)
 
 
+def accept_apply(sp_ext, end, end_all, u, class_mask, a, b, active,
+                 nbr_self, *, n: int):
+    """The exact-single-flip accept-and-apply core — ONE implementation
+    shared by the chromatic kernel (:func:`class_update`) and the fused
+    annealer (:func:`graphdyn.ops.pallas_anneal._fused_class_step`), so
+    the acceptance arithmetic cannot drift between the two chains that
+    both claim it: ΔΣ of every class site read off the two one-step
+    evaluations via disjoint-ball popcounts, per-(site, replica)
+    Metropolis accepts against the caller's uniforms, accepted flips
+    XORed back into the packed words, additive per-replica ΔΣ total.
+    ``class_mask`` is the UNextended ``uint32[n]`` class word mask.
+    Returns ``(sp_ext_new, acc, dsend_tot)``."""
+    dt = a.dtype
+    up = end_all & ~end                    # j: end −1 → +1 under the flip
+    dn = end & ~end_all
+    dsend = 2 * (_ball_counts(up, nbr_self)[:n]
+                 - _ball_counts(dn, nbr_self)[:n])      # int32 [n, Rp]
+    s_pm = _unpack_pm1(sp_ext[:n])                       # int32 [n, Rp]
+    delta_e = (
+        -2.0 * a[None, :] * s_pm.astype(dt)
+        - b[None, :] * dsend.astype(dt)
+    ) / n
+    in_class = (class_mask != 0)[:, None]
+    acc = (u < jnp.exp(-delta_e)) & in_class & active[None, :]
+    flips = _pack_bool(acc, sp_ext.shape[1])
+    sp_new = sp_ext.at[:n].set(sp_ext[:n] ^ flips)
+    dsend_tot = jnp.sum(dsend * acc.astype(jnp.int32), axis=0)
+    return sp_new, acc, dsend_tot
+
+
 def class_update(sp_ext, u, mask_row, anneal_pow, a, b, active,
                  nbr_ext, nbr_self, thr_bits, even_mask, *,
                  n: int, dmax: int, rule: Rule, tie: TieBreak,
@@ -207,21 +237,9 @@ def class_update(sp_ext, u, mask_row, anneal_pow, a, b, active,
     flip_all = jnp.concatenate([mask_row, jnp.zeros((1,), jnp.uint32)])
     end_all = _one_step(sp_ext ^ flip_all[:, None], nbr_ext, thr_bits,
                         even_mask, n, dmax, rule, tie)
-    up = end_all & ~end                    # j: end −1 → +1 under the flip
-    dn = end & ~end_all
-    dsend = 2 * (_ball_counts(up, nbr_self)[:n]
-                 - _ball_counts(dn, nbr_self)[:n])      # int32 [n, Rp]
-    s_pm = _unpack_pm1(sp_ext[:n])                       # int32 [n, Rp]
-    delta_e = (
-        -2.0 * a[None, :] * s_pm.astype(dt)
-        - b[None, :] * dsend.astype(dt)
-    ) / n
-    in_class = (mask_row != 0)[:, None]
-    acc = (u < jnp.exp(-delta_e)) & in_class & active[None, :]
-    W = sp_ext.shape[1]
-    flips = _pack_bool(acc, W)
-    sp_new = sp_ext.at[:n].set(sp_ext[:n] ^ flips)
-    dsend_tot = jnp.sum(dsend * acc.astype(jnp.int32), axis=0)
+    sp_new, acc, dsend_tot = accept_apply(
+        sp_ext, end, end_all, u, mask_row, a, b, active, nbr_self, n=n,
+    )
     # per-proposal-equivalent anneal at class granularity (cap checked
     # before the multiply, as the reference does per step)
     fac_a = jnp.asarray(par_a, dt) ** anneal_pow.astype(dt)

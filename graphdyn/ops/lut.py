@@ -1,0 +1,124 @@
+"""Per-degree update LUTs — the rule axis compiled to popcount tables.
+
+The p-bit annealers in PAPERS.md (arXiv:2602.16143's dual-BRAM LUT engine,
+arXiv:2110.02481's sparse Ising machines) precompute the spin update as a
+small table indexed by the neighbor popcount instead of re-deriving it from
+arithmetic every tick. This module is that idea for the graphdyn rule axis:
+
+- :func:`update_lut` compiles ONE (rule, tie) pair of
+  :mod:`graphdyn.ops.dynamics` into a ``uint8[dmax+1, dmax+1, 2]`` table —
+  next spin bit for every (degree, +1-neighbor count, current bit) triple.
+  The generator is exhaustively oracle-tested against
+  :func:`graphdyn.ops.dynamics.step_spins` on star graphs (a genuinely
+  independent oracle: the reference's ``R·sign(2Σ + C·s)`` integer form,
+  not the LUT formula itself).
+- :func:`lut_node_masks` broadcasts a table against a graph's degree
+  sequence into per-count packed word masks, and :func:`lut_one_step`
+  applies them to the packed state: the carry-save bit-plane counter
+  (:mod:`graphdyn.ops.packed`) produces the popcount, a plane comparator
+  selects the count's mask, and the masked table entry IS the next bit —
+  ``O(dmax·log dmax)`` word ops per step, the same order as the dedicated
+  majority comparator, but now ANY f(degree, count, spin) rule ships as a
+  table instead of hand-derived word logic (ROADMAP item 4's compilation
+  point; the fused annealer :mod:`graphdyn.ops.pallas_anneal` is its first
+  consumer).
+
+Exactness: for the four shipped (rule, tie) pairs ``lut_one_step`` is
+bit-identical to the comparator step of ``ops.packed`` (tested on RRG and
+ragged ER degree sequences).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from graphdyn.ops.dynamics import Rule, TieBreak, rule_coefficients
+from graphdyn.ops.packed import _FULL, _csa_add_one
+
+
+def update_lut(dmax: int, rule: Rule | str = Rule.MAJORITY,
+               tie: TieBreak | str = TieBreak.STAY) -> np.ndarray:
+    """``uint8[dmax+1, dmax+1, 2]``: next spin bit for (degree ``deg``,
+    +1-neighbor count ``cnt``, current bit ``b``). Entries with
+    ``cnt > deg`` are unreachable (a node's popcount cannot exceed its
+    degree) and filled with 0.
+
+    Derivation: with spin ``s = 2b − 1`` and neighbor sum
+    ``Σ = 2·cnt − deg``, one synchronous step is ``R·sign(2Σ + C·s)``
+    (:func:`graphdyn.ops.dynamics.rule_coefficients`); the next bit is 1
+    iff that value is +1. ``sign`` never returns 0 here: ``2Σ`` is even and
+    ``C·s = ±1`` breaks every tie.
+    """
+    if dmax < 0:
+        raise ValueError(f"dmax must be >= 0, got {dmax}")
+    R, C = rule_coefficients(rule, tie)
+    lut = np.zeros((dmax + 1, dmax + 1, 2), np.uint8)
+    for deg in range(dmax + 1):
+        for cnt in range(deg + 1):
+            for b in (0, 1):
+                s = 2 * b - 1
+                out = R * np.sign(2 * (2 * cnt - deg) + C * s)
+                lut[deg, cnt, b] = 1 if out == 1 else 0
+    return lut
+
+
+def lut_node_masks(deg_ext: np.ndarray, lut: np.ndarray) -> np.ndarray:
+    """Broadcast a ``[dmax+1, dmax+1, 2]`` table against the ghost-extended
+    degree sequence ``deg_ext: int[n+1]`` into packed word masks
+    ``uint32[dmax+1, 2, n+1]``: entry ``[cnt, b, i]`` is all-ones when
+    ``lut[deg_i, cnt, b]`` else all-zeros. The ghost row's update is
+    irrelevant (its word is forced back to zero every step), so its masks
+    are zero regardless of the table's degree-0 column."""
+    deg_ext = np.asarray(deg_ext)
+    dmax = lut.shape[0] - 1
+    if int(deg_ext[:-1].max(initial=0)) > dmax:
+        raise ValueError(
+            f"degree sequence exceeds the table's dmax={dmax} "
+            f"(max degree {int(deg_ext.max())})"
+        )
+    n1 = deg_ext.shape[0]
+    masks = np.zeros((dmax + 1, 2, n1), np.uint32)
+    for cnt in range(dmax + 1):
+        for b in (0, 1):
+            on = lut[np.minimum(deg_ext, dmax), cnt, b].astype(bool)
+            masks[cnt, b, on] = np.uint32(0xFFFFFFFF)
+    masks[:, :, n1 - 1] = 0          # ghost row: forced to zero anyway
+    return masks
+
+
+def _count_eq_masks(planes, dmax: int):
+    """Packed equality masks ``eq[c]`` (c = 0..dmax) of the bit-plane
+    counter against each constant count — all-ones words where the
+    per-replica popcount equals ``c``."""
+    out = []
+    full = jnp.uint32(_FULL)
+    zero = jnp.uint32(0)
+    for c in range(dmax + 1):
+        eq = jnp.full_like(planes[0], _FULL)
+        for k, pl in enumerate(planes):
+            bit = full if (c >> k) & 1 else zero
+            eq = eq & ~(pl ^ bit)
+        out.append(eq)
+    return out
+
+
+def lut_one_step(sp_ext, nbr_ext, lut_masks, *, n: int, dmax: int):
+    """One synchronous packed update of the ghost-extended state via the
+    LUT masks (``lut_masks: uint32[dmax+1, 2, n+1]`` — from
+    :func:`lut_node_masks`, as a device array): carry-save popcount over
+    the neighbor gather, then ``out = Σ_c eq_c & (prev ? m[c,1] : m[c,0])``.
+    Bit-identical to the hand-derived comparator step for the four shipped
+    (rule, tie) pairs (tested); the ghost word is forced back to zero."""
+    n_planes = max(int(dmax).bit_length(), 1)
+    planes = [jnp.zeros_like(sp_ext) for _ in range(n_planes)]
+    for j in range(dmax):
+        _csa_add_one(planes, jnp.take(sp_ext, nbr_ext[:, j], axis=0))
+    eqs = _count_eq_masks(planes, dmax)
+    out = jnp.zeros_like(sp_ext)
+    for c in range(dmax + 1):
+        m0 = lut_masks[c, 0][:, None]
+        m1 = lut_masks[c, 1][:, None]
+        out = out | (eqs[c] & ((sp_ext & m1) | (~sp_ext & m0)))
+    return out.at[n].set(jnp.uint32(0))

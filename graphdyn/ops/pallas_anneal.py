@@ -1,0 +1,553 @@
+"""One-kernel annealing: fused LUT-popcount SA with the schedule on device.
+
+The chromatic annealer (:mod:`graphdyn.ops.chromatic`) already runs a whole
+distance-2 color class per device step, but its chunk program re-derives
+the update from the hand-written comparator, draws uniforms through
+``jax.random`` host-key plumbing, and its drive loop polls a stop flag at
+every chunk boundary. The p-bit annealers in PAPERS.md (arXiv:2602.16143's
+dual-BRAM LUT engine, arXiv:2110.02481's sparse Ising machines) show the
+fully fused shape; this module ports it:
+
+- **LUT update** (:mod:`graphdyn.ops.lut`): the dynamics rule is a
+  ``[dmax+1, dmax+1, 2]`` popcount table compiled to packed word masks —
+  the end-state evaluations of the SA objective run through the table, so
+  ANY f(degree, count, spin) rule ships without new word logic (ROADMAP
+  item 4's compilation point).
+- **Counter-based RNG**: proposal/acceptance uniforms come from an
+  explicit Threefry-2x32 with counter ``(step, site)`` — no host key
+  stream, no state to carry; the SAME function body generates the bits in
+  the Pallas kernel, the XLA twin, and the numpy test oracle, so the
+  stream is pinned deterministic per (seed, site, step) and bit-identical
+  across execution modes and process restarts.
+- **Metropolis acceptance with exact per-site ΔE** via the additive
+  end-sum trick the chromatic kernel proved (two LUT one-step evals, CSA
+  ball popcounts, disjoint radius-1 balls ⇒ whole-class flip ≡ per-site
+  single flips).
+- **Device-resident schedule**: the geometric anneal (per-class-step
+  ``a·par_a^|class|`` with cap-before-multiply, per replica) advances
+  INSIDE the one while loop, so an entire fixed-budget SA run executes
+  with zero host transfers between snapshot boundaries.
+
+Two implementations of ONE chain law share :func:`_fused_class_step`
+verbatim:
+
+- :func:`fused_chunk_xla` — the jitted XLA program (ONE while loop over
+  class steps, donated carry; graftcheck pins its structure as the
+  ``fused_anneal`` ledger row). This is the CPU-container contract and the
+  fallback.
+- :func:`fused_chunk_pallas` — the same loop inside ONE ``pallas_call``:
+  state, tables and LUT masks VMEM-resident, uniforms generated in-kernel.
+  Interpret mode makes it tier-1-testable off-chip; whether the in-kernel
+  gathers beat XLA's is a chip-round question
+  (``scripts/pallas_tpu_validate.py`` checklist item 6). A runtime
+  lowering failure degrades to the XLA twin through the established
+  :func:`graphdyn.ops.bdcm.pallas_fallback_spec` / ``resilient_exec``
+  machinery (bit-parity is tested, so the fallback changes throughput,
+  not results).
+
+VMEM gate: :func:`fused_vmem_bytes` models the kernel's resident set (the
+``vmem_block_edges`` precedent); :func:`fused_kernel_supported` returns
+False when the state + tables + per-replica expansion do not fit — the
+fused Pallas kernel targets the search regime (the model admits
+n ≲ 1.1e4 at W=1 / d=3, ~4e3 at W=4, where time-to-target lives);
+larger graphs keep the XLA twin, which still never leaves the chip
+between snapshot boundaries.
+
+Replica lanes: the K-lane drive ladder (ROADMAP item 3's composition)
+rides the packed replica axis — per-replica ``(a, b, caps)`` vectors, so a
+β-scaled drive ladder is one broadcast, 32 lanes per uint32 word. A grid
+axis would buy one lane per grid step; the bit-parallel replica axis buys
+32 per word, so the ladder shares the kernel rather than the grid.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from graphdyn.config import SAConfig
+from graphdyn.ops.chromatic import (
+    ChromaticTables,
+    accept_apply,
+    build_chromatic_tables,
+)
+from graphdyn.ops.lut import lut_node_masks, lut_one_step, update_lut
+from graphdyn.ops.packed import WORD
+
+# key word 1 of the fused proposal stream (key word 0 is the run seed):
+# a fixed tag so the fused stream can never collide with jax.random keys
+# derived from the same seed
+FUSED_STREAM_TAG = 0x464C5554  # b"FLUT"
+
+#: per-core VMEM budget for the fused kernel's resident set — same margin
+#: reasoning as ops.pallas_bdcm.VMEM_BUDGET (the model underestimates the
+#: compiler's scoped-vmem demand by up to ~33%)
+FUSED_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+# ---------------------------------------------------------------------------
+# counter-based RNG (Threefry-2x32) — one body for kernel, XLA and numpy
+# ---------------------------------------------------------------------------
+
+
+def _rotl32(x, r: int):
+    """32-bit rotate-left via operators only, so the same body runs on
+    numpy uint32 arrays (the test oracle) and traced jnp values (the XLA
+    twin and the Pallas kernel)."""
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def threefry2x32(k0, k1, c0, c1):
+    """Threefry-2x32 (20 rounds, the jax.random stream cipher): keys
+    ``(k0, k1)``, counters ``(c0, c1)`` — uint32 arrays or scalars,
+    broadcastable. Returns two uint32 blocks. Operator-only arithmetic so
+    numpy and jnp share the body bit-for-bit."""
+    ks2 = k0 ^ k1 ^ np.uint32(0x1BD11BDA)
+    ks = (k0, k1, ks2)
+    x0 = c0 + k0
+    x1 = c1 + k1
+    rotations = ((13, 15, 26, 6), (17, 29, 16, 24))
+    for d in range(5):
+        for r in rotations[d % 2]:
+            x0 = x0 + x1
+            x1 = _rotl32(x1, r) ^ x0
+        x0 = x0 + ks[(d + 1) % 3]
+        x1 = x1 + ks[(d + 2) % 3] + np.uint32(d + 1)
+    return x0, x1
+
+
+def _bits_to_uniform(bits):
+    """uint32 bits → f32 uniforms in [0, 1): the top 24 bits scaled by
+    2^-24 — exact in f32 for numpy and XLA alike, so the host oracle and
+    both device paths see identical floats."""
+    return (bits >> np.uint32(8)).astype(np.float32) * np.float32(
+        1.0 / (1 << 24)
+    )
+
+
+def counter_uniforms(seed, step, n: int, Rp: int):
+    """The fused proposal stream: f32 uniforms ``[n, Rp]`` for class step
+    ``step``, deterministic per ``(seed, site, step)`` where site =
+    (node, replica). Layout: key ``(seed, FUSED_STREAM_TAG + replica-pair
+    index)``, counter ``(step, node)``; each Threefry block yields the
+    uniforms of replicas ``(2j, 2j+1)`` of its node. Independence across
+    sites and steps is key/counter distinctness; there is no sequential
+    state, so streams are reproducible from (seed, step) alone —
+    resume-invariant across chunk boundaries and process restarts — and
+    keying (not counting) the replica pair makes them invariant under
+    replica-count growth: replicas 0..R−1 of a wider run see the SAME
+    stream (pair granularity; the chromatic driver's word-granularity
+    contract, sharpened)."""
+    pairs = Rp // 2
+    node = lax.broadcasted_iota(jnp.uint32, (n, pairs), 0)
+    pair = lax.broadcasted_iota(jnp.uint32, (n, pairs), 1)
+    k0 = jnp.asarray(seed, jnp.uint32)
+    k1 = jnp.uint32(FUSED_STREAM_TAG) + pair
+    c0 = jnp.full((n, pairs), 1, jnp.uint32) * jnp.asarray(step, jnp.uint32)
+    y0, y1 = threefry2x32(k0, k1, c0, node)
+    u = jnp.stack([y0, y1], axis=2).reshape(n, Rp)
+    return _bits_to_uniform(u)
+
+
+def counter_uniforms_np(seed, step, n: int, Rp: int) -> np.ndarray:
+    """The numpy mirror of :func:`counter_uniforms` — same Threefry body,
+    same key/counter layout, bit-identical floats; the test oracle's
+    stream."""
+    pairs = Rp // 2
+    node = np.broadcast_to(
+        np.arange(n, dtype=np.uint32)[:, None], (n, pairs)
+    )
+    k1 = (np.uint32(FUSED_STREAM_TAG)
+          + np.arange(pairs, dtype=np.uint32)[None, :])
+    c0 = np.full((n, pairs), np.uint32(step), np.uint32)
+    with np.errstate(over="ignore"):
+        y0, y1 = threefry2x32(np.uint32(seed), k1, c0, node)
+    u = np.stack([y0, y1], axis=2).reshape(n, Rp)
+    return _bits_to_uniform(u)
+
+
+# ---------------------------------------------------------------------------
+# tables + VMEM model
+# ---------------------------------------------------------------------------
+
+
+class FusedTables(NamedTuple):
+    """Host-side setup of the fused annealer (numpy arrays): the chromatic
+    distance-2 machinery plus the LUT word masks and the per-class anneal
+    factors (``par**|class|`` — the schedule advances at class
+    granularity, mirroring the chromatic chain)."""
+
+    chrom: ChromaticTables
+    masks_ext: np.ndarray   # uint32[χ, n+1] — ghost column 0
+    lut_masks: np.ndarray   # uint32[dmax+1, 2, n+1]
+    fac_a: np.ndarray       # f32[χ]
+    fac_b: np.ndarray       # f32[χ]
+
+    @property
+    def chi(self) -> int:
+        return self.chrom.chi
+
+    @property
+    def n(self) -> int:
+        return self.chrom.n
+
+    @property
+    def dmax(self) -> int:
+        return self.chrom.dmax
+
+
+def build_fused_tables(graph, config: SAConfig | None = None, *,
+                       seed: int = 0) -> FusedTables:
+    """Distance-2 coloring + LUT masks + anneal factors for ``graph``
+    (deterministic per ``seed``; the coloring validity refusal lives in
+    :func:`graphdyn.ops.chromatic.build_chromatic_tables`)."""
+    config = config or SAConfig()
+    dyn = config.dynamics
+    chrom = build_chromatic_tables(graph, seed=seed)
+    masks_ext = np.concatenate(
+        [chrom.masks, np.zeros((chrom.chi, 1), np.uint32)], axis=1
+    )
+    lut = update_lut(chrom.dmax, dyn.rule, dyn.tie)
+    lm = lut_node_masks(chrom.deg_ext, lut)
+    sizes = chrom.class_sizes.astype(np.float64)  # graftlint: disable=GD004  host staging; fac cast to f32 below
+    fac_a = (config.par_a ** sizes).astype(np.float32)
+    fac_b = (config.par_b ** sizes).astype(np.float32)
+    return FusedTables(chrom=chrom, masks_ext=masks_ext, lut_masks=lm,
+                       fac_a=fac_a, fac_b=fac_b)
+
+
+def fused_vmem_bytes(n: int, W: int, chi: int, dmax: int) -> int:
+    """Resident-set byte model of the fused Pallas kernel (f32/int32 =
+    4 B; ``Rp = 32·W`` expanded replica lanes):
+
+    - packed state carry, double-buffered across loop iterations:
+      ``2·4·(n+1)·W``
+    - CSA planes + count-equality masks: ``(⌈log₂(dmax+1)⌉ + dmax+1)·
+      4·(n+1)·W``
+    - tables: class masks ``4·χ·(n+1)``, LUT masks ``8·(dmax+1)·(n+1)``,
+      neighbor + ball gather tables ``4·(n+1)·(2·dmax+1)``
+    - the per-replica expansion (uniforms, ball counts ×2, unpacked
+      spins, ΔE, accept mask): ``6·4·(n+1)·Rp`` — the dominant term; the
+      32× unpack is what caps the kernel at search-regime n.
+    """
+    Rp = WORD * W
+    n1 = n + 1
+    n_planes = max(int(dmax).bit_length(), 1)
+    return 4 * n1 * (
+        W * (2 + n_planes + dmax + 1)
+        + chi
+        + 2 * (dmax + 1)
+        + (2 * dmax + 1)
+        + 6 * Rp
+    )
+
+
+def fused_kernel_supported(n: int, W: int, chi: int, dmax: int,
+                           budget: int = FUSED_VMEM_BUDGET) -> bool:
+    """Static admission of the fused Pallas kernel: the modeled resident
+    set fits the VMEM budget. A False keeps the chain on the XLA twin
+    (same chain law — the choice moves throughput, never results)."""
+    return fused_vmem_bytes(n, W, chi, dmax) <= budget
+
+
+# ---------------------------------------------------------------------------
+# the chain law: ONE class-step body shared by XLA twin and Pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def _fused_class_step(
+    sp_ext, u, mask_row_ext, fa, fb,
+    sum_end, a, b, t_target, active, steps, accepted,
+    nbr_ext, nbr_self, lut_masks_dev, a_caps, b_caps,
+    *, n: int, dmax: int, target_sum: int,
+):
+    """One fused class step on the ghost-extended packed state: LUT
+    end-state evals, exact per-site ΔE from disjoint-ball popcounts,
+    Metropolis accept against the caller's uniforms, additive ``Σs_end``,
+    per-replica anneal (cap checked before the multiply), first-passage
+    record + freeze. Pure function of its inputs — the XLA while body, the
+    Pallas kernel loop and the oracle test all call THIS, so the chain law
+    cannot drift between execution modes."""
+    end = lut_one_step(sp_ext, nbr_ext, lut_masks_dev, n=n, dmax=dmax)
+    end_all = lut_one_step(
+        sp_ext ^ mask_row_ext[:, None], nbr_ext, lut_masks_dev,
+        n=n, dmax=dmax,
+    )
+    sp_new, acc, dsend_tot = accept_apply(
+        sp_ext, end, end_all, u, mask_row_ext[:n], a, b, active,
+        nbr_self, n=n,
+    )
+    sum_end = sum_end + dsend_tot
+    a_new = jnp.where(active & (a < a_caps), a * fa, a)
+    b_new = jnp.where(active & (b < b_caps), b * fb, b)
+    steps = steps + 1
+    hit = active & (sum_end >= target_sum)
+    t_target = jnp.where(hit, steps, t_target)
+    active = active & ~hit
+    accepted = accepted + jnp.sum(acc.astype(jnp.int32))
+    return (sp_new, sum_end, a_new, b_new, t_target, active, steps,
+            accepted)
+
+
+class FusedState(NamedTuple):
+    """Device carry of the fused annealer. The packed state is carried
+    ghost-EXTENDED (``[n+1, W]``, ghost word pinned 0) so no per-step
+    concatenate re-reads the state (the ``packed_rollout`` ghost-carry
+    lesson). Replica axis padded to ``Rp = 32·W``; pad lanes frozen by
+    ``active``."""
+
+    sp_ext: jnp.ndarray     # uint32[n+1, W]
+    sum_end: jnp.ndarray    # int32[Rp]
+    a: jnp.ndarray          # f32[Rp]
+    b: jnp.ndarray          # f32[Rp]
+    t_target: jnp.ndarray   # int32[Rp] — first-passage class step, −1
+    active: jnp.ndarray     # bool[Rp]
+    steps: jnp.ndarray      # int32[] — global class-step index (the RNG
+    #                         counter, so chunk splits cannot change the
+    #                         chain)
+    accepted: jnp.ndarray   # int32[]
+
+
+def _fused_cond_body(masks_ext, facs, nbr_ext, nbr_self, lut_masks_dev,
+                     a_caps, b_caps, seed, *, n, dmax, chi, target_sum,
+                     chunk_steps, stop_on_first, steps0):
+    """The (cond, body) pair of the ONE fused while loop — over flat class
+    steps (class index = steps % χ), shared verbatim by the XLA twin and
+    the Pallas kernel so GC106's while-count band pins both."""
+
+    def cond(carry):
+        st: FusedState = carry
+        go = jnp.any(st.active) & (st.steps - steps0 < chunk_steps)
+        if stop_on_first:
+            go = go & ~jnp.any(st.t_target >= 0)
+        return go
+
+    def body(carry):
+        st: FusedState = carry
+        c_idx = lax.rem(st.steps, jnp.int32(chi))
+        mask_row_ext = lax.dynamic_index_in_dim(
+            masks_ext, c_idx, 0, keepdims=False
+        )
+        fa = lax.dynamic_index_in_dim(facs[:, 0], c_idx, 0, keepdims=False)
+        fb = lax.dynamic_index_in_dim(facs[:, 1], c_idx, 0, keepdims=False)
+        u = counter_uniforms(seed, st.steps.astype(jnp.uint32), n,
+                             st.sum_end.shape[0])
+        (sp_new, sum_end, a_new, b_new, t_target, active, steps,
+         accepted) = _fused_class_step(
+            st.sp_ext, u, mask_row_ext, fa, fb,
+            st.sum_end, st.a, st.b, st.t_target, st.active, st.steps,
+            st.accepted, nbr_ext, nbr_self, lut_masks_dev,
+            a_caps, b_caps, n=n, dmax=dmax, target_sum=target_sum,
+        )
+        return FusedState(sp_new, sum_end, a_new, b_new, t_target, active,
+                          steps, accepted)
+
+    return cond, body
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n", "dmax", "chi", "target_sum",
+                     "chunk_steps", "stop_on_first"),
+    donate_argnames=("state",),
+)
+def fused_chunk_xla(
+    state: FusedState,
+    seed,
+    masks_ext, facs, nbr_ext, nbr_self, lut_masks_dev, a_caps, b_caps,
+    *,
+    n: int, dmax: int, chi: int, target_sum: int,
+    chunk_steps: int, stop_on_first: bool = False,
+):
+    """Advance up to ``chunk_steps`` class steps as ONE device program —
+    one while loop, donated carry (graftcheck's ``fused_anneal`` ledger
+    row pins exactly this structure: GC106 while-count 1 per band, GC001
+    donation, no baked host constants — every table arrives as an
+    argument)."""
+    cond, body = _fused_cond_body(
+        masks_ext, facs, nbr_ext, nbr_self, lut_masks_dev,
+        a_caps, b_caps, jnp.asarray(seed, jnp.uint32),
+        n=n, dmax=dmax, chi=chi,
+        target_sum=target_sum, chunk_steps=chunk_steps,
+        stop_on_first=stop_on_first, steps0=state.steps,
+    )
+    return lax.while_loop(cond, body, state)
+
+
+# ---------------------------------------------------------------------------
+# the Pallas kernel: the same loop inside one pallas_call
+# ---------------------------------------------------------------------------
+
+
+def _make_fused_kernel(*, n, dmax, chi, target_sum,
+                       chunk_steps, stop_on_first):
+    def kernel(
+        seed_ref, cnt_ref,                       # SMEM scalars
+        sp_ref, se_ref, a_ref, b_ref, tt_ref, act_ref,   # state (aliased)
+        masks_ref, facs_ref, nbr_ref, nbrs_ref, lutm_ref,  # tables
+        acap_ref, bcap_ref,
+        sp_out, se_out, a_out, b_out, tt_out, act_out, cnt_out,
+    ):
+        state = FusedState(
+            sp_ext=sp_ref[:],
+            sum_end=se_ref[0, :],
+            a=a_ref[0, :],
+            b=b_ref[0, :],
+            t_target=tt_ref[0, :],
+            active=act_ref[0, :] != 0,
+            steps=cnt_ref[0],
+            accepted=cnt_ref[1],
+        )
+        cond, body = _fused_cond_body(
+            masks_ref[:], facs_ref[:], nbr_ref[:], nbrs_ref[:], lutm_ref[:],
+            acap_ref[0, :], bcap_ref[0, :], seed_ref[0],
+            n=n, dmax=dmax, chi=chi,
+            target_sum=target_sum, chunk_steps=chunk_steps,
+            stop_on_first=stop_on_first, steps0=cnt_ref[0],
+        )
+        st = lax.while_loop(cond, body, state)
+        sp_out[:] = st.sp_ext
+        se_out[0, :] = st.sum_end
+        a_out[0, :] = st.a
+        b_out[0, :] = st.b
+        tt_out[0, :] = st.t_target
+        act_out[0, :] = st.active.astype(jnp.int32)
+        cnt_out[0] = st.steps
+        cnt_out[1] = st.accepted
+
+    return kernel
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n", "dmax", "chi", "target_sum",
+                     "chunk_steps", "stop_on_first", "interpret"),
+    donate_argnames=("state",),
+)
+def fused_chunk_pallas(
+    state: FusedState,
+    seed,
+    masks_ext, facs, nbr_ext, nbr_self, lut_masks_dev, a_caps, b_caps,
+    *,
+    n: int, dmax: int, chi: int, target_sum: int,
+    chunk_steps: int, stop_on_first: bool = False,
+    interpret: bool = False,
+):
+    """The fused chunk as ONE ``pallas_call``: the whole state + tables
+    sit VMEM-resident (gate via :func:`fused_kernel_supported`), the while
+    loop runs inside the kernel, uniforms are generated in-kernel from the
+    counter RNG, and the state buffers are input/output-aliased (the
+    donation contract). Bit-identical to :func:`fused_chunk_xla` — the
+    loop body IS :func:`_fused_class_step` in both (tested, interpret
+    mode)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    W = state.sp_ext.shape[1]
+    Rp = state.sum_end.shape[0]
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    kernel = _make_fused_kernel(
+        n=n, dmax=dmax, chi=chi,
+        target_sum=target_sum, chunk_steps=chunk_steps,
+        stop_on_first=stop_on_first,
+    )
+    out = pl.pallas_call(
+        kernel,
+        in_specs=[smem, smem] + [vmem] * 13,
+        out_specs=(vmem, vmem, vmem, vmem, vmem, vmem, smem),
+        out_shape=(
+            jax.ShapeDtypeStruct((n + 1, W), jnp.uint32),    # sp_ext
+            jax.ShapeDtypeStruct((1, Rp), jnp.int32),        # sum_end
+            jax.ShapeDtypeStruct((1, Rp), jnp.float32),      # a
+            jax.ShapeDtypeStruct((1, Rp), jnp.float32),      # b
+            jax.ShapeDtypeStruct((1, Rp), jnp.int32),        # t_target
+            jax.ShapeDtypeStruct((1, Rp), jnp.int32),        # active
+            jax.ShapeDtypeStruct((2,), jnp.int32),           # counters
+        ),
+        # state buffers update in place chunk-to-chunk: inputs 2..7 alias
+        # outputs 0..5, the counter scalar pair aliases output 6
+        input_output_aliases={2: 0, 3: 1, 4: 2, 5: 3, 6: 4, 7: 5, 1: 6},
+        interpret=interpret,
+    )(
+        jnp.asarray(seed, jnp.uint32).reshape(1),
+        jnp.stack([state.steps.astype(jnp.int32),
+                   state.accepted.astype(jnp.int32)]),
+        state.sp_ext,
+        state.sum_end.reshape(1, Rp),
+        state.a.reshape(1, Rp),
+        state.b.reshape(1, Rp),
+        state.t_target.reshape(1, Rp),
+        state.active.astype(jnp.int32).reshape(1, Rp),
+        masks_ext, facs, nbr_ext, nbr_self, lut_masks_dev,
+        a_caps.reshape(1, Rp), b_caps.reshape(1, Rp),
+    )
+    sp_ext, se, a, b, tt, act, cnt = out
+    return FusedState(
+        sp_ext=sp_ext,
+        sum_end=se[0],
+        a=a[0],
+        b=b[0],
+        t_target=tt[0],
+        active=act[0] != 0,
+        steps=cnt[0],
+        accepted=cnt[1],
+    )
+
+
+# ---------------------------------------------------------------------------
+# mode resolution + runtime fallback (the shared bdcm machinery)
+# ---------------------------------------------------------------------------
+
+
+class _FusedSpec(NamedTuple):
+    """Kernel-mode holder duck-typed for
+    :func:`graphdyn.ops.bdcm.pallas_fallback_spec` (the ``pallas`` tuple
+    protocol): ``('tpu',)`` compiled kernel, ``('interpret',)`` interpret
+    mode (off-chip tests), ``('',)`` the XLA twin."""
+
+    pallas: tuple
+
+
+def resolve_fused_mode(kernel: str, *, n: int, W: int, chi: int,
+                       dmax: int) -> _FusedSpec:
+    """Static kernel choice: ``'auto'`` takes the Pallas kernel on TPU
+    backends when the VMEM model admits the shape; ``'pallas'`` forces it
+    (interpret mode off-TPU — a test mode, not a throughput mode);
+    ``'xla'`` keeps the twin. Runtime lowering failures degrade through
+    :func:`graphdyn.ops.bdcm.resilient_exec`."""
+    if kernel not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"kernel must be 'auto', 'xla' or 'pallas', got {kernel!r}"
+        )
+    # the tunneled plugin reports "tpu"; hedge "axon" like every other
+    # chip-backend allowlist (bdcm._pallas_class_modes, bench.on_chip)
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    fits = fused_kernel_supported(n, W, chi, dmax)
+    if kernel == "xla":
+        return _FusedSpec(("",))
+    if kernel == "pallas":
+        return _FusedSpec(("tpu",) if on_tpu else ("interpret",))
+    return _FusedSpec(("tpu",) if (on_tpu and fits) else ("",))
+
+
+def fused_chunk(state: FusedState, seed, tables_dev, spec: _FusedSpec,
+                **kwargs) -> FusedState:
+    """Dispatch one fused chunk under ``spec``'s mode. ``tables_dev`` is
+    the 7-tuple of device tables ``(masks_ext, facs, nbr_ext, nbr_self,
+    lut_masks, a_caps, b_caps)`` — the order of
+    ``fused_chunk_xla``/``fused_chunk_pallas``'s positional table args,
+    as ``search.fused._assemble_fused`` builds it."""
+    mode = spec.pallas[0]
+    if mode:
+        return fused_chunk_pallas(
+            state, seed, *tables_dev,
+            interpret=(mode == "interpret"), **kwargs,
+        )
+    return fused_chunk_xla(state, seed, *tables_dev, **kwargs)

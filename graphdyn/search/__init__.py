@@ -1,8 +1,13 @@
-"""graphdyn.search — faster SA search: replica-exchange tempering ladders
-and chromatic block sweeps (ROADMAP item 3; ARCHITECTURE.md "Search
-acceleration")."""
+"""graphdyn.search — faster SA search: replica-exchange tempering ladders,
+chromatic block sweeps and the fused one-kernel annealer (ROADMAP items 3
+and 7; ARCHITECTURE.md "Search acceleration" / "One-kernel annealing")."""
 
 from graphdyn.search.chromatic import ChromaticResult, chromatic_anneal
+from graphdyn.search.fused import (
+    FusedResult,
+    fused_anneal,
+    lower_fused_chunk,
+)
 from graphdyn.search.tempering import (
     TemperResult,
     ladder_betas,
@@ -12,9 +17,12 @@ from graphdyn.search.tempering import (
 
 __all__ = [
     "ChromaticResult",
+    "FusedResult",
     "TemperResult",
     "chromatic_anneal",
+    "fused_anneal",
     "ladder_betas",
+    "lower_fused_chunk",
     "lower_temper_chunk",
     "temper_search",
 ]

@@ -86,6 +86,13 @@ class _TemperState(NamedTuple):
     swap_acc: jnp.ndarray   # int32[] cumulative accepted pair swaps
 
 
+#: longest fixed-budget chunk plan a no-sync drive loop will dispatch:
+#: past this, thousands of potentially-no-op dispatches cost more than the
+#: one scalar readback they save, so auto mode keeps the stop test.
+#: Public: the fused driver (graphdyn.search.fused) shares the bound.
+MAX_FIXED_PLAN_CHUNKS = 4096
+
+
 def ladder_betas(n_lanes: int, beta_min: float = 1.0,
                  beta_max: float = 64.0) -> np.ndarray:
     """The default geometric **drive ladder**, reference → greedy. Lane
@@ -334,6 +341,7 @@ def temper_search(
     swap_moves: bool = True,
     m_target: float = 1.0,
     stop_on_first: bool = False,
+    sync_stop: bool | None = None,
     dtype=jnp.float32,
     checkpoint_path: str | None = None,
     checkpoint_interval_s: float = 30.0,
@@ -363,6 +371,20 @@ def temper_search(
     global, so a preempted ladder resumes bit-exact under a different
     ``mesh``/lane-shard count. ``mesh`` shards the lane axis via
     ``shard_stack`` (bit-identical to unsharded; tested).
+
+    ``sync_stop`` controls the per-chunk ``bool(jnp.any(...))`` stop test
+    of the drive loop (the one sanctioned device→host sync, GD014). The
+    default (None) keeps it only where it buys something: ``stop_on_first``
+    needs the poll to exit early, checkpointed runs poll inside
+    ``ChainCheckpointer.drive``, and an open-ended budget (the 2n³ default)
+    cannot be pre-planned. A FIXED-budget swap-free-or-not run
+    (``stop_on_first=False``, no checkpoint, plan ≤ 4096 chunks) instead
+    dispatches a host-computed chunk plan with NO readback between chunks
+    — lanes that stop early make the remaining chunks no-op dispatches
+    (the while cond is false immediately), and results are bit-identical
+    either way (tested; the ``tta_fixed_budget_sync`` bench row A/Bs the
+    saved sync). Forcing ``sync_stop=False`` with ``stop_on_first``, a
+    checkpoint, or an unplannable budget is refused.
     """
     config = config or SAConfig()
     n = graph.n
@@ -374,6 +396,11 @@ def temper_search(
         raise ValueError(f"m_target must be in (0, 1], got {m_target}")
     if swap_interval < 1:
         raise ValueError(f"swap_interval must be >= 1, got {swap_interval}")
+    if sync_stop is False and checkpoint_path is not None:
+        raise ValueError(
+            "sync_stop=False is incompatible with checkpoint_path: snapshot "
+            "scheduling polls lane liveness at every chunk boundary"
+        )
     target_sum = int(np.ceil(m_target * n))
 
     nbr_dev, state, loop_args, static, np_dt, place = _assemble_ladder(
@@ -449,12 +476,53 @@ def temper_search(
     else:
         from graphdyn.resilience.shutdown import raise_if_requested
 
-        while running(state):
-            state = advance(state)
-            # heartbeat + honor SIGTERM/--deadline at the swap boundary
-            # (exit 75; without a checkpoint there is nothing to snapshot
-            # — chains re-derive from the seed on requeue)
-            raise_if_requested(where="chunk")
+        # fixed-budget plan length: every active lane times out within
+        # max_steps + 1 body iterations, and a chunk advances active lanes
+        # swap_interval steps — past n_chunks full chunks no lane can be
+        # active, so the remaining budget is provably zero
+        n_chunks = -(-(int(static["max_steps"]) + 1) // int(swap_interval))
+        if sync_stop is None:
+            sync = bool(stop_on_first) or n_chunks > MAX_FIXED_PLAN_CHUNKS
+        else:
+            sync = bool(sync_stop)
+            if not sync and stop_on_first:
+                raise ValueError(
+                    "sync_stop=False is incompatible with stop_on_first: "
+                    "early exit IS the per-chunk stop test"
+                )
+            if not sync and n_chunks > MAX_FIXED_PLAN_CHUNKS:
+                raise ValueError(
+                    f"sync_stop=False needs a plannable budget: "
+                    f"max_steps={static['max_steps']} / swap_interval="
+                    f"{swap_interval} is {n_chunks} chunks (> "
+                    f"{MAX_FIXED_PLAN_CHUNKS}) — lower max_steps or raise "
+                    f"swap_interval"
+                )
+        if sync:
+            while running(state):
+                state = advance(state)
+                # heartbeat + honor SIGTERM/--deadline at the swap
+                # boundary (exit 75; without a checkpoint there is nothing
+                # to snapshot — chains re-derive from the seed on requeue)
+                raise_if_requested(where="chunk")
+        else:
+            # the rider fix: a fixed-budget run skips the per-chunk
+            # bool(jnp.any) readback entirely — chunks after every lane
+            # stops are no-op dispatches (while cond false immediately,
+            # swaps need active lanes), so results are bit-identical to
+            # the synced loop (tested) with zero device→host transfers
+            # between dispatch and the final readback. Each boundary
+            # still fences on chunk COMPLETION (a wait, not a transfer):
+            # without it async dispatch would enqueue every chunk in
+            # milliseconds, the heartbeats would all predate the device
+            # work, and a healthy long run would read as wedged to the
+            # PR-10 watchdog while SIGTERM went unhonored until the
+            # whole budget drained
+            for _ in range(n_chunks):
+                state = advance(state)
+                # graftlint: disable-next-line=GD014  liveness fence: completion wait, zero transfers
+                state.chunk_t.block_until_ready()
+                raise_if_requested(where="chunk")
 
     t_target = np.asarray(state.t_target)
     reached = t_target >= 0

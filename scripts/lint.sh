@@ -84,9 +84,10 @@ fi
 # 5. pallascheck — the interpret-mode Pallas kernel parity subset
 #    standalone (pytest -m pallas_interpret): the fused BDCM kernel —
 #    serial and grouped — must reproduce the XLA sweep within the
-#    documented tolerance, and grouped must equal G=1 bit-exactly, on
-#    every PR, not only when a chip window happens to run
-#    scripts/pallas_tpu_validate.py. Skipped with a notice when pytest is
+#    documented tolerance, grouped must equal G=1 bit-exactly, and the
+#    fused one-kernel annealer (ops/pallas_anneal) must equal its XLA
+#    twin bit-for-bit, on every PR, not only when a chip window happens
+#    to run scripts/pallas_tpu_validate.py. Skipped with a notice when pytest is
 #    absent, or when GRAPHDYN_SKIP_PALLASCHECK=1 (set by the tier-1
 #    lint-gate test: the same subset already runs in the suite proper —
 #    no double work; mirrors faultcheck).
@@ -335,6 +336,34 @@ if row["tta_tempering"] is not None:
     assert (row["swap_acceptance_rate"] or 0) > 0, \
         "measured tta_tempering with a DEAD ladder (swap_acceptance_rate " \
         f"= {row['swap_acceptance_rate']}) — swaps never accepted"
+# the fused one-kernel annealer rows: tta_fused (device-step A/B, runs on
+# CPU — counts are seed-deterministic) and fused_sa_rate (chip-only
+# throughput) — both null-or-positive, never 0.0
+assert "tta_fused" in row, "tta_fused row absent"
+tf = row["tta_fused"]
+if tf is None:
+    assert row.get("tta_fused_skipped_reason"), \
+        "null tta_fused needs tta_fused_skipped_reason"
+    print("benchcheck: tta_fused skipped:", row["tta_fused_skipped_reason"])
+else:
+    assert tf.get("speedup_x", 0) > 0, tf
+    assert tf.get("device_steps", 0) > 0, tf
+    assert tf.get("kernel") in ("xla", "pallas", "pallas-interpret"), tf
+assert "fused_sa_rate" in row, "fused_sa_rate column absent"
+fsr = row["fused_sa_rate"]
+if fsr is None:
+    assert row.get("fused_sa_rate_skipped_reason"), \
+        "null fused_sa_rate needs fused_sa_rate_skipped_reason"
+    print("benchcheck: fused_sa_rate skipped:",
+          row["fused_sa_rate_skipped_reason"])
+else:
+    assert fsr > 0, f"fused_sa_rate must be > 0 or null+reason: {fsr}"
+# the rider A/B (saved per-chunk sync on a fixed-budget ladder) rides in
+# the tta row whenever the tta legs measured
+if row["tta_tempering"] is not None:
+    sab = row.get("tta_fixed_budget_sync")
+    assert sab and sab.get("sync_s", 0) > 0 and sab.get("nosync_s", 0) > 0, \
+        f"measured tta row without a valid tta_fixed_budget_sync A/B: {sab}"
 # the durable-store save-overhead column: an interleaved p50/p99 A/B of
 # DurableCheckpoint.save vs raw Checkpoint.save, or an explicit null +
 # reason — never silently absent

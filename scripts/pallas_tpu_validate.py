@@ -36,6 +36,23 @@ bands are provisional until one does):
    ``swap_acceptance_rate`` lands in the committed 0.2–0.9 healthy band
    at the full shape and record the measured wall-clock per leg from the
    round's obs ledger (``bench.tta`` spans) next to the step counts.
+6. One-kernel annealer on chip (first COMPILED run of
+   ``ops/pallas_anneal`` — the CPU container can only interpret it):
+   (a) ``fused_anneal(kernel='pallas')`` at the graftcheck canonical
+   shape (RRG n=48 d=3, R=32, 4 sweeps) must be bit-identical to
+   ``kernel='xla'`` on the same seeds — state, ``Σs_end``, first
+   passages, accept counts (the tier-1 interpret-parity test, now
+   compiled; the counter RNG is integer arithmetic, so any divergence
+   is a lowering bug, not float noise); if Mosaic rejects the in-kernel
+   gathers, confirm the ``resilient_exec`` fallback rebuilds to the XLA
+   twin and record WHICH construct failed — that answer scopes the v2
+   kernel. (b) step 1's ``fused_sa_rate`` row measures for real
+   (null+reason on CPU): record proposals/s vs the packed-rollout
+   headline and vs ``tta_tempering``'s per-leg wall clock, and
+   re-center ``FUSED_VMEM_BUDGET`` if the compiler's scoped-vmem charge
+   differs from the ``fused_vmem_bytes`` model by more than the
+   documented ~33% margin. (c) the ``tta_fused`` device-step counts
+   must match the CPU rows bit-for-bit (same contract as item 5).
 """
 
 from __future__ import annotations

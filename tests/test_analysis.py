@@ -1009,6 +1009,147 @@ class TestGD014SearchLoopSync:
         assert [f for f in lint_sources(sources) if f.code == "GD014"] == []
 
 
+class TestGD015AnnealLoopSync:
+    """Per-temperature-step host syncs in a ``graphdyn/models/`` anneal
+    drive loop: the schedule advances inside the device program
+    (``metropolis_anneal_update``; the fused annealer keeps whole runs on
+    device), so a drive loop reading the device back per step caps
+    time-to-target on the host link (ARCHITECTURE.md "One-kernel
+    annealing")."""
+
+    MODELS = "graphdyn/models/annealer.py"
+    BAD_ITEM = (
+        "def anneal(state, step, n_temps):\n"
+        "    for t in range(n_temps):\n"
+        "        state = step(state)\n"
+        "        if state.m_final.item() >= 1.0:\n"     # GD015
+        "            break\n"
+        "    return state\n"
+    )
+    BAD_DEVICE_GET = (
+        "import jax\n"
+        "def anneal(state, step, n_temps):\n"
+        "    for t in range(n_temps):\n"
+        "        state = step(state)\n"
+        "        log(jax.device_get(state.energy))\n"   # GD015
+        "    return state\n"
+    )
+    BAD_BOOL_SYNC = (
+        "import jax.numpy as jnp\n"
+        "def anneal(state, step):\n"
+        "    while True:\n"
+        "        state = step(state)\n"
+        "        if not bool(jnp.any(state.active)):\n"  # GD015
+        "            break\n"
+        "    return state\n"
+    )
+    BAD_BLOCK = (
+        "def anneal(state, step, n_temps):\n"
+        "    for t in range(n_temps):\n"
+        "        state = step(state)\n"
+        "        state.s.block_until_ready()\n"          # GD015
+        "    return state\n"
+    )
+    GOOD_HOST_BOOKKEEPING = (
+        "def anneal(state, step, metas):\n"
+        "    out = []\n"
+        "    for meta in metas:\n"
+        "        state = step(state)\n"
+        "        out.append(bool(meta.get('failed')))\n"  # host value
+        "    return state, out\n"
+    )
+    GOOD_JIT_LOOP = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def body(x):\n"
+        "    for j in range(4):\n"                   # unrolls at trace time
+        "        x = x + jnp.float32(j)\n"
+        "    return x\n"
+    )
+    GOOD_POST_LOOP_READBACK = (
+        "import numpy as np\n"
+        "def anneal(state, step, n_temps):\n"
+        "    for t in range(n_temps):\n"
+        "        state = step(state)\n"
+        "    return np.asarray(state.s)\n"           # ONE readback, after
+    )
+
+    def test_bad_item(self):
+        assert "GD015" in _codes(self.BAD_ITEM, path=self.MODELS)
+
+    def test_bad_device_get(self):
+        assert "GD015" in _codes(self.BAD_DEVICE_GET, path=self.MODELS)
+
+    def test_bad_bool_of_device_value(self):
+        assert "GD015" in _codes(self.BAD_BOOL_SYNC, path=self.MODELS)
+
+    BAD_INT_SYNC = (
+        "import jax.numpy as jnp\n"
+        "def anneal(state, step, n_temps, target):\n"
+        "    for t in range(n_temps):\n"
+        "        state = step(state)\n"
+        "        if int(jnp.sum(state.sum_end)) >= target:\n"  # GD015
+        "            break\n"
+        "    return state\n"
+    )
+    BAD_FLOAT_SYNC = (
+        "import jax.numpy as jnp\n"
+        "def anneal(state, step, n_temps):\n"
+        "    for t in range(n_temps):\n"
+        "        state = step(state)\n"
+        "        log(float(jnp.max(state.m)))\n"               # GD015
+        "    return state\n"
+    )
+
+    def test_bad_int_float_of_device_call(self):
+        assert "GD015" in _codes(self.BAD_INT_SYNC, path=self.MODELS)
+        assert "GD015" in _codes(self.BAD_FLOAT_SYNC, path=self.MODELS)
+
+    def test_bad_block_until_ready(self):
+        assert "GD015" in _codes(self.BAD_BLOCK, path=self.MODELS)
+
+    def test_good_host_bookkeeping_bool(self):
+        assert "GD015" not in _codes(self.GOOD_HOST_BOOKKEEPING,
+                                     path=self.MODELS)
+
+    def test_good_jit_loop_exempt(self):
+        assert "GD015" not in _codes(self.GOOD_JIT_LOOP, path=self.MODELS)
+
+    def test_good_post_loop_readback(self):
+        assert "GD015" not in _codes(self.GOOD_POST_LOOP_READBACK,
+                                     path=self.MODELS)
+
+    def test_non_models_module_exempt(self):
+        for path in ("graphdyn/search/tempering.py",
+                     "graphdyn/pipeline/groups.py", "bench.py"):
+            assert "GD015" not in _codes(self.BAD_ITEM, path=path), path
+
+    def test_disable_comment(self):
+        src = self.BAD_ITEM.replace(
+            "        if state.m_final.item() >= 1.0:",
+            "        # graftlint: disable-next-line=GD015  debug probe\n"
+            "        if state.m_final.item() >= 1.0:",
+        )
+        assert _codes(src, path=self.MODELS) == []
+
+    def test_catalogued(self):
+        assert "GD015" in RULES
+
+    def test_shipped_models_clean(self):
+        """The shipped solvers honor the rule with no disables: their
+        schedules advance inside the device loops, and the only drive
+        polls are chunk-granular (utils/io — out of scope)."""
+        from pathlib import Path
+
+        root = Path(__file__).resolve().parent.parent
+        sources = [
+            (str(p), p.read_text())
+            for p in sorted((root / "graphdyn" / "models").glob("*.py"))
+        ]
+        assert [f for f in lint_sources(sources) if f.code == "GD015"] == []
+
+
 class TestGD007AtomicPersistence:
     BAD_SAVEZ = (
         "import numpy as np\n"
@@ -1185,7 +1326,7 @@ def test_unreadable_file_is_a_finding(tmp_path):
 
 
 def test_rules_registry_complete():
-    assert set(RULES) == {f"GD{i:03d}" for i in range(1, 15)}
+    assert set(RULES) == {f"GD{i:03d}" for i in range(1, 16)}
 
 
 def test_cli_json_is_one_document_stdout_only(tmp_path):

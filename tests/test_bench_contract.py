@@ -122,6 +122,27 @@ def test_bench_smoke_emits_one_json_line():
     assert "swap_acceptance_rate" in row
     if row["tta_tempering"] is not None:
         assert row["swap_acceptance_rate"] > 0
+    # the fused one-kernel annealer rows: tta_fused measures on CPU too
+    # (device-step counts, seed-deterministic), fused_sa_rate is chip-only
+    # — both null-or-positive, never 0.0
+    assert "tta_fused" in row
+    if row["tta_fused"] is None:
+        assert row["tta_fused_skipped_reason"]
+    else:
+        assert row["tta_fused"]["speedup_x"] > 0
+        assert row["tta_fused"]["device_steps"] > 0
+        assert row["tta_fused"]["kernel"] in (
+            "xla", "pallas", "pallas-interpret")
+    assert "fused_sa_rate" in row
+    if row["fused_sa_rate"] is None:
+        assert "chip-only" in row["fused_sa_rate_skipped_reason"]
+    else:
+        assert row["fused_sa_rate"] > 0
+    # the rider A/B (saved per-chunk sync) rides with measured tta legs
+    if row["tta_tempering"] is not None:
+        sab = row["tta_fixed_budget_sync"]
+        assert sab["sync_s"] > 0 and sab["nosync_s"] > 0
+        assert sab["sync_saved_x"] > 0
     # the cross-round rate trend gate RAN (or was explicitly skipped) and
     # found no unblessed drift — the benchcheck contract
     status = row.get("obs_trend_status")

@@ -1,0 +1,119 @@
+"""ops/lut: the per-degree update-LUT generator and its packed application.
+
+The contract (ISSUE 14 satellite): :func:`update_lut` is exhaustively
+oracle-exact against :func:`graphdyn.ops.dynamics.step_spins` over ALL
+(degree ≤ dmax, popcount ≤ degree, spin) triples for every (rule, tie)
+pair — the oracle is the reference's ``R·sign(2Σ + C·s)`` integer form run
+through the shipped kernel on star graphs, not the LUT formula itself —
+and :func:`lut_one_step` is bit-identical to the hand-derived packed
+comparator step on RRG and ragged ER degree sequences. This is the
+groundwork ROADMAP item 4's rule axis compiles into."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from graphdyn.config import DynamicsConfig, SAConfig
+from graphdyn.graphs import erdos_renyi_graph, random_regular_graph
+from graphdyn.ops.dynamics import Rule, TieBreak, step_spins
+from graphdyn.ops.lut import lut_node_masks, lut_one_step, update_lut
+
+ALL_PAIRS = [(r, t) for r in ("majority", "minority")
+             for t in ("stay", "change")]
+
+
+@pytest.mark.parametrize("rule,tie", ALL_PAIRS)
+@pytest.mark.parametrize("dmax", [1, 3, 4, 6])
+def test_update_lut_exhaustive_star_oracle(rule, tie, dmax):
+    """Every (deg, cnt, spin) entry equals one synchronous step of the
+    shipped dynamics kernel on a star: node 0 has exactly ``deg``
+    neighbors of which ``cnt`` are +1."""
+    lut = update_lut(dmax, rule, tie)
+    assert lut.shape == (dmax + 1, dmax + 1, 2)
+    for deg in range(dmax + 1):
+        for cnt in range(deg + 1):
+            for b in (0, 1):
+                n = max(deg, 1) + 1
+                nbr = np.full((n, max(deg, 1)), n, np.int32)
+                if deg:
+                    nbr[0, :deg] = np.arange(1, deg + 1)
+                s = -np.ones(n, np.int8)
+                s[0] = 2 * b - 1
+                if deg:
+                    s[1:1 + cnt] = 1
+                out = int(np.asarray(
+                    step_spins(jnp.asarray(nbr), jnp.asarray(s), rule, tie)
+                )[0])
+                want = 1 if lut[deg, cnt, b] else -1
+                assert out == want, (rule, tie, deg, cnt, b)
+
+
+def test_update_lut_validations_and_mask_shapes():
+    with pytest.raises(ValueError, match="dmax"):
+        update_lut(-1)
+    lut = update_lut(3)
+    deg_ext = np.array([3, 2, 0, 3, 0], np.int64)   # last row = ghost
+    masks = lut_node_masks(deg_ext, lut)
+    assert masks.shape == (4, 2, 5)
+    assert set(np.unique(masks)) <= {0, 0xFFFFFFFF}
+    # the ghost row's masks are forced zero regardless of the table
+    assert (masks[:, :, -1] == 0).all()
+    # a degree above the table's dmax is refused, not silently clamped
+    with pytest.raises(ValueError, match="exceeds"):
+        lut_node_masks(np.array([5, 0]), lut)
+
+
+@pytest.mark.parametrize("rule,tie", ALL_PAIRS)
+@pytest.mark.parametrize("gname", ["rrg", "er"])
+def test_lut_one_step_matches_comparator_step(rule, tie, gname):
+    """The LUT application is bit-identical to the hand-derived packed
+    comparator step (``ops.chromatic._one_step`` over the shared
+    ``ops.packed`` helpers) on regular AND ragged degree sequences — the
+    structural bridge that lets the fused annealer swap rules without new
+    word logic."""
+    from graphdyn.ops.chromatic import _one_step, _threshold_words
+    from graphdyn.ops.packed import pack_spins
+
+    g = (random_regular_graph(64, 3, seed=0) if gname == "rrg"
+         else erdos_renyi_graph(50, 4.0 / 49, seed=1))
+    n, dmax = g.n, g.nbr.shape[1]
+    nbr_ext = jnp.asarray(np.concatenate(
+        [g.nbr, np.full((1, dmax), n, g.nbr.dtype)], axis=0
+    ).astype(np.int32))
+    deg_ext = np.concatenate([g.deg, [0]]).astype(np.int32)
+    rng = np.random.default_rng(2)
+    s = (2 * rng.integers(0, 2, size=(40, n)) - 1).astype(np.int8)
+    sp = pack_spins(s)
+    sp_ext = jnp.concatenate(
+        [jnp.asarray(sp), jnp.zeros((1, sp.shape[1]), jnp.uint32)], axis=0
+    )
+    lm = jnp.asarray(lut_node_masks(deg_ext, update_lut(dmax, rule, tie)))
+    got = lut_one_step(sp_ext, nbr_ext, lm, n=n, dmax=dmax)
+    n_planes = max(int(dmax).bit_length(), 1)
+    thr_bits, even = _threshold_words(jnp.asarray(deg_ext), n_planes)
+    want = _one_step(sp_ext, nbr_ext, thr_bits, even, n, dmax,
+                     Rule(rule), TieBreak(tie))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_fused_tables_compile_config_rule():
+    """build_fused_tables compiles the CONFIG's (rule, tie) into the
+    masks: a minority/change table differs from majority/stay on the same
+    graph, and the anneal factors are par**|class| per class."""
+    from graphdyn.ops.pallas_anneal import build_fused_tables
+
+    g = random_regular_graph(48, 3, seed=0)
+    maj = build_fused_tables(
+        g, SAConfig(dynamics=DynamicsConfig(p=1, c=1)), seed=0)
+    mino = build_fused_tables(
+        g, SAConfig(dynamics=DynamicsConfig(
+            p=1, c=1, rule="minority", tie="change")), seed=0)
+    assert not np.array_equal(maj.lut_masks, mino.lut_masks)
+    np.testing.assert_array_equal(maj.masks_ext, mino.masks_ext)
+    cfg = SAConfig(dynamics=DynamicsConfig(p=1, c=1))
+    sizes = maj.chrom.class_sizes
+    np.testing.assert_allclose(
+        maj.fac_a, (cfg.par_a ** sizes.astype(np.float64)).astype(np.float32))
+    assert maj.masks_ext.shape == (maj.chi, g.n + 1)
+    assert (maj.masks_ext[:, -1] == 0).all()
