@@ -24,6 +24,14 @@ on (ARCHITECTURE.md "Static analysis & contracts"):
   (``GRAPHDYN_SANITIZE=alias``): host→device crossings digest their source
   buffers and a mutation during the alias window raises
   :class:`~graphdyn.analysis.sanitize.AliasRaceError` deterministically.
+- :mod:`graphdyn.analysis.racecheck` — graftrace, the host-concurrency
+  auditor: an AST inventory of the thread/lock/shared-global surface
+  diffed against the committed ``CONCURRENCY_LEDGER.json`` (rules
+  GT001–GT005), plus the opt-in ``GRAPHDYN_RACECHECK=1`` runtime lock
+  proxy with ledger-asserted lock ordering and the ``GRAPHDYN_RACEFUZZ``
+  seeded schedule fuzzer. Run as
+  ``python -m graphdyn.analysis.racecheck [--update-ledger]``. NOT
+  imported here, mirroring graftcheck: the CLI entry stays import-light.
 """
 
 from graphdyn.analysis.contracts import ContractError, contract  # noqa: F401

@@ -253,28 +253,31 @@ class Finding(NamedTuple):
     message: str
 
 
-def _parse_codes(blob: str) -> set:
-    """Code list from everything after ``disable=``: comma-separated, each
-    piece's first whitespace token must be a GDxxx/all code — so a free-text
-    reason after a single space never corrupts the list (``disable=GD004
-    host staging`` still disables GD004)."""
-    codes = set()
-    for piece in blob.split(","):
-        tok = piece.split()[0] if piece.split() else ""
-        if _CODE_TOKEN.match(tok):
-            codes.add(tok.upper())
-    return codes
+def parse_disable_comments(src: str, disable_re: re.Pattern,
+                           code_token: re.Pattern):
+    """Generic disable-comment parser shared by the in-package linters
+    (graftlint, graftrace — each with its own comment prefix and code
+    regex): ``(same_line: {lineno: set}, next_line: {lineno: set},
+    file: set)``. Codes are comma-separated and each piece's first
+    whitespace token must match ``code_token`` — so a free-text reason
+    after a single space never corrupts the list (``disable=GD004 host
+    staging`` still disables GD004)."""
 
+    def parse_codes(blob: str) -> set:
+        codes = set()
+        for piece in blob.split(","):
+            tok = piece.split()[0] if piece.split() else ""
+            if code_token.match(tok):
+                codes.add(tok.upper())
+        return codes
 
-def _parse_disables(src: str):
-    """(same_line: {lineno: set}, next_line: {lineno: set}, file: set)."""
     same, nxt, whole = {}, {}, set()
     for i, text in enumerate(src.splitlines(), start=1):
-        m = _DISABLE_RE.search(text)
+        m = disable_re.search(text)
         if not m:
             continue
         kind = m.group(1)
-        codes = _parse_codes(m.group(2))
+        codes = parse_codes(m.group(2))
         if kind == "disable":
             same.setdefault(i, set()).update(codes)
         elif kind == "disable-next-line":
@@ -282,6 +285,11 @@ def _parse_disables(src: str):
         else:
             whole.update(codes)
     return same, nxt, whole
+
+
+def _parse_disables(src: str):
+    """(same_line: {lineno: set}, next_line: {lineno: set}, file: set)."""
+    return parse_disable_comments(src, _DISABLE_RE, _CODE_TOKEN)
 
 
 def _dotted(node: ast.AST) -> str:

@@ -646,6 +646,20 @@ def main(argv=None) -> int:
         keep=args.ckpt_keep if args.ckpt_keep is not None else _env_keep(),
     )
 
+    # GRAPHDYN_RACECHECK=1: wrap the inventoried module locks in the
+    # graftrace runtime proxy (graphdyn.analysis.racecheck) BEFORE any
+    # driver thread spawns — per-thread acquisition sequences land in the
+    # flight ring (a post-mortem names the lock a wedged run died waiting
+    # on), the observed lock order is asserted against the committed
+    # CONCURRENCY_LEDGER.json, and GRAPHDYN_RACEFUZZ=<seed> adds the
+    # deterministic schedule jitter. Off (the default) costs exactly this
+    # env check — the module is not even imported and the locks stay
+    # plain threading objects.
+    if _os.environ.get("GRAPHDYN_RACECHECK") == "1":
+        from graphdyn.analysis.racecheck import maybe_install
+
+        maybe_install()
+
     # GRAPHDYN_SANITIZE=alias: run the whole driver under the host-aliasing
     # sanitizer (graphdyn.analysis.sanitize) — a mutated host buffer whose
     # device alias is still alive becomes a deterministic AliasRaceError

@@ -32,6 +32,12 @@ site                 instrumented in
 ``mirror.write``     ``resilience.store.DurableCheckpoint._mirror_save`` —
                      ``raise`` simulates mirror-path ENOSPC: the primary
                      save proceeds, the journal records the degraded mirror
+``mirror.copy``      ``resilience.store.DurableCheckpoint._do_mirror_copy``
+                     — polled on the write-behind WORKER thread (env-plan
+                     injectable only); ``stall`` delays the replica copy,
+                     the graftrace schedule fuzzer's primitive for the
+                     flush-vs-exit race (``race_mirror_exit`` soak
+                     scenario)
 ``chunk.boundary``   ``utils.io.ChainCheckpointer.drive`` — ``preempt``
                      raises at the ``at``-th chunk boundary
 ``rep.boundary``     ``models.sa.sa_ensemble`` / ``models.hpr.hpr_ensemble``
@@ -200,13 +206,20 @@ def _stack() -> list:
 
 
 _env_plan_cache: list = []      # [] = unparsed, [None] or [FaultPlan] = parsed
+_env_plan_lock = threading.Lock()
 
 
 def _env_plan() -> FaultPlan | None:
     if not _env_plan_cache:
         # env plans live for the process (never on the with-stack); their
-        # hit counters run from the first consulted site onward
-        _env_plan_cache.append(FaultPlan.from_env())
+        # hit counters run from the first consulted site onward. Parsed
+        # under a lock: sites are polled from worker threads too (the
+        # write-behind mirror's `mirror.copy`), and two first-pollers
+        # racing the parse would each append a plan with its own hit
+        # counters — split counters make `at=` schedules nondeterministic
+        with _env_plan_lock:
+            if not _env_plan_cache:
+                _env_plan_cache.append(FaultPlan.from_env())
     return _env_plan_cache[0]
 
 
@@ -248,6 +261,7 @@ def check_fault(site: str, key: str = "") -> FaultSpec | None:
             # or a dead NFS mount looks like from the watchdog's seat. The
             # sleep is the whole fault; execution then continues normally,
             # so an UNsupervised run is perturbed only in wall-clock time.
+            # graftrace: disable-next-line=GT005  the injected fault primitive: this sleep IS the hang being simulated, not a synchronization idiom
             time.sleep(spec.secs)
     return spec
 

@@ -58,6 +58,24 @@ scenario             composition
                      (journal ``supervise.quarantine``, bundled
                      post-mortems, exit 86) instead of restarting an
                      N+1-th time
+``race_mirror_exit`` the graftrace schedule fuzzer
+                     (``GRAPHDYN_RACECHECK=1`` + ``GRAPHDYN_RACEFUZZ``)
+                     widens the mirror-flush-vs-process-exit race in a
+                     real subprocess: seeded lock jitter + a ``stall`` at
+                     the ``mirror.copy`` worker site while the child
+                     saves and falls off the end. With the atexit
+                     ``flush_mirror`` fix present the last replica is
+                     ALWAYS mirrored (green across every seed); the
+                     pinned-seed control leg re-runs with the fix
+                     reverted (``atexit.unregister``) and must LOSE the
+                     last replica — proving the fuzzer detects the
+                     historical bug class
+``race_prefetch_close`` prefetcher-close-vs-emit under the same seeded
+                     fuzzer, in-process: close() mid-stream with the
+                     worker mid-build/blocked on a full queue must not
+                     deadlock or leak, the fuzzed threaded stream stays
+                     bit-exact with the synchronous builds, and the
+                     overlap gauge lands exactly once per prefetcher
 ==================== ======================================================
 
 Run it: ``python -m graphdyn.resilience.soak [--bounded] [--seeds N]
@@ -77,6 +95,7 @@ import os
 import shutil
 import sys
 import tempfile
+import time
 
 import numpy as np
 
@@ -259,6 +278,18 @@ SCENARIOS: dict[str, Scenario] = {
                  require_ops=("supervise.start", "supervise.restart",
                               "supervise.quarantine"),
                  mode="crash_loop"),
+        Scenario("race_mirror_exit", "store",
+                 "seeded schedule fuzz on the mirror-flush-vs-exit race: "
+                 "the atexit flush must always deliver the last replica, "
+                 "and the pinned-seed reverted-fix control leg must lose "
+                 "it (the fuzzer detects the historical bug class)",
+                 mirror=True, require_ops=("save", "mirror.save"),
+                 mode="race_mirror"),
+        Scenario("race_prefetch_close", "pipeline",
+                 "seeded schedule fuzz on prefetcher close-vs-emit: no "
+                 "deadlock or thread leak, fuzzed stream bit-exact with "
+                 "synchronous builds, overlap gauge exactly once",
+                 mode="race_prefetch"),
     )
 }
 
@@ -442,6 +473,10 @@ def run_scenario(name: str, seed: int, root: str,
         return _run_hang_detect(scn, seed, root, oracle_cache)
     if scn.mode == "crash_loop":
         return _run_crash_loop(scn, seed, root, oracle_cache)
+    if scn.mode == "race_mirror":
+        return _run_race_mirror(scn, seed, root)
+    if scn.mode == "race_prefetch":
+        return _run_race_prefetch(scn, seed, root)
     rng = np.random.default_rng(seed)
     episodes = _plan_episodes(name, rng)
     workdir = os.path.join(root, name, f"seed{seed}")
@@ -710,6 +745,272 @@ def _run_crash_loop(scn: Scenario, seed: int, root: str,
     return {"scenario": scn.name, "seed": seed, "workload": scn.workload,
             "episodes": eps, "journal_ops": sorted(set(ops)),
             "problems": problems, "ok": not problems}
+
+
+# ---------------------------------------------------------------------------
+# graftrace seeded-schedule race scenarios (ARCHITECTURE.md "Host
+# concurrency model")
+# ---------------------------------------------------------------------------
+
+#: lock-jitter cap for the race scenarios (GRAPHDYN_RACEFUZZ_MAX_MS)
+RACE_FUZZ_MAX_MS = 30.0
+#: the mirror.copy stall (seconds) — must dwarf the child's whole
+#: main-thread runtime (≤ ~0.25 s incl. worst-case jitter) so the
+#: reverted-fix control leg loses the last replica DETERMINISTICALLY,
+#: while the fixed path's atexit flush (timeout 10 s) always drains
+RACE_STALL_SECS = 0.35
+#: saves per child: enough that the write-behind queue is realistically
+#: deep at exit
+RACE_SAVES = 4
+#: the control leg (fix reverted) runs at this seed only — pinned, so the
+#: red outcome is one reproducible schedule, not a per-seed lottery
+RACE_PIN_SEED = 0
+
+
+def _race_mirror_child(primary: str, mirror: str, revert: bool) -> str:
+    """The subprocess body of ``race_mirror_exit``: N durable saves with a
+    write-behind mirror, then fall off the end — exit-vs-flush is the race
+    under test. ``revert=True`` unregisters the atexit ``flush_mirror``
+    (the historical bug, PR-10's fix undone) without touching shipped
+    code."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    lines = [
+        "import sys",
+        f"sys.path.insert(0, {repo!r})",
+        "import numpy as np",
+        "from graphdyn.analysis.racecheck import maybe_install",
+        "from graphdyn.resilience.store import (DurableCheckpoint, "
+        "configure_store, flush_mirror)",
+        "maybe_install()",
+        f"configure_store(mirror={mirror!r}, keep={RACE_SAVES * 2})",
+    ]
+    if revert:
+        lines += [
+            "import atexit",
+            "atexit.unregister(flush_mirror)   # the reverted fix",
+        ]
+    lines += [
+        f"ck = DurableCheckpoint({os.path.join(primary, 'ck')!r})",
+        f"for i in range({RACE_SAVES}):",
+        "    ck.save({'state': np.arange(64) + i}, {'i': i})",
+        "# fall off the end: interpreter teardown races the queue",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def _race_mirror_env(seed: int) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "GRAPHDYN_RACECHECK": "1",
+        "GRAPHDYN_RACEFUZZ": str(seed),
+        "GRAPHDYN_RACEFUZZ_MAX_MS": str(RACE_FUZZ_MAX_MS),
+        # thread-side delay the lock proxy cannot reach: stall every
+        # write-behind copy on the worker (env plans are process-global,
+        # so the WORKER thread polls this one)
+        "GRAPHDYN_FAULT_PLAN": json.dumps([{
+            "site": "mirror.copy", "action": "stall",
+            "secs": RACE_STALL_SECS, "at": 1, "count": 999,
+        }]),
+    })
+    env.pop("GRAPHDYN_CKPT_MIRROR", None)
+    return env
+
+
+def _mirror_last_replica(primary: str, mirror: str) -> str:
+    """The mirror-side path of the LAST save's immutable version — derived
+    through the store's OWN namespacing (`_mirror_base`), so a layout
+    change there can never read as a lost-replica race here."""
+    mbase = _store.DurableCheckpoint(
+        os.path.join(primary, "ck"), mirror=mirror)._mirror_base()
+    return f"{mbase}.v{RACE_SAVES}.npz"
+
+
+def _run_race_mirror(scn: Scenario, seed: int, root: str) -> dict:
+    """Mirror-flush-vs-exit under the seeded schedule fuzzer, end to end
+    in real subprocesses. Green leg (every seed): with the atexit
+    ``flush_mirror`` registration present, the last save's replica is in
+    the mirror after exit despite per-copy stalls and lock jitter. Control
+    leg (pinned seed only): the same child with the registration reverted
+    must LOSE the last replica — the harness provably detects the
+    historical bug class, so a future revert of the fix goes red here."""
+    import subprocess
+
+    workdir = os.path.join(root, scn.name, f"seed{seed}")
+    problems: list[str] = []
+    ep_log: list[dict] = []
+
+    def episode(tag: str, revert: bool) -> tuple[str, str]:
+        d = os.path.join(workdir, tag)
+        primary = os.path.join(d, "primary")
+        mirror = os.path.join(d, "mirror")
+        os.makedirs(primary, exist_ok=True)
+        script = _race_mirror_child(primary, mirror, revert)
+        proc = subprocess.run(
+            [sys.executable, "-c", script], capture_output=True,
+            text=True, timeout=120, env=_race_mirror_env(seed), cwd=d,
+        )
+        ep_log.append({"episode": tag, "rc": proc.returncode,
+                       "revert": revert})
+        if proc.returncode != 0:
+            problems.append(
+                f"{tag} child exited {proc.returncode}: "
+                f"{proc.stderr[-500:]}")
+        return primary, mirror
+
+    # green leg: the shipped fix must hold under this seed's schedule
+    primary, mirror = episode("green", revert=False)
+    last = _mirror_last_replica(primary, mirror)
+    if not problems and not os.path.exists(last):
+        problems.append(
+            f"atexit flush_mirror LOST the last write-behind replica "
+            f"under fuzz seed {seed} (missing {last}) — the "
+            f"flush-vs-exit race regressed")
+    if not problems:
+        pub = os.path.join(os.path.dirname(last), "ck.npz")
+        got = np.load(pub)["state"][0] if os.path.exists(pub) else None
+        if got != RACE_SAVES - 1:
+            problems.append(
+                f"published mirror replica is not the LAST save "
+                f"(state[0]={got}, want {RACE_SAVES - 1})")
+    journal = os.path.join(primary, _store.JOURNAL_NAME)
+    ops = _check_journal(journal, scn.require_ops, problems)
+
+    # control leg, pinned seed: reverting the fix must lose the race —
+    # a detection harness that cannot see the bug it was built for is
+    # not a harness
+    if seed == RACE_PIN_SEED:
+        primary_r, mirror_r = episode("reverted", revert=True)
+        last_r = _mirror_last_replica(primary_r, mirror_r)
+        if not problems and os.path.exists(last_r):
+            problems.append(
+                "control leg: with atexit flush_mirror REVERTED the last "
+                "replica still reached the mirror — the fuzzer no longer "
+                "detects the historical bug class (stall/jitter budget "
+                "too small?)")
+
+    return {"scenario": scn.name, "seed": seed, "workload": scn.workload,
+            "episodes": ep_log, "journal_ops": sorted(set(ops)),
+            "problems": problems, "ok": not problems}
+
+
+def _run_race_prefetch(scn: Scenario, seed: int, root: str) -> dict:
+    """Prefetcher close-vs-emit under the seeded schedule fuzzer,
+    in-process: (a) close() mid-stream — worker mid-build or blocked on a
+    full queue — returns without deadlock and releases the thread,
+    idempotently; (b) the fuzzed threaded stream is bit-exact with the
+    synchronous builds (determinism is structural, the module contract);
+    (c) with a recorder, the overlap gauge lands exactly once per
+    prefetcher. The inventoried locks the fuzzer jitters here are the
+    flight ring's and the journal's — every obs emission the worker and
+    the closer make is schedule-perturbed."""
+    from graphdyn import obs
+    from graphdyn.analysis import racecheck as _rc
+    from graphdyn.pipeline.prefetch import HostPrefetcher
+
+    workdir = os.path.join(root, scn.name, f"seed{seed}")
+    os.makedirs(workdir, exist_ok=True)
+    problems: list[str] = []
+    rng = np.random.default_rng(seed)
+    delays = rng.uniform(0.0, 0.004, size=16)
+
+    def build(k: int):
+        # seeded build latency widens the emit-vs-close window; the value
+        # is a pure function of k (the module's determinism premise)
+        # graftrace: disable-next-line=GT005  injected build latency for the race scenario, not synchronization
+        time.sleep(float(delays[k]))
+        return np.arange(32, dtype=np.int64) + k
+
+    import threading
+
+    from graphdyn.obs import flight
+
+    def live_workers() -> list:
+        return [t for t in threading.enumerate()
+                if t.name == "graphdyn-prefetch" and t.is_alive()]
+
+    was_installed = _rc.installed()
+    if not was_installed:
+        _rc.install(fuzz_seed=seed, fuzz_max_ms=8.0)
+    pre_workers = len(live_workers())
+    flight.clear()                      # so the hung-counter check is ours
+    leg_problems: list[str] = []
+
+    def legs() -> None:
+        # (a) close mid-stream: depth-2 queue is full, worker blocked.
+        # close() always clears _thread — even for a hung worker it merely
+        # abandons — so the REAL leak checks are threading.enumerate()
+        # (no surviving live worker) and the absence of the
+        # pipeline.prefetch.hung counter in the flight ring, both run by
+        # the supervising side after the join.
+        pf = HostPrefetcher(build, list(range(12)), depth=2)
+        first = pf.get(0)
+        if not np.array_equal(first, np.arange(32, dtype=np.int64)):
+            leg_problems.append("mid-stream get returned the wrong build")
+        pf.close()
+        pf.close()                      # idempotent under fuzz
+        # (b) full fuzzed stream == synchronous builds, bit-exact
+        pf2 = HostPrefetcher(build, list(range(12)), depth=3)
+        got = [pf2.get(k) for k in range(12)]
+        pf2.close()
+        if not all(np.array_equal(g, np.arange(32, dtype=np.int64) + k)
+                   for k, g in enumerate(got)):
+            leg_problems.append(
+                "fuzzed prefetch stream diverged from synchronous builds")
+        # (c) overlap gauge exactly once per prefetcher
+        if not obs.enabled():
+            ledger = os.path.join(workdir, "obs.jsonl")
+            with obs.recording(ledger):
+                pf3 = HostPrefetcher(build, list(range(6)), depth=2)
+                for k in range(3):
+                    pf3.get(k)
+                pf3.close()
+                pf3.close()
+            from graphdyn.obs.recorder import read_ledger
+
+            events, _ = read_ledger(ledger)
+            n = sum(1 for e in events
+                    if e.get("name") == "pipeline.prefetch.overlap_util")
+            if n != 1:
+                leg_problems.append(
+                    f"expected exactly one overlap gauge per closed "
+                    f"prefetcher, got {n}")
+
+    try:
+        # the legs run on a bounded worker: HostPrefetcher.get() blocks on
+        # an untimed Queue.get, so the regression class this scenario
+        # exists to catch (worker wedged / close-vs-emit deadlock) would
+        # otherwise hang the soak run and tier-1 forever instead of
+        # failing — the join timeout IS the scenario's deadline
+        runner = threading.Thread(target=legs, name="graphdyn-soak-race-legs",
+                                  daemon=True)
+        runner.start()
+        runner.join(timeout=60.0)
+        if runner.is_alive():
+            problems.append(
+                "scenario WEDGED: the prefetch legs did not finish within "
+                "60 s — a get/close/emit path deadlocked under fuzz")
+        else:
+            problems.extend(leg_problems)
+            # no worker was ever declared hung-and-abandoned: the legs
+            # all closed cleanly, so a hung counter means a real wedge
+            hung = [e for e in flight.snapshot()
+                    if e.get("name") == "pipeline.prefetch.hung"]
+            if hung:
+                problems.append(
+                    f"a prefetch worker wedged past close()'s join window "
+                    f"under fuzz ({len(hung)} pipeline.prefetch.hung "
+                    f"event(s))")
+            if len(live_workers()) > pre_workers:
+                problems.append(
+                    "a live graphdyn-prefetch worker survived the scenario")
+    finally:
+        if not was_installed:
+            _rc.uninstall()
+    return {"scenario": scn.name, "seed": seed, "workload": scn.workload,
+            "episodes": [{"episode": 0, "rc": 0}],
+            "journal_ops": [], "problems": problems, "ok": not problems}
 
 
 def run_soak(scenarios=None, seeds=BOUNDED_SEEDS, root: str | None = None,

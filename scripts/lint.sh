@@ -202,6 +202,31 @@ for name, g in (("rrg", random_regular_graph(512, 3, seed=0)),
 PYEOF
 fi
 
+# 8c. racecheck — the graftrace host-concurrency auditor
+#     (graphdyn.analysis.racecheck): the static AST pass inventories the
+#     thread/lock/shared-global surface, enforces GT001-GT005 and diffs
+#     the declarations against the committed CONCURRENCY_LEDGER.json —
+#     undeclared concurrency growth or a lock-order hazard fails here,
+#     hardware-free and jax-free. Then the racecheck pytest subset
+#     (pytest -m racecheck: rule catalogue, runtime lock proxy, the
+#     GRAPHDYN_RACECHECK=1 smoke). Skipped with a notice when
+#     GRAPHDYN_SKIP_RACECHECK=1 (set by the tier-1 lint-gate test: the
+#     subset already runs in the suite proper — no double work; mirrors
+#     hlocheck).
+if [ "${GRAPHDYN_SKIP_RACECHECK:-0}" = "1" ]; then
+    echo "== racecheck: GRAPHDYN_SKIP_RACECHECK=1 — SKIPPED (subset runs in tier-1) =="
+else
+    echo "== racecheck (graftrace concurrency ledger) =="
+    python -m graphdyn.analysis.racecheck --format=text || fail=1
+    if python -c 'import pytest' 2>/dev/null; then
+        echo "== racecheck (pytest -m racecheck) =="
+        JAX_PLATFORMS=cpu python -m pytest tests/ -q -m racecheck \
+            -p no:cacheprovider || fail=1
+    else
+        echo "== racecheck: pytest not installed — racecheck subset SKIPPED (pip install pytest to enable) =="
+    fi
+fi
+
 # 9. benchcheck — the benchmark's single-JSON-line contract, live (python
 #    bench.py --smoke on the CPU backend): one line of JSON, a positive
 #    headline value, and a positive ensemble_rate row (the grouped-driver

@@ -22,14 +22,20 @@ def test_scenario_catalogue_shape():
     bitrot-detection and primary-loss-mirror stories among them, at least
     one mirror-configured workload, and the PR-10 supervision stories
     (stall detection, deadline preemption, crash-loop quarantine)."""
-    assert len(SCENARIOS) >= 10
+    assert len(SCENARIOS) >= 12
     assert {"bitrot", "mirror_failover", "mirror_degraded",
             "truncated_read", "torn_write", "requeue_storm",
             "hang_detect", "deadline_preempt",
-            "crash_loop_quarantine"} <= set(SCENARIOS)
+            "crash_loop_quarantine", "race_mirror_exit",
+            "race_prefetch_close"} <= set(SCENARIOS)
     assert SCENARIOS["mirror_failover"].mirror
     assert SCENARIOS["hang_detect"].mode == "hang"
     assert SCENARIOS["crash_loop_quarantine"].mode == "crash_loop"
+    # the graftrace seeded-schedule race scenarios (PR: host-concurrency
+    # auditor): the mirror one must assert the write-behind journal story
+    assert SCENARIOS["race_mirror_exit"].mode == "race_mirror"
+    assert "mirror.save" in SCENARIOS["race_mirror_exit"].require_ops
+    assert SCENARIOS["race_prefetch_close"].mode == "race_prefetch"
     assert ("supervise.stall_detected"
             in SCENARIOS["hang_detect"].require_flight)
     assert ("supervise.deadline"
