@@ -1114,6 +1114,23 @@ def main():
             "fingerprints_skipped_reason":
                 f"fingerprint collection failed: {str(e)[:150]}",
         })
+    _mark("derived cost columns (graftcost ledger models)")
+    try:
+        from graphdyn.analysis.graftcost import bench_cost_columns
+
+        # no compilation: the committed COST_LEDGER.json models evaluated
+        # at this bench size (null + reason when the ledger cannot speak
+        # for this backend)
+        extra.update(bench_cost_columns(n))
+    except Exception as e:  # noqa: BLE001 — optional columns, never silent
+        _mark(f"derived cost columns failed: {str(e)[:150]}")
+        reason = f"derived cost columns failed: {str(e)[:150]}"
+        extra.update({
+            "derived_bytes": None,
+            "derived_bytes_skipped_reason": reason,
+            "arithmetic_intensity": None,
+            "arithmetic_intensity_skipped_reason": reason,
+        })
     # progress log: a backend-skipped row says skipped(<reason>), NEVER a
     # zero rate — the JSON already emits null + <row>_skipped_reason, and
     # the human-readable line must be just as unmistakable

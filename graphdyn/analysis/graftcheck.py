@@ -300,25 +300,25 @@ def _canon_rrg(n: int, d: int, seed: int):
     return random_regular_graph(n, d, seed=seed)
 
 
-def _build_packed_rollout(steps: int = 4):
+def _build_packed_rollout(steps: int = 4, n: int = 256, R: int = 128):
     import jax.numpy as jnp
     import numpy as np
 
     from graphdyn.ops.packed import pack_spins, packed_rollout
 
-    g = _canon_rrg(256, 3, 0)
+    g = _canon_rrg(n, 3, 0)
     rng = np.random.default_rng(0)
-    s = (2 * rng.integers(0, 2, size=(128, g.n)) - 1).astype(np.int8)
+    s = (2 * rng.integers(0, 2, size=(R, g.n)) - 1).astype(np.int8)
     return packed_rollout.lower(
         jnp.asarray(g.nbr), jnp.asarray(g.deg), jnp.asarray(pack_spins(s)),
         steps=steps,
     )
 
 
-def _build_bdcm_sweep():
+def _build_bdcm_sweep(n: int = 64):
     from graphdyn.ops.bdcm import BDCMData, lower_sweep
 
-    data = BDCMData(_canon_rrg(64, 3, 1), p=1, c=1)
+    data = BDCMData(_canon_rrg(n, 3, 1), p=1, c=1)
     return lower_sweep(data, damp=0.9)
 
 
@@ -330,14 +330,14 @@ def _entropy_config():
     )
 
 
-def _build_entropy_cell_chunk(G: int = 2):
+def _build_entropy_cell_chunk(G: int = 2, n: int = 48):
     import jax.numpy as jnp
 
     from graphdyn.ops.bdcm import BDCMData
     from graphdyn.pipeline.entropy_group import EntropyCellExec
 
     cells = [
-        (BDCMData(_canon_rrg(48, 3, k), p=1, c=1), 48, 0) for k in range(G)
+        (BDCMData(_canon_rrg(n, 3, k), p=1, c=1), n, 0) for k in range(G)
     ]
     ex = EntropyCellExec(
         cells, _entropy_config(), group_size=G, chunk_sweeps=8, kernel="xla"
@@ -358,11 +358,11 @@ def _hpr_config():
     return HPRConfig(dynamics=DynamicsConfig(p=1, c=1), max_sweeps=20)
 
 
-def _build_hpr_group_loop(G: int = 2):
+def _build_hpr_group_loop(G: int = 2, n: int = 24):
     from graphdyn.pipeline.hpr_group import HPRGroupExec, _build_rep
 
     config = _hpr_config()
-    items = [_build_rep(24, 3, config, k, "pairing") for k in range(G)]
+    items = [_build_rep(n, 3, config, k, "pairing") for k in range(G)]
     ex = HPRGroupExec(items, config, group_size=G, kernel="xla")
     state = ex.init_state(
         [it[2] for it in items], [it[3] for it in items],
@@ -371,13 +371,13 @@ def _build_hpr_group_loop(G: int = 2):
     return ex.lower_loop(state, 5)
 
 
-def _build_sa_group_loop(G: int = 2):
+def _build_sa_group_loop(G: int = 2, n: int = 32):
     from graphdyn.config import DynamicsConfig, SAConfig
     from graphdyn.models.sa import prepare_sa_inputs
     from graphdyn.pipeline.sa_group import lower_group_loop
 
     config = SAConfig(dynamics=DynamicsConfig(p=1, c=1))
-    graphs = [_canon_rrg(32, 3, k) for k in range(G)]
+    graphs = [_canon_rrg(n, 3, k) for k in range(G)]
     preps = [
         prepare_sa_inputs(g, config, n_replicas=1, seed=k, max_steps=50)
         for k, g in enumerate(graphs)
@@ -387,7 +387,7 @@ def _build_sa_group_loop(G: int = 2):
     )
 
 
-def _build_sharded_rollout():
+def _build_sharded_rollout(n: int = 64):
     import jax
 
     from graphdyn.parallel.mesh import make_mesh
@@ -398,10 +398,10 @@ def _build_sharded_rollout():
     # bare 1-device CLI run (the partitioned program depends only on the
     # mesh SHAPE, and (1, 1) exists in both environments)
     mesh = make_mesh((1, 1), ("replica", "node"), devices=jax.devices()[:1])
-    return lower_sharded_rollout(mesh, _canon_rrg(64, 3, 0), 8, steps=2)
+    return lower_sharded_rollout(mesh, _canon_rrg(n, 3, 0), 8, steps=2)
 
 
-def _build_halo_rollout():
+def _build_halo_rollout(n: int = 128):
     from graphdyn.graphs import partition_graph
     from graphdyn.parallel.halo import lower_halo_rollout
     from graphdyn.parallel.mesh import device_pool, make_mesh
@@ -421,7 +421,7 @@ def _build_halo_rollout():
             "host platform: XLA_FLAGS=--xla_force_host_platform_device_count=8)"
         ) from e
     mesh = make_mesh((2,), ("node",), devices=devices[:2])
-    g = _canon_rrg(128, 3, 0)
+    g = _canon_rrg(n, 3, 0)
     return lower_halo_rollout(
         mesh, g, partition_graph(g, 2, seed=0), W=4, steps=2
     )
@@ -433,20 +433,20 @@ def _temper_config():
     return SAConfig(dynamics=DynamicsConfig(p=1, c=1))
 
 
-def _build_temper_chunk(K: int = 4):
+def _build_temper_chunk(K: int = 4, n: int = 48):
     from graphdyn.search.tempering import lower_temper_chunk
 
     return lower_temper_chunk(
-        _canon_rrg(48, 3, 0), _temper_config(), n_lanes=K, seed=0,
+        _canon_rrg(n, 3, 0), _temper_config(), n_lanes=K, seed=0,
         max_steps=200, swap_interval=16,
     )
 
 
-def _build_fused_chunk(R: int = 32):
+def _build_fused_chunk(R: int = 32, n: int = 48):
     from graphdyn.search.fused import lower_fused_chunk
 
     return lower_fused_chunk(
-        _canon_rrg(48, 3, 0), _temper_config(), n_replicas=R, seed=0,
+        _canon_rrg(n, 3, 0), _temper_config(), n_replicas=R, seed=0,
         m_target=0.9, chunk_sweeps=4,
     )
 

@@ -1,0 +1,1095 @@
+"""graftcost — HLO-derived byte/FLOP cost models with a committed ledger.
+
+graftcheck (:mod:`graphdyn.analysis.graftcheck`) pins program *structure*;
+this module pins program *cost*. The repo carries a family of hand-written
+byte models — ``MEM_BANDS``/``packed_state_bytes`` in :mod:`graphdyn.obs.
+memband`, the roofline traffic formulas in :mod:`graphdyn.obs.roofline`,
+``fused_vmem_bytes`` in :mod:`graphdyn.ops.pallas_anneal`, the pallas_bdcm
+VMEM model, ``HaloTables.halo_bytes_per_step`` — and ROADMAP items stake
+real decisions on them (VMEM-margin re-centering in the chip round, serve
+admission control). Nothing previously checked that those formulas still
+describe the programs they model: a kernel rewrite that changes the fused
+resident set leaves the hand model silently stale. The TPU Ising literature
+(PAPERS.md arXiv:1903.11714) rests its headline on exactly this
+bytes-per-update accounting, so the accounting must be *derived*, not
+transcribed.
+
+For every graftcheck-ledgered entry point, graftcost walks the compiled
+HLO (reusing graftcheck's ``_OP_RE`` / ``_DTYPE_BYTES`` / ``_CATEGORY``
+machinery) and derives, per canonical shape:
+
+- **resident bytes** — argument / result / donated bytes parsed from the
+  ``entry_computation_layout`` and the ``input_output_alias`` blob, plus
+  XLA's temp-buffer size, combined into a peak-live estimate
+  ``peak = arg + result − donated + temp``;
+- **bytes moved per execution** — every op's output bytes, bucketed into
+  graftcheck's traffic classes (gather / scatter / dot / reduce /
+  elementwise / layout / collective / …), free plumbing ops and
+  outer-loop/fusion wrappers excluded so bodies are counted once;
+- **a FLOP estimate per op class** — output-element counts weighted per
+  class (2× for dot/reduce, 0 for pure data movement).
+
+Each entry point is evaluated at 2–3 calibration shapes (the size knobs
+the graftcheck builders expose) and an affine model ``q(n) = a + b·n`` is
+least-squares-fitted per quantity, so the derived models are *functions*
+of the size feature, not point samples — ``bench.py`` and ``obs memcheck``
+evaluate them at shapes never compiled here. Fits, samples and the
+blessed hand-model ratios persist to the committed ``COST_LEDGER.json``
+(backend- and jax-version-stamped; ``--update-ledger`` blessing path
+exactly like graftcheck).
+
+Rules (exit code = number of findings):
+
+====== ====================================================================
+GB101  a derived cost sample drifted from its ledger row beyond the
+       per-field band (``_SAMPLE_BANDS``), or the program gained a traffic
+       class the ledger never saw — the program's cost changed without a
+       blessing
+GB102  a registered hand model (``HAND_MODELS``) disagrees with the
+       ledger's derived model beyond the committed tolerance at the
+       calibration shapes — the hand formula went stale (or the program
+       was re-blessed without updating the formula in the same PR)
+GB103  an entry point in the graftcheck fingerprint ledger has no cost
+       row (or there is no cost ledger at all) — coverage, not drift
+GB104  a derived quantity's measured scaling exponent departs from its
+       declared one (``CostEntrySpec.declared``), or the affine fit's
+       relative residual exceeds the entry's tolerance — the model shape
+       itself no longer describes the program
+====== ====================================================================
+
+The hand models register through a small adapter table
+(:data:`HAND_MODELS`): one row per formula, naming the entry point and
+derived quantity it must track and a callable evaluating the formula at
+the entry's canonical configuration for a given size. GB102 compares the
+*ratio* hand/derived against the ratio blessed at ``--update-ledger``
+time: both sides are deterministic, so the shipped tree reproduces the
+blessed ratio exactly, a hand-coefficient edit moves it immediately, and
+a program re-bless (new derived coefficients) moves it until the hand
+formula is updated in the same reviewed PR. Adapters resolve the hand
+function at *call time* so a monkeypatched formula is seen (the
+falsifiability tests rely on this).
+
+CLI, mirroring graftlint/graftcheck/racecheck (one JSON document on
+stdout, diagnostics on stderr, exit code = number of findings)::
+
+    python -m graphdyn.analysis.graftcost [--format=text|json]
+        [--update-ledger] [--ledger PATH] [--entries a,b,...]
+
+The ledger records backend and jax version; the checker diffs only when
+the live backend matches (the gate runs ``JAX_PLATFORMS=cpu``). The first
+TPU round re-centers tolerances on measured ``memory_stats()`` — chip
+checklist item in ``scripts/pallas_tpu_validate.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import re
+import sys
+from pathlib import Path
+from typing import Callable, NamedTuple
+
+from graphdyn.analysis import graftcheck
+from graphdyn.analysis.graftcheck import (
+    _CATEGORY,
+    _DTYPE_BYTES,
+    _OP_RE,
+    Finding,
+    UnsupportedEntry,
+    _canon_rrg,
+    _find_alias_blob,
+)
+
+RULES = {
+    "GB101": "derived cost drifted from the ledger beyond the band",
+    "GB102": "hand model disagrees with the derived model beyond tolerance",
+    "GB103": "graftcheck-ledgered entry point has no cost row",
+    "GB104": "measured scaling exponent departs from the declared one",
+}
+
+LEDGER_NAME = "COST_LEDGER.json"
+
+#: |measured − declared| exponent tolerance (GB104). Wide enough for the
+#: while-loop entries whose XLA programs carry size-independent terms,
+#: tight enough that linear→quadratic (or linear→flat) cannot hide.
+EXPONENT_TOL = 0.35
+
+_SHAPE_TOKEN_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+
+#: ops whose "output" is free plumbing (no buffer written), plus the
+#: loop/fusion wrappers whose bodies are printed — and therefore counted —
+#: separately (counting the wrapper's carry again would double-charge the
+#: whole body output per wrapper level)
+_SKIP_OPS = frozenset({
+    "parameter", "get-tuple-element", "tuple", "after-all", "bitcast",
+    "constant", "while", "conditional", "call", "fusion",
+    "optimization-barrier", "copy-start", "copy-done",
+})
+
+#: FLOPs per output element by traffic class: data movement computes
+#: nothing; dot/reduce do a multiply-add per contribution (2× as the
+#: conventional floor); everything arithmetic is 1 op/element
+_FLOP_WEIGHT = {
+    "elementwise": 1.0, "dot": 2.0, "reduce": 2.0, "rng": 1.0,
+    "sort": 1.0, "custom-call": 1.0,
+    "layout": 0.0, "gather": 0.0, "scatter": 0.0, "collective": 0.0,
+    "hostio": 0.0, "control": 0.0, "constant": 0.0, "fusion": 0.0,
+}
+
+#: quantities fitted per entry (the derived models); ``collective_bytes``
+#: is ``bytes_by_class["collective"]`` so the halo wire bill gets its own
+#: symbolic model
+FIT_QUANTITIES = (
+    "peak_bytes", "arg_bytes", "result_bytes", "bytes_moved", "flops_est",
+    "collective_bytes",
+)
+
+#: GB101 per-field bands: (relative, absolute floor). Live and ledger come
+#: from the same deterministic compile on the stamped backend, so the
+#: shipped tree diffs exactly; the bands exist to absorb jax patch-version
+#: jitter, not real drift.
+_SAMPLE_BANDS = {
+    "arg_bytes": (0.10, 512),
+    "result_bytes": (0.10, 512),
+    "donated_bytes": (0.10, 512),
+    "temp_bytes": (0.50, 4096),
+    "peak_bytes": (0.25, 4096),
+    "bytes_moved": (0.25, 4096),
+    "flops_est": (0.25, 4096),
+}
+
+
+def default_ledger_path() -> Path:
+    """The committed cost ledger at the repo root (next to the graftcheck
+    fingerprint ledger)."""
+    return Path(__file__).resolve().parents[2] / LEDGER_NAME
+
+
+# ---------------------------------------------------------------------------
+# derivation: compiled HLO -> cost facts
+# ---------------------------------------------------------------------------
+
+
+def _find_blob(txt: str, key: str) -> str | None:
+    """Brace-balanced body of ``key{...}`` in the module header (the
+    :func:`graftcheck._find_alias_blob` walk, generalized)."""
+    start = txt.find(key)
+    if start < 0:
+        return None
+    i = start + len(key)
+    depth = 1
+    while i < len(txt) and depth:
+        if txt[i] == "{":
+            depth += 1
+        elif txt[i] == "}":
+            depth -= 1
+        i += 1
+    return txt[start + len(key):i - 1]
+
+
+def _token_bytes(dtype: str, dims: str) -> tuple[int, int]:
+    """(bytes, elements) of one ``dtype[d0,d1,...]`` shape token."""
+    elems = 1
+    for d in dims.split(","):
+        if d.strip():
+            elems *= int(d)
+    return _DTYPE_BYTES.get(dtype, 8) * elems, elems
+
+
+def _shape_bytes(shape_text: str) -> tuple[int, int]:
+    """(bytes, elements) of an HLO result type — an array type or a tuple
+    type (every ``dtype[dims]`` token summed; layout braces carry no
+    tokens)."""
+    nbytes = elems = 0
+    for m in _SHAPE_TOKEN_RE.finditer(shape_text):
+        b, e = _token_bytes(m.group(1), m.group(2))
+        nbytes += b
+        elems += e
+    return nbytes, elems
+
+
+def derive_cost_text(hlo_text: str) -> dict:
+    """The static half of the derivation, from compiled-HLO text alone:
+    argument/result/donated bytes from the entry computation layout and
+    the alias blob, per-class traffic and FLOP estimates from the op walk.
+    ``temp_bytes``/``peak_bytes`` need the executable (see
+    :func:`derive_cost`) and are absent here."""
+    layout = _find_blob(hlo_text, "entry_computation_layout={")
+    arg_list: list[int] = []
+    result_bytes = 0
+    if layout and "->" in layout:
+        args_part, result_part = layout.split("->", 1)
+        for m in _SHAPE_TOKEN_RE.finditer(args_part):
+            arg_list.append(_token_bytes(m.group(1), m.group(2))[0])
+        result_bytes = _shape_bytes(result_part)[0]
+
+    alias = _find_alias_blob(hlo_text)
+    donated = sorted(
+        {int(p) for p in re.findall(r"\(\s*(\d+)\s*,", alias)}
+    ) if alias else []
+    donated_bytes = sum(
+        arg_list[i] for i in donated if i < len(arg_list)
+    )
+
+    bytes_by_class: dict[str, int] = {}
+    flops_by_class: dict[str, float] = {}
+    for m in _OP_RE.finditer(hlo_text):
+        shape, op = m.group(1), m.group(2)
+        if op in _SKIP_OPS:
+            continue
+        nbytes, elems = _shape_bytes(shape)
+        cat = _CATEGORY.get(op, "elementwise")
+        bytes_by_class[cat] = bytes_by_class.get(cat, 0) + nbytes
+        w = _FLOP_WEIGHT.get(cat, 1.0)
+        if w:
+            flops_by_class[cat] = flops_by_class.get(cat, 0.0) + w * elems
+
+    return {
+        "arg_bytes": sum(arg_list),
+        "result_bytes": result_bytes,
+        "donated_bytes": donated_bytes,
+        "bytes_by_class": dict(sorted(bytes_by_class.items())),
+        "bytes_moved": sum(bytes_by_class.values()),
+        "flops_by_class": {
+            k: int(v) for k, v in sorted(flops_by_class.items())
+        },
+        "flops_est": int(sum(flops_by_class.values())),
+    }
+
+
+def _xla_facts(compiled) -> dict:
+    """XLA's own cost/memory analysis, recorded informationally (the
+    derived fields above are what the ledger gates — XLA's numbers anchor
+    the derivation to ground truth but jitter across versions)."""
+    out: dict = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        for key in ("flops", "bytes accessed", "transcendentals"):
+            v = ca.get(key)
+            if v is not None:
+                out[key.replace(" ", "_")] = float(v)
+    except Exception:  # noqa: BLE001 — informational; never kills the check
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        for attr in ("argument_size_in_bytes", "output_size_in_bytes",
+                     "temp_size_in_bytes", "alias_size_in_bytes"):
+            v = getattr(ma, attr, None)
+            if v is not None:
+                out[attr] = int(v)
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
+def derive_cost(lowered) -> dict:
+    """Compile a ``jax.stages.Lowered`` and derive its cost facts: the
+    text-walk fields plus XLA's temp-buffer size and the combined
+    peak-live estimate ``arg + result − donated + temp``."""
+    compiled = lowered.compile()
+    facts = derive_cost_text(compiled.as_text())
+    xla = _xla_facts(compiled)
+    facts["temp_bytes"] = int(xla.get("temp_size_in_bytes", 0))
+    facts["peak_bytes"] = (
+        facts["arg_bytes"] + facts["result_bytes"]
+        - facts["donated_bytes"] + facts["temp_bytes"]
+    )
+    facts["xla"] = xla
+    return facts
+
+
+# ---------------------------------------------------------------------------
+# calibration specs + fits
+# ---------------------------------------------------------------------------
+
+
+class CostEntrySpec(NamedTuple):
+    """Calibration plan for one graftcheck entry point: the sizes the
+    affine models are fitted at (reached via ``lower_entry(name, n=...)``),
+    a held-out size the scaling-law tests predict at (never fitted), the
+    declared scaling exponents per quantity (GB104 gates the measured
+    log-log exponent against these), and the entry's affine-fit residual
+    tolerance (the while-loop entries carry size-independent program terms
+    and instance-dependent class structure, so their residuals are honest
+    but larger)."""
+
+    points: tuple[int, ...]
+    holdout: int
+    declared: dict[str, float]
+    residual_tol: float = 0.12
+
+
+#: one spec per graftcheck entry point. Declared exponents are seeded from
+#: the measured scaling at the calibration shapes (recorded in the ledger
+#: per model as ``exponent``) rounded to the claim they support: 1.0 =
+#: "dominated by size-linear terms", lower values are honest declarations
+#: that the program carries large size-independent structure at these
+#: shapes (the grouped while-loop drivers). GB104 fires when the live
+#: exponent leaves the ±0.35 band around the declaration.
+_LINEAR = {"peak_bytes": 1.0, "arg_bytes": 1.0, "bytes_moved": 1.0,
+           "flops_est": 1.0}
+
+COST_ENTRIES: dict[str, CostEntrySpec] = {
+    "packed_rollout": CostEntrySpec((128, 256, 512), 384, dict(_LINEAR)),
+    "bdcm_sweep": CostEntrySpec((32, 64, 96), 48, dict(_LINEAR)),
+    "entropy_cell_chunk": CostEntrySpec((32, 48, 64), 40, dict(_LINEAR)),
+    "hpr_group_loop": CostEntrySpec((16, 24, 32), 20, dict(_LINEAR)),
+    # the grouped SA driver carries a large size-independent while-loop
+    # program (schedule bookkeeping, swap machinery): at these shapes its
+    # cost is intercept-dominated — sublinear measured exponents are the
+    # honest declaration, and a silent slide to fully n-linear (or
+    # quadratic) traffic still trips the ±0.35 band
+    "sa_group_loop": CostEntrySpec(
+        (24, 32, 48), 40,
+        {"peak_bytes": 0.5, "arg_bytes": 0.9, "bytes_moved": 0.65,
+         "flops_est": 0.65}),
+    "sharded_rollout": CostEntrySpec(
+        (48, 64, 96), 80, {**_LINEAR, "collective_bytes": 1.0}),
+    "halo_rollout": CostEntrySpec(
+        (96, 128, 192), 160, {**_LINEAR, "collective_bytes": 1.0}),
+    # same intercept-dominated shape as sa_group_loop (the ladder's swap
+    # machinery is K-, not n-, extensive)
+    "tempering_ladder": CostEntrySpec(
+        (32, 48, 64), 40,
+        {"peak_bytes": 0.75, "arg_bytes": 0.8, "bytes_moved": 0.55,
+         "flops_est": 0.55}),
+    "fused_anneal": CostEntrySpec(
+        (32, 48, 64), 40, {**_LINEAR, "arg_bytes": 0.9}),
+}
+
+
+def _fit_affine(xs, ys) -> tuple[float, float, float]:
+    """Least-squares ``y = a + b·x`` over the calibration points →
+    (intercept, slope, max relative residual)."""
+    k = len(xs)
+    sx, sy = sum(xs), sum(ys)
+    sxx = sum(x * x for x in xs)
+    sxy = sum(x * y for x, y in zip(xs, ys))
+    den = k * sxx - sx * sx
+    slope = (k * sxy - sx * sy) / den if den else 0.0
+    intercept = (sy - slope * sx) / k
+    residual = max(
+        abs(intercept + slope * x - y) / max(abs(y), 1.0)
+        for x, y in zip(xs, ys)
+    )
+    return intercept, slope, residual
+
+
+def _scaling_exponent(xs, ys) -> float | None:
+    """Measured log-log exponent between the first and last calibration
+    point, or None when the quantity is zero at either end (nothing to
+    scale — e.g. ``collective_bytes`` of a single-device program)."""
+    if ys[0] <= 0 or ys[-1] <= 0:
+        return None
+    return math.log(ys[-1] / ys[0]) / math.log(xs[-1] / xs[0])
+
+
+def _quantity(facts: dict, q: str) -> float:
+    if q == "collective_bytes":
+        return float(facts.get("bytes_by_class", {}).get("collective", 0))
+    return float(facts.get(q, 0))
+
+
+def fit_models(spec: CostEntrySpec, samples: dict[str, dict]) -> dict:
+    """Affine models for every :data:`FIT_QUANTITIES` member, from the
+    entry's calibration samples."""
+    xs = [float(n) for n in spec.points]
+    models = {}
+    for q in FIT_QUANTITIES:
+        ys = [_quantity(samples[str(n)], q) for n in spec.points]
+        intercept, slope, residual = _fit_affine(xs, ys)
+        models[q] = {
+            "intercept": intercept,
+            "slope": slope,
+            "residual": residual,
+            "exponent": _scaling_exponent(xs, ys),
+            "declared_exponent": spec.declared.get(q),
+        }
+    return models
+
+
+def predict(model: dict, n: float) -> float:
+    """Evaluate one fitted model at size ``n``."""
+    return float(model["intercept"] + model["slope"] * n)
+
+
+# ---------------------------------------------------------------------------
+# hand-model adapter table (GB102)
+# ---------------------------------------------------------------------------
+
+
+class HandModel(NamedTuple):
+    """One registered hand-written byte model: the code location (for the
+    ARCHITECTURE.md sync test), the derived quantity it must track, a
+    human-readable formula (rendered into the doc table), and a callable
+    evaluating the formula at the entry's canonical configuration for size
+    ``n``. ``hand`` must resolve the underlying function at *call time*
+    (module-attribute lookup, not a captured reference) so the
+    falsifiability tests can monkeypatch it."""
+
+    name: str
+    module: str
+    entry: str
+    quantity: str
+    formula: str
+    hand: Callable[[int], float]
+    tolerance: float = 0.05
+
+
+def _hand_packed_state(n: int) -> float:
+    from graphdyn.obs import memband
+
+    return float(memband.packed_state_bytes(n, 3, 4))
+
+
+def _hand_packed_traffic(n: int) -> float:
+    from graphdyn.obs import roofline
+
+    # canonical program: R=128 replicas (W=4 words), steps=4
+    # -> n·128·4 spin updates per execution
+    return float(roofline.packed_bytes_per_update(3) * n * 128 * 4)
+
+
+def _hand_bdcm_traffic(n: int) -> float:
+    from graphdyn.obs import roofline
+    from graphdyn.ops.bdcm import BDCMData
+
+    data = BDCMData(_canon_rrg(n, 3, 1), p=1, c=1)
+    return float(sum(
+        len(ec.idx) * roofline.bdcm_bytes_per_edge_sweep(ec.d, data.T)
+        for ec in data.edge_classes
+    ))
+
+
+def _entropy_stack(n: int):
+    from graphdyn.ops.bdcm import BDCMData, stack_bdcm
+
+    return stack_bdcm([
+        BDCMData(_canon_rrg(n, 3, k), p=1, c=1) for k in range(2)
+    ])
+
+
+def _hand_stacked_bdcm(n: int) -> float:
+    from graphdyn.obs import memband
+
+    return float(memband.stacked_bdcm_bytes(_entropy_stack(n)))
+
+
+def _hand_entropy_chunk(n: int) -> float:
+    from graphdyn.obs import memband
+
+    return float(memband.entropy_chunk_bytes(_entropy_stack(n)))
+
+
+def _halo_tables(n: int):
+    from graphdyn.graphs import partition_graph
+    from graphdyn.parallel.halo import build_halo_tables
+
+    g = _canon_rrg(n, 3, 0)
+    return build_halo_tables(g, partition_graph(g, 2, seed=0))
+
+
+def _hand_halo_shard(n: int) -> float:
+    from graphdyn.obs import memband
+
+    t = _halo_tables(n)
+    return float(sum(
+        memband.halo_shard_bytes(int(t.counts[p]), int(t.ghost_counts[p]), 4)
+        for p in range(t.P)
+    ))
+
+
+def _hand_halo_wire(n: int) -> float:
+    t = _halo_tables(n)
+    return float(t.halo_bytes_per_step(4) * 2)   # canonical steps=2
+
+
+def _hand_fused_vmem(n: int) -> float:
+    from graphdyn.ops import pallas_anneal
+
+    t = pallas_anneal.build_fused_tables(
+        _canon_rrg(n, 3, 0), graftcheck._temper_config()
+    )
+    return float(pallas_anneal.fused_vmem_bytes(n, 1, t.chi, t.dmax))
+
+
+def _hand_pallas_bdcm_vmem(n: int) -> float:
+    from graphdyn.ops import pallas_bdcm
+    from graphdyn.ops.bdcm import BDCMData
+
+    data = BDCMData(_canon_rrg(n, 3, 1), p=1, c=1)
+    return float(pallas_bdcm.vmem_bytes(3, data.T, data.num_directed))
+
+
+HAND_MODELS: tuple[HandModel, ...] = (
+    HandModel(
+        "packed_state_bytes", "graphdyn.obs.memband",
+        "packed_rollout", "arg_bytes",
+        "4·n·W + 4·n·d + 4·n  (d=3, W=4)", _hand_packed_state,
+    ),
+    HandModel(
+        "packed_bytes_per_update", "graphdyn.obs.roofline",
+        "packed_rollout", "bytes_moved",
+        "(d+1)/8 B per spin-update × n·R·steps  (d=3, R=128, steps=4)",
+        _hand_packed_traffic,
+    ),
+    HandModel(
+        "bdcm_bytes_per_edge_sweep", "graphdyn.obs.roofline",
+        "bdcm_sweep", "bytes_moved",
+        "Σ_d |E_d|·4·(d·(K+1)·K·M + K²·M + (d+2)·K²)  (p=c=1)",
+        _hand_bdcm_traffic,
+    ),
+    HandModel(
+        "stacked_bdcm_bytes", "graphdyn.obs.memband",
+        "entropy_cell_chunk", "arg_bytes",
+        "G·(2E+1)·K²·4 + Σ_d G·K²·M_d·4 + 8·index tables  (G=2)",
+        _hand_stacked_bdcm,
+    ),
+    HandModel(
+        "entropy_chunk_bytes", "graphdyn.obs.memband",
+        "entropy_cell_chunk", "peak_bytes",
+        "stacked_bdcm_bytes + chi double-buffer + max DP scratch  (G=2)",
+        _hand_entropy_chunk,
+    ),
+    HandModel(
+        "halo_shard_bytes", "graphdyn.obs.memband",
+        "halo_rollout", "peak_bytes",
+        "Σ_shards 4·W·(n_local + n_ghost)  (P=2, W=4)", _hand_halo_shard,
+    ),
+    HandModel(
+        "halo_bytes_per_step", "graphdyn.parallel.halo",
+        "halo_rollout", "collective_bytes",
+        "4·W·n_slab_words × steps  (W=4, steps=2)", _hand_halo_wire,
+    ),
+    HandModel(
+        "fused_vmem_bytes", "graphdyn.ops.pallas_anneal",
+        "fused_anneal", "peak_bytes",
+        "4·(n+1)·(W·(2+planes+dmax+1) + χ + 2·(dmax+1) + (2·dmax+1) "
+        "+ 6·4·W)  (W=1)", _hand_fused_vmem,
+    ),
+    HandModel(
+        "pallas_bdcm.vmem_bytes", "graphdyn.ops.pallas_bdcm",
+        "bdcm_sweep", "peak_bytes",
+        "8·K²·M + 8·(K²·(d+2) + K·M)·edges  (p=c=1, shared-A)",
+        _hand_pallas_bdcm_vmem,
+    ),
+)
+
+
+def hand_model_ratios(entries: dict) -> dict:
+    """The blessed-ratio table for the ledger: per registered hand model,
+    ``hand(n) / derived_predict(n)`` at each calibration point (None when
+    the derived prediction is non-positive at that point)."""
+    out = {}
+    for hm in HAND_MODELS:
+        row = entries.get(hm.entry)
+        if not row or "models" not in row:
+            continue
+        model = row["models"].get(hm.quantity)
+        if model is None:
+            continue
+        ratios = {}
+        for n in COST_ENTRIES[hm.entry].points:
+            p = predict(model, n)
+            ratios[str(n)] = (hm.hand(n) / p) if p > 0 else None
+        out[hm.name] = {
+            "entry": hm.entry,
+            "quantity": hm.quantity,
+            "formula": hm.formula,
+            "tolerance": hm.tolerance,
+            "ratios": ratios,
+        }
+    return out
+
+
+def check_hand_models(ledger: dict, *, diag=None) -> list[Finding]:
+    """GB102: every registered hand model's live ratio against the derived
+    ledger model must match its blessed ratio within the committed
+    tolerance. Needs no compilation — the derived side is the committed
+    model, the hand side is host-table arithmetic."""
+    findings = []
+    blessed_all = ledger.get("hand_models", {})
+    entries = ledger.get("entries", {})
+    for hm in HAND_MODELS:
+        row = entries.get(hm.entry)
+        if not row or "unsupported" in row or "models" not in row:
+            if diag:
+                diag(f"graftcost: {hm.name}: no usable cost row for "
+                     f"{hm.entry} — GB103 covers the gap")
+            continue
+        blessed = blessed_all.get(hm.name)
+        if blessed is None:
+            findings.append(Finding(
+                hm.entry, "GB102",
+                f"hand model {hm.name!r} ({hm.module}) is registered but "
+                f"not blessed in {LEDGER_NAME} — run --update-ledger so "
+                "its ratio against the derived model is committed",
+            ))
+            continue
+        tol = float(blessed.get("tolerance", hm.tolerance))
+        model = row["models"][hm.quantity]
+        for n in COST_ENTRIES[hm.entry].points:
+            want = blessed.get("ratios", {}).get(str(n))
+            p = predict(model, n)
+            if want is None or p <= 0:
+                continue
+            h = hm.hand(n)
+            got = h / p
+            if abs(got - want) / max(abs(want), 1e-9) > tol:
+                findings.append(Finding(
+                    hm.entry, "GB102",
+                    f"hand model {hm.name!r} ({hm.module}) drifted from "
+                    f"the derived {hm.quantity} model at n={n}: hand "
+                    f"{h:.6g} B / derived {p:.6g} B = {got:.4f}, blessed "
+                    f"ratio {want:.4f} (tol ±{tol:.0%}) — the formula "
+                    "went stale (or a re-blessed program left it behind); "
+                    "fix the formula and/or re-run --update-ledger in the "
+                    "same reviewed PR",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# collection + ledger
+# ---------------------------------------------------------------------------
+
+
+def collect_cost_samples(
+    entries=None, *, diag=None
+) -> dict[str, dict]:
+    """Lower + compile every entry at its calibration sizes and derive the
+    cost facts; ``{"unsupported": reason}`` rows mirror graftcheck's
+    environment-skip contract (the halo entry on a 1-device host)."""
+    out: dict[str, dict] = {}
+    for name in entries or sorted(COST_ENTRIES):
+        spec = COST_ENTRIES[name]
+        samples: dict[str, dict] = {}
+        try:
+            for n in spec.points:
+                if diag:
+                    diag(f"graftcost: lowering + compiling {name} at n={n}")
+                samples[str(n)] = derive_cost(
+                    graftcheck.lower_entry(name, n=n)
+                )
+        except UnsupportedEntry as e:
+            if diag:
+                diag(f"graftcost: {name} unsupported here: {e}")
+            out[name] = {"unsupported": str(e)}
+            continue
+        out[name] = samples
+    return out
+
+
+def build_ledger_entries(live: dict[str, dict]) -> dict:
+    """Ledger rows (samples + fitted models) from live cost samples."""
+    rows: dict[str, dict] = {}
+    for name, samples in live.items():
+        if "unsupported" in samples:
+            rows[name] = dict(samples)
+            continue
+        spec = COST_ENTRIES[name]
+        rows[name] = {
+            "feature": "n",
+            "points": list(spec.points),
+            "holdout": spec.holdout,
+            "samples": samples,
+            "models": fit_models(spec, samples),
+        }
+    return rows
+
+
+def load_ledger(path: Path | str | None = None) -> dict | None:
+    p = Path(path) if path else default_ledger_path()
+    if not p.exists():
+        return None
+    with open(p) as fh:
+        return json.load(fh)
+
+
+def write_ledger(live: dict[str, dict],
+                 path: Path | str | None = None) -> Path:
+    """Persist the cost ledger atomically (the GD007 discipline), stamped
+    with backend + jax version like the graftcheck ledger."""
+    import jax
+
+    from graphdyn.utils.io import write_json_atomic
+
+    rows = build_ledger_entries(live)
+    p = Path(path) if path else default_ledger_path()
+    write_json_atomic(str(p), {
+        "version": 1,
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "canon": {
+            name: graftcheck.ENTRIES[name].canon
+            for name in sorted(rows) if name in graftcheck.ENTRIES
+        },
+        "entries": rows,
+        "hand_models": hand_model_ratios(rows),
+    }, indent=2, sort_keys=True)
+    return p
+
+
+def diff_cost_samples(entry: str, ledger_row: dict,
+                      live_samples: dict[str, dict]) -> list[Finding]:
+    """GB101: per-calibration-point, per-field band diff of the live
+    derivation against the ledger row."""
+    findings = []
+    want_samples = ledger_row.get("samples", {})
+    for n_key in sorted(live_samples, key=int):
+        live = live_samples[n_key]
+        want = want_samples.get(n_key)
+        if want is None:
+            findings.append(Finding(
+                entry, "GB101",
+                f"calibration point n={n_key} has no sample in the ledger "
+                "row — the calibration plan changed without --update-ledger",
+            ))
+            continue
+        for field, (rel, floor) in _SAMPLE_BANDS.items():
+            w = float(want.get(field, 0))
+            g = float(live.get(field, 0))
+            band = max(float(floor), rel * abs(w))
+            if abs(g - w) > band:
+                findings.append(Finding(
+                    entry, "GB101",
+                    f"{field} at n={n_key}: ledger {w:.6g} -> live "
+                    f"{g:.6g} (band ±{band:.6g}) — the compiled program's "
+                    "cost changed; if deliberate, re-run --update-ledger "
+                    "and update the dependent hand models in the same PR",
+                ))
+        lcls = want.get("bytes_by_class", {})
+        vcls = live.get("bytes_by_class", {})
+        for cat, got in sorted(vcls.items()):
+            if got and cat not in lcls:
+                findings.append(Finding(
+                    entry, "GB101",
+                    f"new traffic class {cat!r} at n={n_key} "
+                    f"({got} B) absent from the ledger — the program "
+                    "gained a structurally new kind of memory traffic",
+                ))
+        for cat in sorted(lcls):
+            w = float(lcls.get(cat, 0))
+            g = float(vcls.get(cat, 0))
+            band = max(2048.0, 0.5 * w)
+            if abs(g - w) > band:
+                findings.append(Finding(
+                    entry, "GB101",
+                    f"traffic class {cat!r} at n={n_key}: ledger "
+                    f"{w:.6g} B -> live {g:.6g} B (band ±{band:.6g})",
+                ))
+    return findings
+
+
+def check_exponents(entry: str, spec: CostEntrySpec,
+                    live_samples: dict[str, dict]) -> list[Finding]:
+    """GB104, in-suite on the live samples: measured log-log scaling
+    exponent per declared quantity against the declaration, plus the
+    affine-fit residual against the entry's tolerance."""
+    findings = []
+    xs = [float(n) for n in spec.points]
+    for q, declared in sorted(spec.declared.items()):
+        ys = [_quantity(live_samples[str(n)], q) for n in spec.points]
+        alpha = _scaling_exponent(xs, ys)
+        if alpha is None:
+            findings.append(Finding(
+                entry, "GB104",
+                f"{q} declares scaling exponent {declared} but is "
+                "non-positive at a calibration endpoint — the quantity "
+                "vanished from the program (or the calibration plan broke)",
+            ))
+            continue
+        if abs(alpha - declared) > EXPONENT_TOL:
+            findings.append(Finding(
+                entry, "GB104",
+                f"{q}: measured scaling exponent {alpha:.3f} over "
+                f"n={spec.points[0]}..{spec.points[-1]} departs from the "
+                f"declared {declared} (tol ±{EXPONENT_TOL}) — the model "
+                "shape no longer describes the program (quadratic blowup "
+                "or lost size-dependence); update CostEntrySpec.declared "
+                "deliberately if the new scaling is intended",
+            ))
+        _, _, residual = _fit_affine(xs, ys)
+        if residual > spec.residual_tol:
+            findings.append(Finding(
+                entry, "GB104",
+                f"{q}: affine-fit relative residual {residual:.3f} "
+                f"exceeds the entry tolerance {spec.residual_tol} — "
+                "q(n) = a + b·n no longer fits the measured samples "
+                "(the program's cost is no longer affine in n at these "
+                "shapes)",
+            ))
+    return findings
+
+
+def check_coverage(cost_ledger: dict, *, diag=None) -> list[Finding]:
+    """GB103: every entry point in the graftcheck fingerprint ledger must
+    carry a cost row (coverage, not drift — the cost triad is only
+    complete when every structurally-pinned program is also cost-pinned)."""
+    gc_ledger = graftcheck.load_ledger()
+    names = (
+        set(gc_ledger.get("entries", {})) if gc_ledger
+        else set(graftcheck.ENTRIES)
+    )
+    rows = cost_ledger.get("entries", {})
+    findings = []
+    for name in sorted(names):
+        row = rows.get(name)
+        if row is None:
+            findings.append(Finding(
+                name, "GB103",
+                "entry point is in the graftcheck fingerprint ledger but "
+                f"has no cost row in {LEDGER_NAME} — run `python -m "
+                "graphdyn.analysis.graftcost --update-ledger` and commit "
+                "the new row",
+            ))
+        elif "unsupported" in row and diag:
+            diag(f"graftcost: ledger row for {name} is an environment "
+                 f"skip: {row['unsupported']}")
+    return findings
+
+
+def check_ledger(
+    live: dict[str, dict], ledger: dict | None, *, diag=None
+) -> list[Finding]:
+    """Diff live cost derivations against the committed ledger (GB101 /
+    GB104 per entry, GB102 over the hand-model table, GB103 coverage). A
+    missing ledger is a GB103 finding per live entry — the gate must fail
+    until the contract is committed, never silently pass."""
+    import jax
+
+    if ledger is None:
+        return [
+            Finding(name, "GB103",
+                    f"no cost ledger found ({LEDGER_NAME}) — run `python "
+                    "-m graphdyn.analysis.graftcost --update-ledger` and "
+                    "commit it")
+            for name in sorted(live)
+        ]
+    backend = jax.default_backend()
+    if ledger.get("backend") != backend:
+        if diag:
+            diag(
+                f"graftcost: ledger was built on backend="
+                f"{ledger.get('backend')!r}, live backend is {backend!r} — "
+                "skipping the cost diff (costs are backend-specific; the "
+                "gate runs on JAX_PLATFORMS=cpu). Chip rounds re-center "
+                "the ledger per scripts/pallas_tpu_validate.py"
+            )
+        return []
+    if ledger.get("jax") != jax.__version__ and diag:
+        diag(
+            f"graftcost: ledger jax={ledger.get('jax')} != live "
+            f"jax={jax.__version__} — diffing anyway (bands absorb minor "
+            "drift; re-run --update-ledger after a jax upgrade if needed)"
+        )
+    findings = check_coverage(ledger, diag=diag)
+    flagged = {f.entry for f in findings}
+    entries = ledger.get("entries", {})
+    for name in sorted(live):
+        if "unsupported" in live[name]:
+            if diag:
+                diag(f"graftcost: skipping {name} diff — "
+                     f"{live[name]['unsupported']}")
+            continue
+        row = entries.get(name)
+        if row is None or "unsupported" in row or "models" not in row:
+            if name not in flagged:
+                findings.append(Finding(
+                    name, "GB103",
+                    f"no usable cost row in {LEDGER_NAME} — run "
+                    "--update-ledger and commit the new row",
+                ))
+            continue
+        findings.extend(diff_cost_samples(name, row, live[name]))
+        findings.extend(
+            check_exponents(name, COST_ENTRIES[name], live[name])
+        )
+    findings.extend(check_hand_models(ledger, diag=diag))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# consumers: memcheck cross-check + bench columns
+# ---------------------------------------------------------------------------
+
+#: peak-bytes / derived-model bands for the memcheck cross-check rows
+#: (``derived:<entry>`` programs in :func:`graphdyn.obs.memband.
+#: run_memcheck`). PROVISIONAL like MEM_BANDS: the measured peak includes
+#: allocator slop and whatever ran first in the process; the first chip
+#: round re-centers them (pallas_tpu_validate checklist).
+DERIVED_MEM_BANDS: dict[str, tuple[float, float]] = {
+    "derived:packed_rollout": (0.25, 16.0),
+    "derived:fused_anneal": (0.25, 16.0),
+}
+
+
+def derived_peak_bytes(
+    entry: str, n: int, ledger: dict | None = None
+) -> tuple[float | None, str | None]:
+    """Evaluate the committed derived peak-bytes model of ``entry`` at
+    size ``n`` — ``(bytes, None)`` or ``(None, reason)`` (the null+reason
+    contract: no ledger, backend mismatch, no usable row)."""
+    import jax
+
+    ledger = ledger if ledger is not None else load_ledger()
+    if ledger is None:
+        return None, (
+            f"no cost ledger ({LEDGER_NAME}) — run `python -m "
+            "graphdyn.analysis.graftcost --update-ledger`"
+        )
+    backend = jax.default_backend()
+    if ledger.get("backend") != backend:
+        return None, (
+            f"cost ledger was built on backend={ledger.get('backend')!r}, "
+            f"live backend is {backend!r} — re-center the ledger on this "
+            "backend first (pallas_tpu_validate checklist)"
+        )
+    row = ledger.get("entries", {}).get(entry)
+    if not row or "unsupported" in row or "models" not in row:
+        return None, f"no usable cost row for {entry!r} in {LEDGER_NAME}"
+    v = predict(row["models"]["peak_bytes"], n)
+    if v <= 0:
+        return None, (
+            f"derived peak model of {entry!r} is non-positive at n={n} "
+            "(outside the model's useful range)"
+        )
+    return float(v), None
+
+
+def bench_cost_columns(n: int, ledger: dict | None = None) -> dict:
+    """The ``bench.py`` row columns: ``derived_bytes`` (the derived
+    bytes-moved model of the canonical packed rollout evaluated at the
+    bench size) and ``arithmetic_intensity`` (derived FLOP estimate per
+    derived byte moved) — or explicit nulls + reasons when the ledger
+    cannot speak for this process (missing, other backend). No
+    compilation happens here: the committed models are evaluated as
+    functions, which is the point of fitting them."""
+    import jax
+
+    reason = None
+    ledger = ledger if ledger is not None else load_ledger()
+    if ledger is None:
+        reason = (
+            f"no cost ledger ({LEDGER_NAME}) — run `python -m "
+            "graphdyn.analysis.graftcost --update-ledger`"
+        )
+    elif ledger.get("backend") != jax.default_backend():
+        reason = (
+            f"cost ledger backend {ledger.get('backend')!r} != live "
+            f"{jax.default_backend()!r} — derived models are "
+            "backend-specific"
+        )
+    else:
+        row = ledger.get("entries", {}).get("packed_rollout")
+        if not row or "models" not in row:
+            reason = f"no usable packed_rollout cost row in {LEDGER_NAME}"
+        else:
+            db = predict(row["models"]["bytes_moved"], n)
+            fl = predict(row["models"]["flops_est"], n)
+            if db <= 0 or fl <= 0:
+                reason = (
+                    f"derived packed_rollout model non-positive at n={n} "
+                    "(outside the model's useful range)"
+                )
+            else:
+                return {
+                    "derived_bytes": float(db),
+                    "arithmetic_intensity": float(fl / db),
+                }
+    return {
+        "derived_bytes": None,
+        "derived_bytes_skipped_reason": reason,
+        "arithmetic_intensity": None,
+        "arithmetic_intensity_skipped_reason": reason,
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _diag(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m graphdyn.analysis.graftcost",
+        description="graftcost: HLO-derived byte/FLOP cost models over "
+                    "the committed cost ledger (exit code = number of "
+                    "findings)",
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--ledger", default=None,
+                    help=f"ledger path (default: repo-root {LEDGER_NAME})")
+    ap.add_argument("--update-ledger", action="store_true",
+                    help="recompute every entry's samples + fits and "
+                         "rewrite the ledger (incl. blessed hand ratios)")
+    ap.add_argument("--entries", default=None,
+                    help="comma-separated subset of entry points "
+                         f"(default: all of {', '.join(sorted(COST_ENTRIES))})")
+    args = ap.parse_args(argv)
+
+    names = sorted(COST_ENTRIES)
+    if args.entries:
+        names = [e.strip() for e in args.entries.split(",") if e.strip()]
+        unknown = [e for e in names if e not in COST_ENTRIES]
+        if unknown:
+            ap.error(f"unknown entries: {', '.join(unknown)} "
+                     f"(known: {', '.join(sorted(COST_ENTRIES))})")
+
+    live = collect_cost_samples(names, diag=_diag)
+    findings: list[Finding] = []
+    if args.update_ledger:
+        if set(names) != set(COST_ENTRIES):
+            ap.error("--update-ledger rewrites the WHOLE ledger; it cannot "
+                     "be combined with --entries")
+        unsupported = sorted(
+            n for n, s in live.items() if "unsupported" in s
+        )
+        if unsupported:
+            ap.error(
+                "--update-ledger refuses to write a degraded ledger — "
+                f"unsupported here: {', '.join(unsupported)} (run under "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+            )
+        path = write_ledger(live, args.ledger)
+        _diag(f"graftcost: wrote {len(live)} cost row(s) + "
+              f"{len(HAND_MODELS)} blessed hand ratio(s) to {path}")
+    else:
+        findings.extend(
+            check_ledger(live, load_ledger(args.ledger), diag=_diag)
+        )
+
+    if args.format == "json":
+        # exactly ONE JSON document on stdout; diagnostics live on stderr
+        print(json.dumps({
+            "findings": [f._asdict() for f in findings],
+            "cost": {
+                name: (
+                    samples if "unsupported" in samples else {
+                        "samples": samples,
+                        "models": fit_models(COST_ENTRIES[name], samples),
+                    }
+                )
+                for name, samples in live.items()
+            },
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f"{f.entry}: {f.code} {f.message}")
+    if findings:
+        _diag(f"graftcost: {len(findings)} finding(s)")
+    else:
+        _diag(f"graftcost: {len(live)} entry point(s) clean")
+    return min(len(findings), 125)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -133,6 +133,18 @@ Rules (stable codes; each can be silenced per line with
   the exact round-trip class ROADMAP item 7 removes.  search/ chunk
   loops get the coarser GD014 with its sanctioned per-chunk stop test;
   models/ loops are per-rep/per-λ/per-step and get no such sanction.
+- **GD016** hand-rolled byte-size arithmetic outside the sanctioned cost
+  modules: a ``4``/``8`` itemsize literal multiplying two or more shape
+  variables (``4 * n * W``), or ``.nbytes`` aggregated through ``sum()``
+  or arithmetic, in a ``graphdyn/`` module that is NOT one of the
+  registered cost-model homes (``obs/memband.py``, ``obs/roofline.py``,
+  ``ops/pallas_*.py``, ``parallel/halo.py``, ``analysis/graftcost.py``).
+  Every byte model the repo stakes decisions on is gated against the
+  HLO-*derived* models by graftcost's GB102 (ARCHITECTURE.md "Cost-model
+  contracts"); a byte formula floating free in ordinary code is exactly
+  the hand transcription that goes silently stale — register it as a
+  :data:`graphdyn.analysis.graftcost.HAND_MODELS` adapter or move it
+  into a sanctioned module.
 
 Escape hatches, all requiring an explicit code list (``all`` allowed):
 
@@ -173,6 +185,7 @@ RULES = {
     "GD013": "full-node-axis all_gather/jnp.take in a parallel/ shard-mapped body (halo exchange moves boundary words only)",
     "GD014": "host round-trip (np.asarray/device_get/.item()/block_until_ready/int()/float() coercion) inside a search/ drive loop (swap/sweep chunks stay on device)",
     "GD015": "per-temperature-step host sync (.item()/device_get/block_until_ready/bool()/int()/float() of a jnp.- or jax.-rooted call) in a models/ anneal drive loop (advance the schedule on device — ops/pallas_anneal)",
+    "GD016": "hand-rolled byte-size arithmetic (itemsize literal x shape variables, .nbytes aggregation) outside the sanctioned cost modules (register a graftcost HAND_MODELS adapter)",
 }
 
 # device->host materializations GD014 watches inside search/ drive loops
@@ -197,6 +210,20 @@ _GD014_METHODS = {"item", "block_until_ready"}
 _GD015_CALLS = {"jax.device_get", "device_get"}
 _GD015_METHODS = {"item", "block_until_ready"}
 _GD015_DEVICE_ROOTS = ("jnp", "jax")
+
+# GD016: the itemsize literals that mark byte arithmetic when they
+# multiply shape variables. Deliberately ONLY the 4/8 dtype widths — a
+# literal 2 multiplying two names (`2 * E * K` doubled-count idioms) is
+# everywhere in graph code, and the false-positive cost of the narrower
+# net is just that a 2-byte (f16) model ships unflagged until it grows a
+# 4-byte term, which every model in this f32/int32 codebase has.
+_GD016_ITEMSIZES = {4, 8}
+
+# the sanctioned cost-model homes: byte formulas in these modules are
+# (or must be) registered with graftcost's GB102 gate; anywhere else in
+# graphdyn/ they are GD016 findings
+_GD016_SANCTIONED = ("obs/memband.py", "obs/roofline.py",
+                     "parallel/halo.py", "analysis/graftcost.py")
 
 # the wall-clock calls GD011 watches (time.monotonic is exempt: it is the
 # bookkeeping clock for queue waits and deadlines, not a timing idiom);
@@ -420,6 +447,14 @@ class _FileLinter:
         # time-to-target regardless of kernel speed (the fused annealer
         # exists to remove exactly this round-trip)
         self.models_mod = "/models/" in norm
+        # GD016 scope: the graphdyn package OUTSIDE the sanctioned
+        # cost-model homes — byte formulas live where graftcost's GB102
+        # can gate them against the HLO-derived models, nowhere else
+        self.byte_model_strict = (
+            "graphdyn/" in norm
+            and not any(norm.endswith(s) for s in _GD016_SANCTIONED)
+            and "ops/pallas_" not in norm
+        )
 
     def emit(self, node: ast.AST, code: str, message: str) -> None:
         self.findings.append(
@@ -501,6 +536,7 @@ class _FileLinter:
         self._check_shardmap_full_gather(tree)
         self._check_search_loop_sync(tree, seen)
         self._check_anneal_loop_sync(tree, seen)
+        self._check_byte_model_arith(tree)
         self.findings.sort(key=lambda f: (f.line, f.col, f.code))
         return self.findings
 
@@ -982,6 +1018,89 @@ class _FileLinter:
                         f"back once after the loop, and derive chunk "
                         f"budgets host-side",
                     )
+
+    def _check_byte_model_arith(self, tree: ast.Module):
+        """GD016: a hand-rolled byte model outside the sanctioned cost
+        modules — an itemsize literal (4/8) multiplying two or more shape
+        variables (``4 * n * W``), or ``.nbytes`` aggregated through
+        ``sum()`` or arithmetic. Byte formulas must live where graftcost's
+        GB102 gates them against the HLO-derived models (the
+        ``HAND_MODELS`` adapter table); anywhere else they are the hand
+        transcription that goes silently stale when the program changes.
+        One finding per multiplication chain (the flagged node is the
+        outermost ``Mult``)."""
+        if not self.byte_model_strict:
+            return
+
+        def flatten_mult(node):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+                yield from flatten_mult(node.left)
+                yield from flatten_mult(node.right)
+            else:
+                yield node
+
+        # only outermost Mult chains: children of a Mult are part of
+        # their parent's chain, never their own finding
+        inner: set[int] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+                for side in (node.left, node.right):
+                    if isinstance(side, ast.BinOp) \
+                            and isinstance(side.op, ast.Mult):
+                        inner.add(id(side))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.BinOp) \
+                    or not isinstance(node.op, ast.Mult) \
+                    or id(node) in inner:
+                continue
+            factors = list(flatten_mult(node))
+            sizes = [
+                f for f in factors
+                if isinstance(f, ast.Constant)
+                and isinstance(f.value, int) and f.value in _GD016_ITEMSIZES
+            ]
+            names = [
+                f for f in factors
+                if isinstance(f, (ast.Name, ast.Attribute))
+            ]
+            if sizes and len(names) >= 2:
+                self.emit(
+                    node, "GD016",
+                    f"byte-size arithmetic ({sizes[0].value} * "
+                    f"{len(names)} shape variables) outside the "
+                    "sanctioned cost modules — hand byte models go "
+                    "stale silently; register the formula as a "
+                    "graphdyn.analysis.graftcost.HAND_MODELS adapter "
+                    "(GB102 then gates it against the HLO-derived model) "
+                    "or move it into obs/memband.py / obs/roofline.py / "
+                    "parallel/halo.py / ops/pallas_*.py",
+                )
+        for node in ast.walk(tree):
+            is_agg = False
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                    and node.func.id == "sum":
+                is_agg = True
+            elif isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, (ast.Add, ast.Mult)):
+                # direct operands only — a nested chain flags once at its
+                # own BinOp, and .nbytes deeper inside a call argument is
+                # that call's business
+                for side in (node.left, node.right):
+                    if isinstance(side, ast.Attribute) \
+                            and side.attr == "nbytes":
+                        is_agg = True
+            if is_agg and any(
+                isinstance(sub, ast.Attribute) and sub.attr == "nbytes"
+                for sub in ast.walk(node)
+            ):
+                self.emit(
+                    node, "GD016",
+                    ".nbytes aggregation builds a hand byte model outside "
+                    "the sanctioned cost modules — register a "
+                    "graphdyn.analysis.graftcost.HAND_MODELS adapter so "
+                    "GB102 gates the model against the derived one, or "
+                    "move it into a sanctioned cost module",
+                )
 
     def _check_anneal_loop_sync(self, tree: ast.Module, jit_seen: set):
         """GD015: device→host materialization per temperature step — a

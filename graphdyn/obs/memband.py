@@ -221,9 +221,20 @@ class MemRow(NamedTuple):
         return self.lo <= self.frac <= self.hi
 
 
+def _bands(program: str) -> tuple[float, float]:
+    """Band lookup: the hand-model bands here, the ``derived:*``
+    cross-check rows' bands next to the models they evaluate
+    (:data:`graphdyn.analysis.graftcost.DERIVED_MEM_BANDS`)."""
+    if program in MEM_BANDS:
+        return MEM_BANDS[program]
+    from graphdyn.analysis import graftcost
+
+    return graftcost.DERIVED_MEM_BANDS[program]
+
+
 def _row(program: str, measured: int | None, model: float,
          reason: str | None = None) -> MemRow:
-    lo, hi = MEM_BANDS[program]
+    lo, hi = _bands(program)
     frac = (measured / model) if (measured is not None and model) else None
     return MemRow(program, measured, model, frac, lo, hi, reason)
 
@@ -266,9 +277,11 @@ def run_memcheck(*, diag=None) -> list[MemRow]:
             _row("entropy_cell_chunk", None, entropy_chunk_bytes(stk),
                  reason),
             _row("halo_shard", None, _halo_smoke_model(W=W), reason),
+            *_derived_rows(reason),
         ]
     else:
-        rows = [_measure_packed(), *_measure_bdcm_rows(), _measure_halo()]
+        rows = [_measure_packed(), *_measure_bdcm_rows(), _measure_halo(),
+                *_derived_rows(None)]
     from graphdyn import obs
 
     for row in rows:
@@ -286,6 +299,63 @@ def run_memcheck(*, diag=None) -> list[MemRow]:
                      f"frac {row.frac:.3f} (band [{row.lo:g}, {row.hi:g}]) "
                      f"{verdict}")
     return rows
+
+
+def _derived_rows(reason: str | None) -> list[MemRow]:
+    """Cross-check rows against the HLO-*derived* peak models of
+    :mod:`graphdyn.analysis.graftcost` (ARCHITECTURE.md "Cost-model
+    contracts"): the committed ``COST_LEDGER.json`` fit, evaluated at a
+    canonical-family shape well beyond the calibration points — so the
+    hand bands above and the derived bands must BOTH hold on a chip.
+    ``reason`` is the stats-unavailability reason (the structural pass);
+    when stats are live the packed row runs the canonical program and
+    measures its peak, while the fused chunk (whose carry has no
+    standalone runtime harness) stays a structural row with its reason."""
+    from graphdyn.analysis import graftcost
+
+    rows = []
+    for program, entry, n in (
+        ("derived:packed_rollout", "packed_rollout", 32768),
+        ("derived:fused_anneal", "fused_anneal", 4096),
+    ):
+        model, mreason = graftcost.derived_peak_bytes(entry, n)
+        if model is None:
+            rows.append(_row(program, None, 0.0, mreason))
+            continue
+        if reason is not None:
+            rows.append(_row(program, None, model, reason))
+            continue
+        if entry == "packed_rollout":
+            measured, why = _measure_derived_packed(n)
+            rows.append(_row(program, measured, model, why))
+        else:
+            rows.append(_row(
+                program, None, model,
+                "the canonical fused chunk's loop carry has no standalone "
+                "runtime harness — structural check only",
+            ))
+    return rows
+
+
+def _measure_derived_packed(n: int) -> tuple[int | None, str | None]:
+    """Peak bytes through the CANONICAL packed-rollout family (R=128 →
+    W=4, steps=4 — the exact program graftcost's models are fitted on,
+    at a size far outside the fit range)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from graphdyn.graphs import random_regular_graph
+    from graphdyn.ops.packed import pack_spins, packed_rollout
+
+    g = random_regular_graph(n, 3, seed=0)
+    rng = np.random.default_rng(0)
+    s = (2 * rng.integers(0, 2, size=(128, g.n)) - 1).astype(np.int8)
+    out = packed_rollout(
+        jnp.asarray(g.nbr), jnp.asarray(g.deg), jnp.asarray(pack_spins(s)),
+        steps=4,
+    )
+    np.asarray(out)                     # drain: the peak includes the run
+    return peak_hbm_bytes()
 
 
 def _measure_packed(*, n: int = 32768, d: int = 3, W: int = 8,

@@ -75,13 +75,13 @@ VMEM_BUDGET = 10 * 1024 * 1024
 MAX_BLOCK_EDGES = 8192  # wider tiles add nothing once the VPU is saturated
 
 
-def vmem_block_edges(d: int, T: int, budget: int = VMEM_BUDGET,
-                     G: int = 0) -> int:
-    """Largest lane-multiple edge-tile width whose VMEM working set fits
-    ``budget``, capped at ``MAX_BLOCK_EDGES``. Returns 0 when even a single
-    lane-width tile does not fit (callers keep that class on the XLA path).
-
-    Byte model (f32 = 4 B):
+def vmem_bytes(d: int, T: int, edges: int, G: int = 0) -> int:
+    """VMEM working-set byte model of the DP-contract kernel at an
+    ``edges``-wide tile (f32 = 4 B) — the public formula both
+    :func:`vmem_block_edges` and the graftcost hand-model adapter
+    (``graphdyn.analysis.graftcost.HAND_MODELS``) evaluate, so the tiling
+    decision and the GB102 gate can never disagree about what the kernel
+    is believed to hold resident.
 
     - ``G=0`` — the serial / shared-A kernel: the broadcast A rows
       ``[K², M]`` ride the grid pipeline double-buffered → fixed
@@ -104,6 +104,17 @@ def vmem_block_edges(d: int, T: int, budget: int = VMEM_BUDGET,
     else:
         fixed = 8 * K * K * M                    # a_rows, double-buffered
     per_edge = 8 * (K * K * (d + 2) + K * M)     # blocks ×2 + scratch ×2
+    return fixed + per_edge * edges
+
+
+def vmem_block_edges(d: int, T: int, budget: int = VMEM_BUDGET,
+                     G: int = 0) -> int:
+    """Largest lane-multiple edge-tile width whose VMEM working set
+    (:func:`vmem_bytes`) fits ``budget``, capped at ``MAX_BLOCK_EDGES``.
+    Returns 0 when even a single lane-width tile does not fit (callers
+    keep that class on the XLA path)."""
+    fixed = vmem_bytes(d, T, 0, G)
+    per_edge = vmem_bytes(d, T, 1, G) - fixed
     eb = (budget - fixed) // per_edge
     return int(min(MAX_BLOCK_EDGES, max(0, eb // LANE) * LANE))
 

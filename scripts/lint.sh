@@ -137,6 +137,37 @@ else
     fi
 fi
 
+# 6b. costcheck — the HLO-derived cost-model gate (graphdyn.analysis.
+#     graftcost): re-derive every graftcheck-ledgered entry point's
+#     byte/FLOP costs at the calibration shapes and diff them against the
+#     committed COST_LEDGER.json (GB101 drift, GB102 stale hand models,
+#     GB103 coverage, GB104 scaling-exponent departures). Then the
+#     graftcost pytest subset (pytest -m graftcost: falsifiability both
+#     ways, holdout scaling laws, the adapter/doc sync). Skipped with a
+#     notice when GRAPHDYN_SKIP_COSTCHECK=1 (set by the tier-1 lint-gate
+#     test: the subset already runs in that same suite; mirrors hlocheck).
+if [ "${GRAPHDYN_SKIP_COSTCHECK:-0}" = "1" ]; then
+    echo "== costcheck: GRAPHDYN_SKIP_COSTCHECK=1 — SKIPPED (subset runs in tier-1) =="
+else
+    echo "== costcheck (graftcost cost ledger) =="
+    # same simulated 8-device host platform as hlocheck, so the
+    # multi-device entries (halo_rollout) are checked, not skipped
+    cost_xla_flags="${XLA_FLAGS:-}"
+    case "$cost_xla_flags" in
+        *xla_force_host_platform_device_count*) ;;
+        *) cost_xla_flags="$cost_xla_flags --xla_force_host_platform_device_count=8" ;;
+    esac
+    JAX_PLATFORMS=cpu XLA_FLAGS="${cost_xla_flags# }" \
+        python -m graphdyn.analysis.graftcost --format=text || fail=1
+    if python -c 'import pytest' 2>/dev/null; then
+        echo "== costcheck (pytest -m graftcost) =="
+        JAX_PLATFORMS=cpu python -m pytest tests/ -q -m graftcost \
+            -p no:cacheprovider || fail=1
+    else
+        echo "== costcheck: pytest not installed — graftcost subset SKIPPED (pip install pytest to enable) =="
+    fi
+fi
+
 # 7. obscheck — the roofline-anchored runtime perf bands (python -m
 #    graphdyn.obs check): measure the headline CPU proxies (packed
 #    rollout, BDCM sweep core, entropy cell chunk) against rates derived
@@ -266,6 +297,17 @@ if ecp is None:
         "null entropy_cell_rate_pallas needs a skipped_reason"
 else:
     assert ecp > 0, f"entropy_cell_rate_pallas must be > 0 or null+reason: {ecp}"
+# the graftcost derived-cost columns: the committed ledger models
+# evaluated at the bench size — positive, or an explicit null + reason
+# (e.g. a backend the ledger was never blessed on) — NEVER 0.0
+for col in ("derived_bytes", "arithmetic_intensity"):
+    assert col in row, f"{col} column absent"
+    v = row[col]
+    if v is None:
+        assert row.get(f"{col}_skipped_reason"), \
+            f"null {col} needs {col}_skipped_reason"
+    else:
+        assert v > 0, f"{col} must be > 0 or null+reason: {v}"
 # the graftcheck fingerprint summary: a structural snapshot per round, or
 # an explicit null + reason — never silently absent
 assert "fingerprints" in row, "fingerprints row absent"

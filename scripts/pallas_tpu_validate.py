@@ -53,6 +53,17 @@ bands are provisional until one does):
    differs from the ``fused_vmem_bytes`` model by more than the
    documented ~33% margin. (c) the ``tta_fused`` device-step counts
    must match the CPU rows bit-for-bit (same contract as item 5).
+7. Re-center the cost ledger on chip: run ``python -m
+   graphdyn.analysis.graftcost --update-ledger`` on the TPU backend and
+   commit the chip-stamped ``COST_LEDGER.json`` (the cpu-backend gate
+   keeps its own diff; the chip rows are what ``obs memcheck``'s
+   ``derived:*`` cross-check and bench's ``derived_bytes`` /
+   ``arithmetic_intensity`` columns evaluate on-chip). Then re-center
+   ``graftcost.DERIVED_MEM_BANDS`` (provisional, like ``MEM_BANDS``) on
+   the measured ``memory_stats()`` peaks from step 2, and sanity-check
+   the blessed ``fused_vmem_bytes`` GB102 ratio against the compiler's
+   scoped-vmem charge from item 6(b) — all three updates in the same
+   reviewed PR as the band re-centering.
 """
 
 from __future__ import annotations

@@ -1150,6 +1150,82 @@ class TestGD015AnnealLoopSync:
         assert [f for f in lint_sources(sources) if f.code == "GD015"] == []
 
 
+class TestGD016ByteModelArith:
+    """Hand-rolled byte-size arithmetic outside the sanctioned cost
+    modules: an itemsize literal (4/8) multiplying two or more shape
+    variables, or ``.nbytes`` aggregated through ``sum()``/arithmetic.
+    Byte formulas belong where graftcost's GB102 gates them against the
+    HLO-derived models (ARCHITECTURE.md "Cost-model contracts")."""
+
+    OPS = "graphdyn/ops/tables.py"
+    BAD_ITEMSIZE = (
+        "def footprint(n, W):\n"
+        "    return 4 * n * W\n"                    # GD016
+    )
+    BAD_ITEMSIZE8 = (
+        "def footprint(n, chi, dmax):\n"
+        "    total = 8 * n * chi * (1 + dmax)\n"    # GD016
+        "    return total\n"
+    )
+    BAD_NBYTES_SUM = (
+        "def footprint(tables):\n"
+        "    return sum(t.nbytes for t in tables)\n"  # GD016
+    )
+    BAD_NBYTES_ARITH = (
+        "def footprint(a, b):\n"
+        "    return a.nbytes + b.nbytes\n"            # GD016
+    )
+    GOOD_SINGLE_VAR = (
+        "def stride(n):\n"
+        "    return 4 * n\n"                # one shape var: an offset, not a model
+    )
+    GOOD_NON_ITEMSIZE = (
+        "def degree_pairs(E, K):\n"
+        "    return 2 * E * K\n"            # 2 is a count, not an itemsize
+    )
+    GOOD_BARE_NBYTES = (
+        "def report(arr):\n"
+        "    return arr.nbytes\n"           # reading one buffer is not a model
+    )
+
+    def test_bad_itemsize_chain(self):
+        assert "GD016" in _codes(self.BAD_ITEMSIZE, path=self.OPS)
+        assert "GD016" in _codes(self.BAD_ITEMSIZE8, path=self.OPS)
+
+    def test_bad_nbytes_aggregation(self):
+        assert "GD016" in _codes(self.BAD_NBYTES_SUM, path=self.OPS)
+        assert "GD016" in _codes(self.BAD_NBYTES_ARITH, path=self.OPS)
+
+    def test_one_finding_per_chain(self):
+        """A nested a*b*c*d chain flags once at the outermost Mult, not
+        once per BinOp."""
+        codes = _codes(self.BAD_ITEMSIZE8, path=self.OPS)
+        assert codes == ["GD016"]
+
+    def test_good_examples(self):
+        for src in (self.GOOD_SINGLE_VAR, self.GOOD_NON_ITEMSIZE,
+                    self.GOOD_BARE_NBYTES):
+            assert _codes(src, path=self.OPS) == [], src
+
+    def test_sanctioned_modules_exempt(self):
+        for path in ("graphdyn/obs/memband.py", "graphdyn/obs/roofline.py",
+                     "graphdyn/parallel/halo.py",
+                     "graphdyn/analysis/graftcost.py",
+                     "graphdyn/ops/pallas_bdcm.py", "bench.py"):
+            assert "GD016" not in _codes(self.BAD_ITEMSIZE, path=path), path
+
+    def test_disable_comment(self):
+        src = self.BAD_ITEMSIZE.replace(
+            "    return 4 * n * W",
+            "    # graftlint: disable-next-line=GD016  refusal guard\n"
+            "    return 4 * n * W",
+        )
+        assert _codes(src, path=self.OPS) == []
+
+    def test_catalogued(self):
+        assert "GD016" in RULES
+
+
 class TestGD007AtomicPersistence:
     BAD_SAVEZ = (
         "import numpy as np\n"
@@ -1326,7 +1402,7 @@ def test_unreadable_file_is_a_finding(tmp_path):
 
 
 def test_rules_registry_complete():
-    assert set(RULES) == {f"GD{i:03d}" for i in range(1, 16)}
+    assert set(RULES) == {f"GD{i:03d}" for i in range(1, 17)}
 
 
 def test_cli_json_is_one_document_stdout_only(tmp_path):

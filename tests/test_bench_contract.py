@@ -78,6 +78,15 @@ def test_bench_smoke_emits_one_json_line():
         # round's own ledger
         spans = {e["name"] for e in events if e["ev"] == "span"}
         assert "bench.packed_rate" in spans and "bench.int8_rate" in spans
+    # the derived cost-model columns (graftcost ledger models evaluated at
+    # the bench size): positive values, or an explicit null + reason —
+    # never zeros, never silently absent
+    for col in ("derived_bytes", "arithmetic_intensity"):
+        assert col in row, col
+        if row[col] is None:
+            assert row[col + "_skipped_reason"], col
+        else:
+            assert row[col] > 0, (col, row[col])
     # the durable-store save-overhead column (interleaved p50/p99 A/B of
     # DurableCheckpoint.save vs raw Checkpoint.save): a measured ratio or
     # an explicit null + reason — never silently absent

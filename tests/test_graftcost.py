@@ -1,0 +1,343 @@
+"""graftcost: HLO-derived byte/FLOP cost models and the committed cost
+ledger.
+
+The acceptance contract (ISSUE 16): the committed ``COST_LEDGER.json``
+must match the live derivations (GB101 fails tier-1 on unblessed cost
+drift); every registered hand-written byte model must track its derived
+counterpart at the blessed ratio (GB102 — perturbing a hand coefficient
+fails, demonstrated below by monkeypatching ``fused_vmem_bytes``); every
+graftcheck-ledgered entry point must carry a cost row (GB103); and the
+measured scaling exponents must match their declarations (GB104). The
+fitted models are *functions*, not point samples: the held-out-shape
+tests below compile each entry at a size the fit never saw and assert the
+model predicts it. All tests carry the ``graftcost`` marker so
+``scripts/lint.sh`` costcheck can run the subset standalone.
+"""
+
+import copy
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from graphdyn.analysis import graftcheck as gc
+from graphdyn.analysis import graftcost as gcst
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.graftcost
+
+
+@pytest.fixture(scope="module")
+def live_cost():
+    """Live cost derivations for every entry at every calibration point,
+    computed once per module (27 small compiles, ~20 s on CPU)."""
+    return gcst.collect_cost_samples()
+
+
+@pytest.fixture(scope="module")
+def ledger():
+    led = gcst.load_ledger()
+    assert led is not None, (
+        f"{gcst.LEDGER_NAME} missing — run --update-ledger and commit it"
+    )
+    return led
+
+
+# ---------------------------------------------------------------------------
+# the ledger gate
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_matches_live(live_cost, ledger):
+    """THE tier-1 cost gate: live derivations diff clean against the
+    committed ledger across GB101/GB102/GB103/GB104. A failing diff means
+    a program's cost moved — fix the regression, or (if deliberate)
+    re-run ``python -m graphdyn.analysis.graftcost --update-ledger`` and
+    commit the reviewed ledger + hand-model updates in the same PR."""
+    findings = gcst.check_ledger(live_cost, ledger)
+    assert findings == [], "\n".join(
+        f"{f.entry}: {f.code} {f.message}" for f in findings
+    )
+
+
+def test_cost_entries_cover_graftcheck_entries(ledger):
+    """GB103's premise holds on the shipped tree: the cost calibration
+    plan covers exactly the graftcheck entry points, the committed ledger
+    has a row for each, and the coverage check itself is clean."""
+    assert set(gcst.COST_ENTRIES) == set(gc.ENTRIES)
+    assert set(ledger["entries"]) == set(gc.ENTRIES)
+    assert ledger["backend"] == "cpu"   # the hardware-free contract
+    assert gcst.check_coverage(ledger) == []
+
+
+def test_missing_ledger_fails_closed(live_cost):
+    """No ledger file -> a GB103 finding per live entry, never a silent
+    pass."""
+    findings = gcst.check_ledger(live_cost, None)
+    assert {f.code for f in findings} == {"GB103"}
+    assert len(findings) == len(live_cost)
+
+
+def test_update_ledger_roundtrip(tmp_path, live_cost):
+    path = tmp_path / "ledger.json"
+    gcst.write_ledger(live_cost, path)
+    assert gcst.check_ledger(live_cost, gcst.load_ledger(path)) == []
+
+
+# ---------------------------------------------------------------------------
+# falsifiability: each GB rule must fail when its invariant is broken
+# ---------------------------------------------------------------------------
+
+
+def test_gb101_doctored_sample_fails(live_cost, ledger):
+    """Inflating a live peak-bytes sample 3x past the band is a GB101."""
+    name = "packed_rollout"
+    doctored = copy.deepcopy(live_cost[name])
+    k = str(gcst.COST_ENTRIES[name].points[0])
+    doctored[k]["peak_bytes"] *= 3
+    findings = gcst.diff_cost_samples(
+        name, ledger["entries"][name], doctored
+    )
+    assert "GB101" in {f.code for f in findings}
+    assert any("peak_bytes" in f.message for f in findings)
+
+
+def test_gb101_resident_set_change_fails(ledger):
+    """The acceptance-criterion break: actually changing a lowered
+    program's resident set (doubling the packed rollout's replica extent
+    R) without blessing fails GB101 — the derived facts move past every
+    byte band."""
+    name = "packed_rollout"
+    n = gcst.COST_ENTRIES[name].points[0]
+    fat = gcst.derive_cost(gc.lower_entry(name, n=n, R=256))
+    findings = gcst.diff_cost_samples(
+        name, ledger["entries"][name], {str(n): fat}
+    )
+    assert "GB101" in {f.code for f in findings}
+
+
+def test_gb102_hand_coefficient_perturbation_fails(ledger, monkeypatch):
+    """The acceptance-criterion break: doubling ``fused_vmem_bytes``
+    (the Pallas annealer's VMEM formula) fails GB102 against the blessed
+    ratio — with NO compilation, because both sides of the check are
+    committed-model/host-table arithmetic."""
+    import graphdyn.ops.pallas_anneal as pa
+
+    assert gcst.check_hand_models(ledger) == []   # clean before
+    orig = pa.fused_vmem_bytes
+    monkeypatch.setattr(
+        pa, "fused_vmem_bytes", lambda *a, **k: 2 * orig(*a, **k)
+    )
+    findings = gcst.check_hand_models(ledger)
+    assert [f.code for f in findings].count("GB102") >= 1
+    assert all(f.entry == "fused_anneal" for f in findings)
+    assert any("fused_vmem_bytes" in f.message for f in findings)
+
+
+def test_gb102_unblessed_hand_model_fails(ledger):
+    """A registered hand model with no blessed ratio row is a GB102 (the
+    adapter table and the ledger must move together)."""
+    stripped = copy.deepcopy(ledger)
+    del stripped["hand_models"]["fused_vmem_bytes"]
+    findings = gcst.check_hand_models(stripped)
+    assert [f.code for f in findings] == ["GB102"]
+    assert "not blessed" in findings[0].message
+
+
+def test_gb103_dropped_row_fails(ledger):
+    stripped = copy.deepcopy(ledger)
+    del stripped["entries"]["bdcm_sweep"]
+    findings = gcst.check_coverage(stripped)
+    assert [f.code for f in findings] == ["GB103"]
+    assert findings[0].entry == "bdcm_sweep"
+
+
+def test_gb104_broken_scaling_fails(live_cost):
+    """Flattening the samples (same cost at every n) breaks the declared
+    linear exponent; bending the middle point breaks the affine-residual
+    check — both are GB104."""
+    name = "packed_rollout"
+    spec = gcst.COST_ENTRIES[name]
+    flat = copy.deepcopy(live_cost[name])
+    first = flat[str(spec.points[0])]
+    for n in spec.points[1:]:
+        flat[str(n)] = copy.deepcopy(first)    # exponent 0, declared 1.0
+    findings = gcst.check_exponents(name, spec, flat)
+    assert "GB104" in {f.code for f in findings}
+    assert any("scaling exponent" in f.message for f in findings)
+
+    bent = copy.deepcopy(live_cost[name])
+    bent[str(spec.points[1])]["peak_bytes"] *= 2.0   # off the affine line
+    findings = gcst.check_exponents(name, spec, bent)
+    assert any(
+        f.code == "GB104" and "residual" in f.message for f in findings
+    )
+
+
+# ---------------------------------------------------------------------------
+# the models are functions: held-out-shape prediction (never fitted)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("entry", sorted(gcst.COST_ENTRIES))
+def test_holdout_prediction_within_band(entry, ledger):
+    """Compile the entry at its held-out size — a shape the affine fit
+    never saw — and assert the committed model predicts every fitted
+    quantity within 15% (+4 KiB floor for the small-absolute fields).
+    This is what makes the ledger a cost *model* rather than a cache of
+    point samples."""
+    spec = gcst.COST_ENTRIES[entry]
+    assert spec.holdout not in spec.points
+    facts = gcst.derive_cost(gc.lower_entry(entry, n=spec.holdout))
+    models = ledger["entries"][entry]["models"]
+    for q in gcst.FIT_QUANTITIES:
+        model = models.get(q)
+        got = gcst._quantity(facts, q)
+        if model is None or gcst.predict(model, spec.holdout) <= 0:
+            continue   # quantity absent from this entry (e.g. collectives)
+        want = gcst.predict(model, spec.holdout)
+        band = max(4096.0, 0.15 * want)
+        assert abs(got - want) <= band, (
+            f"{entry}.{q} at held-out n={spec.holdout}: derived {got:.6g} "
+            f"vs model prediction {want:.6g} (band ±{band:.6g})"
+        )
+
+
+def test_declared_exponents_match_ledger_fits(ledger):
+    """Every declared exponent sits within the GB104 band of the
+    exponent recorded in the committed ledger fit — the declarations are
+    measurements rounded to a claim, not aspirations."""
+    for name, spec in gcst.COST_ENTRIES.items():
+        models = ledger["entries"][name]["models"]
+        for q, declared in spec.declared.items():
+            exp = models[q].get("exponent")
+            assert exp is not None, (name, q)
+            assert abs(exp - declared) <= gcst.EXPONENT_TOL, (
+                f"{name}.{q}: declared {declared}, ledger fit {exp:.3f}"
+            )
+
+
+# ---------------------------------------------------------------------------
+# hand-model adapter table ↔ ARCHITECTURE.md (single source of truth)
+# ---------------------------------------------------------------------------
+
+
+def test_hand_model_table_synced_with_architecture_md():
+    """Both directions: every registered ``HAND_MODELS`` adapter is a row
+    of ARCHITECTURE.md's byte-model adapter table (name, module, entry,
+    quantity all rendered), and every table row names a registered
+    adapter — the doc cannot drift from the code or vice versa."""
+    import re
+
+    doc = (REPO / "ARCHITECTURE.md").read_text()
+    rows = re.findall(
+        r"^\| *`([\w.]+)` *\| *`([\w.]+)` *\| *(\w+) *\| *(\w+) *\|",
+        doc, re.MULTILINE,
+    )
+    doc_rows = {r[0]: r[1:] for r in rows}
+    registered = {
+        hm.name: (hm.module, hm.entry, hm.quantity)
+        for hm in gcst.HAND_MODELS
+    }
+    assert set(doc_rows) == set(registered), (
+        "ARCHITECTURE.md byte-model adapter table out of sync with "
+        "graftcost.HAND_MODELS: "
+        f"doc-only={sorted(set(doc_rows) - set(registered))}, "
+        f"code-only={sorted(set(registered) - set(doc_rows))}"
+    )
+    for name, want in registered.items():
+        assert doc_rows[name] == want, (
+            f"adapter row {name!r}: doc says {doc_rows[name]}, "
+            f"code says {want}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# consumers: memcheck cross-check rows + bench columns
+# ---------------------------------------------------------------------------
+
+
+def test_memcheck_emits_derived_rows():
+    """obs memcheck cross-checks the measured peak against the DERIVED
+    models too: both ``derived:*`` rows are present and pass (structurally
+    on a stats-less CPU backend: model positive, explicit reason)."""
+    from graphdyn.obs.memband import run_memcheck
+
+    rows = {r.program: r for r in run_memcheck()}
+    for prog in gcst.DERIVED_MEM_BANDS:
+        assert prog in rows, sorted(rows)
+        r = rows[prog]
+        assert r.ok, r
+        assert r.model > 0
+        if r.measured is None:
+            assert r.reason, r      # the null+reason contract
+
+
+def test_bench_cost_columns_positive_with_ledger(ledger):
+    cols = gcst.bench_cost_columns(4096, ledger)
+    assert cols["derived_bytes"] > 0
+    assert cols["arithmetic_intensity"] > 0
+    assert "derived_bytes_skipped_reason" not in cols
+
+
+def test_bench_cost_columns_null_plus_reason():
+    """Wrong backend or unusable row -> explicit nulls with reasons,
+    never zeros and never missing columns."""
+    for bad in ({"backend": "tpu", "entries": {}},
+                {"backend": "cpu", "entries": {}}):
+        cols = gcst.bench_cost_columns(4096, bad)
+        assert cols["derived_bytes"] is None
+        assert cols["arithmetic_intensity"] is None
+        assert cols["derived_bytes_skipped_reason"]
+        assert cols["arithmetic_intensity_skipped_reason"]
+
+
+def test_derived_peak_bytes_contract(ledger):
+    v, reason = gcst.derived_peak_bytes("packed_rollout", 32768, ledger)
+    assert v is not None and v > 0 and reason is None
+    v, reason = gcst.derived_peak_bytes(
+        "packed_rollout", 32768, {"backend": "tpu"}
+    )
+    assert v is None and "backend" in reason
+
+
+# ---------------------------------------------------------------------------
+# CLI contract (mirrors graftlint/graftcheck/racecheck)
+# ---------------------------------------------------------------------------
+
+
+def test_cli_json_is_one_document_stdout_only():
+    proc = subprocess.run(
+        [sys.executable, "-m", "graphdyn.analysis.graftcost",
+         "--format=json", "--entries", "bdcm_sweep"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    doc = json.loads(proc.stdout)        # the whole stdout parses
+    assert proc.returncode == 0, doc["findings"]
+    assert doc["findings"] == []
+    assert set(doc["cost"]) == {"bdcm_sweep"}
+    assert "graftcost" in proc.stderr    # diagnostics went to stderr
+    assert "graftcost" not in proc.stdout
+
+
+def test_cli_unknown_entry_rejected():
+    proc = subprocess.run(
+        [sys.executable, "-m", "graphdyn.analysis.graftcost",
+         "--entries", "nope"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "unknown entries" in proc.stderr
+
+
+def test_cli_update_refuses_entry_subset():
+    proc = subprocess.run(
+        [sys.executable, "-m", "graphdyn.analysis.graftcost",
+         "--update-ledger", "--entries", "bdcm_sweep"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "WHOLE ledger" in proc.stderr

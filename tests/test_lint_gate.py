@@ -21,6 +21,7 @@ REPO = Path(__file__).resolve().parent.parent
 KNOWN_HATCHES = {
     "GRAPHDYN_SKIP_FAULTCHECK", "GRAPHDYN_SKIP_SOAKCHECK",
     "GRAPHDYN_SKIP_PALLASCHECK", "GRAPHDYN_SKIP_HLOCHECK",
+    "GRAPHDYN_SKIP_COSTCHECK",
     "GRAPHDYN_SKIP_OBSCHECK", "GRAPHDYN_SKIP_MEMCHECK",
     "GRAPHDYN_SKIP_COLORCHECK", "GRAPHDYN_SKIP_BENCHCHECK",
     "GRAPHDYN_SKIP_RACECHECK", "GRAPHDYN_SKIP_TRENDGATE",

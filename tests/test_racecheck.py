@@ -379,18 +379,21 @@ def test_rule_catalogue_synced_with_architecture_md():
     can no longer drift from the code by hand (today's 15 GD rules were
     drift-checked manually)."""
     from graphdyn.analysis.graftcheck import RULES as GC_RULES
+    from graphdyn.analysis.graftcost import RULES as GB_RULES
     from graphdyn.analysis.graftlint import RULES as GD_RULES
 
-    defined = set(GD_RULES) | set(GC_RULES) | set(rc.RULES)
+    defined = set(GD_RULES) | set(GC_RULES) | set(rc.RULES) | set(GB_RULES)
     doc = (REPO / "ARCHITECTURE.md").read_text()
-    doc_tokens = set(re.findall(r"\b(?:GD|GC|GT)\d{3}\b", doc))
+    doc_tokens = set(re.findall(r"\b(?:GD|GC|GT|GB)\d{3}\b", doc))
     undocumented = sorted(defined - doc_tokens)
     assert not undocumented, (
         f"rules defined in code but absent from ARCHITECTURE.md's "
         f"catalogue: {undocumented}"
     )
     # GD000/GT000 are the linters' syntax-error sentinels, not rules
-    phantom = sorted(doc_tokens - defined - {"GD000", "GT000", "GC000"})
+    phantom = sorted(
+        doc_tokens - defined - {"GD000", "GT000", "GC000", "GB000"}
+    )
     assert not phantom, (
         f"ARCHITECTURE.md mentions rule ids no linter defines: {phantom}"
     )
