@@ -425,6 +425,97 @@ def heartbeat_overhead(smoke: bool):
     }}
 
 
+def serve_bucket_hit_rate(smoke: bool):
+    """The serve bucketing payoff: a multi-tenant queue where tenants
+    repeat graphs (the serving steady state) drained through the real
+    `graphdyn.serve.Worker`, reporting the BucketCache hit rate. Two
+    graph identities, many jobs each — the expected rate is (jobs-2)/jobs
+    and anything near zero means the cache key broke and every job is
+    paying the table build again. Null + reason on failure, never
+    silent."""
+    import shutil
+    import tempfile
+
+    from graphdyn.serve.spool import Spool
+    from graphdyn.serve.worker import Worker
+
+    per_graph = 3 if smoke else 6
+    root = tempfile.mkdtemp(prefix="graphdyn_bench_serve_")
+    try:
+        spool = Spool(root)
+        base = {"n": 24, "d": 3, "max_sweeps": 16, "chunk_sweeps": 8}
+        for i in range(per_graph):
+            # two tenants, two graph identities, interleaved — the
+            # multi-tenant repeat-graph steady state
+            spool.submit({**base, "graph_seed": 0, "seed": i}, "alice")
+            spool.submit({**base, "graph_seed": 1, "seed": i}, "bob")
+        worker = Worker(spool)
+        jobs = worker.run_until_drained()
+        stats = worker.cache.stats()
+        return {"serve_bucket_hit_rate": {
+            "hit_rate": stats["hit_rate"],
+            "hits": stats["hits"],
+            "misses": stats["misses"],
+            "resident_graphs": stats["resident_graphs"],
+            "jobs": jobs,
+        }}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def serve_job_latency(smoke: bool):
+    """End-to-end serve latency per job (claim → admit → dispatch → run →
+    result on disk), p50/p99, INTERLEAVED warm/cold legs: warm jobs
+    repeat a cached graph identity, cold jobs bring a fresh graph each
+    time (the table build is their tax; the compiled program is shared —
+    same shape class — which is the bucketing claim this row keeps
+    honest). Alternating submissions give both legs the same ambient
+    conditions, same as ckpt_save_overhead. Null + reason on failure,
+    never silent."""
+    import shutil
+    import tempfile
+
+    from graphdyn import obs
+    from graphdyn.serve.spool import PENDING, Spool
+    from graphdyn.serve.worker import Worker
+
+    reps = 4 if smoke else 10
+    root = tempfile.mkdtemp(prefix="graphdyn_bench_serve_lat_")
+    try:
+        spool = Spool(root)
+        base = {"n": 24, "d": 3, "max_sweeps": 16, "chunk_sweeps": 8}
+        # warmup job first (FIFO spool): pays the compile + the warm
+        # graph's table build outside the timed window
+        leg_of = {spool.submit(dict(base), "warm"): None}
+        for i in range(reps):
+            leg_of[spool.submit({**base, "graph_seed": 100 + i},
+                                "cold")] = "cold"
+            leg_of[spool.submit({**base, "seed": i + 1}, "warm")] = "warm"
+        worker = Worker(spool)
+        times: dict = {"warm": [], "cold": []}
+        while True:
+            nxt = [r for r in spool.jobs() if r["state"] == PENDING]
+            if not nxt:
+                break
+            leg = leg_of[nxt[0]["id"]]
+            with obs.timed("bench.serve_job", leg=leg or "warmup") as sw:
+                if not worker.step():
+                    break
+            if leg:
+                times[leg].append(sw.wall_s)
+        out = {}
+        for leg in ("warm", "cold"):
+            out[leg + "_p50_s"] = float(np.percentile(times[leg], 50))
+            out[leg + "_p99_s"] = float(np.percentile(times[leg], 99))
+        return {"serve_job_latency": {
+            **out,
+            "cold_over_warm_p50_x": out["cold_p50_s"] / out["warm_p50_s"],
+            "jobs": 2 * reps,
+        }}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def halo_weak_scaling(smoke: bool, *, n_per=None, R=None, steps=None,
                       iters=None):
     """Weak scaling of the halo-exchange node sharding
@@ -1063,6 +1154,26 @@ def main():
             "heartbeat_overhead": None,
             "heartbeat_overhead_skipped_reason":
                 f"heartbeat A/B failed: {str(e)[:150]}",
+        })
+    _mark("serve bucket hit rate (multi-tenant repeat-graph queue)")
+    try:
+        extra.update(serve_bucket_hit_rate(args.smoke))
+    except Exception as e:  # noqa: BLE001 — optional row, never silent
+        _mark(f"serve bucket hit rate row failed: {str(e)[:150]}")
+        extra.update({
+            "serve_bucket_hit_rate": None,
+            "serve_bucket_hit_rate_skipped_reason":
+                f"serve bucket drain failed: {str(e)[:150]}",
+        })
+    _mark("serve job latency (interleaved warm/cold p50/p99)")
+    try:
+        extra.update(serve_job_latency(args.smoke))
+    except Exception as e:  # noqa: BLE001 — optional row, never silent
+        _mark(f"serve job latency row failed: {str(e)[:150]}")
+        extra.update({
+            "serve_job_latency": None,
+            "serve_job_latency_skipped_reason":
+                f"serve latency A/B failed: {str(e)[:150]}",
         })
     _mark("halo weak scaling (node-axis sharding, fixed n/shard)")
     try:

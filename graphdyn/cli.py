@@ -142,7 +142,14 @@ def build_parser() -> argparse.ArgumentParser:
                "--kernel auto|xla|pallas) — which modes compose with node "
                "sharding and lightcone is the mode-selection table in "
                "ARCHITECTURE.md 'Node-axis sharding & halo exchange' / "
-               "'Search acceleration' / 'One-kernel annealing'.",
+               "'Search acceleration' / 'One-kernel annealing'. "
+               "`serve` runs the multi-tenant job service over a durable "
+               "filesystem spool (submit/status/result need no live "
+               "server; a restarted server recovers its queue from disk; "
+               "oversized jobs are refused by the committed byte models; "
+               "overstaying jobs are checkpoint-evicted and requeued; "
+               "crash-looping tenant jobs are quarantined) — "
+               "ARCHITECTURE.md 'Serving'.",
     )
     ap.add_argument(
         "--ckpt-mirror", default=None, metavar="DIR",
@@ -543,6 +550,56 @@ def build_parser() -> argparse.ArgumentParser:
              "(entropy_ensemble_union — per-member phi/m_init via segment "
              "sums); npz keys gain a member axis",
     )
+
+    srv = sub.add_parser(
+        "serve",
+        help="the multi-tenant job service over a durable filesystem "
+             "spool (graphdyn.serve): run a worker, or submit/inspect "
+             "jobs — submissions need no live server, and a restarted "
+             "server recovers its queue from disk alone",
+    )
+    srv.add_argument(
+        "action", choices=["run", "submit", "status", "result", "queue"],
+        help="run: serve the spool (admission by committed byte models, "
+             "shape-class bucketing with AOT warm-up, per-job "
+             "timeout→evict→requeue, per-tenant crash quarantine); "
+             "submit: durably enqueue a job; status/result: one job's "
+             "record / finished arrays; queue: counts per state",
+    )
+    srv.add_argument("job", nargs="?", default=None,
+                     help="job id (status/result) — give it immediately "
+                          "after the action (argparse does not backfill "
+                          "a trailing positional past options)")
+    srv.add_argument("--root", required=True, metavar="DIR",
+                     help="spool directory (created if missing)")
+    srv.add_argument("--tenant", default="default",
+                     help="tenant name stamped on submissions (quarantine "
+                          "and crash containment are keyed per tenant)")
+    srv.add_argument("--job-timeout", type=float, default=None, metavar="S",
+                     help="per-job deadline (submit: this job; run: "
+                          "default for jobs without one) — overstaying "
+                          "jobs are checkpoint-evicted and requeued with "
+                          "a 4x-escalated slice")
+    srv.add_argument("--max-jobs", type=int, default=None, metavar="N",
+                     help="run: exit 0 after settling N jobs")
+    srv.add_argument("--idle-exit", type=float, default=None, metavar="S",
+                     help="run: exit 0 after S seconds with an empty "
+                          "queue (default: serve forever)")
+    srv.add_argument("--no-warm", action="store_true",
+                     help="run: skip boot-time AOT warm-up of hot shape "
+                          "classes")
+    for flag, typ, hlp in (
+            ("--n", int, "graph size"), ("--d", int, "degree"),
+            ("--graph-seed", int, "graph realization seed"),
+            ("--seed", int, "chain seed"),
+            ("--rule", str, "dynamics rule (majority|minority)"),
+            ("--tie", str, "tie-break (stay|random)"),
+            ("--replicas", int, "replica count (packed 32/word)"),
+            ("--m-target", float, "target magnetization"),
+            ("--max-sweeps", int, "sweep budget"),
+            ("--chunk-sweeps", int, "sweeps per device chunk")):
+        srv.add_argument(flag, type=typ, default=None,
+                         help=f"submit: {hlp} (default: spool default)")
 
     sup = sub.add_parser(
         "run-supervised",
@@ -1163,5 +1220,45 @@ def _run(args) -> int:
             "counts": out.counts.tolist(),
             "out": args.out,
             "plot": args.plot,
+        }))
+    elif args.cmd == "serve":
+        from graphdyn.serve import api as serve_api
+
+        if args.action == "run":
+            from graphdyn.serve.lifecycle import run_service
+
+            return run_service(
+                args.root, job_timeout_s=args.job_timeout,
+                max_jobs=args.max_jobs, idle_exit_s=args.idle_exit,
+                warm=not args.no_warm,
+            )
+        if args.action == "submit":
+            spec = {k: v for k, v in (
+                ("n", args.n), ("d", args.d),
+                ("graph_seed", args.graph_seed), ("seed", args.seed),
+                ("rule", args.rule), ("tie", args.tie),
+                ("replicas", args.replicas), ("m_target", args.m_target),
+                ("max_sweeps", args.max_sweeps),
+                ("chunk_sweeps", args.chunk_sweeps)) if v is not None}
+            job_id = serve_api.submit(args.root, spec, args.tenant,
+                                      timeout_s=args.job_timeout)
+            print(json.dumps({"job": job_id, "root": args.root,
+                              "tenant": args.tenant}))
+            return 0
+        if args.action == "queue":
+            print(json.dumps(serve_api.queue(args.root)))
+            return 0
+        if args.job is None:
+            raise SystemExit(f"serve {args.action} needs a job id")
+        if args.action == "status":
+            print(json.dumps(serve_api.status(args.root, args.job)))
+            return 0
+        res = serve_api.result(args.root, args.job)      # action: result
+        print(json.dumps({
+            "job": args.job,
+            "keys": sorted(res),
+            "m_end_mean": float(np.mean(res["m_end"])),
+            "mag_reached": int(np.sum(res["mag_reached"])),
+            "result": serve_api.status(args.root, args.job)["result"],
         }))
     return 0

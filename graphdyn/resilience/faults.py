@@ -51,6 +51,15 @@ site                 instrumented in
                      returned carry
 ``multihost.init``   ``parallel.mesh.init_multihost`` — ``raise`` simulates
                      a coordinator that is not up yet
+``serve.admit``      ``serve.admission.admit`` — ``raise`` injects the
+                     reject storm: admission stays up but refuses every
+                     decision with an "injected" reason (the client-visible
+                     failure mode of an overloaded admission tier)
+``serve.dispatch``   ``serve.worker.Worker._dispatch`` — ``raise`` simulates
+                     transient infrastructure failure in front of the
+                     device (coordinator blip, compile-cache NFS hiccup):
+                     retried with seeded backoff, then requeued; ``preempt``
+                     is the soak harness's mid-stream worker kill
 ===================  =====================================================
 
 CLI-level tests inject through the ``GRAPHDYN_FAULT_PLAN`` environment
@@ -290,6 +299,10 @@ def maybe_fail(site: str, key: str = "") -> None:
             )
         if site == "multihost.init":
             raise InjectedUnavailable("injected: coordinator unavailable")
+        if site == "serve.dispatch":
+            raise InjectedUnavailable(
+                "injected: dispatch transiently unavailable"
+            )
     raise InjectedFault(f"injected {spec.action} at {site} (hit {spec.hits})")
 
 

@@ -76,6 +76,17 @@ scenario             composition
                      deadlock or leak, the fuzzed threaded stream stays
                      bit-exact with the synchronous builds, and the
                      overlap gauge lands exactly once per prefetcher
+``serve_kill_requeue`` the multi-tenant job service under the seeded
+                     schedule fuzzer: tenants submit to a durable spool
+                     (one oversized job the byte model must refuse, one
+                     short-timeout job the deadline must checkpoint-evict),
+                     the serving child is hard-killed mid-dispatch at a
+                     seeded position (the claimed job left ``running`` on
+                     disk), a restarted child recovers the orphan from
+                     disk alone and drains the queue; every accepted job
+                     must finish bit-exact to a fault-free in-harness
+                     oracle, and the spool journal must carry the full
+                     submit/refuse/requeue/evict/done story
 ==================== ======================================================
 
 Run it: ``python -m graphdyn.resilience.soak [--bounded] [--seeds N]
@@ -290,6 +301,16 @@ SCENARIOS: dict[str, Scenario] = {
                  "deadlock or thread leak, fuzzed stream bit-exact with "
                  "synchronous builds, overlap gauge exactly once",
                  mode="race_prefetch"),
+        Scenario("serve_kill_requeue", "serve",
+                 "multi-tenant serve spool under the schedule fuzzer: "
+                 "hard kill mid-dispatch, restart recovers the orphaned "
+                 "job from disk, oversized job refused by the byte "
+                 "model, short-timeout job checkpoint-evicted, every "
+                 "accepted job bit-exact after requeue",
+                 require_ops=("serve.submit", "serve.refuse",
+                              "serve.requeue", "serve.evict",
+                              "serve.done"),
+                 mode="serve"),
     )
 }
 
@@ -477,6 +498,8 @@ def run_scenario(name: str, seed: int, root: str,
         return _run_race_mirror(scn, seed, root)
     if scn.mode == "race_prefetch":
         return _run_race_prefetch(scn, seed, root)
+    if scn.mode == "serve":
+        return _run_serve_kill_requeue(scn, seed, root)
     rng = np.random.default_rng(seed)
     episodes = _plan_episodes(name, rng)
     workdir = os.path.join(root, name, f"seed{seed}")
@@ -1011,6 +1034,235 @@ def _run_race_prefetch(scn: Scenario, seed: int, root: str) -> dict:
     return {"scenario": scn.name, "seed": seed, "workload": scn.workload,
             "episodes": [{"episode": 0, "rc": 0}],
             "journal_ops": [], "problems": problems, "ok": not problems}
+
+
+# ---------------------------------------------------------------------------
+# serve_kill_requeue: the job service's kill/requeue soak
+# ---------------------------------------------------------------------------
+
+#: serve soak: HBM budget pinned in the child env so the oversized job's
+#: refusal is deterministic regardless of what the host device reports
+SERVE_HBM_BUDGET = 1 << 30
+
+#: the short-timeout job's first slice — far below a cold compile, so the
+#: deadline always fires during attempt 1 and the eviction ladder runs
+SERVE_EVICT_TIMEOUT_S = 0.05
+
+#: serve-specific schedule-fuzz jitter bound: the serve path heartbeats
+#: at every chunk boundary, so its lock-acquisition rate is orders of
+#: magnitude above the chain scenarios' — the chain bound
+#: (RACE_FUZZ_MAX_MS) would turn pure fuzz sleep into the scenario's
+#: whole budget. Permuting thread schedules only needs jitter above the
+#: scheduler's switch granularity, not a large one
+SERVE_FUZZ_MAX_MS = 3.0
+
+
+def _serve_env(seed: int, compile_cache: str) -> dict:
+    """The serving child's environment: schedule fuzzer on (seeded lock
+    jitter over every inventoried lock the spool/worker/bucket cache
+    take), CPU jax, a pinned admission budget, and a persistent compile
+    cache shared across episodes AND seeds — the recovery child replays
+    the same programs the killed child compiled, and paying the XLA
+    compile six times over would be pure soak-budget waste (the cache
+    changes wall time only, never bits)."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "GRAPHDYN_RACECHECK": "1",
+        "GRAPHDYN_RACEFUZZ": str(seed),
+        "GRAPHDYN_RACEFUZZ_MAX_MS": str(SERVE_FUZZ_MAX_MS),
+        "GRAPHDYN_SERVE_HBM_BUDGET": str(SERVE_HBM_BUDGET),
+        "GRAPHDYN_COMPILE_CACHE": compile_cache,
+    })
+    env.pop("GRAPHDYN_FAULT_PLAN", None)
+    return env
+
+
+def _serve_child_script(spool: str) -> str:
+    """The serving child: the real service loop under the fuzzer. An
+    InjectedPreemption from the dispatch fault site is the hard kill —
+    the child dies with the claimed job left ``running`` on disk, exactly
+    what SIGKILL leaves, and exits 75 like a preempted scheduler slot."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return "\n".join([
+        "import sys",
+        f"sys.path.insert(0, {repo!r})",
+        "from graphdyn.analysis.racecheck import maybe_install",
+        "maybe_install()",
+        "from graphdyn.utils.platform import apply_compile_cache",
+        "apply_compile_cache()",
+        "from graphdyn.resilience.faults import InjectedPreemption",
+        "from graphdyn.serve.lifecycle import run_service",
+        "try:",
+        f"    rc = run_service({spool!r}, idle_exit_s=0.25)",
+        "except InjectedPreemption:",
+        "    sys.exit(75)",
+        "sys.exit(rc)",
+    ]) + "\n"
+
+
+def _serve_oracle(spec: dict, kernel: str, oracle_cache: dict) -> dict:
+    """Fault-free in-harness run of one job spec — the parity reference.
+    The served result must be bit-exact: the requeue path is a full
+    deterministic replay (counter RNG), so eviction/kill/requeue may cost
+    time but never bits."""
+    from graphdyn.config import DynamicsConfig, SAConfig
+    from graphdyn.graphs import random_regular_graph
+    from graphdyn.ops.pallas_anneal import build_fused_tables
+    from graphdyn.search.fused import fused_anneal
+
+    key = ("serve", kernel) + tuple(sorted(spec.items()))
+    if key not in oracle_cache:
+        g = random_regular_graph(int(spec["n"]), int(spec["d"]),
+                                 seed=int(spec["graph_seed"]))
+        cfg = SAConfig(dynamics=DynamicsConfig(
+            p=1, c=1, rule=str(spec["rule"]), tie=str(spec["tie"])))
+        # the serve convention: the coloring is the GRAPH's (seeded by
+        # graph_seed — graphdyn.serve.bucketing shares one table set per
+        # graph), the counter-RNG chain is the job's (seed)
+        tables = build_fused_tables(g, cfg, seed=int(spec["graph_seed"]))
+        res = fused_anneal(
+            g, cfg, n_replicas=int(spec["replicas"]),
+            seed=int(spec["seed"]), m_target=float(spec["m_target"]),
+            max_sweeps=int(spec["max_sweeps"]),
+            chunk_sweeps=int(spec["chunk_sweeps"]), kernel=kernel,
+            tables=tables,
+        )
+        oracle_cache[key] = {
+            "conf": np.asarray(res.s),
+            "mag_reached": np.asarray(res.mag_reached),
+            "m_end": np.asarray(res.m_end),
+            "steps_to_target": np.asarray(res.steps_to_target),
+        }
+    return oracle_cache[key]
+
+
+def _run_serve_kill_requeue(scn: Scenario, seed: int, root: str,
+                            oracle_cache: dict | None = None) -> dict:
+    """The serve soak: multi-tenant submissions to a durable spool, a
+    serving child hard-killed mid-dispatch at a seeded position, a second
+    child that must recover the orphaned job from disk alone and drain
+    the queue. Contracts: the oversized job is REFUSED by the byte model
+    (journal reason, never a device allocation), the short-timeout job is
+    checkpoint-EVICTED and still finishes, every accepted job ends
+    ``done`` and bit-exact to the fault-free oracle, and the spool
+    journal is schema-valid with the whole story."""
+    import subprocess
+
+    from graphdyn.serve.admission import admit
+    from graphdyn.serve.spool import Spool
+    from graphdyn.utils.io import load_results_npz
+
+    oracle_cache = {} if oracle_cache is None else oracle_cache
+    rng = np.random.default_rng(seed)
+    workdir = os.path.join(root, scn.name, f"seed{seed}")
+    spool_dir = os.path.join(workdir, "spool")
+    problems: list[str] = []
+    ep_log: list[dict] = []
+
+    # -- tenants submit (no server alive yet: the spool IS the API) -------
+    spool = Spool(spool_dir)
+    accepted = []
+    for tenant in ("alice", "bob"):
+        for _ in range(2):
+            accepted.append(spool.submit(
+                {"n": 24, "d": 3, "graph_seed": int(rng.integers(0, 4)),
+                 "seed": int(rng.integers(0, 2**31 - 1)),
+                 "max_sweeps": 32, "chunk_sweeps": 8}, tenant))
+    # the short-timeout job: MINORITY dynamics never freeze a lane at
+    # m_target, so every chunk of the budget always executes — a
+    # machine-speed-independent runtime floor (256 chunk dispatches)
+    # that the first 0.05 s slice can never beat, warm compile cache or
+    # not. Attempt 1 always evicts; the ×4 escalation finishes it
+    accepted.append(spool.submit(
+        {"n": 128, "d": 3, "rule": "minority",
+         "seed": int(rng.integers(0, 2**31 - 1)),
+         "max_sweeps": 512, "chunk_sweeps": 2},
+        "tim", timeout_s=SERVE_EVICT_TIMEOUT_S))
+    # the oversized job: ~20 GB modeled resident set vs the pinned 1 GiB
+    # budget — must be refused at admission, never reach the device
+    oversized = spool.submit(
+        {"n": 200000, "d": 3, "replicas": 4096}, "carol")
+
+    compile_cache = os.path.join(root, scn.name, "compile_cache")
+    os.makedirs(compile_cache, exist_ok=True)
+
+    def episode(tag: str, fault_plan: list | None) -> int:
+        env = _serve_env(seed, compile_cache)
+        if fault_plan:
+            env["GRAPHDYN_FAULT_PLAN"] = json.dumps(fault_plan)
+        proc = subprocess.run(
+            [sys.executable, "-c", _serve_child_script(spool_dir)],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=workdir)
+        ep_log.append({"episode": tag, "rc": proc.returncode,
+                       "specs": fault_plan or []})
+        return proc.returncode
+
+    # episode 1: hard kill mid-dispatch at a seeded position (the 2nd-4th
+    # dispatch — always mid-queue: five jobs pass admission)
+    kill_at = int(rng.integers(2, 5))
+    rc = episode("kill", [{"site": "serve.dispatch", "action": "preempt",
+                           "at": kill_at}])
+    if rc != EX_TEMPFAIL:
+        problems.append(
+            f"killed episode exited {rc}, expected {EX_TEMPFAIL} "
+            f"(preempt at dispatch {kill_at})")
+    orphans = [r["id"] for r in spool.jobs() if r["state"] == "running"]
+    if not orphans:
+        problems.append(
+            "hard kill left no running orphan in the spool — the kill "
+            "landed outside a claimed job")
+    # episode 2: a fresh server against the same spool — recovery is
+    # from disk alone
+    rc = episode("requeue", None)
+    if rc != EX_OK:
+        problems.append(f"recovery episode exited {rc}, expected {EX_OK}")
+
+    # -- contracts --------------------------------------------------------
+    recs = {r["id"]: r for r in spool.jobs()}
+    over = recs[oversized]
+    if over["state"] != "refused":
+        problems.append(
+            f"oversized job is {over['state']!r}, want refused")
+    elif "exceeds the device budget" not in (over["reason"] or ""):
+        problems.append(
+            f"oversized refusal reason carries no byte-model verdict: "
+            f"{over['reason']!r}")
+    for jid in accepted:
+        if recs[jid]["state"] != "done":
+            problems.append(
+                f"accepted job {jid} ended {recs[jid]['state']!r} "
+                f"(reason {recs[jid]['reason']!r}), want done")
+    journal = os.path.join(spool_dir, _store.JOURNAL_NAME)
+    ops = _check_journal(journal, scn.require_ops, problems)
+    recovered = [r for r in spool.jobs()
+                 if r["id"] in orphans and r["requeues"] >= 1]
+    if orphans and not recovered:
+        problems.append(
+            "the orphaned running job was never requeued by recovery")
+    # bit-exact parity for every accepted job, oracle run fault-free in
+    # the harness with the same admission kernel decision
+    for jid in accepted:
+        rec = recs[jid]
+        if rec["state"] != "done":
+            continue
+        want = _serve_oracle(rec["spec"], admit(rec["spec"]).kernel,
+                             oracle_cache)
+        got = load_results_npz(rec["result"])
+        if set(got) != set(want):
+            problems.append(
+                f"{jid}: result keys {sorted(got)} vs {sorted(want)}")
+            continue
+        for k in want:
+            if not np.array_equal(got[k], want[k]):
+                problems.append(
+                    f"{jid}: result array {k!r} is not bit-exact after "
+                    f"kill/requeue (requeues={rec['requeues']})")
+    return {"scenario": scn.name, "seed": seed, "workload": scn.workload,
+            "episodes": ep_log, "journal_ops": sorted(set(ops)),
+            "problems": problems, "ok": not problems}
 
 
 def run_soak(scenarios=None, seeds=BOUNDED_SEEDS, root: str | None = None,

@@ -91,11 +91,14 @@ JOURNAL_NAME = "run_journal.jsonl"
 
 #: journal event ops (the taxonomy ARCHITECTURE.md documents; validators
 #: reject anything else). The ``supervise.*`` ops are appended by the
-#: restart loop in :mod:`graphdyn.resilience.supervisor`.
+#: restart loop in :mod:`graphdyn.resilience.supervisor`; the ``serve.*``
+#: ops by the job service's spool and worker (:mod:`graphdyn.serve`).
 JOURNAL_OPS = (
     "save", "load", "quarantine", "reject", "failover", "read-error",
     "mirror.save", "mirror.degraded", "remove",
     "supervise.start", "supervise.restart", "supervise.quarantine",
+    "serve.submit", "serve.done", "serve.refuse", "serve.requeue",
+    "serve.evict", "serve.quarantine",
 )
 
 _VERSION_RE = re.compile(r"\.v(\d+)\.npz$")
@@ -329,6 +332,15 @@ def validate_journal(path: str) -> tuple[list[dict], list[str]]:
         "supervise.start": ("argv",),
         "supervise.restart": ("episode", "rc", "kind"),
         "supervise.quarantine": ("site", "crashes"),
+        # the job service's lifecycle chapter (:mod:`graphdyn.serve`):
+        # every spool transition is journalled, so "what happened to my
+        # job" is answerable from the evidence trail alone
+        "serve.submit": ("job", "tenant"),
+        "serve.done": ("job", "tenant", "requeues"),
+        "serve.refuse": ("job", "tenant", "reason"),
+        "serve.requeue": ("job", "tenant", "requeues", "reason"),
+        "serve.evict": ("job", "tenant", "requeues"),
+        "serve.quarantine": ("job", "tenant", "site", "crashes"),
     }
     for i, ev in enumerate(events):
         kind = ev.get("ev")

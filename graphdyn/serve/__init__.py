@@ -1,0 +1,42 @@
+"""graphdyn.serve — the always-on multi-tenant job service.
+
+ROADMAP item 2's missing piece: every engine component exists (the fused
+zero-sync annealer, durable checkpoints, the exit-75/130/86 supervision
+ladder, the graftrace concurrency gate) but nothing *serves*. This package
+is the long-lived process that accepts jobs, survives bad ones, and keeps
+the device busy for everyone else — the pod-scale Ising throughput recipe
+(one resident program fed many independent problems) with the robustness
+ladder wrapped around every job.
+
+Layering (ARCHITECTURE.md "Serving"):
+
+- :mod:`~graphdyn.serve.spool` — the durable filesystem job store
+  (submit/status/result survive a server restart from disk alone);
+- :mod:`~graphdyn.serve.admission` — static byte-model admission: an
+  oversized job is refused with a reason, never OOMs the worker;
+- :mod:`~graphdyn.serve.bucketing` — (graph, rule, solver, params) shape
+  classes with table reuse and AOT warm-up of hot classes at boot;
+- :mod:`~graphdyn.serve.worker` — the persistent worker loop: per-job
+  timeout → checkpoint-eviction → requeue, per-tenant crash quarantine,
+  heartbeats at job boundaries;
+- :mod:`~graphdyn.serve.lifecycle` — boot/recover/drain orchestration
+  behind ``python -m graphdyn.serve`` and ``graphdyn serve``;
+- :mod:`~graphdyn.serve.api` — the thin client face over the spool.
+
+Everything heavy (jax, the solvers) is imported lazily inside functions —
+submitting a job to a spool costs no device runtime.
+"""
+
+from graphdyn.serve.spool import (  # noqa: F401
+    DONE,
+    PENDING,
+    QUARANTINED,
+    REFUSED,
+    RUNNING,
+    Spool,
+    normalize_spec,
+)
+from graphdyn.serve.admission import AdmissionDecision, admit  # noqa: F401
+from graphdyn.serve.bucketing import BucketCache, shape_key  # noqa: F401
+from graphdyn.serve.worker import Worker  # noqa: F401
+from graphdyn.serve.lifecycle import run_service  # noqa: F401

@@ -1,0 +1,45 @@
+"""``python -m graphdyn.serve`` — the standalone service process.
+
+The thin wrapper: argparse, the graceful-shutdown scope (SIGTERM/SIGINT
+land at fused chunk boundaries), and :func:`graphdyn.serve.run_service`.
+The full-featured entry point (obs recording, profiles, supervision of
+the server itself) is ``graphdyn serve run`` in :mod:`graphdyn.cli`; this
+one exists so a bare container can serve with nothing but the package.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    from graphdyn.resilience.shutdown import graceful_shutdown
+    from graphdyn.serve.lifecycle import run_service
+
+    p = argparse.ArgumentParser(
+        prog="python -m graphdyn.serve",
+        description="serve a durable job spool (exit 0 drained/idle, "
+                    "75 preempted with the in-flight job requeued)")
+    p.add_argument("root", help="spool directory (created if missing)")
+    p.add_argument("--job-timeout", type=float, default=None, metavar="S",
+                   help="default per-job deadline: overstaying jobs are "
+                        "checkpoint-evicted and requeued with an "
+                        "escalated slice")
+    p.add_argument("--max-jobs", type=int, default=None, metavar="N",
+                   help="exit 0 after settling N jobs (tests/soak)")
+    p.add_argument("--idle-exit", type=float, default=None, metavar="S",
+                   help="exit 0 after S seconds with an empty queue "
+                        "(default: serve forever)")
+    p.add_argument("--no-warm", action="store_true",
+                   help="skip boot-time AOT warm-up of hot shape classes")
+    args = p.parse_args(argv)
+    with graceful_shutdown():
+        return run_service(
+            args.root, job_timeout_s=args.job_timeout,
+            max_jobs=args.max_jobs, idle_exit_s=args.idle_exit,
+            warm=not args.no_warm)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,121 @@
+"""Static byte-model admission: refuse an oversized job, never OOM.
+
+The serve worker is shared — one tenant's monster shape must not OOM the
+device every other tenant is using. Admission therefore happens BEFORE a
+job reaches the device, from the committed byte models alone:
+
+- :func:`graphdyn.ops.pallas_anneal.fused_vmem_bytes` — the fused
+  kernel's resident-set model, evaluated at a conservative static
+  chromatic bound (``χ ≤ d² + 1``: a distance-2 greedy coloring of a
+  degree-``d`` graph never needs more — the real χ, known only after the
+  coloring runs, can only be smaller, so admission never under-admits);
+- the device memory budget — the plugin's reported ``bytes_limit``
+  (:func:`graphdyn.obs.memband.device_memory_stats`) when a device can
+  speak for itself, else the ``GRAPHDYN_SERVE_HBM_BUDGET`` env override,
+  else a conservative CPU-host default.
+
+A refusal carries the model's numbers in its reason string (modeled bytes
+vs budget), so "why was my job refused" is answerable from the job record
+alone. The decision also selects the engine: a shape whose model exceeds
+the VMEM budget but fits the device budget is ADMITTED on the XLA twin
+(same chain law, bit-identical — the degrade moves throughput, never
+results).
+
+The ``serve.admit`` fault site injects a **reject storm** (every decision
+refuses, with an "injected" reason) — the client-visible failure mode of
+an overloaded admission tier, exercised without any real pressure.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import NamedTuple
+
+from graphdyn.resilience.faults import InjectedFault, maybe_fail
+
+#: fallback device budget when no device reports bytes_limit and no env
+#: override is set — deliberately conservative for a shared CPU host
+DEFAULT_HBM_BUDGET = 1 << 30
+
+
+class AdmissionDecision(NamedTuple):
+    admitted: bool
+    kernel: str         # 'auto' (pallas model fits) | 'xla' | '' (refused)
+    reason: str | None  # refusal reason (None when admitted)
+    model_bytes: int    # fused resident-set model at the static chi bound
+    budget_bytes: int   # the device budget the model was held against
+
+
+def chi_bound(d: int) -> int:
+    """Static upper bound on the distance-2 chromatic number of a
+    degree-``d`` graph (greedy: Δ(G²) + 1 ≤ d² + 1)."""
+    return d * d + 1
+
+
+def device_budget_bytes() -> int:
+    """The budget admitted jobs must fit: device-reported ``bytes_limit``
+    when available, else ``GRAPHDYN_SERVE_HBM_BUDGET``, else the
+    conservative default."""
+    env = os.environ.get("GRAPHDYN_SERVE_HBM_BUDGET", "").strip()
+    if env:
+        try:
+            v = int(float(env))
+            if v > 0:
+                return v
+        except ValueError:
+            pass
+    try:
+        from graphdyn.obs.memband import device_memory_stats
+
+        stats, _ = device_memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception:  # noqa: BLE001 — admission must never crash the worker
+        pass
+    return DEFAULT_HBM_BUDGET
+
+
+def admit(spec: dict, *, key: str = "") -> AdmissionDecision:
+    """One admission decision from the committed models — no compilation,
+    no device allocation, no exception escapes (a malformed spec is a
+    refusal with a reason, not a worker crash)."""
+    from graphdyn.ops.packed import WORD
+    from graphdyn.ops.pallas_anneal import (
+        FUSED_VMEM_BUDGET,
+        fused_vmem_bytes,
+    )
+
+    budget = device_budget_bytes()
+    try:
+        maybe_fail("serve.admit", key=key)
+    except InjectedFault as e:
+        # the injected reject storm: admission stays up but refuses —
+        # exactly what clients of an overloaded admission tier observe
+        return AdmissionDecision(False, "", f"injected reject storm: {e}",
+                                 0, budget)
+    try:
+        n, d, R = int(spec["n"]), int(spec["d"]), int(spec["replicas"])
+        if n < 2 or d < 1 or d >= n or R < 1:
+            return AdmissionDecision(
+                False, "", f"malformed shape: n={n} d={d} replicas={R}",
+                0, budget)
+        if spec.get("solver", "fused") != "fused":
+            return AdmissionDecision(
+                False, "", f"unknown solver {spec.get('solver')!r} "
+                "(this service runs the fused annealer)", 0, budget)
+        W = -(-R // WORD)
+        model = fused_vmem_bytes(n, W, chi_bound(d), d)
+    except (KeyError, TypeError, ValueError) as e:
+        return AdmissionDecision(False, "", f"malformed spec: {e}", 0,
+                                 budget)
+    if model > budget:
+        return AdmissionDecision(
+            False, "",
+            f"modeled resident set {model} B exceeds the device budget "
+            f"{budget} B (n={n}, replicas={R}: refuse at admission, "
+            "never OOM the shared worker)",
+            model, budget)
+    # within budget: the kernel knob stays 'auto' when the VMEM model
+    # admits the fused Pallas kernel, else the XLA twin carries the job
+    kernel = "auto" if model <= FUSED_VMEM_BUDGET else "xla"
+    return AdmissionDecision(True, kernel, None, model, budget)

@@ -1,0 +1,153 @@
+"""Shape-class bucketing: one resident compiled program serves many users.
+
+The pod-scale throughput recipe is multiplexing independent problems onto
+one warm program. Two costs stand between a submitted job and device
+sweeps: the host-side table build (distance-2 coloring + LUT masks,
+:func:`graphdyn.ops.pallas_anneal.build_fused_tables` — identical for
+every job on the same graph) and the XLA compile (identical for every job
+whose traced SHAPES match). This module buckets jobs accordingly:
+
+- the **table cache** is keyed by the full graph identity
+  ``(n, d, graph_seed, rule, tie)`` — a repeat job on the same graph skips
+  the coloring entirely;
+- the **shape class** ``(n, d, rule, tie, W)`` names the compiled-program
+  bucket (χ and the table shapes are a function of the graph identity;
+  the packed word count ``W`` is the replica axis after 32-per-word
+  packing — concurrent tenants land in one class when their jobs trace
+  the same program, which is what keeps the device busy for everyone);
+- **AOT warm-up** at boot runs a one-sweep probe of the hottest classes
+  among the recovered queue, so the first tenant job after a restart pays
+  a bucket hit, not a cold compile (the persistent compile cache —
+  ``--compile-cache`` — is the cross-process backbone; this is the
+  in-process half).
+
+Hit/miss counters feed the ``serve_bucket_hit_rate`` bench row and the
+``serve.bucket`` obs counter.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+def graph_key(spec: dict) -> tuple:
+    """Full graph identity — the table cache key."""
+    return (int(spec["n"]), int(spec["d"]), int(spec["graph_seed"]),
+            str(spec["rule"]), str(spec["tie"]))
+
+
+def shape_key(spec: dict) -> tuple:
+    """The compiled-program shape class: graph identity minus the seed
+    (same-shape graphs trace the same program), plus the packed replica
+    word count (the device-side replica axis)."""
+    from graphdyn.ops.packed import WORD
+
+    W = -(-int(spec["replicas"]) // WORD)
+    return (int(spec["n"]), int(spec["d"]), str(spec["rule"]),
+            str(spec["tie"]), W)
+
+
+class BucketCache:
+    """Graph + table cache with hit accounting. One per server; the worker
+    thread and the boot-time warm-up share it under one lock (declared in
+    CONCURRENCY_LEDGER.json)."""
+
+    def __init__(self, max_graphs: int = 32):
+        self.max_graphs = max_graphs
+        self._lock = threading.Lock()
+        self._graphs: dict = {}     # graph_key -> (Graph, FusedTables)
+        self._hits = 0
+        self._misses = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self._hits + self._misses
+            return {
+                "hits": self._hits, "misses": self._misses,
+                "hit_rate": (self._hits / total) if total else None,
+                "resident_graphs": len(self._graphs),
+            }
+
+    def tables_for(self, spec: dict):
+        """``(graph, tables)`` for the job — cached per graph identity.
+        Insertion-ordered eviction keeps the resident set bounded (a
+        multi-tenant server must not accumulate every graph it ever
+        served)."""
+        from graphdyn import obs
+
+        gk = graph_key(spec)
+        with self._lock:
+            hit = gk in self._graphs
+            if hit:
+                self._hits += 1
+                pair = self._graphs[gk]
+            else:
+                self._misses += 1
+        obs.counter("serve.bucket", hit=int(hit), n=gk[0], d=gk[1])
+        if hit:
+            return pair
+        pair = self._build(spec)
+        with self._lock:
+            while len(self._graphs) >= self.max_graphs:
+                self._graphs.pop(next(iter(self._graphs)))
+            self._graphs[gk] = pair
+        return pair
+
+    def _build(self, spec: dict):
+        from graphdyn.config import DynamicsConfig, SAConfig
+        from graphdyn.graphs import random_regular_graph
+        from graphdyn.ops.pallas_anneal import build_fused_tables
+
+        from graphdyn import obs
+
+        with obs.timed("serve.tables_build", n=int(spec["n"]),
+                       d=int(spec["d"])):
+            g = random_regular_graph(int(spec["n"]), int(spec["d"]),
+                                     seed=int(spec["graph_seed"]))
+            cfg = SAConfig(dynamics=DynamicsConfig(
+                p=1, c=1, rule=str(spec["rule"]), tie=str(spec["tie"])))
+            # the COLORING seed is the graph's, not the job's: the
+            # distance-2 coloring inside the tables is seeded, and these
+            # tables are shared by every job on this graph — keying the
+            # coloring off one job's chain seed would make a served
+            # result depend on which tenant's job happened to build the
+            # cache entry (observed as a soak parity failure). The chain
+            # seed stays the job's own, passed to fused_anneal directly
+            tables = build_fused_tables(g, cfg,
+                                        seed=int(spec["graph_seed"]))
+        return g, tables
+
+    def warm(self, specs: list[dict], *, top_k: int = 2) -> list[tuple]:
+        """AOT warm-up of the hottest shape classes in ``specs`` (the
+        recovered queue at boot): build tables and run a one-sweep probe
+        so the compile happens before the first tenant job. Returns the
+        warmed class keys."""
+        from collections import Counter
+
+        from graphdyn import obs
+
+        by_class = Counter(shape_key(s) for s in specs)
+        warmed = []
+        for cls, _ in by_class.most_common(top_k):
+            probe = next(s for s in specs if shape_key(s) == cls)
+            with obs.timed("serve.warmup", n=cls[0], d=cls[1]):
+                from graphdyn.config import DynamicsConfig, SAConfig
+                from graphdyn.search.fused import fused_anneal
+
+                g, tables = self.tables_for(probe)
+                cfg = SAConfig(dynamics=DynamicsConfig(
+                    p=1, c=1, rule=str(probe["rule"]),
+                    tie=str(probe["tie"])))
+                # one FULL-SIZE chunk (the job's own chunk_sweeps): the
+                # chunk step count is a static arg of the fused program,
+                # so a probe at a different chunk size would warm the
+                # wrong compile — this is exactly the program the class's
+                # jobs dispatch
+                cs = int(probe["chunk_sweeps"])
+                fused_anneal(
+                    g, cfg, n_replicas=int(probe["replicas"]),
+                    seed=int(probe["seed"]), max_sweeps=cs,
+                    chunk_sweeps=cs, kernel="auto", tables=tables,
+                )
+            warmed.append(cls)
+        return warmed

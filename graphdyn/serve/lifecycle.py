@@ -1,0 +1,80 @@
+"""Service lifecycle: boot → recover → warm → serve → drain.
+
+The one entry point behind both ``python -m graphdyn.serve`` and
+``graphdyn serve run``. Boot order is the robustness story in miniature:
+
+1. **recover** — any job a killed worker left ``running`` is requeued
+   before anything else happens (the spool is the queue; a restarted
+   server owes its tenants exactly the jobs the dead one was holding);
+2. **warm** — AOT warm-up of the hottest shape classes among the
+   recovered queue, so the first post-restart job pays a bucket hit, not
+   a cold compile;
+3. **serve** — the worker loop runs on the MAIN thread (it is the one
+   consumer of the process-wide shutdown flag: SIGTERM lands at the next
+   fused chunk boundary, the in-flight job is requeued, and the process
+   exits 75 for the supervisor to restart — the PR-10 ladder, serving
+   edition).
+
+``max_jobs`` / ``idle_exit_s`` bound the loop for tests and the soak
+harness; a production server passes neither and runs until preempted.
+"""
+
+from __future__ import annotations
+
+import time
+
+from graphdyn.resilience.shutdown import (
+    EX_TEMPFAIL,
+    ShutdownRequested,
+    shutdown_requested,
+)
+from graphdyn.serve.bucketing import BucketCache
+from graphdyn.serve.spool import PENDING, Spool
+from graphdyn.serve.worker import Worker
+
+
+def run_service(root: str, *, job_timeout_s: float | None = None,
+                max_jobs: int | None = None,
+                idle_exit_s: float | None = None,
+                warm: bool = True, poll_s: float = 0.05) -> int:
+    """Serve the spool at ``root``; returns the process exit code
+    (0 = drained/idle-exited cleanly, 75 = preempted mid-serve with the
+    in-flight job safely requeued)."""
+    from graphdyn import obs
+
+    spool = Spool(root)
+    recovered = spool.recover()
+    if recovered:
+        obs.counter("serve.recovered", jobs=len(recovered))
+    cache = BucketCache()
+    # warm only what admission would admit: an oversized pending spec must
+    # be refused by the byte model, not compiled by the warm-up
+    from graphdyn.serve.admission import admit
+
+    pending = [r["spec"] for r in spool.jobs()
+               if r["state"] == PENDING and admit(r["spec"]).admitted]
+    if warm and pending:
+        with obs.timed("serve.boot_warm", jobs=len(pending)):
+            cache.warm(pending)
+    worker = Worker(spool, cache=cache, default_timeout_s=job_timeout_s,
+                    poll_s=poll_s)
+    served = 0
+    idle_since = time.monotonic()
+    try:
+        while not shutdown_requested():
+            if worker.step():
+                served += 1
+                idle_since = time.monotonic()
+                if max_jobs is not None and served >= max_jobs:
+                    return 0
+                continue
+            if idle_exit_s is not None and (
+                    time.monotonic() - idle_since) >= idle_exit_s:
+                return 0
+            # graftrace: disable-next-line=GT005  idle poll of the durable queue between submissions — the spool is a filesystem, there is no condition variable
+            time.sleep(poll_s)
+    except ShutdownRequested:
+        # the in-flight job was requeued by the worker before the
+        # re-raise; exit 75 tells the supervisor "restart me"
+        return EX_TEMPFAIL
+    return EX_TEMPFAIL

@@ -1,0 +1,286 @@
+"""The persistent worker loop: every job wrapped in the robustness ladder.
+
+One job's journey through the ladder (ARCHITECTURE.md "Serving"):
+
+1. **admission** — the committed byte models refuse an oversized shape
+   with a reason before it touches the device (``serve.admit`` fault site
+   injects the reject storm);
+2. **dispatch** — the ``serve.dispatch`` fault site models the transient
+   infrastructure failure in front of the device (a coordinator blip, a
+   compile-cache NFS hiccup): retried with the PR-9 seeded-backoff
+   :class:`~graphdyn.resilience.retry.RetryPolicy`, keyed per job so
+   concurrent tenants' retries de-correlate; exhausted retries requeue
+   the job, they do not kill the server;
+3. **run** — the fused annealer under a per-job deadline watchdog
+   (:func:`~graphdyn.resilience.supervisor.supervision`): the job's
+   chunk boundaries heartbeat, and a job that overstays its ``timeout_s``
+   is **checkpoint-evicted** — the durable store records the eviction
+   (tenant, attempt, spec) and the job is requeued with an escalated
+   timeout. Replay is exact: the fused chain's counter RNG makes a
+   rerun-from-spec bit-identical to an uninterrupted run, so eviction
+   never trades latency for correctness. Kernel-lowering failures degrade
+   pallas→xla inside the solver (``resilient_exec``), invisible here;
+4. **crash containment** — an organic exception is dumped to the flight
+   recorder (``obs.crash`` names the site), counted per
+   ``(tenant, site)``, and the job is requeued with backoff — until the
+   same tenant crashes the same site ``quarantine_after`` times, at which
+   point the JOB is quarantined (journal ``serve.quarantine``) and the
+   worker moves on: one tenant's poison job cannot crash-loop the shared
+   worker;
+5. **heartbeats** at every job boundary (``beat("serve.job")``) — the
+   PR-10 watchdog supervises the server itself.
+
+The loop runs synchronously (:meth:`Worker.run_until_drained` — the
+service main thread, tests, bench) or on the declared background thread
+``graphdyn-serve-worker`` (:meth:`Worker.start`/:meth:`Worker.stop`, for
+embedding next to a live submit API).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from graphdyn.resilience.faults import (
+    InjectedFault,
+    InjectedPreemption,
+    InjectedUnavailable,
+    maybe_fail,
+)
+from graphdyn.resilience.retry import RetryPolicy
+from graphdyn.resilience.shutdown import (
+    ShutdownRequested,
+    clear_shutdown,
+    shutdown_requested,
+)
+from graphdyn.serve.admission import admit
+from graphdyn.serve.bucketing import BucketCache
+from graphdyn.serve.spool import Spool
+
+#: an evicted job's next attempt gets a longer slice — a deterministic
+#: replay under the same timeout would evict forever
+EVICT_TIMEOUT_ESCALATION = 4.0
+
+#: same-(tenant, site) crashes before the job is quarantined
+QUARANTINE_AFTER = 2
+
+
+class Worker:
+    """The serve loop over one :class:`~graphdyn.serve.spool.Spool`."""
+
+    def __init__(self, spool: Spool, *, cache: BucketCache | None = None,
+                 retry: RetryPolicy | None = None,
+                 quarantine_after: int = QUARANTINE_AFTER,
+                 default_timeout_s: float | None = None,
+                 poll_s: float = 0.05):
+        self.spool = spool
+        self.default_timeout_s = default_timeout_s
+        self.cache = cache or BucketCache()
+        #: dispatch retry: seeded full jitter so tenants' retries
+        #: de-correlate (the PR-9 storm argument, applied to serving)
+        self.retry = retry or RetryPolicy(
+            tries=3, base_delay_s=0.01, max_delay_s=0.1, jitter=True)
+        self.quarantine_after = quarantine_after
+        self.poll_s = poll_s
+        #: (tenant, site) -> consecutive crash count (the quarantine key)
+        self._crashes: dict = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- the background-thread face (GT003: bounded join in stop()) -------
+
+    def start(self) -> "Worker":
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="graphdyn-serve-worker", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 30.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if not self.step():
+                # drained: idle-wait for new submissions
+                # graftrace: disable-next-line=GT005  idle poll of the durable queue — the spool is a filesystem, there is no condition variable to wait on
+                time.sleep(self.poll_s)
+
+    # -- the synchronous face ---------------------------------------------
+
+    def run_until_drained(self, *, max_jobs: int | None = None) -> int:
+        """Process until the queue is empty (or ``max_jobs`` done);
+        returns the number of jobs that left the pending state. The
+        service main loop and every in-process consumer (tests, bench,
+        the soak children) drive this."""
+        done = 0
+        while max_jobs is None or done < max_jobs:
+            if not self.step():
+                return done
+            done += 1
+        return done
+
+    # -- one job ----------------------------------------------------------
+
+    def step(self) -> bool:
+        """Claim and settle one job (any terminal-or-requeued outcome
+        counts as settled). False when the queue is drained. External
+        preemption (SIGTERM / the server watchdog) re-raises after the
+        in-flight job is safely requeued."""
+        from graphdyn.resilience.supervisor import beat
+
+        rec = self.spool.claim()
+        if rec is None:
+            return False
+        beat("serve.job")
+        job_id, tenant, spec = rec["id"], rec["tenant"], rec["spec"]
+
+        decision = admit(spec, key=job_id)
+        if not decision.admitted:
+            self.spool.refuse(job_id, decision.reason or "refused")
+            return True
+
+        if not self._dispatch(job_id):
+            self.spool.requeue(
+                job_id, "dispatch retries exhausted (transient "
+                "infrastructure failure in front of the device)")
+            return True
+
+        try:
+            self._run_job(rec, decision.kernel)
+        except ShutdownRequested as e:
+            self._on_shutdown(rec, e)
+        except InjectedPreemption:
+            # a hard kill is a hard kill: the record stays RUNNING on
+            # disk and restart recovery requeues it — exactly what a
+            # SIGKILLed worker leaves behind
+            raise
+        except Exception as e:  # noqa: BLE001 — contained per tenant
+            self._on_crash(rec, e)
+        else:
+            self.spool.finish(job_id)
+        beat("serve.job")
+        return True
+
+    def _dispatch(self, job_id: str) -> bool:
+        """The transient-failure seam in front of the device: retried with
+        seeded backoff, keyed per job. True = dispatched."""
+        delays = list(self.retry.delays(key=f"serve.dispatch:{job_id}"))
+        for attempt in range(self.retry.tries):
+            try:
+                maybe_fail("serve.dispatch", key=job_id)
+                return True
+            except InjectedUnavailable:
+                from graphdyn import obs
+
+                if attempt >= len(delays):
+                    return False
+                obs.counter("serve.dispatch_retry", job=job_id,
+                            attempt=attempt + 1)
+                # graftrace: disable-next-line=GT005  the retry policy's seeded backoff delay — the de-correlation IS the sleep
+                time.sleep(delays[attempt])
+        return False
+
+    def _run_job(self, rec: dict, kernel: str) -> None:
+        from graphdyn import obs
+        from graphdyn.config import DynamicsConfig, SAConfig
+        from graphdyn.resilience.supervisor import supervision
+        from graphdyn.search.fused import fused_anneal
+        from graphdyn.utils.io import save_results_npz
+
+        spec = rec["spec"]
+        g, tables = self.cache.tables_for(spec)
+        cfg = SAConfig(dynamics=DynamicsConfig(
+            p=1, c=1, rule=str(spec["rule"]), tie=str(spec["tie"])))
+        timeout = rec.get("timeout_s")
+        if timeout is None:
+            timeout = self.default_timeout_s
+        # escalation: attempt k runs under timeout * 4^evictions so a
+        # deterministic replay cannot evict forever
+        if timeout is not None:
+            timeout = float(timeout) * (
+                EVICT_TIMEOUT_ESCALATION ** rec.get("requeues", 0))
+        self._job_t0 = time.monotonic()
+        self._job_timeout = timeout
+        with supervision(None, timeout):
+            with obs.timed("serve.job", job=rec["id"], tenant=rec["tenant"],
+                           n=int(spec["n"]), replicas=int(spec["replicas"])):
+                res = fused_anneal(
+                    g, cfg, n_replicas=int(spec["replicas"]),
+                    seed=int(spec["seed"]), m_target=float(spec["m_target"]),
+                    max_sweeps=int(spec["max_sweeps"]),
+                    chunk_sweeps=int(spec["chunk_sweeps"]),
+                    kernel=kernel, tables=tables,
+                )
+        save_results_npz(
+            rec["result"], conf=res.s, mag_reached=res.mag_reached,
+            m_end=res.m_end, steps_to_target=res.steps_to_target,
+        )
+
+    # -- ladder rungs ------------------------------------------------------
+
+    def _on_shutdown(self, rec: dict, e: ShutdownRequested) -> None:
+        """Disambiguate the one shutdown flag: the per-job deadline firing
+        is an EVICTION (requeue, clear, keep serving); anything else is
+        real preemption (requeue, re-raise — the server is being told to
+        die)."""
+        timeout = self._job_timeout
+        elapsed = time.monotonic() - self._job_t0
+        if timeout is not None and elapsed >= 0.9 * timeout:
+            self._evict(rec, elapsed)
+            clear_shutdown()
+            if shutdown_requested():     # pragma: no cover — signal raced
+                raise e
+            return
+        self.spool.requeue(rec["id"], f"preempted at {e.where or 'chunk'} "
+                           "boundary (server shutdown)")
+        raise e
+
+    def _evict(self, rec: dict, elapsed: float) -> None:
+        """Checkpoint-eviction: the durable store records the eviction
+        evidence (who, which attempt, the full replayable spec — replay
+        is exact by the counter-RNG contract), the journal carries
+        ``serve.evict``, and the job goes back to pending with an
+        escalated slice."""
+        import numpy as np
+
+        from graphdyn.resilience.store import DurableCheckpoint, journal_event
+
+        ck = DurableCheckpoint(
+            os.path.join(self.spool.root, "evict", rec["id"]))
+        ck.save(
+            {"requeues": np.asarray(rec.get("requeues", 0)),
+             "elapsed_s": np.asarray(elapsed)},
+            {"job": rec["id"], "tenant": rec["tenant"],
+             "spec": rec["spec"], "evicted": True},
+        )
+        journal_event(self.spool.journal, "serve.evict",
+                      job=rec["id"], tenant=rec["tenant"],
+                      requeues=rec.get("requeues", 0),
+                      elapsed_s=round(elapsed, 3))
+        self.spool.requeue(
+            rec["id"], f"evicted after {elapsed:.3f}s (per-job timeout); "
+            "replay is exact (counter-RNG chain)")
+
+    def _on_crash(self, rec: dict, e: Exception) -> None:
+        """Per-tenant crash containment: dump the post-mortem, count per
+        (tenant, site), requeue below the bar, quarantine at it."""
+        from graphdyn.obs import flight
+
+        site = f"serve.job:{type(e).__name__}"
+        if isinstance(e, InjectedFault):
+            site = "serve.job:injected"
+        flight.dump("exception", exc=e, site=site)
+        key = (rec["tenant"], site)
+        self._crashes[key] = self._crashes.get(key, 0) + 1
+        crashes = self._crashes[key]
+        if crashes >= self.quarantine_after:
+            self.spool.quarantine(rec["id"], site, crashes)
+            return
+        self.spool.requeue(
+            rec["id"], f"crash at {site} ({e}); attempt {crashes} of "
+            f"{self.quarantine_after} before quarantine", crashed=True)

@@ -81,6 +81,23 @@ else
         --format=text || fail=1
 fi
 
+# 4c. servecheck — the job-service test subset standalone (pytest -m
+#     serve): the durable spool state machine, byte-model admission,
+#     bucketing, the worker's evict/requeue/quarantine ladder, and the
+#     restarted-server recovery regression. Skipped with a notice when
+#     pytest is absent, or when GRAPHDYN_SKIP_SERVECHECK=1 (set by the
+#     tier-1 lint-gate test: the same subset already runs in the suite
+#     proper — no double work; mirrors faultcheck).
+if [ "${GRAPHDYN_SKIP_SERVECHECK:-0}" = "1" ]; then
+    echo "== servecheck: GRAPHDYN_SKIP_SERVECHECK=1 — SKIPPED (subset runs in tier-1) =="
+elif python -c 'import pytest' 2>/dev/null; then
+    echo "== servecheck (pytest -m serve) =="
+    JAX_PLATFORMS=cpu python -m pytest tests/ -q -m serve \
+        -p no:cacheprovider || fail=1
+else
+    echo "== servecheck: pytest not installed — SKIPPED (pip install pytest to enable) =="
+fi
+
 # 5. pallascheck — the interpret-mode Pallas kernel parity subset
 #    standalone (pytest -m pallas_interpret): the fused BDCM kernel —
 #    serial and grouped — must reproduce the XLA sweep within the
@@ -308,6 +325,26 @@ for col in ("derived_bytes", "arithmetic_intensity"):
             f"null {col} needs {col}_skipped_reason"
     else:
         assert v > 0, f"{col} must be > 0 or null+reason: {v}"
+# the serve rows: multi-tenant bucket hit rate and end-to-end job
+# latency through the real worker — measured positive, or an explicit
+# null + reason — NEVER 0.0 (the same null-or-positive contract)
+assert "serve_bucket_hit_rate" in row, "serve_bucket_hit_rate row absent"
+sbh = row["serve_bucket_hit_rate"]
+if sbh is None:
+    assert row.get("serve_bucket_hit_rate_skipped_reason"), \
+        "null serve_bucket_hit_rate needs a skipped_reason"
+else:
+    assert sbh["hit_rate"] > 0, f"serve bucket hit_rate must be > 0: {sbh}"
+    assert sbh["jobs"] > 0 and sbh["misses"] > 0, sbh
+assert "serve_job_latency" in row, "serve_job_latency row absent"
+sjl = row["serve_job_latency"]
+if sjl is None:
+    assert row.get("serve_job_latency_skipped_reason"), \
+        "null serve_job_latency needs a skipped_reason"
+else:
+    assert sjl["warm_p50_s"] > 0 and sjl["cold_p50_s"] > 0, sjl
+    assert sjl["warm_p99_s"] > 0 and sjl["cold_p99_s"] > 0, sjl
+    assert sjl["cold_over_warm_p50_x"] > 0 and sjl["jobs"] > 0, sjl
 # the graftcheck fingerprint summary: a structural snapshot per round, or
 # an explicit null + reason — never silently absent
 assert "fingerprints" in row, "fingerprints row absent"

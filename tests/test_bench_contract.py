@@ -110,6 +110,24 @@ def test_bench_smoke_emits_one_json_line():
         assert hbo["overhead_p50_x"] > 0
         assert hbo["off_p50_s"] > 0 and hbo["on_p50_s"] > 0
         assert hbo["beats_per_run"] > 0 and hbo["runs"] > 0
+    # the serve rows: multi-tenant bucket hit rate and end-to-end job
+    # latency through the real serve worker — measured positive values,
+    # or an explicit null + reason — never silently absent, never 0.0
+    assert "serve_bucket_hit_rate" in row
+    sbh = row["serve_bucket_hit_rate"]
+    if sbh is None:
+        assert row["serve_bucket_hit_rate_skipped_reason"]
+    else:
+        assert sbh["hit_rate"] > 0
+        assert sbh["jobs"] > 0 and sbh["misses"] > 0
+    assert "serve_job_latency" in row
+    sjl = row["serve_job_latency"]
+    if sjl is None:
+        assert row["serve_job_latency_skipped_reason"]
+    else:
+        assert sjl["warm_p50_s"] > 0 and sjl["cold_p50_s"] > 0
+        assert sjl["warm_p99_s"] > 0 and sjl["cold_p99_s"] > 0
+        assert sjl["cold_over_warm_p50_x"] > 0 and sjl["jobs"] > 0
     # the device-memory column: a positive peak, or an explicit null +
     # reason (CPU: no usable memory_stats) — never silently absent,
     # never a fake 0 (graphdyn.obs.memband.peak_hbm_bytes)

@@ -25,6 +25,7 @@ KNOWN_HATCHES = {
     "GRAPHDYN_SKIP_OBSCHECK", "GRAPHDYN_SKIP_MEMCHECK",
     "GRAPHDYN_SKIP_COLORCHECK", "GRAPHDYN_SKIP_BENCHCHECK",
     "GRAPHDYN_SKIP_RACECHECK", "GRAPHDYN_SKIP_TRENDGATE",
+    "GRAPHDYN_SKIP_SERVECHECK",
 }
 
 

@@ -1,0 +1,408 @@
+"""graphdyn.serve: the durable spool state machine, byte-model admission,
+shape-class bucketing, and the worker's evict/requeue/quarantine ladder.
+
+The whole module carries the ``serve`` marker so ``scripts/lint.sh``'s
+servecheck step can run it standalone (``pytest -m serve``); the fault-site
+tests additionally carry ``faultinject`` so faultcheck sees the new
+``serve.admit`` / ``serve.dispatch`` sites. The restarted-server recovery
+regression (acceptance: a fresh process against an existing spool recovers
+every pending job from disk alone) runs as a real subprocess.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from graphdyn.resilience.faults import FaultPlan, FaultSpec
+from graphdyn.resilience.store import JOURNAL_NAME, validate_journal
+from graphdyn.serve.admission import admit, chi_bound, device_budget_bytes
+from graphdyn.serve.bucketing import BucketCache, graph_key, shape_key
+from graphdyn.serve.spool import (
+    DONE,
+    PENDING,
+    QUARANTINED,
+    REFUSED,
+    RUNNING,
+    Spool,
+    normalize_spec,
+)
+from graphdyn.serve.worker import Worker
+
+pytestmark = pytest.mark.serve
+
+SMALL = {"n": 24, "d": 3, "max_sweeps": 16, "chunk_sweeps": 8}
+
+
+def _ops(root):
+    events, problems = validate_journal(os.path.join(root, JOURNAL_NAME))
+    assert not problems, problems
+    return [e["op"] for e in events if e.get("ev") == "journal"]
+
+
+# ---------------------------------------------------------------------------
+# spool: the durable state machine
+# ---------------------------------------------------------------------------
+
+
+def test_normalize_spec_fills_defaults_and_rejects_unknown():
+    spec = normalize_spec({"n": 10})
+    assert spec["n"] == 10 and spec["d"] == 3 and spec["solver"] == "fused"
+    with pytest.raises(ValueError, match="unknown job spec key"):
+        normalize_spec({"banana": 1})
+
+
+def test_spool_submit_claim_finish_roundtrip(tmp_path):
+    sp = Spool(str(tmp_path))
+    jid = sp.submit(dict(SMALL), "alice")
+    assert sp.load(jid)["state"] == PENDING
+    rec = sp.claim()
+    assert rec["id"] == jid and sp.load(jid)["state"] == RUNNING
+    sp.finish(jid)
+    assert sp.load(jid)["state"] == DONE
+    assert sp.claim() is None
+    ops = _ops(str(tmp_path))
+    assert ops == ["serve.submit", "serve.done"]
+
+
+def test_spool_claim_order_is_submit_order(tmp_path):
+    sp = Spool(str(tmp_path))
+    ids = [sp.submit(dict(SMALL), t) for t in ("b", "a", "c")]
+    claimed = [sp.claim()["id"] for _ in ids]
+    assert claimed == ids
+
+
+def test_spool_requeue_bumps_and_journals_reason(tmp_path):
+    sp = Spool(str(tmp_path))
+    jid = sp.submit(dict(SMALL), "alice")
+    sp.claim()
+    rec = sp.requeue(jid, "preempted mid-run")
+    assert rec["state"] == PENDING and rec["requeues"] == 1
+    assert rec["crashes"] == 0
+    rec = sp.claim()
+    sp.requeue(jid, "crashed", crashed=True)
+    assert sp.load(jid)["crashes"] == 1
+    events, _ = validate_journal(os.path.join(str(tmp_path), JOURNAL_NAME))
+    requeues = [e for e in events if e.get("op") == "serve.requeue"]
+    assert [e["requeues"] for e in requeues] == [1, 2]
+    assert requeues[0]["reason"] == "preempted mid-run"
+
+
+def test_spool_recover_requeues_only_running(tmp_path):
+    """The restart contract: a killed worker's claimed job goes back to
+    pending; settled and queued jobs are untouched."""
+    sp = Spool(str(tmp_path))
+    j_run = sp.submit(dict(SMALL), "alice")
+    j_pend = sp.submit(dict(SMALL), "bob")
+    j_done = sp.submit(dict(SMALL), "carol")
+    sp.claim()                                   # j_run -> running
+    for _ in range(2):
+        sp.claim()
+    sp.requeue(j_pend, "back to queue")
+    sp.finish(j_done)
+    assert Spool(str(tmp_path)).recover() == [j_run]
+    assert sp.load(j_run)["state"] == PENDING
+    assert sp.load(j_run)["requeues"] == 1
+    assert sp.load(j_done)["state"] == DONE
+
+
+def test_spool_records_survive_process_restart_subprocess(tmp_path):
+    """ACCEPTANCE: a fresh PROCESS against an existing spool recovers every
+    pending job from disk alone — no shared memory, no live server."""
+    sp = Spool(str(tmp_path))
+    ids = [sp.submit(dict(SMALL), "alice") for _ in range(3)]
+    sp.claim()                                   # orphan one as running
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = "\n".join([
+        "import sys, json",
+        f"sys.path.insert(0, {repo!r})",
+        "from graphdyn.serve.spool import Spool",
+        f"sp = Spool({str(tmp_path)!r})",
+        "recovered = sp.recover()",
+        "print(json.dumps({'recovered': recovered,",
+        "                  'counts': sp.counts()}))",
+    ])
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["recovered"] == [ids[0]]
+    assert out["counts"]["pending"] == 3
+    assert out["counts"]["running"] == 0
+
+
+# ---------------------------------------------------------------------------
+# admission: the committed byte models
+# ---------------------------------------------------------------------------
+
+
+def test_admission_admits_small_shape_on_fused_kernel():
+    d = admit(normalize_spec(dict(SMALL)))
+    assert d.admitted and d.kernel == "auto" and d.reason is None
+    assert 0 < d.model_bytes <= d.budget_bytes
+
+
+def test_admission_refuses_oversized_with_byte_model_reason(monkeypatch):
+    monkeypatch.setenv("GRAPHDYN_SERVE_HBM_BUDGET", str(1 << 30))
+    d = admit(normalize_spec({"n": 200000, "d": 3, "replicas": 4096}))
+    assert not d.admitted
+    assert "exceeds the device budget" in d.reason
+    assert str(d.model_bytes) in d.reason        # the numbers are IN the
+    assert str(d.budget_bytes) in d.reason       # refusal, not a log file
+    assert d.model_bytes > d.budget_bytes
+
+
+def test_admission_env_budget_override(monkeypatch):
+    monkeypatch.setenv("GRAPHDYN_SERVE_HBM_BUDGET", "12345")
+    assert device_budget_bytes() == 12345
+    assert not admit(normalize_spec(dict(SMALL))).admitted
+
+
+def test_admission_mid_size_degrades_to_xla_twin(monkeypatch):
+    """A shape whose model exceeds the Pallas VMEM budget but fits the
+    device budget is ADMITTED on the XLA twin — the degrade moves
+    throughput, never the verdict."""
+    from graphdyn.ops.pallas_anneal import FUSED_VMEM_BUDGET, fused_vmem_bytes
+
+    monkeypatch.setenv("GRAPHDYN_SERVE_HBM_BUDGET", str(1 << 30))
+    spec = normalize_spec({"n": 20000, "d": 3, "replicas": 512})
+    model = fused_vmem_bytes(20000, 16, chi_bound(3), 3)
+    assert FUSED_VMEM_BUDGET < model <= (1 << 30)   # the premise
+    d = admit(spec)
+    assert d.admitted and d.kernel == "xla"
+
+
+def test_admission_malformed_is_refusal_not_crash():
+    for spec in ({"n": 1, "d": 3}, {"n": 24, "d": 0}, {"n": 4, "d": 4},
+                 {"n": 24, "d": 3, "replicas": 0}):
+        d = admit(normalize_spec(spec))
+        assert not d.admitted and "malformed" in d.reason
+    d = admit({**normalize_spec(dict(SMALL)), "solver": "bdcm"})
+    assert not d.admitted and "unknown solver" in d.reason
+
+
+@pytest.mark.faultinject
+def test_admission_reject_storm_fault_site():
+    """serve.admit 'raise' = the injected reject storm: admission stays up
+    but refuses with an 'injected' reason — a worker crash would be the
+    bug."""
+    with FaultPlan([FaultSpec("serve.admit", action="raise", at=1,
+                              count=2)]):
+        d = admit(normalize_spec(dict(SMALL)))
+        assert not d.admitted
+        assert "injected reject storm" in d.reason
+        d = admit(normalize_spec(dict(SMALL)))
+        assert not d.admitted
+    assert admit(normalize_spec(dict(SMALL))).admitted   # storm over
+
+
+@pytest.mark.faultinject
+def test_dispatch_transient_fault_retried_then_requeued(tmp_path):
+    """serve.dispatch 'raise' is transient unavailability: one blip is
+    absorbed by the seeded-backoff retry (job still finishes); a hard
+    storm exhausts the budget and REQUEUES the job — the server survives
+    either way."""
+    from graphdyn.resilience.retry import RetryPolicy
+
+    sp = Spool(str(tmp_path))
+    jid = sp.submit(dict(SMALL), "alice")
+    w = Worker(sp, retry=RetryPolicy(tries=3, base_delay_s=0.001,
+                                     max_delay_s=0.002, jitter=True))
+    with FaultPlan([FaultSpec("serve.dispatch", action="raise", at=1,
+                              count=1)]):
+        w.run_until_drained()
+    assert sp.load(jid)["state"] == DONE
+
+    jid2 = sp.submit(dict(SMALL), "bob")
+    with FaultPlan([FaultSpec("serve.dispatch", action="raise", at=1,
+                              count=99)]):
+        assert w.step()                          # settles by requeueing
+    rec = sp.load(jid2)
+    assert rec["state"] == PENDING and rec["requeues"] == 1
+    assert "dispatch retries exhausted" in rec["reason"]
+    w.run_until_drained()                        # plan gone: finishes
+    assert sp.load(jid2)["state"] == DONE
+
+
+# ---------------------------------------------------------------------------
+# bucketing
+# ---------------------------------------------------------------------------
+
+
+def test_shape_key_packs_replicas_and_drops_seeds():
+    a = normalize_spec({**SMALL, "replicas": 1, "seed": 1, "graph_seed": 7})
+    b = normalize_spec({**SMALL, "replicas": 32, "seed": 2, "graph_seed": 9})
+    assert shape_key(a) == shape_key(b)          # same W=1 word
+    assert graph_key(a) != graph_key(b)          # different graphs
+    c = normalize_spec({**SMALL, "replicas": 33})
+    assert shape_key(c) != shape_key(a)          # W=2
+
+
+def test_bucket_cache_hits_and_eviction(tmp_path):
+    cache = BucketCache(max_graphs=2)
+    s0 = normalize_spec({**SMALL, "graph_seed": 0})
+    s1 = normalize_spec({**SMALL, "graph_seed": 1})
+    s2 = normalize_spec({**SMALL, "graph_seed": 2})
+    g0a = cache.tables_for(s0)
+    g0b = cache.tables_for(s0)
+    assert g0a is g0b                            # the hit IS reuse
+    cache.tables_for(s1)
+    cache.tables_for(s2)                         # evicts s0 (oldest)
+    st = cache.stats()
+    assert st == {"hits": 1, "misses": 3, "hit_rate": 0.25,
+                  "resident_graphs": 2}
+    assert cache.tables_for(s0) is not g0a       # rebuilt after eviction
+
+
+def test_bucket_tables_seeded_by_graph_not_job(tmp_path):
+    """The soak-found invariant: the coloring inside the shared tables is
+    the GRAPH's (graph_seed), so a served result cannot depend on which
+    tenant's chain seed built the cache entry."""
+    from graphdyn.serve.worker import Worker
+
+    results = {}
+    for order in ((3, 9), (9, 3)):               # build order swapped
+        sp = Spool(str(tmp_path / f"order{order[0]}"))
+        for s in order:
+            sp.submit({**SMALL, "seed": s}, "t")
+        Worker(sp).run_until_drained()
+        for rec in sp.jobs():
+            key = rec["spec"]["seed"]
+            arr = np.load(rec["result"])["conf"]
+            results.setdefault(key, []).append(arr)
+    for key, (a, b) in results.items():
+        assert np.array_equal(a, b), f"seed {key} depends on build order"
+
+
+def test_bucket_warm_probes_hot_classes(tmp_path):
+    cache = BucketCache()
+    specs = [normalize_spec({**SMALL, "seed": i}) for i in range(3)]
+    specs.append(normalize_spec({**SMALL, "n": 30, "seed": 9}))
+    warmed = cache.warm(specs, top_k=1)
+    assert warmed == [shape_key(specs[0])]       # the majority class
+    assert cache.stats()["misses"] == 1          # probe built its tables
+
+
+# ---------------------------------------------------------------------------
+# worker ladder
+# ---------------------------------------------------------------------------
+
+
+def test_worker_drains_multi_tenant_queue_with_refusal(tmp_path,
+                                                       monkeypatch):
+    monkeypatch.setenv("GRAPHDYN_SERVE_HBM_BUDGET", str(1 << 30))
+    sp = Spool(str(tmp_path))
+    ok = [sp.submit({**SMALL, "seed": i}, t)
+          for i, t in enumerate(("alice", "bob"))]
+    bad = sp.submit({"n": 200000, "d": 3, "replicas": 4096}, "carol")
+    assert Worker(sp).run_until_drained() == 3
+    assert all(sp.load(j)["state"] == DONE for j in ok)
+    rec = sp.load(bad)
+    assert rec["state"] == REFUSED
+    assert "exceeds the device budget" in rec["reason"]
+    assert not os.path.exists(rec["result"])     # never reached the device
+    ops = _ops(str(tmp_path))
+    assert ops.count("serve.done") == 2 and ops.count("serve.refuse") == 1
+
+
+def test_worker_timeout_evicts_then_escalates_to_done(tmp_path):
+    """The eviction ladder: a 50 ms slice under a cold compile always
+    evicts attempt 1 (journal serve.evict + a durable eviction checkpoint),
+    escalation x4 finishes the replay, and the result is still written."""
+    sp = Spool(str(tmp_path))
+    jid = sp.submit({"n": 64, "d": 3, "rule": "minority", "max_sweeps": 256,
+                     "chunk_sweeps": 2}, "tim", timeout_s=0.05)
+    Worker(sp).run_until_drained()
+    rec = sp.load(jid)
+    assert rec["state"] == DONE and rec["requeues"] >= 1
+    ops = _ops(str(tmp_path))
+    assert ops.count("serve.evict") >= 1
+    assert ops.count("serve.evict") == ops.count("serve.requeue")
+    # the eviction evidence is durable: checkpoint + its own journal
+    evict_dir = os.path.join(str(tmp_path), "evict")
+    assert os.path.exists(os.path.join(evict_dir, jid + ".npz"))
+    from graphdyn.resilience.shutdown import shutdown_requested
+
+    assert not shutdown_requested()              # the flag was cleared
+
+
+def test_worker_quarantines_poison_job_and_serves_on(tmp_path):
+    """Crash containment: a spec that passes admission but crashes the
+    solver is requeued once, quarantined at the bar — and the next
+    tenant's job still runs."""
+    sp = Spool(str(tmp_path))
+    poison = sp.submit({**SMALL, "rule": "no-such-rule"}, "mallory")
+    good = sp.submit(dict(SMALL), "alice")
+    w = Worker(sp, quarantine_after=2)
+    w.run_until_drained()
+    rec = sp.load(poison)
+    assert rec["state"] == QUARANTINED and rec["crashes"] == 1
+    assert "crash(es) at serve.job:" in rec["reason"]
+    assert sp.load(good)["state"] == DONE
+    ops = _ops(str(tmp_path))
+    assert "serve.quarantine" in ops
+
+
+def test_worker_background_thread_face(tmp_path):
+    """start()/stop(): the declared graphdyn-serve-worker thread drains
+    submissions arriving while it runs, and stop() joins bounded."""
+    import time
+
+    sp = Spool(str(tmp_path))
+    w = Worker(sp, poll_s=0.01).start()
+    try:
+        jid = sp.submit(dict(SMALL), "alice")
+        deadline = time.monotonic() + 60.0
+        while (sp.load(jid)["state"] != DONE
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert sp.load(jid)["state"] == DONE
+    finally:
+        w.stop(timeout_s=30.0)
+    assert w._thread is None
+
+
+def test_run_service_recovers_drains_and_exits_clean(tmp_path,
+                                                     monkeypatch):
+    """Boot order: recover the orphan, refuse the oversized, drain, exit
+    0 on idle."""
+    from graphdyn.serve.lifecycle import run_service
+
+    monkeypatch.setenv("GRAPHDYN_SERVE_HBM_BUDGET", str(1 << 30))
+    sp = Spool(str(tmp_path))
+    orphan = sp.submit(dict(SMALL), "alice")
+    sp.claim()                                   # killed worker's leftover
+    sp.submit({"n": 200000, "d": 3, "replicas": 4096}, "carol")
+    rc = run_service(str(tmp_path), idle_exit_s=0.1)
+    assert rc == 0
+    counts = sp.counts()
+    assert counts[DONE] == 1 and counts[REFUSED] == 1
+    assert sp.load(orphan)["requeues"] == 1
+    events, problems = validate_journal(os.path.join(str(tmp_path),
+                                                     JOURNAL_NAME))
+    assert not problems, problems
+    recovery = [e for e in events if e.get("op") == "serve.requeue"]
+    assert any("recovered" in e["reason"] for e in recovery)
+
+
+def test_serve_cli_submit_run_status_result(tmp_path, capsys):
+    from graphdyn.cli import main
+
+    root = str(tmp_path / "spool")
+    assert main(["serve", "submit", "--root", root, "--tenant", "alice",
+                 "--n", "24", "--max-sweeps", "16",
+                 "--chunk-sweeps", "8"]) == 0
+    jid = json.loads(capsys.readouterr().out.strip())["job"]
+    assert main(["serve", "run", "--root", root, "--idle-exit", "0.1"]) == 0
+    capsys.readouterr()
+    assert main(["serve", "status", jid, "--root", root]) == 0
+    assert json.loads(capsys.readouterr().out.strip())["state"] == DONE
+    assert main(["serve", "result", jid, "--root", root]) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["keys"] == ["conf", "m_end", "mag_reached",
+                           "steps_to_target"]
