@@ -617,6 +617,87 @@ def halo_weak_scaling(smoke: bool, *, n_per=None, R=None, steps=None,
     }
 
 
+def powerlaw_rate_row(smoke: bool, *, n=None, R=None, steps=None,
+                      iters=None):
+    """Degree-bucketed power-law fast path vs the padded equal-edge RRG
+    baseline (ROADMAP item 3): a seeded configuration-model power-law
+    graph — the hub-heavy regime where the padded ``nbr[n, dmax]`` table
+    explodes — runs through ``graphdyn.ops.bucketed.bucketed_rollout``;
+    the control is a random-regular graph with (approximately) the same
+    edge count through the padded ``packed_rollout``. Both legs count the
+    same ``n·R·steps`` spin updates per iteration, so the ratio prices
+    the bucketed layout against the degree-regular workload XLA loves.
+    Acceptance (asserted in-suite at test shapes): the bucketed power-law
+    rate stays within 4× of the padded equal-edge RRG rate. Null + reason
+    on any failure, never 0.0."""
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks.common import draw_u32
+    from graphdyn import obs
+    from graphdyn.graphs import (
+        degree_buckets,
+        degree_cv,
+        powerlaw_graph,
+        random_regular_graph,
+    )
+    from graphdyn.ops.bucketed import bucketed_rollout
+    from graphdyn.ops.packed import packed_rollout
+
+    defaults = (8192, 256, 10, 2) if smoke else (100_000, 1024, 20, 3)
+    n = n if n is not None else defaults[0]
+    R = R if R is not None else defaults[1]
+    steps = steps if steps is not None else defaults[2]
+    iters = iters if iters is not None else defaults[3]
+    W = R // 32
+
+    g = powerlaw_graph(n, gamma=2.2, dmin=2, seed=0)
+    b = degree_buckets(g)
+    st = jnp.asarray(draw_u32(0, (n, W)))
+    st = bucketed_rollout(b, st, steps)           # compile + warm
+    _sync(st)
+    with obs.timed("bench.powerlaw_rate", layout="bucketed") as sw:
+        for _ in range(iters):
+            st = bucketed_rollout(b, st, steps)
+        _sync(st)
+    bucketed = n * R * steps * iters / sw.wall_s
+    obs.gauge("ops.bucketed.rate", bucketed, n=n, R=R)
+    _mark(f"powerlaw bucketed: n={n} dmax={int(g.dmax)} "
+          f"rate {bucketed:.3e}")
+
+    # equal-edge padded control: d = round(2E/n), bumped to keep n·d even
+    d = max(3, int(round(float(g.deg.sum()) / n)))
+    if (n * d) % 2:
+        d += 1
+    gr = random_regular_graph(n, d, seed=0)
+    nbr = jnp.asarray(gr.nbr)
+    deg = jnp.asarray(gr.deg)
+    f = jax.jit(lambda x: packed_rollout(nbr, deg, x, steps),
+                donate_argnums=0)
+    st = f(jnp.asarray(draw_u32(1, (n, W))))
+    _sync(st)
+    with obs.timed("bench.powerlaw_rate", layout="padded_rrg") as sw:
+        for _ in range(iters):
+            st = f(st)
+        _sync(st)
+    padded = n * R * steps * iters / sw.wall_s
+    _mark(f"powerlaw control RRG d={d}: rate {padded:.3e} "
+          f"(rrg/bucketed {padded / bucketed:.2f}x)")
+    return {
+        "powerlaw_rate": bucketed,
+        "powerlaw_rate_detail": {
+            "rrg_padded_rate": padded,
+            "rrg_over_bucketed_x": padded / bucketed,
+            "hub_degree": int(g.deg.max()),
+            "degree_cv": degree_cv(g.deg),
+            "table_entries": int(b.table_entries),
+            "padded_entries": int(n) * int(g.dmax),
+            "workload": {"n": n, "gamma": 2.2, "dmin": 2, "d_rrg": d,
+                         "R": R, "steps": steps, "iters": iters},
+        },
+    }
+
+
 def tta_rows(smoke: bool):
     """Time-to-target-magnetization A/B (ROADMAP item 3): device steps
     until the rolled-out end-state magnetization first reaches the target,
@@ -1187,6 +1268,16 @@ def main():
             "halo_bytes_per_step": None,
             "halo_bytes_per_step_skipped_reason":
                 f"halo weak scaling failed: {str(e)[:150]}",
+        })
+    _mark("powerlaw bucketed rate vs equal-edge RRG (powerlaw_rate)")
+    try:
+        extra.update(powerlaw_rate_row(args.smoke))
+    except Exception as e:  # noqa: BLE001 — optional row, never silent
+        _mark(f"powerlaw rate row failed: {str(e)[:150]}")
+        extra.update({
+            "powerlaw_rate": None,
+            "powerlaw_rate_skipped_reason":
+                f"powerlaw A/B failed: {str(e)[:150]}",
         })
     _mark("time-to-target search A/B (tta_tempering / tta_chromatic)")
     try:

@@ -28,11 +28,16 @@ History-Passing reinforcement, BDCM entropy curves — see SURVEY.md):
 from graphdyn.graphs import (  # noqa: F401
     Graph,
     EdgeTables,
+    DegreeBuckets,
     random_regular_graph,
     erdos_renyi_graph,
+    powerlaw_graph,
+    from_edgelist,
     graph_from_edges,
     build_edge_tables,
     bfs_order,
+    degree_buckets,
+    degree_cv,
     permute_nodes,
     replicate_disjoint,
     disjoint_union,

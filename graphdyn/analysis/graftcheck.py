@@ -427,6 +427,19 @@ def _build_halo_rollout(n: int = 128):
     )
 
 
+def _build_bucketed_rollout(n: int = 256, W: int = 4, steps: int = 4):
+    from graphdyn.graphs import degree_buckets, powerlaw_graph
+    from graphdyn.ops.bucketed import lower_bucketed_rollout
+
+    # canonical POWER-LAW family (the graph class the layout exists for:
+    # the bucket schedule is degree-sequence-dependent, so the seeded
+    # generator pins it); the fingerprint pins the one-program contract —
+    # a single fused loop over the static bucket schedule with a donated
+    # carry, no per-bucket dispatch and no dmax-padded gather
+    g = powerlaw_graph(n, gamma=2.5, dmin=2, seed=0)
+    return lower_bucketed_rollout(degree_buckets(g), W=W, steps=steps)
+
+
 def _temper_config():
     from graphdyn.config import DynamicsConfig, SAConfig
 
@@ -475,6 +488,11 @@ ENTRIES: dict[str, EntrySpec] = {
     "sharded_rollout": EntrySpec(
         _build_sharded_rollout, donates=False,
         canon="1-device (replica, node) mesh, RRG n=64 d=3, R=8, steps=2",
+    ),
+    "bucketed_rollout": EntrySpec(
+        _build_bucketed_rollout, donates=True,
+        canon="power-law n=256 gamma=2.5 dmin=2 seed=0, degree-bucketed "
+              "layout, W=4, steps=4, comparator route",
     ),
     "halo_rollout": EntrySpec(
         _build_halo_rollout, donates=True,

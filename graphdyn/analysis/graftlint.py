@@ -145,6 +145,18 @@ Rules (stable codes; each can be silenced per line with
   the hand transcription that goes silently stale — register it as a
   :data:`graphdyn.analysis.graftcost.HAND_MODELS` adapter or move it
   into a sanctioned module.
+- **GD017** ghost-padded node-table construction outside ``graphs.py``:
+  a ``np.full``/``jnp.full`` whose shape is a ≥2-element tuple and whose
+  fill value is a non-constant expression that ALSO appears as one of
+  the shape dimensions — the ``np.full((n, dmax), n)`` idiom that pads a
+  per-node neighbor table with the dimension-sized ghost id.  The padded
+  ``nbr[n, dmax]`` layout charges EVERY node the maximum degree, which a
+  single power-law hub inflates by orders of magnitude (ROADMAP item 3);
+  layouts therefore come from the ``graphs.py`` builders (which the
+  degree-bucketed fast path, :func:`graphdyn.graphs.degree_buckets`, can
+  replace wholesale), not from ad-hoc ``full`` constructions scattered
+  through kernels.  The single-ghost-ROW extension ``full((1, dmax), n)``
+  stays legal everywhere (the fill matches no dimension).
 
 Escape hatches, all requiring an explicit code list (``all`` allowed):
 
@@ -186,6 +198,7 @@ RULES = {
     "GD014": "host round-trip (np.asarray/device_get/.item()/block_until_ready/int()/float() coercion) inside a search/ drive loop (swap/sweep chunks stay on device)",
     "GD015": "per-temperature-step host sync (.item()/device_get/block_until_ready/bool()/int()/float() of a jnp.- or jax.-rooted call) in a models/ anneal drive loop (advance the schedule on device — ops/pallas_anneal)",
     "GD016": "hand-rolled byte-size arithmetic (itemsize literal x shape variables, .nbytes aggregation) outside the sanctioned cost modules (register a graftcost HAND_MODELS adapter)",
+    "GD017": "ghost-padded node-table construction (np.full with a dimension-sized ghost-id fill) outside graphs.py (build layouts through the graphs.py builders / degree_buckets)",
 }
 
 # device->host materializations GD014 watches inside search/ drive loops
@@ -455,6 +468,12 @@ class _FileLinter:
             and not any(norm.endswith(s) for s in _GD016_SANCTIONED)
             and "ops/pallas_" not in norm
         )
+        # GD017 scope: the graphdyn package OUTSIDE graphs.py — the one
+        # sanctioned home of node-table layout construction (the padded
+        # builders AND their degree-bucketed replacement live there)
+        self.node_table_strict = (
+            "graphdyn/" in norm and not norm.endswith("graphdyn/graphs.py")
+        )
 
     def emit(self, node: ast.AST, code: str, message: str) -> None:
         self.findings.append(
@@ -537,6 +556,7 @@ class _FileLinter:
         self._check_search_loop_sync(tree, seen)
         self._check_anneal_loop_sync(tree, seen)
         self._check_byte_model_arith(tree)
+        self._check_padded_table_full(tree)
         self.findings.sort(key=lambda f: (f.line, f.col, f.code))
         return self.findings
 
@@ -1100,6 +1120,43 @@ class _FileLinter:
                     "graphdyn.analysis.graftcost.HAND_MODELS adapter so "
                     "GB102 gates the model against the derived one, or "
                     "move it into a sanctioned cost module",
+                )
+
+    def _check_padded_table_full(self, tree: ast.Module):
+        """GD017: a ``full`` call building a ghost-padded node table
+        outside ``graphs.py`` — shape a ≥2-element tuple, fill a
+        non-constant expression syntactically identical to one of the
+        shape dimensions (``np.full((n, dmax), n)``: the dimension-sized
+        ghost id as fill is the signature of the padded neighbor-table
+        layout, which one power-law hub inflates for every node). The
+        single-ghost-ROW extension ``full((1, dmax), n)`` matches no
+        dimension and stays legal; a constant fill (``-1``, a pad
+        sentinel) is bookkeeping, not a layout."""
+        if not self.node_table_strict:
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _dotted(node.func).rsplit(".", 1)[-1] != "full":
+                continue
+            if len(node.args) < 2:
+                continue
+            shape, fill = node.args[0], node.args[1]
+            if not isinstance(shape, ast.Tuple) or len(shape.elts) < 2:
+                continue
+            if isinstance(fill, ast.Constant):
+                continue
+            fill_dump = ast.dump(fill)
+            if any(ast.dump(e) == fill_dump for e in shape.elts):
+                self.emit(
+                    node, "GD017",
+                    "ghost-padded node-table construction (the fill value "
+                    "is one of the shape dimensions — the np.full((n, "
+                    "dmax), n) padded-layout idiom) outside graphs.py; "
+                    "node layouts come from the graphs.py builders, and "
+                    "power-law degree sequences route through "
+                    "graphs.degree_buckets instead of paying dmax per "
+                    "node (ROADMAP item 3)",
                 )
 
     def _check_anneal_loop_sync(self, tree: ast.Module, jit_seen: set):

@@ -597,7 +597,11 @@ def build_parser() -> argparse.ArgumentParser:
             ("--replicas", int, "replica count (packed 32/word)"),
             ("--m-target", float, "target magnetization"),
             ("--max-sweeps", int, "sweep budget"),
-            ("--chunk-sweeps", int, "sweeps per device chunk")):
+            ("--chunk-sweeps", int, "sweeps per device chunk"),
+            ("--edges", int, "declared edge count (heavy-tail jobs: "
+             "prices admission by the bucketed byte model)"),
+            ("--degree-cv", float, "declared degree coefficient of "
+             "variation (>= 1.0 routes the bucketed layout)")):
         srv.add_argument(flag, type=typ, default=None,
                          help=f"submit: {hlp} (default: spool default)")
 
@@ -1239,7 +1243,9 @@ def _run(args) -> int:
                 ("rule", args.rule), ("tie", args.tie),
                 ("replicas", args.replicas), ("m_target", args.m_target),
                 ("max_sweeps", args.max_sweeps),
-                ("chunk_sweeps", args.chunk_sweeps)) if v is not None}
+                ("chunk_sweeps", args.chunk_sweeps),
+                ("edges", args.edges),
+                ("degree_cv", args.degree_cv)) if v is not None}
             job_id = serve_api.submit(args.root, spec, args.tenant,
                                       timeout_s=args.job_timeout)
             print(json.dumps({"job": job_id, "root": args.root,

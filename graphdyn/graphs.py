@@ -375,6 +375,121 @@ def erdos_renyi_graph(
     return graph_from_edges(n, np.stack([i, j], axis=1))
 
 
+def from_edgelist(edges, *, n: int | None = None, dmax: int | None = None) -> Graph:
+    """Ingest an EXTERNAL undirected edge list into the padded-table
+    :class:`Graph` — the entry point for real (social/web) graphs that
+    arrive as pair dumps rather than from the seeded generators.
+
+    Accepts an ``[E, 2]`` array or any iterable of ``(u, v)`` pairs.
+    Unlike :func:`graph_from_edges` (which trusts its caller), this
+    sanitizes: self-loops are dropped and duplicate undirected edges
+    (either orientation) are deduplicated keeping the FIRST occurrence in
+    input order, so the result is a simple graph and the edge order is
+    deterministic in the input order. ``n`` defaults to ``max id + 1``
+    (it must be given explicitly for an empty list). Round-trip contract:
+    ``from_edgelist(g.edges, n=g.n)`` reproduces ``g``'s tables for any
+    simple :class:`Graph` (tested).
+    """
+    if isinstance(edges, np.ndarray):
+        e = edges.astype(np.int64).reshape(-1, 2)
+    else:
+        e = np.array(list(edges), dtype=np.int64).reshape(-1, 2)
+    if n is None:
+        if e.size == 0:
+            raise ValueError("empty edge list: pass n explicitly")
+        n = int(e.max()) + 1
+    e = e[e[:, 0] != e[:, 1]]                      # self-loops dropped
+    if e.size:
+        lo = np.minimum(e[:, 0], e[:, 1])
+        hi = np.maximum(e[:, 0], e[:, 1])
+        _, first = np.unique(lo * max(n, 1) + hi, return_index=True)
+        e = e[np.sort(first)]                      # first occurrence kept
+    return graph_from_edges(n, e, dmax=dmax)
+
+
+def powerlaw_graph(
+    n: int,
+    *,
+    gamma: float = 2.5,
+    dmin: int = 2,
+    dmax: int | None = None,
+    seed=None,
+    method: str = "configuration",
+) -> Graph:
+    """Sample a power-law (scale-free) graph on ``n`` nodes — the degree
+    regime the thesis's own motivation lives in (opinion consensus on
+    social networks), where one hub can have ``~n^(1/(γ−1))`` neighbors
+    and the padded ``nbr[n, dmax]`` table explodes (ROADMAP item 3; the
+    degree-bucketed layout of :func:`degree_buckets` is the fast path).
+
+    ``method='configuration'`` (default): degrees drawn from the discrete
+    power law ``P(k) ∝ k^−γ`` on ``[dmin, dmax]`` (``dmax`` defaults to
+    ``n−1``, the natural cutoff), stubs paired uniformly, then the
+    **erased** configuration model — self-loops and duplicate edges
+    dropped — so realized degrees can undershoot drawn degrees slightly
+    at the hubs (standard; the degree SEQUENCE law is what matters here).
+    ``method='ba'``: Barabási–Albert preferential attachment with
+    ``dmin`` edges per arriving node (γ → 3 tail), a Python loop — use it
+    for small sampling-parity graphs, the configuration model at scale.
+    Host NumPy, deterministic per ``seed``.
+    """
+    if n < 2:
+        raise ValueError(f"need n >= 2, got {n}")
+    if dmin < 1:
+        raise ValueError(f"dmin must be >= 1, got {dmin}")
+    if gamma <= 1.0:
+        raise ValueError(f"gamma must be > 1, got {gamma}")
+    if dmax is None:
+        dmax = n - 1
+    if not dmin <= dmax <= n - 1:
+        raise ValueError(f"need dmin <= dmax <= n-1, got [{dmin}, {dmax}]")
+    rng = _as_rng(seed)
+    if method == "ba":
+        m = dmin
+        if m >= n:
+            raise ValueError(f"BA needs dmin < n, got dmin={dmin}, n={n}")
+        # repeated-nodes preferential attachment: sampling uniformly from
+        # the endpoint multiset IS degree-proportional sampling
+        repeated: list[int] = list(range(m))
+        edges = []
+        for v in range(m, n):
+            chosen: set[int] = set()
+            guard = 0
+            while len(chosen) < m:
+                guard += 1
+                if guard > 64 * m:
+                    # degenerate early multiset: fall back to uniform
+                    pool = [u for u in range(v) if u not in chosen]
+                    chosen.update(
+                        int(u) for u in rng.choice(
+                            pool, size=m - len(chosen), replace=False)
+                    )
+                    break
+                chosen.add(int(repeated[int(rng.integers(len(repeated)))]))
+            for u in chosen:
+                edges.append((u, v))
+                repeated.extend((u, v))
+        return from_edgelist(np.array(edges, dtype=np.int64), n=n)
+    if method != "configuration":
+        raise ValueError(
+            f"method must be 'configuration' or 'ba', got {method!r}"
+        )
+    ks = np.arange(dmin, dmax + 1, dtype=np.int64)
+    w = ks ** (-gamma)
+    deg = rng.choice(ks, size=n, p=w / w.sum())
+    if deg.sum() % 2:
+        deg[int(rng.integers(n))] += 1              # stub parity
+    stubs = np.repeat(np.arange(n, dtype=np.int64), deg)
+    rng.shuffle(stubs)
+    u, v = stubs[0::2], stubs[1::2]
+    keep = u != v                                   # erased: no self-loops
+    u, v = u[keep], v[keep]
+    lo, hi = np.minimum(u, v), np.maximum(u, v)
+    _, first = np.unique(lo * n + hi, return_index=True)
+    first = np.sort(first)                          # erased: dedup, stable
+    return graph_from_edges(n, np.stack([lo[first], hi[first]], axis=1))
+
+
 def bfs_order(graph: Graph) -> np.ndarray:
     """Breadth-first node ordering (frontier-vectorized; spans all
     components). Returns ``order`` with ``order[k]`` = old id of the node
@@ -410,6 +525,128 @@ def bfs_order(graph: Graph) -> np.ndarray:
     return order
 
 
+def degree_cv(deg) -> float:
+    """Coefficient of variation of a degree sequence (std/mean, host
+    float) — the layout-routing statistic: ~0 for an RRG, ``1/sqrt(c)``
+    for ER(c), diverging with n for a power-law tail. The ``sa``/``fused``
+    drivers and serve admission switch to the degree-bucketed layout when
+    this crosses :data:`graphdyn.ops.bucketed.BUCKETED_CV_THRESHOLD`."""
+    deg = np.asarray(deg)
+    if deg.size == 0:
+        return 0.0
+    mean = float(deg.mean())
+    if mean <= 0.0:
+        return 0.0
+    return float(deg.std()) / mean
+
+
+def _bit_length(v: np.ndarray) -> np.ndarray:
+    """Vectorized ``int.bit_length`` (host int math — no float log2)."""
+    v = np.asarray(v, dtype=np.int64)
+    out = np.zeros(v.shape, np.int64)
+    for k in range(63):
+        bit = np.int64(1) << k
+        out += v >= bit
+        if not (v >= bit).any():
+            break
+    return out
+
+
+class DegreeBuckets(NamedTuple):
+    """Degree-bucketed node layout (host numpy) — the power-law fast path.
+
+    Nodes are permuted bucket-major into ``O(log dmax)`` power-of-two
+    degree buckets: node ``i`` lands in bucket ``ceil(log2(deg_i))``
+    (degrees 0 and 1 in bucket 0), so every node in a width-``2^b``
+    bucket has degree in ``(2^(b-1), 2^b]`` and the tight per-bucket
+    neighbor block ``nbr[b]: int32[n_b, 2^b]`` pads each row by at most
+    2x over its true degree. Total table entries are therefore
+    ``<= 4E + n_0`` — edge-count-proportional — vs the padded table's
+    ``n·dmax``, which one degree-1e5 hub inflates for ALL n nodes (the
+    generalization of the BDCM ``class_bucket`` ghost-row machinery from
+    entropy solvers to the dynamics kernels; consumed by
+    :mod:`graphdyn.ops.bucketed`).
+
+    Neighbor entries are PERMUTED node ids indexing the bucketed state
+    order, ghost-padded with ``n`` (the same zero-contribution slot as
+    the padded kernel). Only non-empty buckets are materialized.
+
+    Attributes:
+      n:       global node count.
+      order:   int64[n] old id of the node in permuted slot k.
+      inv:     int64[n] permuted slot of old node i.
+      offsets: int64[B+1] bucket boundaries in the permuted order.
+      widths:  tuple[int, ...] static per-bucket padded width (powers of
+               two, strictly increasing).
+      nbr:     tuple of int32[n_b, width_b] per-bucket neighbor blocks.
+      deg:     tuple of int32[n_b] per-bucket true degrees.
+    """
+
+    n: int
+    order: np.ndarray
+    inv: np.ndarray
+    offsets: np.ndarray
+    widths: tuple
+    nbr: tuple
+    deg: tuple
+
+    @property
+    def B(self) -> int:
+        return len(self.widths)
+
+    @property
+    def table_entries(self) -> int:
+        """Σ_b n_b · width_b — the bucketed analogue of ``n·dmax``."""
+        return int(sum(t.shape[0] * t.shape[1] for t in self.nbr))
+
+
+def degree_buckets(graph: Graph, *, seed: int | None = None) -> DegreeBuckets:
+    """Build the :class:`DegreeBuckets` layout for ``graph`` (host NumPy,
+    one-time cost; deterministic — ``seed=None`` keeps the stable
+    original order within each bucket, preserving whatever locality the
+    input labeling already has, an int seed applies a deterministic
+    within-bucket shuffle instead)."""
+    n = graph.n
+    deg = graph.deg.astype(np.int64)
+    bucket = _bit_length(np.maximum(deg - 1, 0))    # deg<=1 -> 0, else ceil(log2)
+    if seed is None:
+        order = np.argsort(bucket, kind="stable").astype(np.int64)
+    else:
+        jitter = np.random.default_rng(seed).random(n)
+        order = np.lexsort((jitter, bucket)).astype(np.int64)
+    inv = np.empty(n, np.int64)
+    inv[order] = np.arange(n)
+    # ghost index n maps to itself: bucket blocks gather the ghost-
+    # extended permuted state exactly like the padded kernel
+    inv_ext = np.concatenate([inv, [n]])
+
+    present = np.unique(bucket)
+    counts = np.array([(bucket == b).sum() for b in present], np.int64)
+    offsets = np.concatenate([[0], np.cumsum(counts)])
+    widths, nbrs, degs = [], [], []
+    for k, b in enumerate(present):
+        ids = order[offsets[k]:offsets[k + 1]]
+        w = 1 << int(b)
+        take = min(w, graph.dmax)
+        blk = inv_ext[graph.nbr[ids, :take].astype(np.int64)]
+        if take < w:
+            blk = np.concatenate(
+                [blk, np.full((ids.size, w - take), n, np.int64)], axis=1
+            )
+        widths.append(w)
+        nbrs.append(blk.astype(np.int32))
+        degs.append(graph.deg[ids].astype(np.int32))
+    return DegreeBuckets(
+        n=n,
+        order=order,
+        inv=inv,
+        offsets=offsets,
+        widths=tuple(widths),
+        nbr=tuple(nbrs),
+        deg=tuple(degs),
+    )
+
+
 class Partition(NamedTuple):
     """An edge-cut node partition for node-axis sharding (host numpy).
 
@@ -421,13 +658,27 @@ class Partition(NamedTuple):
     the boundary nodes' spin words per synchronous step, so ``edge_cut``
     (equivalently the boundary counts) IS the per-step DCN/ICI byte bill.
 
+    **Hub splitting** (``hubs`` non-empty): vertices above the
+    ``hub_threshold`` degree are owned by NO part (``part[hub] = -1``,
+    excluded from ``order``/``offsets``) and vertex-cut REPLICATED
+    instead — every shard holds the hub's spin words and contributes a
+    partial popcount of its locally-owned hub neighbors, combined by a
+    ring allreduce over the existing halo exchange
+    (:mod:`graphdyn.parallel.halo`). Without splitting, a degree-1e5 hub
+    makes every partition cut-dominated: the hub is boundary to every
+    part and its whole neighborhood ships each step; ``edge_cut`` here
+    counts only NON-hub edges (hub traffic is the bounded
+    ``O(P·hubs·log dmax)`` allreduce instead).
+
     Attributes:
-      part:     int32[n] part id of each original node.
-      order:    int64[n] original node ids in part-major layout order.
+      part:     int32[n] part id of each original node (-1 = hub).
+      order:    int64[n - hubs] non-hub node ids in part-major order.
       offsets:  int64[P+1] part boundaries into ``order``.
       interior: int64[P] interior-node count per part (the first
                 ``interior[p]`` rows of part ``p``'s segment).
-      edge_cut: number of undirected edges crossing parts.
+      edge_cut: number of undirected NON-hub edges crossing parts.
+      hubs:     int64[h] vertex-cut replicated hub node ids (sorted),
+                or None (no hub splitting — the default layout).
     """
 
     part: np.ndarray
@@ -435,6 +686,7 @@ class Partition(NamedTuple):
     offsets: np.ndarray
     interior: np.ndarray
     edge_cut: int
+    hubs: np.ndarray | None = None
 
     @property
     def P(self) -> int:
@@ -466,6 +718,7 @@ def partition_graph(
     seed: int = 0,
     refine_rounds: int = 8,
     balance_slack: float = 0.1,
+    hub_threshold: int | None = None,
 ) -> Partition:
     """Edge-cut-minimizing partition into ``n_parts`` balanced parts.
 
@@ -486,12 +739,43 @@ def partition_graph(
     node permutation with the interior/boundary split per part
     (:class:`Partition`); the ghost tables the halo exchange needs are
     derived from it by :func:`partition_ghosts`.
+
+    ``hub_threshold`` enables **hub splitting**: nodes with degree >=
+    threshold are pulled out as vertex-cut replicated hubs (see
+    :class:`Partition`), their incident edges removed from the working
+    graph BEFORE partitioning — so hubs neither drag the edge cut nor
+    skew the balance, and the remaining bounded-degree residual
+    partitions as well as an RRG/ER graph would.
     """
     n = graph.n
     if n_parts < 1:
         raise ValueError(f"n_parts must be >= 1, got {n_parts}")
     if n_parts > n:
         raise ValueError(f"n_parts={n_parts} > n={n}")
+    hubs = None
+    work = graph
+    if hub_threshold is not None:
+        if hub_threshold < 1:
+            raise ValueError(
+                f"hub_threshold must be >= 1, got {hub_threshold}"
+            )
+        hub_mask = graph.deg >= hub_threshold
+        hubs = np.where(hub_mask)[0].astype(np.int64)
+        if hubs.size:
+            if n_parts > n - hubs.size:
+                raise ValueError(
+                    f"n_parts={n_parts} > non-hub nodes {n - hubs.size}"
+                )
+            e_all = graph.edges.astype(np.int64)
+            if e_all.size:
+                keep = ~(hub_mask[e_all[:, 0]] | hub_mask[e_all[:, 1]])
+                e_all = e_all[keep]
+            # hubs stay as ISOLATED nodes of the working graph: owned by
+            # no part, never boundary, replicated by the halo layer
+            work = graph_from_edges(n, e_all, dmax=graph.dmax)
+        else:
+            hubs = None
+    graph = work
     order0 = bfs_order(graph)
     pos = np.empty(n, np.int64)
     pos[order0] = np.arange(n)
@@ -560,8 +844,16 @@ def partition_graph(
         cross = part[e[:, 0]] != part[e[:, 1]]
         is_boundary[e[cross, 0]] = True
         is_boundary[e[cross, 1]] = True
+    if hubs is not None:
+        # hubs are owned by no part; part=-1 sorts them to the head of
+        # the lexsort, where the slice strips them from `order`
+        part[hubs] = -1
     order = np.lexsort((pos, is_boundary, part)).astype(np.int64)
-    counts = np.bincount(part, minlength=n_parts).astype(np.int64)
+    if hubs is not None:
+        order = order[hubs.size:]
+    counts = np.bincount(
+        part[part >= 0], minlength=n_parts
+    ).astype(np.int64)
     offsets = np.concatenate([[0], np.cumsum(counts)])
     interior = counts - np.bincount(
         part[is_boundary], minlength=n_parts
@@ -572,6 +864,7 @@ def partition_graph(
         offsets=offsets,
         interior=interior,
         edge_cut=edge_cut(graph, part),
+        hubs=hubs,
     )
 
 
@@ -588,7 +881,9 @@ def partition_ghosts(graph: Graph, partition: Partition) -> list[np.ndarray]:
         return [np.empty(0, np.int64) for _ in range(partition.P)]
     src = np.concatenate([e[:, 0], e[:, 1]])
     dst = np.concatenate([e[:, 1], e[:, 0]])
-    cross = part[src] != part[dst]
+    # hub endpoints (part -1, vertex-cut replicated on every shard) are
+    # never ghosts: their rows are locally resident by construction
+    cross = (part[src] != part[dst]) & (part[src] >= 0) & (part[dst] >= 0)
     src, dst = src[cross], dst[cross]
     for p in range(partition.P):
         out.append(np.unique(dst[part[src] == p]))

@@ -337,8 +337,22 @@ def simulated_annealing(
     rollout_mode: str = "full",
     lc_tables=None,
     kernel: str = "auto",
+    layout: str = "auto",
 ) -> SAResult:
     """Run batched SA chains.
+
+    ``layout`` selects the node layout (``'auto'`` | ``'padded'`` |
+    ``'bucketed'``): ``'auto'`` routes through
+    :func:`graphdyn.ops.bucketed.auto_layout` — a degree CV at or above
+    the bucketed threshold (power-law graphs; an RRG sits at 0) relabels
+    the graph bucket-major (:func:`graphdyn.graphs.degree_buckets`) so
+    the padded tables gather in degree-sorted order, and the returned
+    configurations are mapped back to the caller's node ids. The chain
+    LAW is label-equivariant but the seeded realization is not (site
+    proposals index nodes by id), so a relabeled run is a different —
+    equally distributed — chain; injected ``proposals``/``uniforms`` and
+    prebuilt ``lc_tables`` are node-indexed and therefore require
+    ``layout='padded'``.
 
     ``kernel`` selects the anneal execution engine (the PR-5 kernel-knob
     convention, ARCHITECTURE.md "Kernel selection"): ``'auto'`` and
@@ -399,6 +413,39 @@ def simulated_annealing(
         raise ValueError(
             f"kernel must be 'auto', 'xla' or 'pallas', got {kernel!r}"
         )
+    if layout not in ("auto", "padded", "bucketed"):
+        raise ValueError(
+            f"layout must be 'auto', 'padded' or 'bucketed', got {layout!r}"
+        )
+    if layout == "auto":
+        from graphdyn.ops.bucketed import auto_layout
+
+        layout = auto_layout(graph.deg)
+    if layout == "bucketed":
+        if proposals is not None or uniforms is not None:
+            raise ValueError(
+                "injected proposals/uniforms are node-indexed: pass "
+                "layout='padded' to keep the caller's labeling"
+            )
+        if lc_tables is not None:
+            raise ValueError(
+                "prebuilt lightcone tables are node-indexed: pass "
+                "layout='padded' to keep the caller's labeling"
+            )
+        from graphdyn.graphs import degree_buckets, permute_nodes
+
+        order = degree_buckets(graph).order
+        g_b, inv = permute_nodes(graph, order)
+        res = simulated_annealing(
+            g_b, config, n_replicas=n_replicas, seed=seed,
+            s0=None if s0 is None else np.asarray(s0)[..., order],
+            a0=a0, b0=b0, max_steps=max_steps, dtype=dtype,
+            backend=backend, checkpoint_path=checkpoint_path,
+            checkpoint_interval_s=checkpoint_interval_s,
+            chunk_steps=chunk_steps, rollout_mode=rollout_mode,
+            kernel=kernel, layout="padded",
+        )
+        return res._replace(s=res.s[..., inv])
     config = config or SAConfig()
     n = graph.n
     dyn = config.dynamics

@@ -56,6 +56,9 @@ MEM_BANDS: dict[str, tuple[float, float]] = {
     # the shard state is a fraction of the process peak when the P=1
     # baseline ran first in the same process
     "halo_shard": (0.25, 16.0),
+    # degree-bucketed layout: lo is loose for the same shared-process
+    # reason as halo_shard (the padded baseline usually ran first)
+    "bucketed_state": (0.25, 16.0),
 }
 
 
@@ -81,6 +84,29 @@ def halo_shard_bytes(n_local: int, n_ghost: int, W: int) -> int:
     also the shard's per-step exchange traffic — residency and DCN bytes
     share one model (``HaloTables.halo_bytes_per_step``)."""
     return 4 * n_local * W + 4 * n_ghost * W
+
+
+def bucketed_state_bytes(n: int, W: int, table_entries: int) -> int:
+    """Resident device state of the degree-bucketed rollout
+    (:mod:`graphdyn.ops.bucketed`): the ``uint32[n, W]`` spin words, the
+    bucketed neighbor blocks (``table_entries = Σ_b n_b·2^b`` int32 slots
+    — :attr:`graphdyn.graphs.DegreeBuckets.table_entries`), and the
+    per-bucket degree vectors (``n`` int32 total). The padded model
+    charges ``4·n·dmax`` for the table; this one charges the tight
+    blocks, which :func:`bucketed_table_entries_bound` caps at
+    ``4E + n`` — edge-count proportional, the whole point of the layout
+    (serve admission prices power-law jobs with THIS model instead of
+    over-refusing by the hub factor)."""
+    return 4 * n * W + 4 * table_entries + 4 * n
+
+
+def bucketed_table_entries_bound(n: int, n_edges: int) -> int:
+    """Upper bound on :attr:`DegreeBuckets.table_entries` from the edge
+    count alone (what admission has before any layout exists): each node's
+    block row rounds its degree up to a power of two, at most doubling it
+    except degree-0/1 rows which cost one slot — so
+    ``Σ_b n_b·2^b ≤ Σ_v max(2·deg(v), 1) ≤ 4·E + n``."""
+    return 4 * n_edges + n
 
 
 def stacked_bdcm_bytes(stk) -> int:
@@ -277,11 +303,13 @@ def run_memcheck(*, diag=None) -> list[MemRow]:
             _row("entropy_cell_chunk", None, entropy_chunk_bytes(stk),
                  reason),
             _row("halo_shard", None, _halo_smoke_model(W=W), reason),
+            _row("bucketed_state", None, _bucketed_smoke_model(W=W),
+                 reason),
             *_derived_rows(reason),
         ]
     else:
         rows = [_measure_packed(), *_measure_bdcm_rows(), _measure_halo(),
-                *_derived_rows(None)]
+                _measure_bucketed(), *_derived_rows(None)]
     from graphdyn import obs
 
     for row in rows:
@@ -316,6 +344,7 @@ def _derived_rows(reason: str | None) -> list[MemRow]:
     rows = []
     for program, entry, n in (
         ("derived:packed_rollout", "packed_rollout", 32768),
+        ("derived:bucketed_rollout", "bucketed_rollout", 32768),
         ("derived:fused_anneal", "fused_anneal", 4096),
     ):
         model, mreason = graftcost.derived_peak_bytes(entry, n)
@@ -327,6 +356,9 @@ def _derived_rows(reason: str | None) -> list[MemRow]:
             continue
         if entry == "packed_rollout":
             measured, why = _measure_derived_packed(n)
+            rows.append(_row(program, measured, model, why))
+        elif entry == "bucketed_rollout":
+            measured, why = _measure_derived_bucketed(n)
             rows.append(_row(program, measured, model, why))
         else:
             rows.append(_row(
@@ -354,6 +386,21 @@ def _measure_derived_packed(n: int) -> tuple[int | None, str | None]:
         jnp.asarray(g.nbr), jnp.asarray(g.deg), jnp.asarray(pack_spins(s)),
         steps=4,
     )
+    np.asarray(out)                     # drain: the peak includes the run
+    return peak_hbm_bytes()
+
+
+def _measure_derived_bucketed(n: int) -> tuple[int | None, str | None]:
+    """Peak bytes through the CANONICAL bucketed-rollout family (power-law
+    γ=2.5 dmin=2 seed=0, W=4, steps=4 — the exact program graftcost's
+    models are fitted on, at a size far outside the fit range)."""
+    import numpy as np
+
+    from graphdyn.graphs import degree_buckets, powerlaw_graph
+    from graphdyn.ops.bucketed import bucketed_rollout
+
+    b = degree_buckets(powerlaw_graph(n, gamma=2.5, dmin=2, seed=0))
+    out = bucketed_rollout(b, np.zeros((n, 4), np.uint32), 4)
     np.asarray(out)                     # drain: the peak includes the run
     return peak_hbm_bytes()
 
@@ -419,6 +466,35 @@ def _measure_halo(*, n: int = 8192, P: int = 2, W: int = 8,
         for p in range(tables.P)
     )
     return _row("halo_shard", peak, model, reason)
+
+
+def _bucketed_smoke_buckets(n: int = 4096):
+    """The bucketed smoke layout: a seeded power-law graph (the family the
+    layout exists for) at a shape small enough for the structural pass."""
+    from graphdyn.graphs import degree_buckets, powerlaw_graph
+
+    g = powerlaw_graph(n, gamma=2.5, dmin=2, seed=0)
+    return g, degree_buckets(g)
+
+
+def _bucketed_smoke_model(*, W: int, n: int = 4096) -> float:
+    """``bucketed_state`` model bytes at the smoke shape."""
+    _, b = _bucketed_smoke_buckets(n)
+    return float(bucketed_state_bytes(b.n, W, b.table_entries))
+
+
+def _measure_bucketed(*, n: int = 4096, W: int = 8, steps: int = 8) -> MemRow:
+    """Peak bytes through the bucketed rollout on the power-law smoke."""
+    import numpy as np
+
+    from graphdyn.ops.bucketed import bucketed_rollout
+
+    g, b = _bucketed_smoke_buckets(n)
+    out = bucketed_rollout(b, np.zeros((n, W), np.uint32), steps)
+    np.asarray(out)                     # drain: the peak includes the run
+    peak, reason = peak_hbm_bytes()
+    return _row("bucketed_state", peak,
+                bucketed_state_bytes(b.n, W, b.table_entries), reason)
 
 
 def _measure_bdcm_rows() -> list[MemRow]:

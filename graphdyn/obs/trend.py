@@ -43,6 +43,7 @@ TREND_ROWS: dict[str, tuple[float, float]] = {
     "ensemble_rate": (0.25, 20.0),
     "ensemble_rate_serial": (0.25, 20.0),
     "entropy_cell_rate": (0.25, 20.0),
+    "powerlaw_rate": (0.25, 20.0),
     "torch_cpu_rate": (0.25, 20.0),
 }
 

@@ -38,6 +38,27 @@ from graphdyn.ops.dynamics import Rule, TieBreak, rule_coefficients
 from graphdyn.ops.packed import _FULL, _csa_add_one
 
 
+def update_lut_rows(degs, max_cnt: int,
+                    rule: Rule | str = Rule.MAJORITY,
+                    tie: TieBreak | str = TieBreak.STAY) -> np.ndarray:
+    """``uint8[len(degs), max_cnt+1, 2]``: the :func:`update_lut` rows for
+    an EXPLICIT degree list (vectorized host NumPy). This is the bucketed
+    kernel's per-bucket table build (:mod:`graphdyn.ops.bucketed`): a
+    power-law hub pushes ``dmax`` into the thousands, where materializing
+    the full ``[dmax+1, dmax+1, 2]`` square costs O(dmax²) for rows no
+    node in the bucket has — the row build is the same formula, degree
+    sequence in, so :func:`update_lut` and the bucketed masks cannot
+    drift (update_lut IS this function over ``arange(dmax+1)``)."""
+    degs = np.asarray(degs, np.int64).reshape(-1)
+    R, C = rule_coefficients(rule, tie)
+    deg = degs[:, None, None]
+    cnt = np.arange(max_cnt + 1, dtype=np.int64)[None, :, None]
+    b = np.arange(2, dtype=np.int64)[None, None, :]
+    # R·sign(2Σ + C·s) with Σ = 2·cnt − deg, s = 2b − 1 (see update_lut)
+    val = R * np.sign(2 * (2 * cnt - deg) + C * (2 * b - 1))
+    return ((val == 1) & (cnt <= deg)).astype(np.uint8)
+
+
 def update_lut(dmax: int, rule: Rule | str = Rule.MAJORITY,
                tie: TieBreak | str = TieBreak.STAY) -> np.ndarray:
     """``uint8[dmax+1, dmax+1, 2]``: next spin bit for (degree ``deg``,
@@ -53,15 +74,7 @@ def update_lut(dmax: int, rule: Rule | str = Rule.MAJORITY,
     """
     if dmax < 0:
         raise ValueError(f"dmax must be >= 0, got {dmax}")
-    R, C = rule_coefficients(rule, tie)
-    lut = np.zeros((dmax + 1, dmax + 1, 2), np.uint8)
-    for deg in range(dmax + 1):
-        for cnt in range(deg + 1):
-            for b in (0, 1):
-                s = 2 * b - 1
-                out = R * np.sign(2 * (2 * cnt - deg) + C * s)
-                lut[deg, cnt, b] = 1 if out == 1 else 0
-    return lut
+    return update_lut_rows(np.arange(dmax + 1), dmax, rule, tie)
 
 
 def lut_node_masks(deg_ext: np.ndarray, lut: np.ndarray) -> np.ndarray:

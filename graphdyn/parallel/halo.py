@@ -96,46 +96,87 @@ class HaloTables(NamedTuple):
     schedule: tuple           # ((delta, send_idx[P, m], recv_idx[P, m]), ...)
     n_halo_words: int         # useful boundary rows per step (Σ ghosts)
     n_slab_words: int         # shipped rows per step (P · Σ_δ m_δ, pads incl.)
+    # hub-split vertex cut (None/empty on hubless partitions — the layout
+    # and every fingerprinted program are then byte-identical to before):
+    hub_global: np.ndarray | None = None  # int64[H] global hub ids
+    hub_deg: np.ndarray | None = None     # int32[H] ORIGINAL hub degrees
+    hub_nbr_loc: np.ndarray | None = None  # int32[P, H, hd_max] local rows
+    hub_ring_words: int = 0   # rows shipped per step by the hub ring
 
     @property
     def P(self) -> int:
         return self.nbr_loc.shape[0]
 
     @property
-    def n_rows(self) -> int:
-        # owned + ghosts + trash + zero
-        return self.n_local_max + self.n_ghost_max + 2
+    def n_hubs(self) -> int:
+        return 0 if self.hub_global is None else int(self.hub_global.size)
 
     @property
-    def trash_row(self) -> int:
+    def n_rows(self) -> int:
+        # owned + ghosts + replicated hubs + trash + zero
+        return self.n_local_max + self.n_ghost_max + self.n_hubs + 2
+
+    @property
+    def hub_row0(self) -> int:
+        """First replicated-hub row (hubs occupy ``[hub_row0, trash_row)``)."""
         return self.n_local_max + self.n_ghost_max
 
     @property
+    def trash_row(self) -> int:
+        return self.n_local_max + self.n_ghost_max + self.n_hubs
+
+    @property
     def zero_row(self) -> int:
-        return self.n_local_max + self.n_ghost_max + 1
+        return self.n_local_max + self.n_ghost_max + self.n_hubs + 1
 
     def halo_bytes_per_step(self, W: int) -> int:
         """ACTUAL exchange traffic of one synchronous step at ``W`` spin
         words per node — the padded slabs the collectives ship
-        (``4·W·n_slab_words``), not the useful-words floor
+        (``4·W·n_slab_words``) plus the hub partial-popcount ring
+        (``4·W·hub_ring_words``), not the useful-words floor
         (``4·W·n_halo_words``). The number the weak-scaling bench row and
-        the obs gauge report; the ratio of the two is pad overhead from
+        the obs gauge report; the slab/useful ratio is pad overhead from
         partition imbalance."""
-        return 4 * W * self.n_slab_words
+        return 4 * W * (self.n_slab_words + self.hub_ring_words)
 
 
 def build_halo_tables(graph: Graph, partition: Partition) -> HaloTables:
     """Build the per-shard layout + static exchange schedule for
-    ``partition`` (pure host NumPy; one-time cost per graph)."""
-    n, dmax = graph.n, graph.dmax
+    ``partition`` (pure host NumPy; one-time cost per graph).
+
+    Hub-split partitions (``partition.hubs`` non-empty — see
+    :func:`graphdyn.graphs.partition_graph` ``hub_threshold``) get the
+    vertex-cut layout: every shard carries a replicated row per hub, the
+    owned-row ``dmax`` shrinks to the max NON-hub degree (the whole point
+    — one degree-1e5 hub no longer pads every owned row), and
+    ``hub_nbr_loc[p, i]`` lists hub ``i``'s neighbors OWNED BY shard p
+    (hub–hub neighbors charged to shard 0 so each edge counts once): the
+    per-shard partial popcounts those rows produce are ring-combined each
+    step (see :func:`make_halo_rollout`)."""
+    n = graph.n
     Pn = partition.P
     counts = partition.counts
+    hubs = (
+        partition.hubs if partition.hubs is not None
+        else np.empty(0, np.int64)
+    ).astype(np.int64)
+    H = int(hubs.size)
+    # hub-split shrinks the owned-row gather width to the non-hub max
+    # degree; hubless tables keep graph.dmax so the layout (and the
+    # committed halo_rollout fingerprint) is unchanged
+    if H:
+        hub_mask = np.zeros(n, bool)
+        hub_mask[hubs] = True
+        dmax = int(graph.deg[~hub_mask].max(initial=1))
+    else:
+        dmax = graph.dmax
     n_local_max = int(counts.max())
     ghosts = partition_ghosts(graph, partition)
     ghost_counts = np.array([g.size for g in ghosts], np.int64)
     n_ghost_max = int(ghost_counts.max(initial=0))
-    n_rows = n_local_max + n_ghost_max + 2
+    n_rows = n_local_max + n_ghost_max + H + 2
     trash_row, zero_row = n_rows - 2, n_rows - 1
+    hub_row0 = n_local_max + n_ghost_max
 
     nbr_loc = np.full((Pn, n_local_max, dmax), zero_row, np.int32)
     deg_loc = np.zeros((Pn, n_local_max), np.int32)
@@ -154,7 +195,9 @@ def build_halo_tables(graph: Graph, partition: Partition) -> HaloTables:
         lut = np.full(n + 1, zero_row, np.int64)
         lut[seg] = np.arange(seg.size)
         lut[gl] = n_local_max + np.arange(gl.size)
-        nbr_loc[p, :seg.size] = lut[graph.nbr[seg].astype(np.int64)]
+        if H:
+            lut[hubs] = hub_row0 + np.arange(H)
+        nbr_loc[p, :seg.size] = lut[graph.nbr[seg, :dmax].astype(np.int64)]
         deg_loc[p, :seg.size] = graph.deg[seg]
         real[p, :seg.size] = True
         owned_global[p, :seg.size] = seg
@@ -162,9 +205,45 @@ def build_halo_tables(graph: Graph, partition: Partition) -> HaloTables:
         gpos = np.full(n, -1, np.int64)
         gpos[gl] = np.arange(gl.size)
         ghost_pos.append(gpos)
+    if H:
+        row_of[hubs] = 0
     loc_of = (
         partition.part.astype(np.int64) * n_local_max + row_of
     ).astype(np.int32)
+    if H:
+        loc_of[hubs] = -1        # hubs live on every shard, not one row
+
+    # hub neighbor slices: shard p accumulates hub i's popcount over the
+    # neighbors p OWNS; hub–hub neighbors ride on shard 0 only, so every
+    # edge contributes to exactly one partial count and the ring-combined
+    # total equals the unsharded popcount bit-for-bit
+    hub_nbr_loc = None
+    hub_ring_words = 0
+    if H:
+        slices: list[list[np.ndarray]] = [[] for _ in range(Pn)]
+        hub_lut = np.full(n, -1, np.int64)
+        hub_lut[hubs] = hub_row0 + np.arange(H)
+        for i, h in enumerate(hubs):
+            nbrs = graph.nbr[h, :graph.deg[h]].astype(np.int64)
+            owners = partition.part[nbrs]
+            for p in range(Pn):
+                mine = nbrs[owners == p]
+                rows = row_of[mine]
+                if p == 0:
+                    rows = np.concatenate(
+                        [rows, hub_lut[nbrs[owners < 0]]]
+                    )
+                slices[p].append(rows)
+        hd_max = max(
+            (r.size for per_p in slices for r in per_p), default=1
+        )
+        hd_max = max(hd_max, 1)
+        hub_nbr_loc = np.full((Pn, H, hd_max), zero_row, np.int32)
+        for p in range(Pn):
+            for i, rows in enumerate(slices[p]):
+                hub_nbr_loc[p, i, :rows.size] = rows
+        n_planes_hub = max(int(graph.deg[hubs].max()).bit_length(), 1)
+        hub_ring_words = Pn * (Pn - 1) * H * n_planes_hub
 
     # static exchange schedule, grouped by shard offset delta = (p - q) % P:
     # sender q ships the boundary nodes that shard p = (q + delta) % P
@@ -205,6 +284,10 @@ def build_halo_tables(graph: Graph, partition: Partition) -> HaloTables:
         schedule=tuple(schedule),
         n_halo_words=int(ghost_counts.sum()),
         n_slab_words=Pn * sum(s.shape[1] for (_, s, _) in schedule),
+        hub_global=hubs if H else None,
+        hub_deg=graph.deg[hubs].astype(np.int32) if H else None,
+        hub_nbr_loc=hub_nbr_loc,
+        hub_ring_words=hub_ring_words,
     )
 
 
@@ -253,14 +336,33 @@ def make_halo_rollout(
     dmax = tables.dmax
     n_planes = max(int(dmax).bit_length(), 1)
     perms = exchange_perms(tables)
+    Pn = tables.P
+    H = tables.n_hubs
+    hub0 = tables.hub_row0
+    if H:
+        # replicated-hub constants (host data -> jaxpr constants): the
+        # comparator thresholds come from the ORIGINAL hub degrees, so a
+        # hub's update is the unsharded rule applied to the ring-combined
+        # total popcount
+        hd_max = tables.hub_nbr_loc.shape[2]
+        hd = tables.hub_deg.astype(np.int64)
+        n_planes_hub = max(int(hd.max()).bit_length(), 1)
+        thr_h = (hd // 2).astype(np.uint32)
+        even_h = np.where(hd % 2 == 0, _FULL, np.uint32(0))[:, None]
+        thr_bits_h = [
+            np.where((thr_h >> k) & 1 == 1, _FULL, np.uint32(0))[:, None]
+            for k in range(n_planes_hub)
+        ]
+        ring_perm = tuple((q, (q + 1) % Pn) for q in range(Pn))
 
-    def rollout(nbr_l, deg_l, real_l, send_l, recv_l, sp_l):
+    def rollout(nbr_l, deg_l, real_l, send_l, recv_l, sp_l, *hub_l):
         nbr = nbr_l[0]
         deg = deg_l[0]
         real = real_l[0]
         sends = [s[0] for s in send_l]
         recvs = [r[0] for r in recv_l]
         sp0 = sp_l[0]
+        hub_nbr = hub_l[0][0][0] if H else None
 
         thr = (deg // 2).astype(jnp.uint32)
         even_mask = jnp.where(deg % 2 == 0, _FULL, jnp.uint32(0))[:, None]
@@ -278,7 +380,39 @@ def make_halo_rollout(
             # pad rows stay inert under every rule (cf. the unsharded
             # kernel's forced ghost word)
             out = jnp.where(real[:, None], out, sp[:nm])
+            if H:
+                # partial popcount of every hub over the neighbors THIS
+                # shard owns, from the same pre-update state as `out`
+                hpl = [
+                    jnp.zeros((H, sp.shape[1]), sp.dtype)
+                    for _ in range(n_planes_hub)
+                ]
+                for j in range(hd_max):
+                    _csa_add_one(hpl, jnp.take(sp, hub_nbr[:, j], axis=0))
+                prev_h = lax.dynamic_slice_in_dim(sp, hub0, H, axis=0)
             sp = lax.dynamic_update_slice(sp, out, (0, 0))
+            if H:
+                # ring-allreduce the partial counts: (P-1) ppermute
+                # rounds; bit-plane ripple-carry addition is exact, and
+                # n_planes_hub bounds the total (= the hub degree), so no
+                # carry ever leaves the top plane. Every shard computes
+                # the identical total -> hub rows stay replicated.
+                acc, buf = hpl, hpl
+                for _ in range(Pn - 1):
+                    buf = [
+                        lax.ppermute(pl, node_axis, ring_perm) for pl in buf
+                    ]
+                    carry = jnp.zeros_like(acc[0])
+                    nxt = []
+                    for a, b in zip(acc, buf):
+                        nxt.append(a ^ b ^ carry)
+                        carry = (a & b) | (carry & (a ^ b))
+                    acc = nxt
+                gt_h, eq_h = _compare_planes(acc, thr_bits_h)
+                out_h = _rule_tie_combine(
+                    gt_h, eq_h & even_h, prev_h, rule, tie
+                )
+                sp = lax.dynamic_update_slice(sp, out_h, (hub0, 0))
             # halo exchange: boundary words only, one slab per offset
             for perm, s_idx, r_idx in zip(perms, sends, recvs):
                 buf = jnp.take(sp, s_idx, axis=0)
@@ -291,10 +425,15 @@ def make_halo_rollout(
     k = len(tables.schedule)
     spec2 = P(node_axis, None)
     spec3 = P(node_axis, None, None)
+    in_specs = (spec3, spec2, spec2, [spec2] * k, [spec2] * k, spec3)
+    if H:
+        # hub tables ride AFTER sp so the donated-carry position (and the
+        # hubless flat jaxpr graftcheck fingerprints) never moves
+        in_specs = in_specs + ([spec3],)
     f = shard_map(
         rollout,
         mesh=mesh,
-        in_specs=(spec3, spec2, spec2, [spec2] * k, [spec2] * k, spec3),
+        in_specs=in_specs,
         out_specs=spec3,
         check_vma=False,
     )
@@ -309,12 +448,15 @@ def scatter_state(tables: HaloTables, sp: np.ndarray) -> np.ndarray:
     W = sp.shape[1]
     out = np.zeros((tables.P, tables.n_rows, W), np.uint32)
     nm = tables.n_local_max
+    h0 = tables.hub_row0
     for p in range(tables.P):
         cnt = int(tables.counts[p])
         out[p, :cnt] = sp[tables.owned_global[p, :cnt]]
         gcnt = int(tables.ghost_counts[p])
         if gcnt:
             out[p, nm:nm + gcnt] = sp[tables.ghost_global[p, :gcnt]]
+        if tables.n_hubs:
+            out[p, h0:h0 + tables.n_hubs] = sp[tables.hub_global]
     return out
 
 
@@ -325,6 +467,10 @@ def gather_state(tables: HaloTables, sp_loc: np.ndarray) -> np.ndarray:
     for p in range(tables.P):
         cnt = int(tables.counts[p])
         out[tables.owned_global[p, :cnt]] = sp_loc[p, :cnt]
+    if tables.n_hubs:
+        # hub rows are replicated and updated identically on every shard
+        h0 = tables.hub_row0
+        out[tables.hub_global] = sp_loc[0, h0:h0 + tables.n_hubs]
     return out
 
 
@@ -378,6 +524,12 @@ class HaloProgram:
             [jax.device_put(jnp.asarray(s), spec2) for (_, s, _) in t.schedule],
             [jax.device_put(jnp.asarray(r), spec2) for (_, _, r) in t.schedule],
         )
+        # hub tables ride after sp (see make_halo_rollout); empty for
+        # hubless partitions so the call signature is unchanged
+        self._hub_consts = (
+            ([jax.device_put(jnp.asarray(t.hub_nbr_loc), spec3)],)
+            if t.n_hubs else ()
+        )
 
     def place(self, sp) -> jax.Array:
         """Scatter + place a global ``uint32[n, W]`` state onto the mesh."""
@@ -398,7 +550,7 @@ class HaloProgram:
                 self.tables.halo_bytes_per_step(W),
                 P=self.tables.P, W=W, steps=self.steps,
             )
-        return self._fn(*self._consts, sp_loc)
+        return self._fn(*self._consts, sp_loc, *self._hub_consts)
 
     def fetch(self, sp_loc: jax.Array) -> np.ndarray:
         """Placed state back to the global ``uint32[n, W]`` order."""
@@ -448,6 +600,11 @@ def sa_halo_cols(tables: HaloTables, s: np.ndarray) -> np.ndarray:
     """Global int8 spins ``[R, n]`` -> halo column layout
     ``[R, P * n_rows]`` (owned + consistent ghosts; trash/zero columns 0,
     so ghost-padded neighbor slots contribute 0 to neighbor sums)."""
+    if tables.n_hubs:
+        raise NotImplementedError(
+            "the int8 SA halo layout does not implement hub replication; "
+            "partition without hub_threshold for the sharded SA solver"
+        )
     s = np.asarray(s, np.int8)
     R = s.shape[0]
     nm = tables.n_local_max
@@ -539,4 +696,4 @@ def lower_halo_rollout(
         node_axis=node_axis,
     )
     sp_loc = prog.place(np.zeros((graph.n, W), np.uint32))
-    return prog._fn.lower(*prog._consts, sp_loc)
+    return prog._fn.lower(*prog._consts, sp_loc, *prog._hub_consts)

@@ -224,6 +224,7 @@ def fused_anneal(
     kernel: str = "auto",
     betas=None,
     tables: FusedTables | None = None,
+    layout: str = "auto",
 ) -> FusedResult:
     """Anneal R packed replicas by fused LUT class sweeps until each
     reaches ``Σs_end ≥ ceil(m_target·n)`` (first passage recorded per
@@ -238,8 +239,43 @@ def fused_anneal(
     on completion (a wait, not a transfer) so liveness tracks real work
     (``stop_on_first=True``, or a plan longer than 4096 chunks, adds the
     sanctioned per-chunk stop test). Pass ``tables`` to amortize the
-    coloring + LUT build across calls on the same graph."""
+    coloring + LUT build across calls on the same graph.
+
+    ``layout`` (``'auto'`` | ``'padded'`` | ``'bucketed'``) follows the
+    :func:`graphdyn.models.sa.simulated_annealing` convention: ``'auto'``
+    consults :func:`graphdyn.ops.bucketed.auto_layout`, and a degree CV
+    at or above the bucketed threshold relabels the graph bucket-major
+    before the coloring/LUT build (degree-sorted gathers), mapping the
+    returned configurations back to the caller's ids. The seeded chain
+    realization is labeling-dependent (sites index nodes), so the
+    relabeled run is a different, equally distributed chain; prebuilt
+    ``tables`` pin the caller's labeling and require ``layout='padded'``.
+    """
     config = config or SAConfig()
+    if layout not in ("auto", "padded", "bucketed"):
+        raise ValueError(
+            f"layout must be 'auto', 'padded' or 'bucketed', got {layout!r}"
+        )
+    if layout == "auto":
+        from graphdyn.ops.bucketed import auto_layout
+
+        layout = "padded" if tables is not None else auto_layout(graph.deg)
+    if layout == "bucketed":
+        if tables is not None:
+            raise ValueError(
+                "prebuilt FusedTables pin the caller's node labeling: "
+                "pass layout='padded' (or tables=None) to relabel"
+            )
+        from graphdyn.graphs import degree_buckets, permute_nodes
+
+        g_b, inv = permute_nodes(graph, degree_buckets(graph).order)
+        res = fused_anneal(
+            g_b, config, n_replicas=n_replicas, seed=seed,
+            m_target=m_target, max_sweeps=max_sweeps,
+            chunk_sweeps=chunk_sweeps, stop_on_first=stop_on_first,
+            kernel=kernel, betas=betas, layout="padded",
+        )
+        return res._replace(s=res.s[..., inv])
     if chunk_sweeps < 1:
         raise ValueError(f"chunk_sweeps must be >= 1, got {chunk_sweeps}")
     if max_sweeps < 1:

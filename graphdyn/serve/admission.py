@@ -9,6 +9,13 @@ job reaches the device, from the committed byte models alone:
   chromatic bound (``χ ≤ d² + 1``: a distance-2 greedy coloring of a
   degree-``d`` graph never needs more — the real χ, known only after the
   coloring runs, can only be smaller, so admission never under-admits);
+- :func:`graphdyn.obs.memband.bucketed_state_bytes` — for jobs that
+  declare ``edges`` and a ``degree_cv`` at or above the bucketed routing
+  threshold (:data:`graphdyn.ops.bucketed.BUCKETED_CV_THRESHOLD`): the
+  degree-bucketed layout's edge-count-proportional model replaces the
+  padded-dmax formula, which over-refuses scale-free shapes by the hub
+  factor (their ``d`` is the max degree), and the decision routes the
+  job to the ``bucketed`` engine;
 - the device memory budget — the plugin's reported ``bytes_limit``
   (:func:`graphdyn.obs.memband.device_memory_stats`) when a device can
   speak for itself, else the ``GRAPHDYN_SERVE_HBM_BUDGET`` env override,
@@ -40,7 +47,7 @@ DEFAULT_HBM_BUDGET = 1 << 30
 
 class AdmissionDecision(NamedTuple):
     admitted: bool
-    kernel: str         # 'auto' (pallas model fits) | 'xla' | '' (refused)
+    kernel: str         # 'auto' (pallas fits) | 'xla' | 'bucketed' | ''
     reason: str | None  # refusal reason (None when admitted)
     model_bytes: int    # fused resident-set model at the static chi bound
     budget_bytes: int   # the device budget the model was held against
@@ -79,6 +86,7 @@ def admit(spec: dict, *, key: str = "") -> AdmissionDecision:
     """One admission decision from the committed models — no compilation,
     no device allocation, no exception escapes (a malformed spec is a
     refusal with a reason, not a worker crash)."""
+    from graphdyn.ops.bucketed import BUCKETED_CV_THRESHOLD
     from graphdyn.ops.packed import WORD
     from graphdyn.ops.pallas_anneal import (
         FUSED_VMEM_BUDGET,
@@ -104,6 +112,35 @@ def admit(spec: dict, *, key: str = "") -> AdmissionDecision:
                 False, "", f"unknown solver {spec.get('solver')!r} "
                 "(this service runs the fused annealer)", 0, budget)
         W = -(-R // WORD)
+        # power-law jobs declare their edge count and degree CV; when the
+        # CV crosses the bucketed-routing threshold the job is priced with
+        # the degree-bucketed byte model (edge-count proportional) instead
+        # of the padded-dmax formula, which over-refuses scale-free shapes
+        # by the hub factor (here d is the MAX degree, so d²·n is absurd)
+        cv = float(spec.get("degree_cv", 0.0))
+        n_edges = spec.get("edges")
+        if cv >= BUCKETED_CV_THRESHOLD and n_edges is not None:
+            from graphdyn.obs.memband import (
+                bucketed_state_bytes,
+                bucketed_table_entries_bound,
+            )
+
+            n_edges = int(n_edges)
+            if n_edges < 0:
+                return AdmissionDecision(
+                    False, "", f"malformed shape: edges={n_edges}", 0,
+                    budget)
+            model = bucketed_state_bytes(
+                n, W, bucketed_table_entries_bound(n, n_edges))
+            if model > budget:
+                return AdmissionDecision(
+                    False, "",
+                    f"modeled bucketed resident set {model} B exceeds the "
+                    f"device budget {budget} B (n={n}, edges={n_edges}, "
+                    f"replicas={R}: refuse at admission, never OOM the "
+                    "shared worker)",
+                    model, budget)
+            return AdmissionDecision(True, "bucketed", None, model, budget)
         model = fused_vmem_bytes(n, W, chi_bound(d), d)
     except (KeyError, TypeError, ValueError) as e:
         return AdmissionDecision(False, "", f"malformed spec: {e}", 0,

@@ -60,6 +60,12 @@ SPEC_DEFAULTS: dict = {
     "m_target": 0.9,
     "max_sweeps": 64,
     "chunk_sweeps": 16,
+    # heavy-tail declarations: a job whose degree CV crosses the bucketed
+    # routing threshold AND declares its edge count is priced with the
+    # degree-bucketed byte model and routed to the bucketed layout
+    # (graphdyn.serve.admission); None/0.0 = the padded default
+    "edges": None,
+    "degree_cv": 0.0,
 }
 
 

@@ -209,12 +209,18 @@ class Worker:
         with supervision(None, timeout):
             with obs.timed("serve.job", job=rec["id"], tenant=rec["tenant"],
                            n=int(spec["n"]), replicas=int(spec["replicas"])):
+                # a 'bucketed' admission routes the LAYOUT, not the device
+                # kernel: the annealer relabels bucket-major and builds
+                # its own tables (prebuilt ones pin the padded labeling)
+                bucketed = kernel == "bucketed"
                 res = fused_anneal(
                     g, cfg, n_replicas=int(spec["replicas"]),
                     seed=int(spec["seed"]), m_target=float(spec["m_target"]),
                     max_sweeps=int(spec["max_sweeps"]),
                     chunk_sweeps=int(spec["chunk_sweeps"]),
-                    kernel=kernel, tables=tables,
+                    kernel="auto" if bucketed else kernel,
+                    layout="bucketed" if bucketed else "auto",
+                    tables=None if bucketed else tables,
                 )
         save_results_npz(
             rec["result"], conf=res.s, mag_reached=res.mag_reached,

@@ -325,6 +325,18 @@ for col in ("derived_bytes", "arithmetic_intensity"):
             f"null {col} needs {col}_skipped_reason"
     else:
         assert v > 0, f"{col} must be > 0 or null+reason: {v}"
+# the power-law bucketed-layout row: a measured positive rate with its
+# equal-edge RRG control, or an explicit null + reason — NEVER 0.0
+assert "powerlaw_rate" in row, "powerlaw_rate row absent"
+plr = row["powerlaw_rate"]
+if plr is None:
+    assert row.get("powerlaw_rate_skipped_reason"), \
+        "null powerlaw_rate needs powerlaw_rate_skipped_reason"
+else:
+    assert plr > 0, f"powerlaw_rate must be > 0 or null+reason: {plr}"
+    det = row["powerlaw_rate_detail"]
+    assert det["rrg_padded_rate"] > 0 and det["rrg_over_bucketed_x"] > 0
+    assert det["hub_degree"] > 0 and det["table_entries"] > 0
 # the serve rows: multi-tenant bucket hit rate and end-to-end job
 # latency through the real worker — measured positive, or an explicit
 # null + reason — NEVER 0.0 (the same null-or-positive contract)

@@ -1226,6 +1226,59 @@ class TestGD016ByteModelArith:
         assert "GD016" in RULES
 
 
+class TestGD017PaddedTableFull:
+    """Ghost-padded node-table construction (``np.full`` with a
+    dimension-sized ghost-id fill) outside ``graphs.py``: the padded
+    ``nbr[n, dmax]`` idiom hand-rolled at a call site bypasses the
+    degree-bucketed layout routing (ROADMAP item 3) — layouts are built
+    through the ``graphs.py`` builders / ``degree_buckets``."""
+
+    OPS = "graphdyn/ops/tables.py"
+    BAD_GHOST_FULL = (
+        "import numpy as np\n"
+        "def build(n, dmax):\n"
+        "    return np.full((n, dmax), n, np.int32)\n"   # GD017
+    )
+    GOOD_CONST_FILL = (
+        "import numpy as np\n"
+        "def build(n, dmax):\n"
+        "    return np.full((n, dmax), -1, np.int32)\n"  # sentinel, not ghost id
+    )
+    GOOD_OTHER_FILL = (
+        "import numpy as np\n"
+        "def build(n, dmax, ghost):\n"
+        "    return np.full((n, dmax), ghost)\n"   # fill is not a dimension
+    )
+    GOOD_1D = (
+        "import numpy as np\n"
+        "def build(n):\n"
+        "    return np.full(n, n)\n"               # not a 2-D node table
+    )
+
+    def test_bad_ghost_padded_table(self):
+        assert "GD017" in _codes(self.BAD_GHOST_FULL, path=self.OPS)
+
+    def test_good_examples(self):
+        for src in (self.GOOD_CONST_FILL, self.GOOD_OTHER_FILL,
+                    self.GOOD_1D):
+            assert _codes(src, path=self.OPS) == [], src
+
+    def test_graphs_and_out_of_tree_exempt(self):
+        for path in ("graphdyn/graphs.py", "bench.py", "tests/test_x.py"):
+            assert "GD017" not in _codes(self.BAD_GHOST_FULL, path=path), path
+
+    def test_disable_comment(self):
+        src = self.BAD_GHOST_FULL.replace(
+            "    return np.full((n, dmax), n, np.int32)",
+            "    # graftlint: disable-next-line=GD017  ball-table build\n"
+            "    return np.full((n, dmax), n, np.int32)",
+        )
+        assert _codes(src, path=self.OPS) == []
+
+    def test_catalogued(self):
+        assert "GD017" in RULES
+
+
 class TestGD007AtomicPersistence:
     BAD_SAVEZ = (
         "import numpy as np\n"
@@ -1402,7 +1455,7 @@ def test_unreadable_file_is_a_finding(tmp_path):
 
 
 def test_rules_registry_complete():
-    assert set(RULES) == {f"GD{i:03d}" for i in range(1, 17)}
+    assert set(RULES) == {f"GD{i:03d}" for i in range(1, 18)}
 
 
 def test_cli_json_is_one_document_stdout_only(tmp_path):

@@ -128,6 +128,22 @@ def test_bench_smoke_emits_one_json_line():
         assert sjl["warm_p50_s"] > 0 and sjl["cold_p50_s"] > 0
         assert sjl["warm_p99_s"] > 0 and sjl["cold_p99_s"] > 0
         assert sjl["cold_over_warm_p50_x"] > 0 and sjl["jobs"] > 0
+    # the power-law bucketed-layout row (degree-bucketed rollout vs the
+    # equal-edge padded RRG control): a measured positive rate with its
+    # control detail, or an explicit null + reason — never 0.0
+    assert "powerlaw_rate" in row
+    plr = row["powerlaw_rate"]
+    if plr is None:
+        assert row["powerlaw_rate_skipped_reason"]
+    else:
+        assert plr > 0
+        det = row["powerlaw_rate_detail"]
+        assert det["rrg_padded_rate"] > 0
+        assert det["rrg_over_bucketed_x"] > 0
+        assert det["hub_degree"] > 0 and det["table_entries"] > 0
+        # the whole point of the layout: resident table bytes follow E,
+        # not n·dmax — the bucketed table must beat the padded one
+        assert det["table_entries"] < det["padded_entries"]
     # the device-memory column: a positive peak, or an explicit null +
     # reason (CPU: no usable memory_stats) — never silently absent,
     # never a fake 0 (graphdyn.obs.memband.peak_hbm_bytes)

@@ -298,3 +298,154 @@ class TestGreedyColoring:
                    for p in validate_coloring(g, np.zeros(3, np.int32)))
         bad_chi = np.arange(g.n, dtype=np.int32) % (g.dmax + 9)
         assert validate_coloring(g, bad_chi) != []
+
+
+# ---------------------------------------------------------------------------
+# power-law fast path: edge-list ingest + degree-bucketed layout (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+class TestFromEdgelist:
+    def test_round_trip_reproduces_tables(self):
+        from graphdyn.graphs import from_edgelist, powerlaw_graph
+
+        for g in (random_regular_graph(80, 3, seed=1),
+                  erdos_renyi_graph(120, 4.0 / 119, seed=2),
+                  powerlaw_graph(150, gamma=2.4, dmin=2, seed=3)):
+            h = from_edgelist(g.edges, n=g.n)
+            assert h.n == g.n
+            assert np.array_equal(h.edges, g.edges)
+            assert np.array_equal(h.nbr, g.nbr)
+            assert np.array_equal(h.deg, g.deg)
+
+    def test_sanitizes_self_loops_and_duplicates(self):
+        from graphdyn.graphs import from_edgelist
+
+        g = from_edgelist(
+            [(0, 1), (1, 1), (1, 0), (2, 0), (0, 2), (1, 2)], n=4)
+        _assert_simple(g)
+        assert g.num_edges == 3            # (0,1), (2,0), (1,2) survive
+        assert np.array_equal(g.edges[0], [0, 1])
+        assert np.array_equal(g.edges[1], [2, 0])   # first occurrence kept
+        assert g.deg[3] == 0               # isolated id below n stays
+
+    def test_empty_list_needs_n(self):
+        from graphdyn.graphs import from_edgelist
+
+        with pytest.raises(ValueError, match="n explicitly"):
+            from_edgelist([])
+        g = from_edgelist([], n=5)
+        assert g.n == 5 and g.num_edges == 0
+
+    def test_accepts_array_and_infers_n(self):
+        from graphdyn.graphs import from_edgelist
+
+        e = np.array([[0, 3], [3, 1]], np.int64)
+        g = from_edgelist(e)
+        assert g.n == 4 and g.num_edges == 2
+
+
+class TestPowerlawGraph:
+    def test_validation(self):
+        from graphdyn.graphs import powerlaw_graph
+
+        with pytest.raises(ValueError, match="n"):
+            powerlaw_graph(1)
+        with pytest.raises(ValueError, match="dmin"):
+            powerlaw_graph(50, dmin=0)
+        with pytest.raises(ValueError, match="gamma"):
+            powerlaw_graph(50, gamma=1.0)
+        with pytest.raises(ValueError, match="dmax"):
+            powerlaw_graph(50, dmin=5, dmax=3)
+
+    def test_deterministic_simple_heavy_tailed(self):
+        from graphdyn.graphs import degree_cv, powerlaw_graph
+
+        a = powerlaw_graph(800, gamma=2.3, dmin=2, seed=11)
+        b = powerlaw_graph(800, gamma=2.3, dmin=2, seed=11)
+        assert np.array_equal(a.edges, b.edges)
+        _assert_simple(a)
+        assert (a.deg >= 1).all()          # configuration repair keeps degrees
+        # the tail is the point: CV crosses the bucketed-routing threshold
+        assert degree_cv(a.deg) >= 1.0
+        assert a.dmax >= 8 * np.median(a.deg)
+
+    def test_ba_method(self):
+        from graphdyn.graphs import powerlaw_graph
+
+        g = powerlaw_graph(300, dmin=2, seed=4, method="ba")
+        _assert_simple(g)
+        assert (g.deg[2:] >= 2).all()
+
+
+class TestDegreeBuckets:
+    def test_layout_invariants(self):
+        from graphdyn.graphs import degree_buckets, powerlaw_graph
+
+        g = powerlaw_graph(500, gamma=2.3, dmin=2, seed=6)
+        b = degree_buckets(g)
+        # widths are powers of two; every node's degree fits half-open
+        assert all(w & (w - 1) == 0 for w in b.widths)
+        for i, deg_b in enumerate(b.deg):
+            w = b.widths[i]
+            assert (deg_b <= w).all()
+            if w > 1:
+                assert (deg_b > w // 2).all()
+        # order/inv are inverse permutations; blocks tile the node set
+        assert np.array_equal(np.sort(b.order), np.arange(g.n))
+        assert np.array_equal(b.order[b.inv], np.arange(g.n))
+        assert b.offsets[-1] == g.n
+        assert b.table_entries == sum(
+            t.shape[0] * t.shape[1] for t in b.nbr)
+        # edge-proportional: tight blocks beat the padded n·dmax table
+        assert b.table_entries <= 4 * g.num_edges + g.n
+        assert b.table_entries < g.n * g.dmax
+
+    def test_neighbor_sets_preserved(self):
+        from graphdyn.graphs import degree_buckets, powerlaw_graph
+
+        g = powerlaw_graph(200, gamma=2.5, dmin=2, seed=8)
+        b = degree_buckets(g)
+        for i, blk in enumerate(b.nbr):
+            for k in range(blk.shape[0]):
+                new = b.offsets[i] + k
+                old = b.order[new]
+                d = int(g.deg[old])
+                got = blk[k]
+                assert (got[d:] == g.n).all()       # ghost-padded tail
+                want = sorted(b.inv[g.nbr[old][:d]])
+                assert sorted(got[:d]) == want      # bucketed neighbor ids
+
+    def test_seeded_shuffle_stays_in_bucket(self):
+        from graphdyn.graphs import degree_buckets, powerlaw_graph
+
+        g = powerlaw_graph(300, gamma=2.4, dmin=2, seed=9)
+        a = degree_buckets(g)
+        c = degree_buckets(g, seed=3)
+        assert a.widths == c.widths
+        assert np.array_equal(a.offsets, c.offsets)
+        for i in range(len(a.widths)):
+            lo, hi = a.offsets[i], a.offsets[i + 1]
+            assert set(a.order[lo:hi]) == set(c.order[lo:hi])
+
+
+def test_degree_cv_reference_values():
+    from graphdyn.graphs import degree_cv
+
+    assert degree_cv(np.full(100, 7)) == pytest.approx(0.0)
+    assert degree_cv(np.array([], np.int64)) == 0.0
+    deg = np.array([1, 1, 1, 1, 96])
+    assert degree_cv(deg) == pytest.approx(np.std(deg) / np.mean(deg))
+
+
+def test_permute_nodes_round_trip():
+    from graphdyn.graphs import permute_nodes, powerlaw_graph
+
+    g = powerlaw_graph(120, gamma=2.5, dmin=2, seed=2)
+    order = np.random.default_rng(0).permutation(g.n)
+    h, inv = permute_nodes(g, order)
+    assert np.array_equal(inv[order], np.arange(g.n))
+    assert np.array_equal(np.sort(h.deg), np.sort(g.deg))
+    # edges relabel consistently: endpoint degree multiset is preserved
+    assert np.array_equal(
+        np.sort(g.deg[g.edges].ravel()), np.sort(h.deg[h.edges].ravel()))

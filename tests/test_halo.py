@@ -478,3 +478,73 @@ def test_bench_halo_weak_scaling_null_reason_single_device(monkeypatch):
     assert ">= 2 devices" in row["halo_weak_efficiency_skipped_reason"]
     assert row["halo_bytes_per_step"] is None
     assert row["halo_bytes_per_step_skipped_reason"]
+
+
+# ---------------------------------------------------------------------------
+# hub splitting: vertex-cut replicated hubs (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("P", [2, 4])
+def test_halo_hub_split_bit_exact(P):
+    """packed_rollout(partition=) with hub-split partitions equals the
+    unsharded program bitwise on a seeded power-law — the hub's popcount
+    is accumulated from per-shard partials over the ring, and partial
+    CSA/integer addition is exact, so any divergence is a replication or
+    ring bug, not roundoff."""
+    from graphdyn.graphs import powerlaw_graph
+
+    g = powerlaw_graph(400, gamma=2.3, dmin=2, seed=5)
+    part = partition_graph(g, P, seed=0, hub_threshold=32)
+    assert part.hubs is not None and part.hubs.size > 0
+    assert (g.deg[part.hubs] >= 32).all()
+    tables = build_halo_tables(g, part)
+    assert tables.n_hubs == part.hubs.size
+    # the ring ships a bounded O(P·H·log dmax) payload per step
+    assert tables.hub_ring_words > 0
+    rng = np.random.default_rng(1)
+    s = (2 * rng.integers(0, 2, size=(64, g.n)) - 1).astype(np.int8)
+    sp = pack_spins(s)
+    nbr, deg = jnp.asarray(g.nbr), jnp.asarray(g.deg)
+    for rule, tie in (("majority", "stay"), ("minority", "change")):
+        ref = np.asarray(packed_rollout(
+            nbr, deg, jnp.asarray(sp), 12, rule, tie))
+        got = np.asarray(packed_rollout(
+            nbr, deg, jnp.asarray(sp), 12, rule, tie, partition=part))
+        np.testing.assert_array_equal(got, ref, err_msg=f"P={P} {rule}")
+
+
+def test_halo_hub_split_layout_and_controls():
+    """The hub-split layout contract: hubs are owned by no part, the
+    owned-row gather width shrinks to the non-hub max degree, and a
+    hubless partition of the same graph keeps hub tables empty (the
+    fast-path predicate, not graph class, decides)."""
+    from graphdyn.graphs import powerlaw_graph
+
+    g = powerlaw_graph(400, gamma=2.3, dmin=2, seed=5)
+    part = partition_graph(g, 4, seed=0, hub_threshold=32)
+    assert (part.part[part.hubs] == -1).all()
+    assert np.array_equal(
+        np.sort(np.concatenate([part.order, part.hubs])), np.arange(g.n))
+    tables = build_halo_tables(g, part)
+    hub_mask = np.zeros(g.n, bool)
+    hub_mask[part.hubs] = True
+    assert tables.nbr_loc.shape[2] == int(g.deg[~hub_mask].max())
+    assert tables.nbr_loc.shape[2] < g.dmax
+    # hubless control on the SAME graph: no hub tables, no ring
+    plain = partition_graph(g, 4, seed=0)
+    assert plain.hubs is None
+    t2 = build_halo_tables(g, plain)
+    assert t2.n_hubs == 0 and t2.hub_ring_words == 0
+    # the int8 SA halo layout refuses hub-split partitions explicitly
+    with pytest.raises(NotImplementedError, match="hub"):
+        sa_halo_cols(tables, np.zeros((2, g.n), np.int8))
+
+
+def test_partition_hub_threshold_validation():
+    g = random_regular_graph(64, 3, seed=0)
+    with pytest.raises(ValueError, match="hub_threshold"):
+        partition_graph(g, 2, hub_threshold=0)
+    # a threshold above dmax is a no-op: hubless partition
+    part = partition_graph(g, 2, seed=0, hub_threshold=1000)
+    assert part.hubs is None or part.hubs.size == 0

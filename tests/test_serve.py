@@ -406,3 +406,82 @@ def test_serve_cli_submit_run_status_result(tmp_path, capsys):
     out = json.loads(capsys.readouterr().out.strip())
     assert out["keys"] == ["conf", "m_end", "mag_reached",
                            "steps_to_target"]
+
+
+# ---------------------------------------------------------------------------
+# bucketed admission: heavy-tail jobs priced by the edge-count model
+# ---------------------------------------------------------------------------
+
+
+def test_normalize_spec_accepts_heavy_tail_declarations():
+    spec = normalize_spec({"n": 10, "edges": 40, "degree_cv": 2.5})
+    assert spec["edges"] == 40 and spec["degree_cv"] == 2.5
+    # the padded default: no declaration
+    spec = normalize_spec({"n": 10})
+    assert spec["edges"] is None and spec["degree_cv"] == 0.0
+
+
+def test_admission_bucketed_routes_and_prices_by_edges():
+    from graphdyn.obs.memband import (
+        bucketed_state_bytes,
+        bucketed_table_entries_bound,
+    )
+    from graphdyn.ops.packed import WORD
+
+    n, E, R = 50_000, 120_000, 64
+    spec = normalize_spec(
+        {"n": n, "d": 900, "replicas": R, "edges": E, "degree_cv": 3.2})
+    d = admit(spec)
+    assert d.admitted and d.kernel == "bucketed" and d.reason is None
+    W = -(-R // WORD)
+    assert d.model_bytes == bucketed_state_bytes(
+        n, W, bucketed_table_entries_bound(n, E))
+    assert d.model_bytes <= d.budget_bytes
+
+
+def test_admission_bucketed_rescues_padded_over_refusal():
+    """The point of the bucketed byte model: a scale-free shape whose MAX
+    degree poisons the padded dmax formula is refused without the edge
+    declaration and admitted with it — same n, same hub."""
+    base = {"n": 50_000, "d": 900, "replicas": 64}
+    refused = admit(normalize_spec(dict(base)))
+    assert not refused.admitted
+    assert "exceeds the device budget" in refused.reason
+    admitted = admit(normalize_spec(
+        {**base, "edges": 120_000, "degree_cv": 3.2}))
+    assert admitted.admitted and admitted.kernel == "bucketed"
+    assert admitted.model_bytes < refused.model_bytes
+
+
+def test_admission_low_cv_ignores_edge_declaration():
+    """Below the routing threshold the declaration is inert: the padded
+    model and kernel choice are unchanged (one predicate, shared with the
+    drivers — an RRG-shaped job cannot sneak onto the bucketed price)."""
+    spec = normalize_spec({**SMALL, "edges": 36, "degree_cv": 0.1})
+    d = admit(spec)
+    assert d.admitted and d.kernel == "auto"
+    assert d.model_bytes == admit(normalize_spec(dict(SMALL))).model_bytes
+
+
+def test_admission_bucketed_malformed_edges_refused():
+    spec = normalize_spec(
+        {**SMALL, "edges": -5, "degree_cv": 2.0})
+    d = admit(spec)
+    assert not d.admitted and "malformed" in d.reason
+
+
+def test_worker_runs_bucketed_job_end_to_end(tmp_path):
+    """A bucketed-admitted job settles DONE through the worker: the
+    admission kernel tag routes the fused annealer's LAYOUT (the worker
+    drops prebuilt padded tables — they pin the padded labeling) and the
+    result lands in the durable store."""
+    spool = Spool(str(tmp_path / "serve"))
+    job = spool.submit(
+        {**SMALL, "edges": 36, "degree_cv": 2.0, "replicas": 32},
+        tenant="t1")
+    assert Worker(spool).run_until_drained() == 1
+    rec = spool.load(job)
+    assert rec["state"] == DONE, rec
+    out = np.load(rec["result"])
+    assert out["conf"].shape == (32, SMALL["n"])
+    assert set(np.unique(out["conf"])) <= {-1, 1}
