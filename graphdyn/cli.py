@@ -598,10 +598,17 @@ def build_parser() -> argparse.ArgumentParser:
             ("--m-target", float, "target magnetization"),
             ("--max-sweeps", int, "sweep budget"),
             ("--chunk-sweeps", int, "sweeps per device chunk"),
-            ("--edges", int, "declared edge count (heavy-tail jobs: "
-             "prices admission by the bucketed byte model)"),
+            ("--solver", str, "engine: fused (annealer on an RRG) or "
+             "bucketed (degree-bucketed rollout on a power-law graph, "
+             "priced edge-proportionally)"),
+            ("--edges", int, "declared edge count (required for "
+             "--solver bucketed: prices admission by the "
+             "edge-proportional byte model; worker-validated against "
+             "the built graph)"),
+            ("--gamma", float, "power-law exponent of the served graph "
+             "(--solver bucketed; --d is dmin)"),
             ("--degree-cv", float, "declared degree coefficient of "
-             "variation (>= 1.0 routes the bucketed layout)")):
+             "variation (informational; does not affect admission)")):
         srv.add_argument(flag, type=typ, default=None,
                          help=f"submit: {hlp} (default: spool default)")
 
@@ -1238,6 +1245,7 @@ def _run(args) -> int:
             )
         if args.action == "submit":
             spec = {k: v for k, v in (
+                ("solver", args.solver),
                 ("n", args.n), ("d", args.d),
                 ("graph_seed", args.graph_seed), ("seed", args.seed),
                 ("rule", args.rule), ("tie", args.tie),
@@ -1245,6 +1253,7 @@ def _run(args) -> int:
                 ("max_sweeps", args.max_sweeps),
                 ("chunk_sweeps", args.chunk_sweeps),
                 ("edges", args.edges),
+                ("gamma", args.gamma),
                 ("degree_cv", args.degree_cv)) if v is not None}
             job_id = serve_api.submit(args.root, spec, args.tenant,
                                       timeout_s=args.job_timeout)
@@ -1264,7 +1273,9 @@ def _run(args) -> int:
             "job": args.job,
             "keys": sorted(res),
             "m_end_mean": float(np.mean(res["m_end"])),
-            "mag_reached": int(np.sum(res["mag_reached"])),
+            # bucketed-rollout results have no target-reached notion
+            "mag_reached": (int(np.sum(res["mag_reached"]))
+                            if "mag_reached" in res else None),
             "result": serve_api.status(args.root, args.job)["result"],
         }))
     return 0

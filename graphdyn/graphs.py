@@ -477,8 +477,14 @@ def powerlaw_graph(
     ks = np.arange(dmin, dmax + 1, dtype=np.int64)
     w = ks ** (-gamma)
     deg = rng.choice(ks, size=n, p=w / w.sum())
-    if deg.sum() % 2:
-        deg[int(rng.integers(n))] += 1              # stub parity
+    if deg.sum() % 2:                               # stub parity
+        i = int(rng.integers(n))
+        if (deg < dmax).any():
+            while deg[i] >= dmax:                   # keep support [dmin, dmax]
+                i = int(rng.integers(n))
+            deg[i] += 1
+        else:
+            deg[i] -= 1                # dmin == dmax == every draw: shed one
     stubs = np.repeat(np.arange(n, dtype=np.int64), deg)
     rng.shuffle(stubs)
     u, v = stubs[0::2], stubs[1::2]

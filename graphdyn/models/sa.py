@@ -421,6 +421,15 @@ def simulated_annealing(
         from graphdyn.ops.bucketed import auto_layout
 
         layout = auto_layout(graph.deg)
+        if layout == "bucketed" and checkpoint_path is not None:
+            # resume identity: run_fingerprint hashes the run's edge list,
+            # so a bucket-major relabel would orphan every checkpoint
+            # written under the caller's labeling (including all pre-layout
+            # checkpoints). Auto-routed checkpointed runs therefore pin the
+            # padded path; an EXPLICIT layout='bucketed' stays allowed —
+            # degree_buckets is deterministic, so its checkpoints are
+            # self-consistent across reruns.
+            layout = "padded"
     if layout == "bucketed":
         if proposals is not None or uniforms is not None:
             raise ValueError(

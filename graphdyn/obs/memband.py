@@ -94,9 +94,12 @@ def bucketed_state_bytes(n: int, W: int, table_entries: int) -> int:
     per-bucket degree vectors (``n`` int32 total). The padded model
     charges ``4·n·dmax`` for the table; this one charges the tight
     blocks, which :func:`bucketed_table_entries_bound` caps at
-    ``4E + n`` — edge-count proportional, the whole point of the layout
-    (serve admission prices power-law jobs with THIS model instead of
-    over-refusing by the hub factor)."""
+    ``4E + n`` — edge-count proportional, the whole point of the layout.
+    Serve admission prices ``solver='bucketed'`` jobs with THIS model —
+    and ONLY those: this formula describes the bucketed rollout's
+    resident set, not the fused annealer's (whose padded-dmax/χ tables
+    are labeling-invariant), so pricing a fused job with it would
+    under-admit by the hub factor."""
     return 4 * n * W + 4 * table_entries + 4 * n
 
 

@@ -59,6 +59,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from graphdyn.graphs import Graph, Partition, partition_ghosts
+from graphdyn.ops.bucketed import UNROLL_MAX as _UNROLL_MAX
 from graphdyn.ops.dynamics import Rule, TieBreak
 from graphdyn.parallel.mesh import device_pool, make_mesh, shard_map
 
@@ -238,6 +239,11 @@ def build_halo_tables(graph: Graph, partition: Partition) -> HaloTables:
             (r.size for per_p in slices for r in per_p), default=1
         )
         hd_max = max(hd_max, 1)
+        if hd_max > _UNROLL_MAX:
+            # wide hubs take the segment-reshape popcount (see
+            # make_halo_rollout), which needs UNROLL_MAX | hd_max; the pad
+            # slots gather the zero row and contribute 0
+            hd_max += -hd_max % _UNROLL_MAX
         hub_nbr_loc = np.full((Pn, H, hd_max), zero_row, np.int32)
         for p in range(Pn):
             for i, rows in enumerate(slices[p]):
@@ -324,6 +330,10 @@ def make_halo_rollout(
     so results are bit-exact to the unsharded kernel; the only
     collectives are the schedule's boundary ``ppermute`` slabs.
     """
+    from graphdyn.ops.bucketed import (
+        _pack_lanes,
+        _wide_bucket_counts,
+    )
     from graphdyn.ops.packed import (
         _compare_planes,
         _csa_add_one,
@@ -383,12 +393,27 @@ def make_halo_rollout(
             if H:
                 # partial popcount of every hub over the neighbors THIS
                 # shard owns, from the same pre-update state as `out`
-                hpl = [
-                    jnp.zeros((H, sp.shape[1]), sp.dtype)
-                    for _ in range(n_planes_hub)
-                ]
-                for j in range(hd_max):
-                    _csa_add_one(hpl, jnp.take(sp, hub_nbr[:, j], axis=0))
+                if hd_max <= _UNROLL_MAX:
+                    # narrow slices: unrolled CSA, one gather+add per slot
+                    hpl = [
+                        jnp.zeros((H, sp.shape[1]), sp.dtype)
+                        for _ in range(n_planes_hub)
+                    ]
+                    for j in range(hd_max):
+                        _csa_add_one(
+                            hpl, jnp.take(sp, hub_nbr[:, j], axis=0)
+                        )
+                else:
+                    # wide slices: the ops/bucketed segment scheme —
+                    # UNROLL_MAX-slot segments CSA'd then dense-summed as
+                    # integer counts (exact), so program size stays
+                    # O(log d_hub) instead of O(d_hub/P) unrolled adds;
+                    # repack the counts into the ring's bit-planes
+                    cnt = _wide_bucket_counts(sp, hub_nbr)
+                    hpl = [
+                        _pack_lanes((cnt >> p) & 1)
+                        for p in range(n_planes_hub)
+                    ]
                 prev_h = lax.dynamic_slice_in_dim(sp, hub0, H, axis=0)
             sp = lax.dynamic_update_slice(sp, out, (0, 0))
             if H:

@@ -9,13 +9,20 @@ job reaches the device, from the committed byte models alone:
   chromatic bound (``χ ≤ d² + 1``: a distance-2 greedy coloring of a
   degree-``d`` graph never needs more — the real χ, known only after the
   coloring runs, can only be smaller, so admission never under-admits);
-- :func:`graphdyn.obs.memband.bucketed_state_bytes` — for jobs that
-  declare ``edges`` and a ``degree_cv`` at or above the bucketed routing
-  threshold (:data:`graphdyn.ops.bucketed.BUCKETED_CV_THRESHOLD`): the
-  degree-bucketed layout's edge-count-proportional model replaces the
-  padded-dmax formula, which over-refuses scale-free shapes by the hub
-  factor (their ``d`` is the max degree), and the decision routes the
-  job to the ``bucketed`` engine;
+- :func:`graphdyn.obs.memband.bucketed_state_bytes` — for
+  ``solver='bucketed'`` jobs only: those run the degree-bucketed packed
+  rollout (:mod:`graphdyn.ops.bucketed`) on a power-law graph, whose
+  resident set genuinely IS edge-count proportional, so the declared
+  ``edges`` price the program that executes. The declaration is
+  **re-validated by the worker** against the built graph's real table
+  (:attr:`graphdyn.graphs.DegreeBuckets.table_entries` vs the admitted
+  bound) before any device dispatch — an under-declared job is refused
+  at that rung (:class:`DeclaredShapeMismatch`), never run. Fused jobs
+  are NEVER priced by this model: the fused annealer's tables are
+  padded-``dmax``/χ-bound whatever the node labeling (a bucket-major
+  relabel is an isomorphism), so only the fused formula above prices
+  them — a model below the program's real resident set is how a shared
+  worker OOMs, the exact failure admission exists to prevent;
 - the device memory budget — the plugin's reported ``bytes_limit``
   (:func:`graphdyn.obs.memband.device_memory_stats`) when a device can
   speak for itself, else the ``GRAPHDYN_SERVE_HBM_BUDGET`` env override,
@@ -49,8 +56,15 @@ class AdmissionDecision(NamedTuple):
     admitted: bool
     kernel: str         # 'auto' (pallas fits) | 'xla' | 'bucketed' | ''
     reason: str | None  # refusal reason (None when admitted)
-    model_bytes: int    # fused resident-set model at the static chi bound
+    model_bytes: int    # resident-set model of the engine that will run
     budget_bytes: int   # the device budget the model was held against
+
+
+class DeclaredShapeMismatch(Exception):
+    """A ``solver='bucketed'`` job's built graph needs more table entries
+    than its declared ``edges`` admitted — the job was under-priced.
+    Raised by the worker's pre-dispatch validation; the job is refused
+    with this message, never dispatched."""
 
 
 def chi_bound(d: int) -> int:
@@ -86,7 +100,6 @@ def admit(spec: dict, *, key: str = "") -> AdmissionDecision:
     """One admission decision from the committed models — no compilation,
     no device allocation, no exception escapes (a malformed spec is a
     refusal with a reason, not a worker crash)."""
-    from graphdyn.ops.bucketed import BUCKETED_CV_THRESHOLD
     from graphdyn.ops.packed import WORD
     from graphdyn.ops.pallas_anneal import (
         FUSED_VMEM_BUDGET,
@@ -107,29 +120,39 @@ def admit(spec: dict, *, key: str = "") -> AdmissionDecision:
             return AdmissionDecision(
                 False, "", f"malformed shape: n={n} d={d} replicas={R}",
                 0, budget)
-        if spec.get("solver", "fused") != "fused":
+        solver = str(spec.get("solver", "fused"))
+        if solver not in ("fused", "bucketed"):
             return AdmissionDecision(
                 False, "", f"unknown solver {spec.get('solver')!r} "
-                "(this service runs the fused annealer)", 0, budget)
+                "(this service runs the fused annealer and the bucketed "
+                "rollout)", 0, budget)
         W = -(-R // WORD)
-        # power-law jobs declare their edge count and degree CV; when the
-        # CV crosses the bucketed-routing threshold the job is priced with
-        # the degree-bucketed byte model (edge-count proportional) instead
-        # of the padded-dmax formula, which over-refuses scale-free shapes
-        # by the hub factor (here d is the MAX degree, so d²·n is absurd)
-        cv = float(spec.get("degree_cv", 0.0))
-        n_edges = spec.get("edges")
-        if cv >= BUCKETED_CV_THRESHOLD and n_edges is not None:
+        if solver == "bucketed":
+            # the edge-proportional ENGINE: the worker builds a power-law
+            # graph, lays it out in degree buckets, and runs the
+            # ops/bucketed rollout — the one serve program whose resident
+            # set tracks the edge count, so the declared edges price what
+            # actually runs (and the worker re-validates the declaration
+            # against the built table before dispatch). Fused jobs never
+            # take this price: their tables are padded-dmax/chi-bound
+            # regardless of node labeling.
             from graphdyn.obs.memband import (
                 bucketed_state_bytes,
                 bucketed_table_entries_bound,
             )
 
-            n_edges = int(n_edges)
-            if n_edges < 0:
+            n_edges = spec.get("edges")
+            if n_edges is None:
                 return AdmissionDecision(
-                    False, "", f"malformed shape: edges={n_edges}", 0,
-                    budget)
+                    False, "",
+                    "bucketed solver requires a declared edge count "
+                    "('edges'): the edge-proportional byte model has no "
+                    "other static input", 0, budget)
+            n_edges = int(n_edges)
+            if n_edges < 0 or n_edges > n * (n - 1) // 2:
+                return AdmissionDecision(
+                    False, "", f"malformed shape: edges={n_edges} "
+                    f"(simple graph on n={n} nodes)", 0, budget)
             model = bucketed_state_bytes(
                 n, W, bucketed_table_entries_bound(n, n_edges))
             if model > budget:
@@ -141,6 +164,10 @@ def admit(spec: dict, *, key: str = "") -> AdmissionDecision:
                     "shared worker)",
                     model, budget)
             return AdmissionDecision(True, "bucketed", None, model, budget)
+        # the fused annealer's price is the padded formula whatever the
+        # job declares: a bucket-major relabel is an isomorphism (same
+        # dmax, same chi, same nbr_ext/LUT/CSA shapes), so no declaration
+        # can shrink this program's resident set
         model = fused_vmem_bytes(n, W, chi_bound(d), d)
     except (KeyError, TypeError, ValueError) as e:
         return AdmissionDecision(False, "", f"malformed spec: {e}", 0,

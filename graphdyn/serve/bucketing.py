@@ -31,9 +31,17 @@ import threading
 
 
 def graph_key(spec: dict) -> tuple:
-    """Full graph identity — the table cache key."""
-    return (int(spec["n"]), int(spec["d"]), int(spec["graph_seed"]),
-            str(spec["rule"]), str(spec["tie"]))
+    """Full graph identity — the table cache key. The solver leads the
+    tuple (fused jobs build an RRG + fused tables, bucketed jobs a
+    power-law graph + degree-bucket layout — same ``(n, d, seed)`` names
+    different graphs per engine), and bucketed identities carry the
+    power-law exponent."""
+    solver = str(spec.get("solver", "fused"))
+    key = (solver, int(spec["n"]), int(spec["d"]), int(spec["graph_seed"]),
+           str(spec["rule"]), str(spec["tie"]))
+    if solver == "bucketed":
+        key += (float(spec.get("gamma", 2.5)),)
+    return key
 
 
 def shape_key(spec: dict) -> tuple:
@@ -43,8 +51,8 @@ def shape_key(spec: dict) -> tuple:
     from graphdyn.ops.packed import WORD
 
     W = -(-int(spec["replicas"]) // WORD)
-    return (int(spec["n"]), int(spec["d"]), str(spec["rule"]),
-            str(spec["tie"]), W)
+    return (str(spec.get("solver", "fused")), int(spec["n"]),
+            int(spec["d"]), str(spec["rule"]), str(spec["tie"]), W)
 
 
 class BucketCache:
@@ -83,7 +91,7 @@ class BucketCache:
                 pair = self._graphs[gk]
             else:
                 self._misses += 1
-        obs.counter("serve.bucket", hit=int(hit), n=gk[0], d=gk[1])
+        obs.counter("serve.bucket", hit=int(hit), n=gk[1], d=gk[2])
         if hit:
             return pair
         pair = self._build(spec)
@@ -99,6 +107,21 @@ class BucketCache:
         from graphdyn.ops.pallas_anneal import build_fused_tables
 
         from graphdyn import obs
+
+        if str(spec.get("solver", "fused")) == "bucketed":
+            # the edge-proportional engine's "tables" are the graph plus
+            # its degree-bucket layout: a power-law realization (d = dmin,
+            # seeded) laid out by degree_buckets — no coloring, no LUT
+            # masks, and a resident set the admission byte model actually
+            # describes
+            from graphdyn.graphs import degree_buckets, powerlaw_graph
+
+            with obs.timed("serve.tables_build", n=int(spec["n"]),
+                           d=int(spec["d"])):
+                g = powerlaw_graph(
+                    int(spec["n"]), gamma=float(spec.get("gamma", 2.5)),
+                    dmin=int(spec["d"]), seed=int(spec["graph_seed"]))
+                return g, degree_buckets(g)
 
         with obs.timed("serve.tables_build", n=int(spec["n"]),
                        d=int(spec["d"])):
@@ -126,11 +149,16 @@ class BucketCache:
 
         from graphdyn import obs
 
+        # warm-up probes dispatch the fused annealer; bucketed-solver
+        # jobs compile on first dispatch instead (their rollout program
+        # is far cheaper to trace than the fused chain)
+        specs = [s for s in specs
+                 if str(s.get("solver", "fused")) == "fused"]
         by_class = Counter(shape_key(s) for s in specs)
         warmed = []
         for cls, _ in by_class.most_common(top_k):
             probe = next(s for s in specs if shape_key(s) == cls)
-            with obs.timed("serve.warmup", n=cls[0], d=cls[1]):
+            with obs.timed("serve.warmup", n=cls[1], d=cls[2]):
                 from graphdyn.config import DynamicsConfig, SAConfig
                 from graphdyn.search.fused import fused_anneal
 

@@ -19,6 +19,9 @@ Job state machine (ARCHITECTURE.md "Serving")::
                           │          crash below the quarantine bar)
                           ├──────▶ quarantined (N same-site crashes)
     pending ──refuse──▶ refused   (admission: byte model over budget)
+    running ──refuse──▶ refused   (bucketed engine: built graph exceeds
+                                   the declared edge count's admitted
+                                   model — under-priced, never dispatched)
 
 Every transition lands in the run journal (``run_journal.jsonl``,
 :func:`graphdyn.resilience.store.journal_event`) under the ``serve.*`` ops
@@ -49,9 +52,13 @@ SPOOL_SCHEMA = 1
 #: spec defaults — a submitted spec is normalized ONCE at submit time, so
 #: the on-disk record (not the server's code version) defines the job
 SPEC_DEFAULTS: dict = {
+    # 'fused' (the annealer on an RRG) or 'bucketed' (the degree-bucketed
+    # packed rollout on a power-law graph — the edge-proportional engine;
+    # graphdyn.serve.admission prices each by the model of the program it
+    # actually runs)
     "solver": "fused",
     "n": 64,
-    "d": 3,
+    "d": 3,                  # fused: RRG degree; bucketed: power-law dmin
     "graph_seed": 0,
     "seed": 0,
     "rule": "majority",
@@ -60,12 +67,18 @@ SPEC_DEFAULTS: dict = {
     "m_target": 0.9,
     "max_sweeps": 64,
     "chunk_sweeps": 16,
-    # heavy-tail declarations: a job whose degree CV crosses the bucketed
-    # routing threshold AND declares its edge count is priced with the
-    # degree-bucketed byte model and routed to the bucketed layout
-    # (graphdyn.serve.admission); None/0.0 = the padded default
+    # bucketed-solver declarations: 'edges' (REQUIRED for
+    # solver='bucketed') prices admission with the edge-proportional byte
+    # model, and the worker re-validates it against the built graph's
+    # table before dispatch; 'gamma' is the power-law exponent of the
+    # served graph. Both are inert on fused jobs — the fused annealer's
+    # resident set is padded-dmax-bound whatever a tenant declares, so no
+    # declaration can discount its price. ('degree_cv' is retained so
+    # pre-existing on-disk records still parse; it no longer affects
+    # admission.)
     "edges": None,
     "degree_cv": 0.0,
+    "gamma": 2.5,
 }
 
 

@@ -11,7 +11,11 @@ One job's journey through the ladder (ARCHITECTURE.md "Serving"):
    :class:`~graphdyn.resilience.retry.RetryPolicy`, keyed per job so
    concurrent tenants' retries de-correlate; exhausted retries requeue
    the job, they do not kill the server;
-3. **run** — the fused annealer under a per-job deadline watchdog
+3. **run** — the solver (the fused annealer, or the degree-bucketed
+   rollout for ``solver='bucketed'`` jobs — which first re-validates the
+   declared edge count against the built graph's table, refusing an
+   under-priced job before any device work) under a per-job deadline
+   watchdog
    (:func:`~graphdyn.resilience.supervisor.supervision`): the job's
    chunk boundaries heartbeat, and a job that overstays its ``timeout_s``
    is **checkpoint-evicted** — the durable store records the eviction
@@ -54,7 +58,7 @@ from graphdyn.resilience.shutdown import (
     clear_shutdown,
     shutdown_requested,
 )
-from graphdyn.serve.admission import admit
+from graphdyn.serve.admission import DeclaredShapeMismatch, admit
 from graphdyn.serve.bucketing import BucketCache
 from graphdyn.serve.spool import Spool
 
@@ -152,6 +156,12 @@ class Worker:
 
         try:
             self._run_job(rec, decision.kernel)
+        except DeclaredShapeMismatch as e:
+            # the bucketed engine's pre-dispatch validation: the built
+            # graph outgrew the declared edge count's admitted byte model
+            # — an under-priced job is refused, never dispatched (the
+            # admission guarantee holds against the REAL table)
+            self.spool.refuse(job_id, str(e))
         except ShutdownRequested as e:
             self._on_shutdown(rec, e)
         except InjectedPreemption:
@@ -209,23 +219,60 @@ class Worker:
         with supervision(None, timeout):
             with obs.timed("serve.job", job=rec["id"], tenant=rec["tenant"],
                            n=int(spec["n"]), replicas=int(spec["replicas"])):
-                # a 'bucketed' admission routes the LAYOUT, not the device
-                # kernel: the annealer relabels bucket-major and builds
-                # its own tables (prebuilt ones pin the padded labeling)
-                bucketed = kernel == "bucketed"
-                res = fused_anneal(
-                    g, cfg, n_replicas=int(spec["replicas"]),
-                    seed=int(spec["seed"]), m_target=float(spec["m_target"]),
-                    max_sweeps=int(spec["max_sweeps"]),
-                    chunk_sweeps=int(spec["chunk_sweeps"]),
-                    kernel="auto" if bucketed else kernel,
-                    layout="bucketed" if bucketed else "auto",
-                    tables=None if bucketed else tables,
-                )
-        save_results_npz(
-            rec["result"], conf=res.s, mag_reached=res.mag_reached,
-            m_end=res.m_end, steps_to_target=res.steps_to_target,
-        )
+                if kernel == "bucketed":
+                    # the edge-proportional engine (admission priced THIS
+                    # program): validate + roll the bucketed kernel
+                    payload = self._run_bucketed(spec, g, tables)
+                else:
+                    res = fused_anneal(
+                        g, cfg, n_replicas=int(spec["replicas"]),
+                        seed=int(spec["seed"]),
+                        m_target=float(spec["m_target"]),
+                        max_sweeps=int(spec["max_sweeps"]),
+                        chunk_sweeps=int(spec["chunk_sweeps"]),
+                        kernel=kernel, tables=tables,
+                    )
+                    payload = {
+                        "conf": res.s, "mag_reached": res.mag_reached,
+                        "m_end": res.m_end,
+                        "steps_to_target": res.steps_to_target,
+                    }
+        save_results_npz(rec["result"], **payload)
+
+    def _run_bucketed(self, spec: dict, g, buckets) -> dict:
+        """One ``solver='bucketed'`` job: re-validate the declared edge
+        count against the BUILT graph's table (the admitted byte model
+        must cover what runs — :class:`DeclaredShapeMismatch` refuses an
+        under-declared job before any device work), then roll the packed
+        degree-bucketed kernel for the sweep budget over seeded random
+        initial replicas."""
+        import numpy as np
+
+        from graphdyn.obs.memband import bucketed_table_entries_bound
+        from graphdyn.ops.bucketed import bucketed_rollout_global
+        from graphdyn.ops.packed import pack_spins, unpack_spins
+
+        n_edges = int(spec["edges"])
+        bound = bucketed_table_entries_bound(g.n, n_edges)
+        if buckets.table_entries > bound:
+            raise DeclaredShapeMismatch(
+                f"declared edges={n_edges} admit {bound} table entries "
+                f"but the built graph needs {buckets.table_entries}: the "
+                "job was under-priced at admission — resubmit with the "
+                "real edge count")
+        R = int(spec["replicas"])
+        rng = np.random.default_rng(int(spec["seed"]))
+        s0 = (2 * rng.integers(0, 2, size=(R, g.n)) - 1).astype(np.int8)
+        out = bucketed_rollout_global(
+            g, pack_spins(s0), int(spec["max_sweeps"]),
+            rule=str(spec["rule"]), tie=str(spec["tie"]), buckets=buckets)
+        s = unpack_spins(out, R)
+        return {
+            "conf": s,
+            # graftlint: disable-next-line=GD004  host observable, exact sum
+            "m_end": s.astype(np.float64).mean(axis=1),
+            "steps": np.asarray(int(spec["max_sweeps"])),
+        }
 
     # -- ladder rungs ------------------------------------------------------
 

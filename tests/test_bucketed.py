@@ -158,6 +158,24 @@ def test_sa_layout_knob_refusals():
         )
 
 
+def test_sa_auto_layout_with_checkpoint_pins_padded(tmp_path):
+    """Resume identity: run_fingerprint hashes the run's edge list, so a
+    bucket-major relabel orphans every checkpoint written under the
+    caller's labeling. layout='auto' with a checkpoint therefore pins the
+    padded path — bit-identical to an explicit padded run, and a
+    pre-layout checkpoint keeps resuming under the new auto default."""
+    from graphdyn.models.sa import simulated_annealing
+
+    g = powerlaw_graph(150, gamma=2.3, dmin=2, seed=5)
+    assert auto_layout(g.deg) == "bucketed"   # auto WOULD relabel
+    kw = dict(n_replicas=3, seed=0, max_steps=40)
+    ck = str(tmp_path / "ck")
+    a = simulated_annealing(g, _sa_cfg(), layout="auto",
+                            checkpoint_path=ck, **kw)
+    p = simulated_annealing(g, _sa_cfg(), layout="padded", **kw)
+    np.testing.assert_array_equal(a.s, p.s)
+
+
 def test_fused_layout_knob_and_table_refusal():
     from graphdyn.ops.pallas_anneal import build_fused_tables
     from graphdyn.search.fused import fused_anneal

@@ -377,6 +377,21 @@ class TestPowerlawGraph:
         _assert_simple(g)
         assert (g.deg[2:] >= 2).all()
 
+    def test_stub_parity_respects_dmax(self):
+        """The parity bump lands on a node below dmax, so the documented
+        [dmin, dmax] degree support holds even when the bumped draw sat at
+        the cutoff (sweep enough seeds that the parity branch fires on
+        dmax-heavy draws)."""
+        from graphdyn.graphs import powerlaw_graph
+
+        for seed in range(24):
+            g = powerlaw_graph(30, gamma=1.5, dmin=2, dmax=3, seed=seed)
+            assert int(g.deg.max()) <= 3, seed
+        # degenerate single-point support with odd total: sheds one stub
+        # instead of looping or breaching dmax
+        g = powerlaw_graph(5, gamma=2.0, dmin=3, dmax=3, seed=1)
+        assert int(g.deg.max()) <= 3
+
 
 class TestDegreeBuckets:
     def test_layout_invariants(self):

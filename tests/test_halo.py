@@ -514,6 +514,39 @@ def test_halo_hub_split_bit_exact(P):
         np.testing.assert_array_equal(got, ref, err_msg=f"P={P} {rule}")
 
 
+@pytest.mark.parametrize("P", [2, 4])
+def test_halo_wide_hub_segment_path_bit_exact(P):
+    """Per-shard hub slices wider than UNROLL_MAX take the ops/bucketed
+    segment-reshape popcount (program size O(log d_hub), not one unrolled
+    add per neighbor slot) — same bits as the unsharded kernel. The tiny
+    hubs of the seeded power-law tests never leave the unrolled path, so
+    this graph forces one genuine big hub: degree 160, slices of ~160/P
+    neighbors per shard."""
+    from graphdyn.graphs import from_edgelist
+    from graphdyn.ops.bucketed import UNROLL_MAX
+
+    n = 200
+    edges = [(0, v) for v in range(1, 161)]
+    edges += [(u, u + 1) for u in range(1, n - 1)] + [(n - 1, 1)]
+    g = from_edgelist(np.array(edges, np.int64), n=n)
+    assert int(g.deg[0]) == 160
+    part = partition_graph(g, P, seed=0, hub_threshold=32)
+    assert part.hubs is not None and 0 in part.hubs
+    tables = build_halo_tables(g, part)
+    hd_max = tables.hub_nbr_loc.shape[2]
+    assert hd_max > UNROLL_MAX and hd_max % UNROLL_MAX == 0
+    rng = np.random.default_rng(3)
+    s = (2 * rng.integers(0, 2, size=(64, n)) - 1).astype(np.int8)
+    sp = pack_spins(s)
+    nbr, deg = jnp.asarray(g.nbr), jnp.asarray(g.deg)
+    for rule, tie in (("majority", "stay"), ("minority", "change")):
+        ref = np.asarray(packed_rollout(
+            nbr, deg, jnp.asarray(sp), 10, rule, tie))
+        got = np.asarray(packed_rollout(
+            nbr, deg, jnp.asarray(sp), 10, rule, tie, partition=part))
+        np.testing.assert_array_equal(got, ref, err_msg=f"P={P} {rule}")
+
+
 def test_halo_hub_split_layout_and_controls():
     """The hub-split layout contract: hubs are owned by no part, the
     owned-row gather width shrinks to the non-hub max degree, and a

@@ -409,19 +409,24 @@ def test_serve_cli_submit_run_status_result(tmp_path, capsys):
 
 
 # ---------------------------------------------------------------------------
-# bucketed admission: heavy-tail jobs priced by the edge-count model
+# bucketed solver: the edge-proportional engine and its admission price
 # ---------------------------------------------------------------------------
 
+BUCKETED_SMALL = {"solver": "bucketed", "n": 24, "d": 2, "gamma": 2.5,
+                  "max_sweeps": 8}
 
-def test_normalize_spec_accepts_heavy_tail_declarations():
-    spec = normalize_spec({"n": 10, "edges": 40, "degree_cv": 2.5})
-    assert spec["edges"] == 40 and spec["degree_cv"] == 2.5
-    # the padded default: no declaration
+
+def test_normalize_spec_accepts_bucketed_solver_fields():
+    spec = normalize_spec(
+        {"solver": "bucketed", "n": 10, "edges": 40, "gamma": 2.2})
+    assert spec["solver"] == "bucketed"
+    assert spec["edges"] == 40 and spec["gamma"] == 2.2
+    # the fused default: no declaration
     spec = normalize_spec({"n": 10})
-    assert spec["edges"] is None and spec["degree_cv"] == 0.0
+    assert spec["solver"] == "fused" and spec["edges"] is None
 
 
-def test_admission_bucketed_routes_and_prices_by_edges():
+def test_admission_bucketed_solver_prices_by_edges():
     from graphdyn.obs.memband import (
         bucketed_state_bytes,
         bucketed_table_entries_bound,
@@ -430,7 +435,7 @@ def test_admission_bucketed_routes_and_prices_by_edges():
 
     n, E, R = 50_000, 120_000, 64
     spec = normalize_spec(
-        {"n": n, "d": 900, "replicas": R, "edges": E, "degree_cv": 3.2})
+        {"solver": "bucketed", "n": n, "d": 2, "replicas": R, "edges": E})
     d = admit(spec)
     assert d.admitted and d.kernel == "bucketed" and d.reason is None
     W = -(-R // WORD)
@@ -439,49 +444,76 @@ def test_admission_bucketed_routes_and_prices_by_edges():
     assert d.model_bytes <= d.budget_bytes
 
 
-def test_admission_bucketed_rescues_padded_over_refusal():
-    """The point of the bucketed byte model: a scale-free shape whose MAX
-    degree poisons the padded dmax formula is refused without the edge
-    declaration and admitted with it — same n, same hub."""
+def test_admission_fused_price_immune_to_declarations():
+    """Regression for the under-pricing hole: a fused job whose padded
+    model exceeds the budget STAYS refused no matter what edge count or
+    degree CV it declares — the fused annealer's tables are
+    padded-dmax/chi-bound under any node labeling, so a declaration that
+    discounted the price would admit a job whose real resident set OOMs
+    the shared worker. The same shape IS servable, but only on the
+    engine whose memory the edge model describes (solver='bucketed')."""
     base = {"n": 50_000, "d": 900, "replicas": 64}
     refused = admit(normalize_spec(dict(base)))
     assert not refused.admitted
     assert "exceeds the device budget" in refused.reason
-    admitted = admit(normalize_spec(
+    declared = admit(normalize_spec(
         {**base, "edges": 120_000, "degree_cv": 3.2}))
-    assert admitted.admitted and admitted.kernel == "bucketed"
-    assert admitted.model_bytes < refused.model_bytes
+    assert not declared.admitted
+    assert declared.model_bytes == refused.model_bytes
+    rerouted = admit(normalize_spec(
+        {"solver": "bucketed", "n": 50_000, "d": 2, "replicas": 64,
+         "edges": 120_000}))
+    assert rerouted.admitted and rerouted.kernel == "bucketed"
+    assert rerouted.model_bytes < refused.model_bytes
 
 
-def test_admission_low_cv_ignores_edge_declaration():
-    """Below the routing threshold the declaration is inert: the padded
-    model and kernel choice are unchanged (one predicate, shared with the
-    drivers — an RRG-shaped job cannot sneak onto the bucketed price)."""
-    spec = normalize_spec({**SMALL, "edges": 36, "degree_cv": 0.1})
+def test_admission_fused_declarations_inert():
+    """Declarations never perturb a fused job's price or kernel choice."""
+    spec = normalize_spec({**SMALL, "edges": 36, "degree_cv": 2.0})
     d = admit(spec)
     assert d.admitted and d.kernel == "auto"
     assert d.model_bytes == admit(normalize_spec(dict(SMALL))).model_bytes
 
 
-def test_admission_bucketed_malformed_edges_refused():
-    spec = normalize_spec(
-        {**SMALL, "edges": -5, "degree_cv": 2.0})
-    d = admit(spec)
+def test_admission_bucketed_malformed_or_missing_edges_refused():
+    d = admit(normalize_spec({**BUCKETED_SMALL, "edges": -5}))
     assert not d.admitted and "malformed" in d.reason
+    d = admit(normalize_spec({**BUCKETED_SMALL, "edges": 10_000}))
+    assert not d.admitted and "malformed" in d.reason   # > n(n-1)/2
+    d = admit(normalize_spec(dict(BUCKETED_SMALL)))
+    assert not d.admitted and "declared edge count" in d.reason
 
 
 def test_worker_runs_bucketed_job_end_to_end(tmp_path):
-    """A bucketed-admitted job settles DONE through the worker: the
-    admission kernel tag routes the fused annealer's LAYOUT (the worker
-    drops prebuilt padded tables — they pin the padded labeling) and the
-    result lands in the durable store."""
+    """A bucketed-solver job settles DONE through the worker: the server
+    builds the power-law graph + degree-bucket layout, the declaration
+    validates against the real table, and the bucketed rollout's result
+    lands in the durable store."""
+    from graphdyn.graphs import powerlaw_graph
+
+    g = powerlaw_graph(24, gamma=2.5, dmin=2, seed=0)
+    E = int(g.edges.shape[0])
     spool = Spool(str(tmp_path / "serve"))
     job = spool.submit(
-        {**SMALL, "edges": 36, "degree_cv": 2.0, "replicas": 32},
-        tenant="t1")
+        {**BUCKETED_SMALL, "edges": E, "replicas": 32}, tenant="t1")
     assert Worker(spool).run_until_drained() == 1
     rec = spool.load(job)
     assert rec["state"] == DONE, rec
     out = np.load(rec["result"])
-    assert out["conf"].shape == (32, SMALL["n"])
+    assert out["conf"].shape == (32, 24)
     assert set(np.unique(out["conf"])) <= {-1, 1}
+    assert np.allclose(out["m_end"],
+                       out["conf"].astype(np.float64).mean(axis=1))
+
+
+def test_worker_refuses_underdeclared_bucketed_job(tmp_path):
+    """The validation rung: a declaration small enough to pass admission
+    but below the built graph's real table is refused by the worker
+    before dispatch — the admitted byte model must cover what runs."""
+    spool = Spool(str(tmp_path / "serve"))
+    job = spool.submit(
+        {**BUCKETED_SMALL, "edges": 1, "replicas": 32}, tenant="t1")
+    assert Worker(spool).run_until_drained() == 1
+    rec = spool.load(job)
+    assert rec["state"] == REFUSED, rec
+    assert "under-priced" in rec["reason"]
