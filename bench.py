@@ -698,6 +698,123 @@ def powerlaw_rate_row(smoke: bool, *, n=None, R=None, steps=None,
     }
 
 
+def stream_rate_row(smoke: bool, *, n=None, R=None, steps=None,
+                    iters=None):
+    """Out-of-core streamed rollout (``graphdyn.ops.streamed``) on an
+    adjacency whose RESIDENT working set exceeds a clamped device budget:
+    the budget is pinned at 1/4 of the modeled resident bucketed bytes,
+    so the plan MUST chunk (several chunks, host-resident) and the row
+    prices exactly the regime the engine exists for. Two legs over the
+    same plan: ``prefetch_depth=0`` (forced-synchronous gathers — the
+    overlap baseline) vs ``prefetch_depth=2`` (double-buffered host
+    prefetch), so ``hiding_frac`` reports how much of the gather wall
+    clock the overlap actually hides (the acceptance gate — >= 50% — is
+    asserted by the slow-tier test at its own shapes; the bench row only
+    reports). Null + reason on any failure, never 0.0."""
+    from benchmarks.common import draw_u32
+    from graphdyn import obs
+    from graphdyn.graphs import degree_buckets, powerlaw_graph
+    from graphdyn.obs import memband
+    from graphdyn.ops.streamed import build_stream_plan, streamed_rollout
+
+    defaults = (8192, 256, 6, 2) if smoke else (65536, 1024, 10, 2)
+    n = n if n is not None else defaults[0]
+    R = R if R is not None else defaults[1]
+    steps = steps if steps is not None else defaults[2]
+    iters = iters if iters is not None else defaults[3]
+    W = R // 32
+
+    g = powerlaw_graph(n, gamma=2.2, dmin=2, seed=0)
+    resident = int(memband.bucketed_state_bytes(
+        n, W, int(degree_buckets(g).table_entries)))
+    # 1/4 of the resident model forces several chunks; the worst hub's
+    # single-node feasibility floor (×2: double-buffered) is the hard
+    # lower clamp — below it no chunking exists at all
+    budget = max(resident // 4,
+                 2 * int(memband.streamed_min_bytes(int(g.deg.max()), W)))
+    plan = build_stream_plan(g, W=W, device_budget_bytes=budget)
+    legs: dict = {}
+    for depth in (0, 2):
+        sp = np.asarray(draw_u32(0, (n, W)))
+        stats: dict = {}
+        streamed_rollout(g, sp, 1, plan=plan, prefetch_depth=depth)  # warm
+        with obs.timed("bench.stream_rate", depth=depth) as sw:
+            for _ in range(iters):
+                streamed_rollout(g, sp, steps, plan=plan,
+                                 prefetch_depth=depth, stats_out=stats)
+        legs[depth] = {"wall_s": sw.wall_s, "stats": stats}
+    wall0 = legs[0]["wall_s"]
+    wall2 = legs[2]["wall_s"]
+    rate = n * R * steps * iters / wall2
+    obs.gauge("ops.streamed.rate", rate, n=n, R=R,
+              chunks=len(plan.chunks))
+    _mark(f"stream rate: n={n} chunks={len(plan.chunks)} "
+          f"budget {budget} rate {rate:.3e} "
+          f"(sync/overlap {wall0 / wall2:.2f}x)")
+    return {
+        "stream_rate": rate,
+        "stream_rate_detail": {
+            "sync_rate": n * R * steps * iters / wall0,
+            "hiding_frac": max(0.0, 1.0 - wall2 / wall0),
+            "overlap_frac": float(
+                legs[2]["stats"].get("overlap_frac", 0.0)),
+            "chunks": len(plan.chunks),
+            "device_budget_bytes": budget,
+            "resident_model_bytes": resident,
+            "h2d_bytes": int(legs[2]["stats"].get("h2d_bytes", 0)),
+            "workload": {"n": n, "gamma": 2.2, "dmin": 2, "R": R,
+                         "steps": steps, "iters": iters},
+        },
+    }
+
+
+def churn_rate_row(smoke: bool, *, n=None, R=None, steps=None,
+                   churn_per_step=None):
+    """Live edge churn through the streamed engine: a seeded mutation
+    schedule (``graphdyn.ops.streamed.seeded_churn``) applied at chunk
+    boundaries with incremental rebuild of exactly the touched chunks,
+    while the rollout keeps advancing. The row is applied mutations per
+    second (schedule candidates surviving the idempotent filters, over
+    the mutation+rebuild wall clock — plan build time excluded); the
+    spin-update rate rides in the detail as proof the dynamics never
+    stalled. Null + reason on any failure, never 0.0."""
+    from benchmarks.common import draw_u32
+    from graphdyn import obs
+    from graphdyn.graphs import powerlaw_graph
+    from graphdyn.ops.streamed import seeded_churn, streamed_rollout
+
+    defaults = (4096, 256, 8, 64.0) if smoke else (32768, 512, 12, 512.0)
+    n = n if n is not None else defaults[0]
+    R = R if R is not None else defaults[1]
+    steps = steps if steps is not None else defaults[2]
+    churn_per_step = (churn_per_step if churn_per_step is not None
+                      else defaults[3])
+    W = R // 32
+
+    g = powerlaw_graph(n, gamma=2.2, dmin=2, seed=0)
+    schedule = seeded_churn(n, steps, rate=churn_per_step, seed=7)
+    sp = np.asarray(draw_u32(0, (n, W)))
+    stats: dict = {}
+    with obs.timed("bench.churn_rate", n=n) as sw:
+        streamed_rollout(g, sp, steps, n_chunks=4, churn=schedule,
+                         stats_out=stats)
+    applied = int(stats.get("mutations", 0))
+    wall = max(sw.wall_s - float(stats.get("build_s", 0.0)), 1e-9)
+    rate = applied / wall
+    obs.gauge("ops.streamed.churn_rate", rate, n=n, applied=applied)
+    _mark(f"churn rate: n={n} applied={applied} rate {rate:.3e}/s")
+    return {
+        "churn_rate": rate,
+        "churn_rate_detail": {
+            "applied_mutations": applied,
+            "scheduled_batches": len(schedule),
+            "spin_update_rate": n * R * steps / sw.wall_s,
+            "workload": {"n": n, "R": R, "steps": steps,
+                         "churn_per_step": churn_per_step, "seed": 7},
+        },
+    }
+
+
 def tta_rows(smoke: bool):
     """Time-to-target-magnetization A/B (ROADMAP item 3): device steps
     until the rolled-out end-state magnetization first reaches the target,
@@ -1278,6 +1395,26 @@ def main():
             "powerlaw_rate": None,
             "powerlaw_rate_skipped_reason":
                 f"powerlaw A/B failed: {str(e)[:150]}",
+        })
+    _mark("out-of-core streamed rollout rate (stream_rate)")
+    try:
+        extra.update(stream_rate_row(args.smoke))
+    except Exception as e:  # noqa: BLE001 — optional row, never silent
+        _mark(f"stream rate row failed: {str(e)[:150]}")
+        extra.update({
+            "stream_rate": None,
+            "stream_rate_skipped_reason":
+                f"streamed overlap A/B failed: {str(e)[:150]}",
+        })
+    _mark("live edge churn rate through the streamed engine (churn_rate)")
+    try:
+        extra.update(churn_rate_row(args.smoke))
+    except Exception as e:  # noqa: BLE001 — optional row, never silent
+        _mark(f"churn rate row failed: {str(e)[:150]}")
+        extra.update({
+            "churn_rate": None,
+            "churn_rate_skipped_reason":
+                f"churn drive failed: {str(e)[:150]}",
         })
     _mark("time-to-target search A/B (tta_tempering / tta_chromatic)")
     try:

@@ -440,6 +440,25 @@ def _build_bucketed_rollout(n: int = 256, W: int = 4, steps: int = 4):
     return lower_bucketed_rollout(degree_buckets(g), W=W, steps=steps)
 
 
+def _build_streamed_chunk(n: int = 256, W: int = 4, n_chunks: int = 3):
+    from graphdyn.graphs import powerlaw_graph
+    from graphdyn.ops.streamed import build_stream_plan, lower_streamed_chunk
+
+    # canonical power-law family (the out-of-core layout exists for graphs
+    # whose resident form does NOT fit); the fingerprinted program is the
+    # per-chunk device step at the LAST chunk's shapes — degree-ascending
+    # chunk order makes that the wide (hub) chunk, the shape regime whose
+    # deoptimization (comparator route flipping, a dmax-padded gather
+    # sneaking in) this row exists to catch. The degree cutoff is pinned
+    # at 64 so the padded hub width is the SAME power of two (64 >
+    # UNROLL_MAX — the wide route) at every graftcost calibration size:
+    # uncapped, the hub width grows ~n^(1/(γ−1)) and the cost rows stop
+    # being affine in n
+    g = powerlaw_graph(n, gamma=2.5, dmin=2, dmax=64, seed=0)
+    plan = build_stream_plan(g, W=W, n_chunks=n_chunks)
+    return lower_streamed_chunk(plan.chunks[-1], W=W)
+
+
 def _temper_config():
     from graphdyn.config import DynamicsConfig, SAConfig
 
@@ -493,6 +512,15 @@ ENTRIES: dict[str, EntrySpec] = {
         _build_bucketed_rollout, donates=True,
         canon="power-law n=256 gamma=2.5 dmin=2 seed=0, degree-bucketed "
               "layout, W=4, steps=4, comparator route",
+    ),
+    # the out-of-core per-chunk step: donates=False is the CONTRACT here —
+    # the [M+1, W] gathered slab can never alias the [C, W] chunk output,
+    # and a donation annotation would only buy spurious "donated buffer
+    # not usable" warnings on every host round-trip (GD006 at the jit)
+    "streamed_rollout": EntrySpec(
+        _build_streamed_chunk, donates=False,
+        canon="power-law n=256 gamma=2.5 dmin=2 dmax=64 seed=0, stream "
+              "plan K=3, last (hub) chunk's device step, W=4",
     ),
     "halo_rollout": EntrySpec(
         _build_halo_rollout, donates=True,

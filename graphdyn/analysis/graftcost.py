@@ -345,6 +345,14 @@ COST_ENTRIES: dict[str, CostEntrySpec] = {
     "bucketed_rollout": CostEntrySpec(
         (128, 256, 512), 384, {**_LINEAR, "peak_bytes": 0.75},
         residual_tol=0.25),
+    # the streamed entry fingerprints ONE chunk's device step (the last,
+    # hub-heavy chunk of a K=3 plan over the seeded power-law family,
+    # degree cutoff pinned at 64 so the padded hub width is the same
+    # power of two at every size — uncapped the width grows ~n^(2/3) and
+    # nothing here is affine): C and M scale linearly, with the same
+    # seeded-realization jitter allowance as the resident bucketed kernel
+    "streamed_rollout": CostEntrySpec(
+        (128, 256, 512), 384, dict(_LINEAR), residual_tol=0.25),
     "bdcm_sweep": CostEntrySpec((32, 64, 96), 48, dict(_LINEAR)),
     "entropy_cell_chunk": CostEntrySpec((32, 48, 64), 40, dict(_LINEAR)),
     "hpr_group_loop": CostEntrySpec((16, 24, 32), 20, dict(_LINEAR)),
@@ -526,6 +534,19 @@ def _hand_halo_wire(n: int) -> float:
     return float(t.halo_bytes_per_step(4) * 2)   # canonical steps=2
 
 
+def _hand_streamed_chunk(n: int) -> float:
+    from graphdyn.graphs import powerlaw_graph
+    from graphdyn.obs import memband
+    from graphdyn.ops.streamed import build_stream_plan
+
+    ch = build_stream_plan(
+        powerlaw_graph(n, gamma=2.5, dmin=2, dmax=64, seed=0),
+        W=4, n_chunks=3,
+    ).chunks[-1]
+    return float(memband.streamed_chunk_bytes(
+        ch.C, ch.M, int(ch.nbr_loc.shape[1]), 4))
+
+
 def _hand_fused_vmem(n: int) -> float:
     from graphdyn.ops import pallas_anneal
 
@@ -578,6 +599,12 @@ HAND_MODELS: tuple[HandModel, ...] = (
         "entropy_cell_chunk", "peak_bytes",
         "stacked_bdcm_bytes + chi double-buffer + max DP scratch  (G=2)",
         _hand_entropy_chunk,
+    ),
+    HandModel(
+        "streamed_chunk_bytes", "graphdyn.obs.memband",
+        "streamed_rollout", "arg_bytes",
+        "4·(M+1)·W + 4·C·width + 8·C + 4·C·W  (last chunk of K=3, W=4)",
+        _hand_streamed_chunk,
     ),
     HandModel(
         "halo_shard_bytes", "graphdyn.obs.memband",

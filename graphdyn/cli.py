@@ -277,6 +277,80 @@ def build_parser() -> argparse.ArgumentParser:
              "a0 = linspace(a0-frac, this, n-replicas) * n (no swap moves "
              "— for replica exchange use `graphdyn temper`)",
     )
+    sa.add_argument(
+        "--layout", choices=["auto", "padded", "bucketed", "streamed"],
+        default="auto",
+        help="node layout of the per-repetition driver (models/sa.py): "
+             "auto routes high-degree-CV graphs bucket-major; streamed "
+             "evaluates every candidate end-sum through the out-of-core "
+             "chunked rollout (ops/streamed) — the route when padded "
+             "tables exceed the device budget; non-padded layouts run "
+             "the serial repetition loop",
+    )
+    sa.add_argument(
+        "--stream-chunks", type=int, default=4, metavar="K",
+        help="with --layout streamed: host-resident chunk count of the "
+             "stream plan (two chunks device-resident at a time)",
+    )
+
+    strm = sub.add_parser(
+        "stream",
+        help="out-of-core streamed rollout: dynamics on a graph larger "
+             "than the device budget, with double-buffered host→device "
+             "chunk gathers and optional live edge churn (ops/streamed; "
+             "ARCHITECTURE.md 'Out-of-core streaming & edge churn')",
+    )
+    strm.add_argument("--n", type=int, default=4096)
+    strm.add_argument(
+        "--gamma", type=float, default=2.5,
+        help="power-law degree exponent of the generated graph",
+    )
+    strm.add_argument("--dmin", type=int, default=2,
+                      help="power-law minimum degree")
+    strm.add_argument("--graph-seed", type=int, default=0)
+    strm.add_argument("--rule", choices=["majority", "minority"],
+                      default="majority")
+    strm.add_argument("--tie", choices=["stay", "change"], default="stay")
+    strm.add_argument("--steps", type=int, default=32,
+                      help="synchronous update steps")
+    strm.add_argument("--replicas", type=int, default=32,
+                      help="bit-packed replica count (32 per uint32 word)")
+    strm.add_argument("--seed", type=int, default=0,
+                      help="initial-spin seed (also the run identity seed)")
+    strm.add_argument(
+        "--chunks", type=int, default=4, metavar="K",
+        help="host-resident chunk count (ignored when --device-budget is "
+             "given)",
+    )
+    strm.add_argument(
+        "--device-budget", type=int, default=None, metavar="BYTES",
+        help="pack chunks greedily so two fit in BYTES (the double-buffer "
+             "peak) instead of a fixed --chunks count",
+    )
+    strm.add_argument(
+        "--prefetch-depth", type=int, default=2, metavar="D",
+        help="host-prefetch lookahead; 0 forces synchronous gathers (the "
+             "overlap A/B baseline)",
+    )
+    strm.add_argument(
+        "--churn-rate", type=float, default=0.0, metavar="R",
+        help="live edge churn: Poisson(R/2) adds + drops per step, applied "
+             "at chunk boundaries with incremental table rebuild "
+             "(seeded_churn — pure in (--n, --steps, R, --churn-seed))",
+    )
+    strm.add_argument("--churn-seed", type=int, default=0)
+    strm.add_argument(
+        "--checkpoint", default=None,
+        help="path prefix for preemption-safe exact resume; applied churn "
+             "is journaled (stream.churn) so a requeued run replays the "
+             "past bit-exactly from the journal alone; SIGTERM "
+             "checkpoints at the next chunk boundary and exits 75 "
+             "(EX_TEMPFAIL)",
+    )
+    strm.add_argument("--checkpoint-interval", type=float, default=30.0)
+    _add_resilience_flags(strm)
+    strm.add_argument("--out", default=None,
+                      help="npz path (conf int8[R, n] + per-replica m_end)")
 
     tmp = sub.add_parser(
         "temper",
@@ -598,15 +672,20 @@ def build_parser() -> argparse.ArgumentParser:
             ("--m-target", float, "target magnetization"),
             ("--max-sweeps", int, "sweep budget"),
             ("--chunk-sweeps", int, "sweeps per device chunk"),
-            ("--solver", str, "engine: fused (annealer on an RRG) or "
+            ("--solver", str, "engine: fused (annealer on an RRG), "
              "bucketed (degree-bucketed rollout on a power-law graph, "
-             "priced edge-proportionally)"),
+             "priced edge-proportionally), or streamed (out-of-core "
+             "chunked rollout, priced per chunk — runs shapes the "
+             "resident engines refuse)"),
             ("--edges", int, "declared edge count (required for "
-             "--solver bucketed: prices admission by the "
-             "edge-proportional byte model; worker-validated against "
-             "the built graph)"),
+             "--solver bucketed/streamed: prices admission by the "
+             "edge-proportional/per-chunk byte model; worker-validated "
+             "against the built graph)"),
+            ("--dmax", int, "declared worst hub degree (--solver "
+             "streamed: the single-node-chunk feasibility floor; "
+             "worker-validated against the built graph)"),
             ("--gamma", float, "power-law exponent of the served graph "
-             "(--solver bucketed; --d is dmin)"),
+             "(--solver bucketed/streamed; --d is dmin)"),
             ("--degree-cv", float, "declared degree coefficient of "
              "variation (informational; does not affect admission)")):
         srv.add_argument(flag, type=typ, default=None,
@@ -801,6 +880,12 @@ def _run(args) -> int:
                 "pass --sharded as well (the per-repetition driver has no "
                 "node axis to shard)"
             )
+        if args.sharded and args.layout not in ("auto", "padded"):
+            raise SystemExit(
+                f"--layout {args.layout} selects a per-repetition driver "
+                "layout; the mesh solver shards the padded node axis "
+                "(drop --sharded, or --layout auto/padded)"
+            )
         if args.sharded:
             import jax
 
@@ -880,12 +965,52 @@ def _run(args) -> int:
             checkpoint_interval_s=args.checkpoint_interval,
             rollout_mode=args.rollout_mode,
             group_size=args.group_size, prefetch=args.prefetch,
+            layout=args.layout, stream_chunks=args.stream_chunks,
         )
         print(json.dumps({
             "solver": "sa",
             "mag_reached": out.mag_reached.tolist(),
             "num_steps": out.num_steps.tolist(),
             "m_final": out.m_final.tolist(),
+            "out": args.out,
+        }))
+    elif args.cmd == "stream":
+        from graphdyn.graphs import powerlaw_graph
+        from graphdyn.ops.packed import pack_spins, unpack_spins
+        from graphdyn.ops.streamed import seeded_churn, streamed_rollout
+        from graphdyn.utils.io import save_results_npz
+
+        g = powerlaw_graph(args.n, gamma=args.gamma, dmin=args.dmin,
+                           seed=args.graph_seed)
+        rng = np.random.default_rng(args.seed)
+        s0 = (2 * rng.integers(0, 2, size=(args.replicas, args.n)) - 1
+              ).astype(np.int8)
+        churn = (seeded_churn(args.n, args.steps, rate=args.churn_rate,
+                              seed=args.churn_seed)
+                 if args.churn_rate > 0 else None)
+        stats: dict = {}
+        sp_end = streamed_rollout(
+            g, pack_spins(s0), args.steps,
+            rule=args.rule, tie=args.tie,
+            n_chunks=None if args.device_budget is not None else args.chunks,
+            device_budget_bytes=args.device_budget,
+            prefetch_depth=args.prefetch_depth, churn=churn,
+            checkpoint_path=args.checkpoint,
+            checkpoint_interval_s=args.checkpoint_interval,
+            seed=args.seed, stats_out=stats,
+        )
+        s_end = unpack_spins(sp_end, args.replicas)
+        m_end = s_end.astype(np.float64).sum(axis=1) / args.n  # graftlint: disable=GD004  host observable, exact sum
+        if args.out:
+            save_results_npz(args.out, conf=s_end, m_end=m_end)
+        print(json.dumps({
+            "solver": "stream", "n": args.n, "steps": args.steps,
+            "chunks": stats.get("chunks"),
+            "overlap_frac": stats.get("overlap_frac"),
+            "h2d_bytes": stats.get("h2d_bytes"),
+            "d2h_bytes": stats.get("d2h_bytes"),
+            "mutations": stats.get("mutations"),
+            "m_end_mean": float(m_end.mean()),
             "out": args.out,
         }))
     elif args.cmd == "temper":
@@ -1253,6 +1378,7 @@ def _run(args) -> int:
                 ("max_sweeps", args.max_sweeps),
                 ("chunk_sweeps", args.chunk_sweeps),
                 ("edges", args.edges),
+                ("dmax", args.dmax),
                 ("gamma", args.gamma),
                 ("degree_cv", args.degree_cv)) if v is not None}
             job_id = serve_api.submit(args.root, spec, args.tenant,

@@ -375,7 +375,13 @@ def erdos_renyi_graph(
     return graph_from_edges(n, np.stack([i, j], axis=1))
 
 
-def from_edgelist(edges, *, n: int | None = None, dmax: int | None = None) -> Graph:
+def from_edgelist(
+    edges,
+    *,
+    n: int | None = None,
+    dmax: int | None = None,
+    strict: bool = False,
+) -> Graph:
     """Ingest an EXTERNAL undirected edge list into the padded-table
     :class:`Graph` — the entry point for real (social/web) graphs that
     arrive as pair dumps rather than from the seeded generators.
@@ -385,10 +391,14 @@ def from_edgelist(edges, *, n: int | None = None, dmax: int | None = None) -> Gr
     sanitizes: self-loops are dropped and duplicate undirected edges
     (either orientation) are deduplicated keeping the FIRST occurrence in
     input order, so the result is a simple graph and the edge order is
-    deterministic in the input order. ``n`` defaults to ``max id + 1``
+    deterministic in the input order. ``strict=True`` REJECTS instead of
+    sanitizing — a pointed :class:`ValueError` naming the first offending
+    input rows, for pipelines where a dirty dump means upstream corruption
+    rather than expected noise. Endpoints outside ``[0, n)`` are always an
+    error (never silently re-labeled). ``n`` defaults to ``max id + 1``
     (it must be given explicitly for an empty list). Round-trip contract:
     ``from_edgelist(g.edges, n=g.n)`` reproduces ``g``'s tables for any
-    simple :class:`Graph` (tested).
+    simple :class:`Graph` (tested) — a simple graph passes ``strict``.
     """
     if isinstance(edges, np.ndarray):
         e = edges.astype(np.int64).reshape(-1, 2)
@@ -397,12 +407,48 @@ def from_edgelist(edges, *, n: int | None = None, dmax: int | None = None) -> Gr
     if n is None:
         if e.size == 0:
             raise ValueError("empty edge list: pass n explicitly")
+        if e.min() < 0:
+            raise ValueError(
+                "negative node id(s) in edge list: first offending rows "
+                f"{e[(e < 0).any(axis=1)][:5].tolist()}"
+            )
         n = int(e.max()) + 1
-    e = e[e[:, 0] != e[:, 1]]                      # self-loops dropped
+    if e.size:
+        bad = (e < 0).any(axis=1) | (e >= n).any(axis=1)
+        if bad.any():
+            rows = np.flatnonzero(bad)
+            raise ValueError(
+                f"{rows.size} edge endpoint(s) outside [0, {n}): first at "
+                f"input row(s) {rows[:5].tolist()} = "
+                f"{e[rows[:5]].tolist()}; fix the ids or pass a larger n"
+            )
+    loops = e[:, 0] == e[:, 1] if e.size else np.zeros(0, bool)
+    if strict and loops.any():
+        rows = np.flatnonzero(loops)
+        raise ValueError(
+            f"strict edge list has {rows.size} self-loop(s): first at "
+            f"input row(s) {rows[:5].tolist()} = "
+            f"{e[rows[:5]].tolist()}; drop them upstream or call with "
+            "strict=False to sanitize"
+        )
+    e = e[~loops]                                  # self-loops dropped
     if e.size:
         lo = np.minimum(e[:, 0], e[:, 1])
         hi = np.maximum(e[:, 0], e[:, 1])
-        _, first = np.unique(lo * max(n, 1) + hi, return_index=True)
+        key = lo * max(n, 1) + hi
+        uniq, first, counts = np.unique(
+            key, return_index=True, return_counts=True)
+        if strict and (counts > 1).any():
+            dup_keys = uniq[counts > 1]
+            order = np.argsort(first[counts > 1])
+            ex = [[int(k) // max(n, 1), int(k) % max(n, 1)]
+                  for k in dup_keys[order][:5]]
+            raise ValueError(
+                f"strict edge list has {dup_keys.size} duplicate "
+                f"undirected edge(s) (counting either orientation): first "
+                f"duplicated pair(s) {ex}; dedup upstream or call with "
+                "strict=False to keep each pair's first occurrence"
+            )
         e = e[np.sort(first)]                      # first occurrence kept
     return graph_from_edges(n, e, dmax=dmax)
 

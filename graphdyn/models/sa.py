@@ -338,11 +338,12 @@ def simulated_annealing(
     lc_tables=None,
     kernel: str = "auto",
     layout: str = "auto",
+    stream_chunks: int = 4,
 ) -> SAResult:
     """Run batched SA chains.
 
     ``layout`` selects the node layout (``'auto'`` | ``'padded'`` |
-    ``'bucketed'``): ``'auto'`` routes through
+    ``'bucketed'`` | ``'streamed'``): ``'auto'`` routes through
     :func:`graphdyn.ops.bucketed.auto_layout` — a degree CV at or above
     the bucketed threshold (power-law graphs; an RRG sits at 0) relabels
     the graph bucket-major (:func:`graphdyn.graphs.degree_buckets`) so
@@ -352,7 +353,14 @@ def simulated_annealing(
     proposals index nodes by id), so a relabeled run is a different —
     equally distributed — chain; injected ``proposals``/``uniforms`` and
     prebuilt ``lc_tables`` are node-indexed and therefore require
-    ``layout='padded'``.
+    ``layout='padded'``. ``'streamed'`` keeps the caller's labeling but
+    evaluates every candidate end-sum through the out-of-core streamed
+    rollout (:func:`graphdyn.ops.streamed.streamed_rollout`, chunked over
+    ``stream_chunks`` host-resident chunks) — the route for graphs whose
+    padded tables exceed the device budget; injected streams stay
+    allowed (no relabel), and the chain is bit-identical to
+    ``layout='padded'`` (shared draw + Metropolis helpers, integer
+    end-sums are engine-independent — tested).
 
     ``kernel`` selects the anneal execution engine (the PR-5 kernel-knob
     convention, ARCHITECTURE.md "Kernel selection"): ``'auto'`` and
@@ -413,9 +421,10 @@ def simulated_annealing(
         raise ValueError(
             f"kernel must be 'auto', 'xla' or 'pallas', got {kernel!r}"
         )
-    if layout not in ("auto", "padded", "bucketed"):
+    if layout not in ("auto", "padded", "bucketed", "streamed"):
         raise ValueError(
-            f"layout must be 'auto', 'padded' or 'bucketed', got {layout!r}"
+            f"layout must be 'auto', 'padded', 'bucketed' or 'streamed', "
+            f"got {layout!r}"
         )
     if layout == "auto":
         from graphdyn.ops.bucketed import auto_layout
@@ -455,6 +464,34 @@ def simulated_annealing(
             kernel=kernel, layout="padded",
         )
         return res._replace(s=res.s[..., inv])
+    if layout == "streamed":
+        if backend == "cpu":
+            raise ValueError(
+                "layout='streamed' is the out-of-core device route; the "
+                "numpy oracle is fully resident by construction — drop "
+                "backend='cpu' or use layout='padded'"
+            )
+        if checkpoint_path is not None:
+            raise ValueError(
+                "layout='streamed' has no chunked-chain resume (the chain "
+                "is host-stepped; the streamed rollout's own checkpoints "
+                "cover serve jobs, not this chain) — use layout='padded' "
+                "for checkpointed SA chains"
+            )
+        if rollout_mode != "full":
+            raise ValueError(
+                "rollout_mode='lightcone' caches a device-resident "
+                "trajectory, which is exactly what the out-of-core "
+                "streamed layout exists to avoid — use rollout_mode='full'"
+            )
+        # injected proposals/uniforms stay ALLOWED: the streamed layout
+        # keeps the caller's node labeling (chunks address global ids),
+        # which is the bit-parity lever against layout='padded'
+        return _sa_streamed(
+            graph, config or SAConfig(), n_replicas=n_replicas, seed=seed,
+            s0=s0, a0=a0, b0=b0, proposals=proposals, uniforms=uniforms,
+            max_steps=max_steps, dtype=dtype, stream_chunks=stream_chunks,
+        )
     config = config or SAConfig()
     n = graph.n
     dyn = config.dynamics
@@ -604,6 +641,92 @@ def simulated_annealing(
     )
 
 
+def _sa_streamed(
+    graph, config, *, n_replicas, seed, s0, a0, b0, proposals, uniforms,
+    max_steps, dtype, stream_chunks,
+):
+    """``layout='streamed'``: the SAME serial Metropolis chain law, with
+    every candidate end-sum computed by the out-of-core streamed rollout
+    (:func:`graphdyn.ops.streamed.streamed_rollout`) instead of a
+    device-resident gather — the SA route for graphs whose padded tables
+    exceed the device budget.
+
+    The chain is host-stepped (one streamed rollout per MCMC step);
+    proposal draws and the Metropolis/anneal arithmetic go through the
+    SAME shared helpers as the device loop (:func:`draw_sa_proposal`,
+    :func:`metropolis_anneal_update`), so bit-parity with
+    ``layout='padded'`` is structural: integer end-sums are
+    engine-independent (the streamed rollout is bit-exact to the packed
+    kernel), and the acceptance arithmetic is literally the same code on
+    the same dtype. Node labeling is the caller's throughout."""
+    from graphdyn.ops.packed import WORD, pack_spins, unpack_spins
+    from graphdyn.ops.streamed import build_stream_plan, streamed_rollout
+
+    n = graph.n
+    dyn = config.dynamics
+    rollout = dyn.p + dyn.c - 1
+    prep = prepare_sa_inputs(
+        graph, config, n_replicas=n_replicas, seed=seed, s0=s0, a0=a0,
+        b0=b0, proposals=proposals, uniforms=uniforms, max_steps=max_steps,
+    )
+    (R, seed, s0, a0, b0, proposals, uniforms,
+     max_steps, stream_len, injected) = prep
+    np_dt = np.float32 if dtype == jnp.float32 else np.float64  # graftlint: disable=GD004  dtype mirror for host results
+    W = -(-R // WORD)
+    plan = build_stream_plan(graph, W=W, n_chunks=stream_chunks)
+
+    def end_sums(s_batch):
+        """Integer Σ_i s_end_i per replica via the streamed engine —
+        exact, so chain decisions cannot depend on the engine."""
+        out = streamed_rollout(
+            graph, pack_spins(np.asarray(s_batch)), rollout,
+            rule=dyn.rule, tie=dyn.tie, plan=plan,
+        )
+        return jnp.asarray(unpack_spins(out, R).astype(np.int32).sum(axis=1))
+
+    s = jnp.asarray(s0)
+    a_v = jnp.asarray(a0.astype(np_dt))
+    b_v = jnp.asarray(b0.astype(np_dt))
+    dt = a_v.dtype
+    key = jax.vmap(jax.random.PRNGKey)(
+        np.arange(R, dtype=np.uint32) + np.uint32(seed))
+    sum_end = end_sums(s0)
+    m0 = sum_end.astype(dt) / n
+    t = jnp.zeros((R,), jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+    m_final = m0
+    active = m0 < 1.0
+    par_a = jnp.asarray(np_dt(config.par_a))
+    par_b = jnp.asarray(np_dt(config.par_b))
+    a_cap = jnp.asarray(np_dt(config.a_cap_frac * n))
+    b_cap = jnp.asarray(np_dt(config.b_cap_frac * n))
+    prop_j = jnp.asarray(proposals)
+    unif_j = jnp.asarray(uniforms.astype(np_dt))
+    ridx = jnp.arange(R)
+    # graftlint: disable-next-line=GD015  streamed layout: state pages through host RAM between proposals, so the chain is host-stepped by design — the per-step readback IS the chunk boundary; layout='padded' keeps the fused on-device annealer
+    while bool(jnp.any(active)):
+        i, u = draw_sa_proposal(
+            key, t, prop_j, unif_j,
+            injected=injected, stream_len=stream_len, n=n, dt=dt,
+        )
+        s_i = s[ridx, i].astype(jnp.int32)
+        s_flip = s.at[ridx, i].set((-s_i).astype(jnp.int8))
+        sum_end_flip = end_sums(s_flip)
+        do, sum_end, a_v, b_v, t, m_final, active = metropolis_anneal_update(
+            active, a_v, b_v, t, m_final, sum_end, sum_end_flip, s_i, u,
+            par_a=par_a, par_b=par_b, a_cap=a_cap, b_cap=b_cap,
+            max_steps=max_steps, n=n,
+        )
+        s = jnp.where(do[:, None], s_flip, s)
+    s_final = np.asarray(s)
+    mag = s_final.astype(np.float64).sum(axis=1) / n  # graftlint: disable=GD004  host observable, exact sum
+    return SAResult(
+        s=s_final,
+        mag_reached=mag.astype(np_dt),
+        num_steps=np.asarray(t),
+        m_final=np.asarray(m_final),
+    )
+
+
 def energy(
     graph,
     s,
@@ -667,6 +790,8 @@ def sa_ensemble(
     rollout_mode: str = "full",
     group_size: int | None = None,
     prefetch: int = 2,
+    layout: str = "auto",
+    stream_chunks: int = 4,
 ) -> SAEnsembleResult:
     """The reference's experiment driver (`SA_RRG.py:58-92`): ``n_stat``
     repetitions, each on a freshly sampled RRG(n, d). Pass ``save_path`` to
@@ -696,14 +821,26 @@ def sa_ensemble(
     completed-rep prefix before propagating
     :class:`~graphdyn.resilience.ShutdownRequested`; fault site
     ``rep.boundary`` fires once per repetition in repetition order (at
-    group boundaries under the grouped path)."""
-    serial_only = backend == "cpu" or rollout_mode != "full"
+    group boundaries under the grouped path).
+
+    ``layout`` is forwarded to each repetition's
+    :func:`simulated_annealing`; non-default layouts (``'bucketed'`` /
+    ``'streamed'``) run the serial repetition loop — the grouped program
+    stacks padded neighbor tables and covers only that layout."""
+    if layout not in ("auto", "padded", "bucketed", "streamed"):
+        raise ValueError(
+            f"layout must be 'auto', 'padded', 'bucketed' or 'streamed', "
+            f"got {layout!r}"
+        )
+    serial_only = (backend == "cpu" or rollout_mode != "full"
+                   or layout not in ("auto", "padded"))
     if group_size is None:
         group_size = 0 if serial_only else min(max(n_stat, 1), 8)
     if group_size and serial_only:
         raise ValueError(
-            "group_size >= 1 requires the jax backend and "
-            "rollout_mode='full' (pass group_size=0 for the serial loop)"
+            "group_size >= 1 requires the jax backend, "
+            "rollout_mode='full' and a padded-family layout (pass "
+            "group_size=0 for the serial loop)"
         )
     if group_size:
         from graphdyn.pipeline.sa_group import sa_ensemble_grouped
@@ -758,8 +895,11 @@ def sa_ensemble(
         g = random_regular_graph(n, d, seed=seed + k, method=graph_method)
         chain_ckpt = (
             checkpoint_path + f"_chain{k}"
-            if checkpoint_path and backend != "cpu" else None
-        )   # driver-level resume still works for the numpy-oracle backend.
+            if checkpoint_path and backend != "cpu"
+            and layout != "streamed" else None
+        )   # driver-level resume still works for the numpy-oracle backend
+        # and the host-stepped streamed layout (which refuses chain
+        # checkpoints).
         # Per-rep chain paths: driver snapshots are interval-gated, so
         # next_rep may lag the in-flight rep after a preemption — a SHARED
         # chain path would then hold a later rep's snapshot, which the
@@ -779,6 +919,7 @@ def sa_ensemble(
                 checkpoint_path=chain_ckpt,
                 checkpoint_interval_s=checkpoint_interval_s,
                 rollout_mode=rollout_mode,  # cpu+lightcone raises there, loudly
+                layout=layout, stream_chunks=stream_chunks,
             )
         except ShutdownRequested:
             # the in-flight chain already checkpointed itself at its chunk

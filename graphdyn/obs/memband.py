@@ -59,6 +59,11 @@ MEM_BANDS: dict[str, tuple[float, float]] = {
     # degree-bucketed layout: lo is loose for the same shared-process
     # reason as halo_shard (the padded baseline usually ran first)
     "bucketed_state": (0.25, 16.0),
+    # out-of-core streamed layout: the model charges only the two
+    # resident chunks, so lo is very loose (resident baselines usually
+    # ran first in the same process and dominate the peak) and hi is wide
+    # until the first chip round calibrates it
+    "streamed_chunk": (0.05, 64.0),
 }
 
 
@@ -110,6 +115,69 @@ def bucketed_table_entries_bound(n: int, n_edges: int) -> int:
     except degree-0/1 rows which cost one slot — so
     ``Σ_b n_b·2^b ≤ Σ_v max(2·deg(v), 1) ≤ 4·E + n``."""
     return 4 * n_edges + n
+
+
+def streamed_chunk_bytes(C: int, M: int, width: int, W: int) -> int:
+    """Device-resident bytes of ONE streamed chunk's step
+    (:mod:`graphdyn.ops.streamed`): the gathered state slab
+    ``uint32[M+1, W]`` (owned ∪ neighbor rows + the ghost zero row), the
+    slab-local neighbor table ``int32[C, width]``, the degree/self-row
+    vectors (``8·C``), and the ``uint32[C, W]`` output block. The ONLY
+    term that scales with the whole graph is host RAM — this is the
+    formula that deletes the device-memory cliff."""
+    return 4 * (M + 1) * W + 4 * C * width + 8 * C + 4 * C * W
+
+
+def streamed_state_bytes(n: int, W: int, n_edges: int, chunks: int) -> int:
+    """Modeled peak DEVICE bytes of the streamed rollout at ``chunks``
+    chunks: two chunks resident at once (active + prefetched) under the
+    double-buffered lane, each charged :func:`streamed_chunk_bytes` at
+    the balanced per-chunk shape — ``C = ⌈n/K⌉`` owned rows, table slots
+    ``e_c = ⌈(4E+n)/K⌉`` (the :func:`bucketed_table_entries_bound` split
+    across chunks; the degree-ascending chunk walk keeps the power-of-two
+    row padding within the same 2× the bucketed layout pays), slab rows
+    ``M ≤ C + e_c`` (every gathered neighbor row is some table slot).
+    Serve admission prices ``solver='streamed'`` jobs with THIS model —
+    the per-chunk device term is what turns "refused: oversized" into
+    "admitted: streamed"."""
+    if chunks < 1:
+        raise ValueError(f"chunks must be >= 1, got {chunks}")
+    C = -(-n // chunks)
+    e_c = -(-bucketed_table_entries_bound(n, n_edges) // chunks)
+    return 2 * streamed_chunk_bytes(C, C + e_c, 1, W) + 4 * e_c - 4 * C
+    # NOTE on the width term: streamed_chunk_bytes charges 4·C·width for
+    # the table; at the balanced shape that term IS 4·e_c, so the call
+    # above passes width=1 and the correction re-prices it exactly.
+
+
+def streamed_min_bytes(dmax: int, W: int) -> int:
+    """The feasibility floor of the streamed layout: the device bytes of
+    a single-node chunk holding the worst declared hub (slab of ``2 +
+    dmax`` rows, one power-of-two padded table row). Double-buffered,
+    ``2×`` this must fit the budget or no chunking can help — the check
+    admission runs before sizing the chunk count."""
+    width = 1 << max(int(dmax) - 1, 0).bit_length()
+    return streamed_chunk_bytes(1, 1 + dmax, width, W)
+
+
+def streamed_chunk_count(n: int, W: int, n_edges: int,
+                         budget_bytes: int) -> int | None:
+    """The smallest chunk count whose :func:`streamed_state_bytes` fits
+    ``budget_bytes`` — or None when even one-node chunks cannot (the
+    caller refuses with the modeled floor). Monotone in K, so a doubling
+    walk + binary search."""
+    if streamed_state_bytes(n, W, n_edges, max(n, 1)) > budget_bytes:
+        return None
+    lo, hi = 1, 1
+    while streamed_state_bytes(n, W, n_edges, hi) > budget_bytes:
+        lo, hi = hi, min(hi * 2, max(n, 1))
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if streamed_state_bytes(n, W, n_edges, mid) > budget_bytes:
+            lo = mid + 1
+        else:
+            hi = mid
+    return hi
 
 
 def stacked_bdcm_bytes(stk) -> int:
@@ -308,11 +376,14 @@ def run_memcheck(*, diag=None) -> list[MemRow]:
             _row("halo_shard", None, _halo_smoke_model(W=W), reason),
             _row("bucketed_state", None, _bucketed_smoke_model(W=W),
                  reason),
+            _row("streamed_chunk", None, _streamed_smoke_model(W=W),
+                 reason),
             *_derived_rows(reason),
         ]
     else:
         rows = [_measure_packed(), *_measure_bdcm_rows(), _measure_halo(),
-                _measure_bucketed(), *_derived_rows(None)]
+                _measure_bucketed(), _measure_streamed(),
+                *_derived_rows(None)]
     from graphdyn import obs
 
     for row in rows:
@@ -498,6 +569,40 @@ def _measure_bucketed(*, n: int = 4096, W: int = 8, steps: int = 8) -> MemRow:
     peak, reason = peak_hbm_bytes()
     return _row("bucketed_state", peak,
                 bucketed_state_bytes(b.n, W, b.table_entries), reason)
+
+
+def _streamed_smoke_plan(n: int = 4096, chunks: int = 8):
+    """The streamed smoke layout: the SAME seeded power-law family as the
+    bucketed smoke (the workload class the streaming path serves), split
+    into a fixed chunk count."""
+    from graphdyn.ops.streamed import build_stream_plan
+
+    g, _ = _bucketed_smoke_buckets(n)
+    return g, build_stream_plan(g, W=8, n_chunks=chunks)
+
+
+def _streamed_smoke_model(*, W: int, n: int = 4096, chunks: int = 8) -> float:
+    """``streamed_chunk`` model bytes at the smoke shape: the two largest
+    REAL chunks of the smoke plan (the admission-side
+    :func:`streamed_state_bytes` models the balanced split; memcheck
+    holds the band against the plan that actually ran)."""
+    from graphdyn.ops.streamed import plan_device_bytes
+
+    _, plan = _streamed_smoke_plan(n, chunks)
+    return float(plan_device_bytes(plan, W))
+
+
+def _measure_streamed(*, n: int = 4096, chunks: int = 8, W: int = 8,
+                      steps: int = 8) -> MemRow:
+    """Peak bytes through the streamed rollout on the power-law smoke."""
+    import numpy as np
+
+    from graphdyn.ops.streamed import plan_device_bytes, streamed_rollout
+
+    g, plan = _streamed_smoke_plan(n, chunks)
+    streamed_rollout(g, np.zeros((n, W), np.uint32), steps, plan=plan)
+    peak, reason = peak_hbm_bytes()
+    return _row("streamed_chunk", peak, plan_device_bytes(plan, W), reason)
 
 
 def _measure_bdcm_rows() -> list[MemRow]:

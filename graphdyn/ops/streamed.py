@@ -1,0 +1,632 @@
+"""Out-of-core streamed rollout — larger-than-HBM graphs + live churn.
+
+Every resident kernel (:mod:`graphdyn.ops.packed`,
+:mod:`graphdyn.ops.bucketed`) holds the FULL neighbor table and state in
+device memory, so the largest graph the system can run is the largest
+table that fits — serve admission simply refuses anything bigger
+(ROADMAP item 3, the last structural memory cliff). Here the node axis
+is partitioned into host-resident **chunks**: only the active chunk's
+state slab + neighbor table live on device, and while the device steps
+chunk ``c`` a :class:`graphdyn.pipeline.prefetch.HostPrefetcher` lane
+gathers + uploads chunk ``c+1``'s slab in the background — the boundary-
+overlap discipline of the TPU Ising kernels (PAPERS.md arXiv:1903.11714)
+applied to the host↔device seam instead of the core↔core seam. ``obs``
+spans attribute the h2d/d2h bytes per step and the driver emits the
+measured ``stream.overlap_util`` gauge, so the overlap is evidence, not
+assumption.
+
+Exactness is structural: every chunk applies the SAME carry-save
+bit-plane popcount / bitwise comparator as the resident kernels — the
+shared helpers imported from :mod:`graphdyn.ops.packed` and
+:mod:`graphdyn.ops.bucketed` — and integer popcounts are exact and
+order-independent, so a node's update is identical whether its neighbor
+state arrives from a resident table or a streamed slab. The rollout is
+**bit-exact** to ``packed_rollout`` / ``bucketed_rollout_global`` on any
+graph small enough to run both (tested across the rule × tie ×
+RRG/power-law matrix).
+
+On top of the chunk boundaries rides the **mutation stream**: batches of
+edges arriving/expiring mid-rollout (:class:`ChurnBatch`), applied at
+the synchronous step boundary with an incremental table rebuild of only
+the touched chunks — the evolving-adjacency workload the sparse Ising
+machines treat as first-class (PAPERS.md arXiv:2110.02481). Every
+applied batch is journaled (``stream.churn`` op) next to the checkpoint,
+so a preempted run replays the identical churn sequence bit-exactly
+through the PR-9/10 requeue machinery **from the journal alone** — the
+schedule is never consulted for steps the journal already covers.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Iterable, NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from graphdyn import obs
+from graphdyn.graphs import Graph, degree_buckets
+from graphdyn.ops.bucketed import (
+    UNROLL_MAX,
+    _csa_bucket,
+    _pack_lanes,
+    _wide_bucket_counts,
+)
+from graphdyn.ops.dynamics import Rule, TieBreak
+from graphdyn.ops.packed import _FULL, _compare_planes, _rule_tie_combine
+from graphdyn.obs.memband import streamed_chunk_bytes
+
+__all__ = [
+    "StreamChunk", "StreamPlan", "ChurnBatch", "build_stream_plan",
+    "chunk_device_bytes", "plan_device_bytes", "streamed_rollout",
+    "seeded_churn", "lower_streamed_chunk",
+]
+
+
+def _pow2_width(dmax: int) -> int:
+    """The padded slot width for a chunk of max degree ``dmax`` — the
+    :func:`graphdyn.graphs.degree_buckets` power-of-two convention
+    (degrees 0/1 share width 1; wide widths ≥ 64 are automatically
+    multiples of :data:`~graphdyn.ops.bucketed.UNROLL_MAX`, the segment
+    requirement of the wide CSA path)."""
+    return 1 << int(max(int(dmax) - 1, 0)).bit_length()
+
+
+class StreamChunk(NamedTuple):
+    """One host-resident chunk of the node axis (host numpy).
+
+    The chunk OWNS ``nodes`` (the global ids it updates); its device
+    working set is the **slab** — the packed state rows of ``gids``
+    (owned nodes ∪ their neighbors, sorted global ids) plus one ghost
+    zero row at local index ``len(gids)``. ``nbr_loc`` indexes the slab
+    (ghost-padded), ``self_loc`` maps each owned node to its slab row.
+
+    Attributes:
+      nodes:   int64[C] owned global node ids.
+      gids:    int64[M] global ids whose state the slab carries (sorted;
+               a superset of ``nodes``).
+      nbr_loc: int32[C, w] slab-local neighbor table, ghost = M, with
+               ``w`` the chunk's power-of-two padded width.
+      deg:     int32[C] true degrees of the owned nodes.
+      self_loc: int32[C] slab row of each owned node.
+    """
+
+    nodes: np.ndarray
+    gids: np.ndarray
+    nbr_loc: np.ndarray
+    deg: np.ndarray
+    self_loc: np.ndarray
+
+    @property
+    def C(self) -> int:
+        return self.nodes.size
+
+    @property
+    def M(self) -> int:
+        return self.gids.size
+
+    @property
+    def width(self) -> int:
+        return self.nbr_loc.shape[1]
+
+
+class StreamPlan(NamedTuple):
+    """The chunked layout of one graph: every node owned by exactly one
+    chunk (``chunk_of[i]``), chunks walked in order each synchronous
+    step. Built by :func:`build_stream_plan`; rebuilt incrementally per
+    touched chunk when churn mutates the adjacency."""
+
+    n: int
+    chunks: tuple
+    chunk_of: np.ndarray
+
+    @property
+    def K(self) -> int:
+        return len(self.chunks)
+
+
+def chunk_device_bytes(C: int, M: int, width: int, W: int) -> int:
+    """Device-resident bytes of ONE chunk's step at ``W`` state words:
+    slab ``4·(M+1)·W`` (+ ghost row) + neighbor table ``4·C·w`` + degree
+    and self-row vectors ``8·C`` + output block ``4·C·W``. The quantity
+    the ``streamed_state_bytes`` memband model charges per chunk and
+    :func:`build_stream_plan`'s budget mode packs against. The formula
+    itself lives in :func:`graphdyn.obs.memband.streamed_chunk_bytes`
+    (a registered graftcost HAND_MODELS adapter, gated against the
+    HLO-derived model); this is the ops-side alias."""
+    return streamed_chunk_bytes(C, M, width, W)
+
+
+def plan_device_bytes(plan: StreamPlan, W: int) -> int:
+    """Peak modeled device bytes of the plan: the two largest chunks
+    resident at once (active + prefetched) under double-buffering."""
+    per = sorted(
+        (chunk_device_bytes(c.C, c.M, c.width, W) for c in plan.chunks),
+        reverse=True,
+    )
+    return sum(per[:2]) if len(per) > 1 else (per[0] if per else 0)
+
+
+def _adjacency_lists(graph: Graph) -> list[np.ndarray]:
+    """Per-node neighbor id arrays (sorted) from the padded table."""
+    return [
+        np.sort(graph.nbr[i, : graph.deg[i]].astype(np.int64))
+        for i in range(graph.n)
+    ]
+
+
+def _build_chunk(nodes: np.ndarray, adj: list[np.ndarray]) -> StreamChunk:
+    """Materialize one chunk's slab-local tables from the adjacency."""
+    nodes = np.asarray(nodes, np.int64)
+    degs = np.array([adj[i].size for i in nodes], np.int64)
+    width = _pow2_width(int(degs.max()) if nodes.size else 0)
+    nbr_cat = (np.concatenate([adj[i] for i in nodes])
+               if nodes.size else np.empty(0, np.int64))
+    gids = np.unique(np.concatenate([nodes, nbr_cat]))
+    M = gids.size
+    # global -> slab row (gids is sorted, so searchsorted is the inverse)
+    self_loc = np.searchsorted(gids, nodes)
+    nbr_loc = np.full((nodes.size, width), M, np.int64)
+    if nbr_cat.size:
+        loc_cat = np.searchsorted(gids, nbr_cat)
+        pos = 0
+        for r, d in enumerate(degs):
+            nbr_loc[r, :d] = loc_cat[pos:pos + d]
+            pos += d
+    return StreamChunk(
+        nodes=nodes, gids=gids,
+        nbr_loc=nbr_loc.astype(np.int32),
+        deg=degs.astype(np.int32),
+        self_loc=self_loc.astype(np.int32),
+    )
+
+
+def build_stream_plan(graph: Graph, *, W: int, n_chunks: int | None = None,
+                      device_budget_bytes: int | None = None,
+                      adj: list[np.ndarray] | None = None) -> StreamPlan:
+    """Partition the node axis into host-resident chunks.
+
+    Nodes are walked in :func:`graphdyn.graphs.degree_buckets` order
+    (degree-ascending) so each chunk's power-of-two padded width is tight
+    — the same layout economics as the bucketed kernel, per chunk.
+
+    Exactly one of ``n_chunks`` (fixed chunk count, contiguous equal
+    slices) or ``device_budget_bytes`` must be given. Budget mode packs
+    greedily: a chunk closes when its modeled bytes
+    (:func:`chunk_device_bytes`, using the conservative slab bound
+    ``M ≤ C + Σdeg``) would exceed **half** the budget — two chunks are
+    resident at once under double-buffered prefetch. Raises
+    ``ValueError`` when even a single node cannot fit (admission performs
+    the same feasibility check up front).
+    """
+    if (n_chunks is None) == (device_budget_bytes is None):
+        raise ValueError(
+            "pass exactly one of n_chunks or device_budget_bytes"
+        )
+    if adj is None:
+        adj = _adjacency_lists(graph)
+    order = degree_buckets(graph).order
+    groups: list[np.ndarray] = []
+    if n_chunks is not None:
+        if not 1 <= n_chunks <= max(graph.n, 1):
+            raise ValueError(
+                f"n_chunks must be in [1, {graph.n}], got {n_chunks}"
+            )
+        groups = [g for g in np.array_split(order, n_chunks) if g.size]
+    else:
+        half = device_budget_bytes // 2
+        cur: list[int] = []
+        c = deg_sum = 0
+        for i in order:
+            d = adj[i].size
+            # degrees ascend along the walk, so the newest node's
+            # power-of-two width bounds the whole candidate block
+            w = _pow2_width(d)
+            est = chunk_device_bytes(
+                c + 1, (c + 1) + deg_sum + d, w, W)
+            if cur and est > half:
+                groups.append(np.asarray(cur, np.int64))
+                cur, c, deg_sum = [], 0, 0
+                est = chunk_device_bytes(1, 1 + d, w, W)
+            if est > half:
+                raise ValueError(
+                    f"node {int(i)} (degree {d}) alone needs {est} B — "
+                    f"over half the {device_budget_bytes} B device "
+                    f"budget; the graph cannot be streamed at W={W}"
+                )
+            cur.append(int(i))
+            c += 1
+            deg_sum += d
+        if cur:
+            groups.append(np.asarray(cur, np.int64))
+    chunks = tuple(_build_chunk(g, adj) for g in groups)
+    chunk_of = np.empty(graph.n, np.int32)
+    for k, ch in enumerate(chunks):
+        chunk_of[ch.nodes] = k
+    return StreamPlan(n=graph.n, chunks=chunks, chunk_of=chunk_of)
+
+
+# ---------------------------------------------------------------------------
+# device step of one chunk — the graftcheck-fingerprinted program
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("rule", "tie"))
+# graftlint: disable-next-line=GD006  the [M+1,W] slab can never alias the [C,W] output — donation would only emit XLA "not usable" noise
+def _stream_chunk_device(nbr_loc, deg, self_loc, slab, rule: str = "majority",
+                         tie: str = "stay"):
+    """One synchronous update of one chunk's owned nodes from its state
+    slab (graftcheck fingerprints THIS program as the
+    ``streamed_rollout`` ledger entry). ``slab: uint32[M+1, W]`` — the
+    gathered packed state with the ghost zero row last (not donated: the
+    output shape ``[C, W]`` can never alias it); returns ``uint32[C, W]``.
+    Narrow chunks run the unrolled CSA + bitwise comparator, wide (hub)
+    chunks the segmented CSA + integer comparator — the exact arithmetic
+    of the resident bucketed kernel, shared helpers."""
+    rule = Rule(rule)
+    tie = TieBreak(tie)
+    width = nbr_loc.shape[1]
+    prev = jnp.take(slab, self_loc, axis=0)
+    if width > UNROLL_MAX:
+        cnt = _wide_bucket_counts(slab, nbr_loc)
+        two = 2 * cnt
+        deg_col = deg.astype(jnp.int32)[:, None, None]
+        return _rule_tie_combine(
+            _pack_lanes(two > deg_col), _pack_lanes(two == deg_col),
+            prev, rule, tie)
+    n_planes = max(width.bit_length(), 1)
+    planes = _csa_bucket(slab, nbr_loc, n_planes)
+    thr = (deg // 2).astype(jnp.uint32)
+    even = jnp.where(deg % 2 == 0, _FULL, jnp.uint32(0))[:, None]
+    thr_bits = [
+        jnp.where((thr >> k) & 1 == 1, _FULL, jnp.uint32(0))[:, None]
+        for k in range(n_planes)
+    ]
+    gt, eq = _compare_planes(planes, thr_bits)
+    return _rule_tie_combine(gt, eq & even, prev, rule, tie)
+
+
+def lower_streamed_chunk(chunk: StreamChunk, *, W: int,
+                         rule: str = "majority", tie: str = "stay"):
+    """Lower (without executing) the streamed chunk step at this chunk's
+    shapes — the program :mod:`graphdyn.analysis.graftcheck` fingerprints
+    for the ``streamed_rollout`` ledger entry. Kept next to the kernel so
+    a refactor updates the fingerprinted surface in place."""
+    nbr = jnp.asarray(chunk.nbr_loc)
+    deg = jnp.asarray(chunk.deg)
+    self_loc = jnp.asarray(chunk.self_loc)
+    slab = jax.ShapeDtypeStruct((chunk.M + 1, W), jnp.uint32)
+    return _stream_chunk_device.lower(nbr, deg, self_loc, slab, rule, tie)
+
+
+# ---------------------------------------------------------------------------
+# the mutation stream — live edge churn at chunk boundaries
+# ---------------------------------------------------------------------------
+
+
+class ChurnBatch(NamedTuple):
+    """One batch of edge mutations applied at the boundary BEFORE step
+    ``step`` (0-based): ``drops`` leave first, then ``adds`` arrive.
+    Both are int ``[k, 2]`` endpoint arrays; application is idempotent —
+    drops of absent edges and adds of present edges or self-loops are
+    filtered, and only the surviving mutations are journaled."""
+
+    step: int
+    adds: np.ndarray
+    drops: np.ndarray
+
+
+def seeded_churn(n: int, steps: int, *, rate: float,
+                 seed: int) -> list[ChurnBatch]:
+    """A deterministic churn schedule: per step, ``Poisson(rate/2)``
+    candidate arrivals and departures over uniform node pairs (pure in
+    ``(n, steps, rate, seed)`` — the prerequisite for journal replay
+    equivalence tests). Departure candidates are drawn blind to the live
+    adjacency; the idempotent filters in application make that exact."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for t in range(steps):
+        ka = int(rng.poisson(rate / 2.0))
+        kd = int(rng.poisson(rate / 2.0))
+        adds = rng.integers(0, n, size=(ka, 2), dtype=np.int64)
+        drops = rng.integers(0, n, size=(kd, 2), dtype=np.int64)
+        if ka or kd:
+            out.append(ChurnBatch(step=t, adds=adds, drops=drops))
+    return out
+
+
+class _Adjacency:
+    """Mutable per-node neighbor sets over a base graph — the live
+    adjacency the churn stream edits. ``apply`` filters a batch down to
+    the mutations that actually change the graph (drops of absent edges,
+    duplicate/self-loop adds are dropped) and returns them with the
+    touched node set, so the caller journals exactly what happened and
+    rebuilds exactly the chunks whose tables changed."""
+
+    def __init__(self, graph: Graph):
+        self.n = graph.n
+        self._sets = [
+            set(graph.nbr[i, : graph.deg[i]].astype(int).tolist())
+            for i in range(graph.n)
+        ]
+
+    def apply(self, adds, drops):
+        applied_drops, applied_adds = [], []
+        touched: set[int] = set()
+        for u, v in np.asarray(drops, np.int64).reshape(-1, 2):
+            u, v = int(u), int(v)
+            if u == v or v not in self._sets[u]:
+                continue
+            self._sets[u].discard(v)
+            self._sets[v].discard(u)
+            applied_drops.append((min(u, v), max(u, v)))
+            touched.update((u, v))
+        for u, v in np.asarray(adds, np.int64).reshape(-1, 2):
+            u, v = int(u), int(v)
+            if u == v or v in self._sets[u]:
+                continue
+            self._sets[u].add(v)
+            self._sets[v].add(u)
+            applied_adds.append((min(u, v), max(u, v)))
+            touched.update((u, v))
+        return applied_adds, applied_drops, touched
+
+    def neighbor_lists(self) -> list[np.ndarray]:
+        return [
+            np.fromiter(sorted(s), np.int64, len(s)) for s in self._sets
+        ]
+
+    def neighbors_of(self, i: int) -> np.ndarray:
+        return np.fromiter(sorted(self._sets[i]), np.int64,
+                           len(self._sets[i]))
+
+
+def _rebuild_touched(plan: StreamPlan, adj_lists: list[np.ndarray],
+                     touched: set[int]) -> StreamPlan:
+    """Rebuild ONLY the chunks owning a touched node — chunk membership
+    is stable under churn (ownership never moves), so the rebuild cost is
+    proportional to the churn locality, not the graph."""
+    dirty = {int(plan.chunk_of[i]) for i in touched}
+    chunks = tuple(
+        _build_chunk(ch.nodes, adj_lists) if k in dirty else ch
+        for k, ch in enumerate(plan.chunks)
+    )
+    return StreamPlan(n=plan.n, chunks=chunks, chunk_of=plan.chunk_of)
+
+
+# ---------------------------------------------------------------------------
+# the streamed rollout driver
+# ---------------------------------------------------------------------------
+
+
+class _StreamState(NamedTuple):
+    sp: np.ndarray       # uint32[n, W] packed state, GLOBAL node order
+    t: int               # completed synchronous steps
+    seq: int             # applied churn batches so far (journal cursor)
+
+
+def _one_step(state: _StreamState, plan_ref: list, adj, schedule,
+              journal, rule: str, tie: str, depth: int,
+              totals: dict) -> _StreamState:
+    """Advance one synchronous step: apply due churn at the boundary,
+    then sweep every chunk with the prefetch lane one chunk ahead."""
+    plan: StreamPlan = plan_ref[0]
+    t, seq = state.t, state.seq
+    # -- churn boundary: drops then adds, journal what was applied -------
+    while seq < len(schedule) and schedule[seq].step <= t:
+        batch = schedule[seq]
+        adds, drops, touched = adj.apply(batch.adds, batch.drops)
+        if touched:
+            plan = _rebuild_touched(plan, adj.neighbor_lists(), touched)
+            plan_ref[0] = plan
+        if journal is not None:
+            journal(step=int(batch.step), seq=int(seq),
+                    adds=[list(e) for e in adds],
+                    drops=[list(e) for e in drops],
+                    n_adds=len(adds), n_drops=len(drops))
+        totals["mutations"] += len(adds) + len(drops)
+        seq += 1
+    # -- chunk sweep: prefetch gathers chunk c+1 while c steps -----------
+    from graphdyn.pipeline.prefetch import HostPrefetcher
+
+    sp, W = state.sp, state.sp.shape[1]
+    new = np.empty_like(sp)
+
+    def build(c: int):
+        ch = plan.chunks[c]
+        slab = np.concatenate(
+            [sp[ch.gids], np.zeros((1, W), np.uint32)], axis=0)
+        dev = (jnp.asarray(ch.nbr_loc), jnp.asarray(ch.deg),
+               jnp.asarray(ch.self_loc), jnp.asarray(slab))
+        # graftlint: disable-next-line=GD016  measured H2D traffic gauge over the arrays actually staged, not a predictive byte model — the model is streamed_chunk_bytes in obs/memband
+        nbytes = sum(int(a.nbytes) for a in dev)
+        return dev, nbytes
+
+    h2d = d2h = 0
+    pf = HostPrefetcher(build, range(plan.K), depth=depth)
+    try:
+        with obs.span("stream.step", step=t, chunks=plan.K):
+            for c in range(plan.K):
+                (nbr, deg, self_loc, slab), nbytes = pf.get(c)
+                out = _stream_chunk_device(
+                    nbr, deg, self_loc, slab, rule, tie)
+                out_np = np.asarray(out)
+                new[plan.chunks[c].nodes] = out_np
+                h2d += nbytes
+                d2h += int(out_np.nbytes)
+    finally:
+        totals["build_s"] += pf._build_s
+        totals["wait_s"] += pf._wait_s
+        pf.close()
+    totals["h2d_bytes"] += h2d
+    totals["d2h_bytes"] += d2h
+    if obs.enabled():
+        obs.gauge("stream.h2d_bytes", h2d, step=t, chunks=plan.K)
+        obs.gauge("stream.d2h_bytes", d2h, step=t, chunks=plan.K)
+    return _StreamState(sp=new, t=t + 1, seq=seq)
+
+
+def _replay_churn_from_journal(jpath: str, t0: int, adj: _Adjacency,
+                               plan_ref: list):
+    """Re-apply every journaled ``stream.churn`` batch with ``step <
+    t0`` — the resumed adjacency comes from the journal ALONE (the
+    schedule may disagree about the past; the journal is the record of
+    what this run actually applied). Returns the dedup set of applied
+    ``(step, seq)`` pairs and the resume journal cursor."""
+    from graphdyn.obs.recorder import read_ledger
+
+    try:
+        events, _ = read_ledger(jpath)
+    except (OSError, ValueError):
+        events = []
+    seen: set[tuple[int, int]] = set()
+    batches = []
+    for ev in events:
+        if ev.get("ev") != "journal" or ev.get("op") != "stream.churn":
+            continue
+        key = (int(ev.get("step", -1)), int(ev.get("seq", -1)))
+        if key in seen:
+            continue            # a requeued run re-journals nothing, but
+        seen.add(key)           # dedup keeps replay idempotent anyway
+        batches.append((key, ev.get("adds") or [], ev.get("drops") or []))
+    touched_all: set[int] = set()
+    applied = 0
+    for (step, _), adds, drops in sorted(batches, key=lambda b: b[0]):
+        if step >= t0:
+            continue            # boundary not yet crossed by the resumed
+        a = np.asarray(adds, np.int64).reshape(-1, 2)
+        d = np.asarray(drops, np.int64).reshape(-1, 2)
+        _, _, touched = adj.apply(a, d)
+        touched_all |= touched
+        applied += 1
+    if touched_all:
+        plan_ref[0] = _rebuild_touched(
+            plan_ref[0], adj.neighbor_lists(), touched_all)
+    return applied
+
+
+def streamed_rollout(graph: Graph, sp, steps: int, *,
+                     rule: str = "majority", tie: str = "stay",
+                     n_chunks: int | None = None,
+                     device_budget_bytes: int | None = None,
+                     plan: StreamPlan | None = None,
+                     prefetch_depth: int = 2,
+                     churn: Iterable[ChurnBatch] | None = None,
+                     checkpoint_path: str | None = None,
+                     checkpoint_interval_s: float = 30.0,
+                     seed: int = 0,
+                     stats_out: dict | None = None) -> np.ndarray:
+    """Roll packed spins ``sp: uint32[n, W]`` (GLOBAL node order) for
+    ``steps`` synchronous updates with only one chunk (plus the
+    prefetched next) resident on device. Bit-exact to
+    :func:`graphdyn.ops.packed.packed_rollout` /
+    :func:`graphdyn.ops.bucketed.bucketed_rollout_global` on the same
+    graph (no permutation: chunks address global ids).
+
+    ``churn``: optional :class:`ChurnBatch` schedule (sorted by step),
+    applied at boundaries with incremental rebuild of touched chunks and
+    journaled under the ``stream.churn`` op when checkpointing.
+    ``prefetch_depth=0`` is the forced-synchronous A/B leg (gathers
+    serialize with compute — the overlap baseline). ``stats_out`` (dict)
+    receives the measured totals: ``build_s``, ``wait_s``,
+    ``overlap_frac``, ``h2d_bytes``, ``d2h_bytes``, ``mutations``,
+    ``steps``, ``chunks``.
+
+    With ``checkpoint_path``, preemption resume is exact: the snapshot
+    carries ``(sp, t, seq)`` and the resumed run replays the journaled
+    churn for ``step < t`` from the journal ALONE before consulting the
+    schedule for the remaining boundaries.
+    """
+    sp = np.ascontiguousarray(np.asarray(sp, np.uint32))
+    if sp.ndim != 2 or sp.shape[0] != graph.n:
+        raise ValueError(
+            f"sp must be uint32[n={graph.n}, W], got {sp.shape}"
+        )
+    W = sp.shape[1]
+    schedule = sorted(churn, key=lambda b: (b.step,)) if churn else []
+    adj = _Adjacency(graph)
+    if plan is None:
+        plan = build_stream_plan(
+            graph, W=W, n_chunks=n_chunks,
+            device_budget_bytes=device_budget_bytes,
+            adj=adj.neighbor_lists(),
+        )
+    plan_ref = [plan]
+    totals = {"build_s": 0.0, "wait_s": 0.0, "h2d_bytes": 0,
+              "d2h_bytes": 0, "mutations": 0}
+
+    journal = None
+    ckpt = None
+    state = _StreamState(sp=sp, t=0, seq=0)
+    if checkpoint_path:
+        from graphdyn.resilience.store import (
+            journal_event, journal_path_for,
+        )
+        from graphdyn.utils.io import ChainCheckpointer, run_fingerprint
+
+        jpath = journal_path_for(checkpoint_path)
+
+        def journal(**fields):
+            journal_event(jpath, "stream.churn", **fields)
+
+        # identity EXCLUDES the churn schedule: the journal (not the
+        # schedule argument) is authoritative for boundaries already
+        # crossed, so a resume with a tampered past schedule must still
+        # validate — that is the journal-alone replay contract
+        fp = run_fingerprint(
+            graph.edges, np.int64(graph.n), np.int64(steps), str(rule),
+            str(tie), np.int64(W),
+        )
+        ckpt = ChainCheckpointer(
+            checkpoint_path, kind="streamed_rollout", seed=seed, fp=fp,
+            interval_s=checkpoint_interval_s,
+            extra_meta={"W": int(W)},
+        )
+        loaded = ckpt.load_state(
+            check=lambda a: a["sp"].shape == sp.shape)
+        if loaded is not None:
+            t0 = int(loaded["t"])
+            seq0 = int(loaded["seq"])
+            replayed = _replay_churn_from_journal(jpath, t0, adj, plan_ref)
+            state = _StreamState(
+                sp=np.ascontiguousarray(loaded["sp"].astype(np.uint32)),
+                t=t0, seq=seq0)
+            if obs.enabled():
+                obs.counter("stream.resume", t=t0, seq=seq0,
+                            replayed=replayed)
+
+    def advance(s: _StreamState) -> _StreamState:
+        return _one_step(s, plan_ref, adj, schedule, journal, rule, tie,
+                         prefetch_depth, totals)
+
+    def active(s: _StreamState) -> bool:
+        return s.t < steps
+
+    if ckpt is not None:
+        state = ckpt.drive(
+            state, advance=advance, active=active,
+            payload=lambda s: {"sp": s.sp, "t": np.int64(s.t),
+                               "seq": np.int64(s.seq)},
+        )
+    else:
+        while active(state):
+            state = advance(state)
+
+    build_s, wait_s = totals["build_s"], totals["wait_s"]
+    overlap = max(0.0, 1.0 - wait_s / build_s) if build_s > 0 else 0.0
+    if obs.enabled() and build_s > 0:
+        obs.gauge(
+            "stream.overlap_util", overlap,
+            build_s=round(build_s, 6), wait_s=round(wait_s, 6),
+            depth=prefetch_depth, steps=int(state.t),
+            chunks=plan_ref[0].K,
+            h2d_bytes=totals["h2d_bytes"], d2h_bytes=totals["d2h_bytes"],
+        )
+    if stats_out is not None:
+        stats_out.update(
+            totals, overlap_frac=overlap, steps=int(state.t),
+            chunks=plan_ref[0].K,
+        )
+    return state.sp

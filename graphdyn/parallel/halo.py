@@ -624,28 +624,32 @@ def sa_halo_exchange(s, sends, recvs, perms, node_axis: str):
 def sa_halo_cols(tables: HaloTables, s: np.ndarray) -> np.ndarray:
     """Global int8 spins ``[R, n]`` -> halo column layout
     ``[R, P * n_rows]`` (owned + consistent ghosts; trash/zero columns 0,
-    so ghost-padded neighbor slots contribute 0 to neighbor sums)."""
-    if tables.n_hubs:
-        raise NotImplementedError(
-            "the int8 SA halo layout does not implement hub replication; "
-            "partition without hub_threshold for the sharded SA solver"
-        )
+    so ghost-padded neighbor slots contribute 0 to neighbor sums).
+    Hub-split tables additionally replicate every hub's spin into the hub
+    columns ``[hub_row0, trash_row)`` of EVERY shard — the vertex-cut
+    invariant the SA solver maintains step to step (identical hub updates
+    on all shards) and re-establishes on every accepted hub flip."""
     s = np.asarray(s, np.int8)
     R = s.shape[0]
     nm = tables.n_local_max
     out = np.zeros((R, tables.P * tables.n_rows), np.int8)
     view = out.reshape(R, tables.P, tables.n_rows)
+    h0 = tables.hub_row0
     for p in range(tables.P):
         cnt = int(tables.counts[p])
         view[:, p, :cnt] = s[:, tables.owned_global[p, :cnt]]
         gcnt = int(tables.ghost_counts[p])
         if gcnt:
             view[:, p, nm:nm + gcnt] = s[:, tables.ghost_global[p, :gcnt]]
+        if tables.n_hubs:
+            view[:, p, h0:h0 + tables.n_hubs] = s[:, tables.hub_global]
     return out
 
 
 def sa_halo_uncols(tables: HaloTables, s_cols: np.ndarray) -> np.ndarray:
-    """Halo column layout back to global int8 spins ``[R, n]``."""
+    """Halo column layout back to global int8 spins ``[R, n]`` (hub spins
+    read from shard 0's replicated columns — every shard carries the same
+    values by the vertex-cut invariant)."""
     s_cols = np.asarray(s_cols)
     R = s_cols.shape[0]
     view = s_cols.reshape(R, tables.P, tables.n_rows)
@@ -653,6 +657,9 @@ def sa_halo_uncols(tables: HaloTables, s_cols: np.ndarray) -> np.ndarray:
     for p in range(tables.P):
         cnt = int(tables.counts[p])
         out[:, tables.owned_global[p, :cnt]] = view[:, p, :cnt]
+    if tables.n_hubs:
+        h0 = tables.hub_row0
+        out[:, tables.hub_global] = view[:, 0, h0:h0 + tables.n_hubs]
     return out
 
 

@@ -243,10 +243,18 @@ def make_halo_sa_solver(
     traffic scales with the edge CUT instead of ``n``. ``tables`` is a
     :class:`graphdyn.parallel.halo.HaloTables`; the extra leading args of
     ``init_fn``/``chunk_fn`` are the placed layout tables, and ``chunk_fn``
-    takes the replicated ``loc_of`` owner map as its final argument (the
-    proposal flip must find node ``i``'s shard and column). Not lru-cached:
-    the host tables are unhashable — one build per driver call, which the
-    chunked drive loop amortizes exactly like the jit cache would."""
+    takes the replicated ``loc_of`` owner map and ``hub_of`` hub-slot map
+    as its final arguments (the proposal flip must find node ``i``'s shard
+    and column — or, for a vertex-cut hub, its replicated hub column on
+    EVERY shard). Hub-split tables are first-class: each shard gathers its
+    local partial neighbor sum for every hub from ``hub_nbr_loc`` and a
+    ``psum`` over the node axis yields the exact total (hub–hub terms live
+    on shard 0 only, so nothing is double-counted), every shard then writes
+    the identical sign update into its hub columns — the replication
+    invariant needs no extra collective beyond that one integer psum.
+    Not lru-cached: the host tables are unhashable — one build per driver
+    call, which the chunked drive loop amortizes exactly like the jit
+    cache would."""
     from graphdyn.parallel.halo import (
         exchange_perms,
         sa_halo_exchange,
@@ -257,42 +265,73 @@ def make_halo_sa_solver(
     nm = tables.n_local_max
     perms = exchange_perms(tables)
     k = len(tables.schedule)
+    H = int(tables.n_hubs)
+    hub_row0 = tables.hub_row0
 
-    def _tools(nbr_l, real_l, sends, recvs):
+    def _tools(nbr_l, real_l, sends, recvs, hub_nbr_l):
+        if H:
+            hd = hub_nbr_l.shape[-1]
+
+            def hub_step(s):
+                # partial hub neighbor sums from THIS shard's owned rows
+                # (+ hub–hub terms on shard 0; zero-column pads read 0),
+                # psum -> exact totals, replicated on every shard
+                Rl_ = s.shape[0]
+                g = jnp.take(
+                    s.astype(jnp.int32), hub_nbr_l.reshape(-1), axis=1
+                ).reshape(Rl_, H, hd)
+                tot = lax.psum(g.sum(axis=2), node_axis)
+                s_hub = s[:, hub_row0:hub_row0 + H].astype(jnp.int32)
+                return (
+                    R_coef * jnp.sign(2 * tot + C_coef * s_hub)
+                ).astype(jnp.int8)
+
         def rollout(s_loc):
             def rbody(_, s):
+                if H:
+                    hub_new = hub_step(s)   # from the OLD state, like owned
                 s = sa_halo_local_step(nbr_l, s, real_l, R_coef, C_coef)
+                if H:
+                    s = lax.dynamic_update_slice(s, hub_new, (0, hub_row0))
                 return sa_halo_exchange(s, sends, recvs, perms, node_axis)
 
             return lax.fori_loop(0, rollout_steps, rbody, s_loc)
 
         def block_sum(s_loc):
             # pad-free Σ over this shard's OWNED real columns (ghosts and
-            # pads excluded — each node is counted once, on its owner)
-            return jnp.where(
+            # pads excluded — each node is counted once, on its owner);
+            # replicated hub columns are counted once, on shard 0
+            out = jnp.where(
                 real_l[None, :], s_loc[:, :nm].astype(jnp.int32), 0
             ).sum(axis=1)
+            if H:
+                hub_sum = s_loc[:, hub_row0:hub_row0 + H].astype(
+                    jnp.int32).sum(axis=1)
+                out = out + jnp.where(
+                    lax.axis_index(node_axis) == 0, hub_sum, 0)
+            return out
 
         def end_sum(s_loc):
             return lax.psum(block_sum(rollout(s_loc)), node_axis)
 
         return rollout, block_sum, end_sum
 
-    def init(nbr_l, real_l, send_l, recv_l, s0):
+    def init(nbr_l, real_l, send_l, recv_l, hub_nbr_l, s0):
         sends = [x[0] for x in send_l]
         recvs = [x[0] for x in recv_l]
-        _, _, end_sum = _tools(nbr_l, real_l, sends, recvs)
+        _, _, end_sum = _tools(nbr_l, real_l, sends, recvs, hub_nbr_l)
         return end_sum(s0)
 
-    def chunk(nbr_l, real_l, send_l, recv_l, s_local, key, a, b, t,
-              m_final_in, active_in, sum_end_in, par_a, par_b, a_cap, b_cap,
-              proposals, uniforms, loc_of):
+    def chunk(nbr_l, real_l, send_l, recv_l, hub_nbr_l, s_local, key, a, b,
+              t, m_final_in, active_in, sum_end_in, par_a, par_b, a_cap,
+              b_cap, proposals, uniforms, loc_of, hub_of):
         sends = [x[0] for x in send_l]
         recvs = [x[0] for x in recv_l]
         Rl = s_local.shape[0]
         dt = a.dtype
         node_idx = lax.axis_index(node_axis)
-        _, block_sum, end_sum = _tools(nbr_l, real_l, sends, recvs)
+        _, block_sum, end_sum = _tools(nbr_l, real_l, sends, recvs,
+                                       hub_nbr_l)
 
         def cond(st: _State):
             go = st.live > 0
@@ -306,10 +345,24 @@ def make_halo_sa_solver(
                 injected=injected, stream_len=stream_len, n=n_real, dt=dt,
             )
             # flip proposal i on its owning shard's column (loc_of maps the
-            # global id to owner * n_local_max + row)
+            # global id to owner * n_local_max + row). A hub has NO owner
+            # (loc_of == -1): its spin lives replicated in the hub columns
+            # of every shard, so the flip is applied on ALL shards — that
+            # is the propagation the vertex cut requires before the
+            # candidate rollout reads any replica
             lg = jnp.take(loc_of, i)
-            col = lg % nm
-            owned = (lg // nm) == node_idx
+            if H:
+                hu = jnp.take(hub_of, i)
+                is_hub = hu >= 0
+                col = jnp.where(is_hub, hub_row0 + jnp.maximum(hu, 0),
+                                lg % nm)
+                owned = ((lg // nm) == node_idx) | is_hub
+                count_here = jnp.where(is_hub, node_idx == 0,
+                                       (lg // nm) == node_idx)
+            else:
+                col = lg % nm
+                owned = (lg // nm) == node_idx
+                count_here = owned
             ridx = jnp.arange(Rl, dtype=jnp.int32)
             s_i_local = st.s[ridx, col].astype(jnp.int32)
             flipped = st.s.at[ridx, col].set((-s_i_local).astype(jnp.int8))
@@ -317,8 +370,9 @@ def make_halo_sa_solver(
             # propagate the flip into its ghost copies BEFORE the rollout:
             # the all_gather solver re-gathers the full state every step,
             # here the exchanged boundary columns are the only remote view
+            # (hub flips need no exchange — already applied on every shard)
             s_flip = sa_halo_exchange(s_flip, sends, recvs, perms, node_axis)
-            s_i = lax.psum(jnp.where(owned, s_i_local, 0), node_axis)
+            s_i = lax.psum(jnp.where(count_here, s_i_local, 0), node_axis)
 
             sum_end_flip = end_sum(s_flip)
 
@@ -353,6 +407,7 @@ def make_halo_sa_solver(
         P(node_axis),                      # real    [P*nm]
         [P(node_axis, None)] * k,          # send_idx per offset [P, m]
         [P(node_axis, None)] * k,          # recv_idx per offset [P, m]
+        P(node_axis, None, None),          # hub_nbr_loc [P, H, hd_max]
     )
     init_fn = jax.jit(shard_map(
         init,
@@ -372,6 +427,7 @@ def make_halo_sa_solver(
             P(replica_axis, None),         # proposals
             P(replica_axis, None),         # uniforms
             P(),                           # loc_of
+            P(),                           # hub_of
         ),
         out_specs=(
             P(replica_axis, node_axis),
@@ -742,6 +798,17 @@ def sa_sharded(
             chunk_steps=int(chunk_steps) if ckpt is not None else None,
         )
         spec2 = P(node_axis, None)
+        # hub-split tables: the per-shard hub partial-sum gather rows and
+        # the global-id -> hub-slot map (solver statics; a hub-free
+        # partition ships 1-element dummies the solver never traces)
+        hub_nbr_h = (
+            tables.hub_nbr_loc if tables.n_hubs
+            else np.full((node_shards, 1, 1), tables.zero_row, np.int32)
+        )
+        hub_of_h = np.full(n, -1, np.int32)
+        if tables.n_hubs:
+            hub_of_h[tables.hub_global] = np.arange(
+                tables.n_hubs, dtype=np.int32)
         lead = (
             place_sharded(
                 mesh,
@@ -754,6 +821,8 @@ def sa_sharded(
              for (_, s, _) in tables.schedule],
             [place_sharded(mesh, jnp.asarray(r), spec2)
              for (_, _, r) in tables.schedule],
+            place_sharded(mesh, jnp.asarray(hub_nbr_h),
+                          P(node_axis, None, None)),
         )
     else:
         init_fn, chunk_fn = make_sharded_sa_solver(
@@ -819,6 +888,7 @@ def sa_sharded(
     if halo:
         consts = consts + (
             place_sharded(mesh, jnp.asarray(tables.loc_of), P()),
+            place_sharded(mesh, jnp.asarray(hub_of_h), P()),
         )
 
     fields = ("s", "key", "a", "b", "t", "m_final", "active", "sum_end")

@@ -211,6 +211,20 @@ def _plan_episodes(name: str, rng: np.random.Generator) -> list[Episode]:
             for _ in range(int(rng.integers(2, 4)))
         ]
         return eps + [Episode(specs=[], expect=EX_OK)]
+    if name == "stream_churn":
+        # preempt the out-of-core streamed rollout at chunk boundaries
+        # while its churn schedule is live — twice, so the second resume
+        # must replay journaled mutations written across TWO processes —
+        # then a clean finish. The signal action takes the graceful
+        # checkpoint path (deterministic, race-free); the stream.churn
+        # journal is the replay evidence _check_journal asserts on.
+        return [
+            Episode(specs=[{"site": "chunk.boundary", "action": "signal",
+                            "at": int(rng.integers(2, 5))}]),
+            Episode(specs=[{"site": "chunk.boundary", "action": "signal",
+                            "at": int(rng.integers(2, 5))}]),
+            Episode(specs=[], expect=EX_OK),
+        ]
     if name == "deadline_preempt":
         # the preemption is the --deadline timer taking the SIGTERM path
         # mid-run; the requeue runs without it. A side-effect-only `stall`
@@ -301,6 +315,13 @@ SCENARIOS: dict[str, Scenario] = {
                  "deadlock or thread leak, fuzzed stream bit-exact with "
                  "synchronous builds, overlap gauge exactly once",
                  mode="race_prefetch"),
+        Scenario("stream_churn", "stream",
+                 "out-of-core streamed rollout with live edge churn: "
+                 "preempted twice at chunk boundaries mid-churn, each "
+                 "requeue replays the journaled mutations bit-exactly "
+                 "from the journal alone (the schedule past the resume "
+                 "point is never re-trusted)",
+                 require_ops=("save", "load", "stream.churn")),
         Scenario("serve_kill_requeue", "serve",
                  "multi-tenant serve spool under the schedule fuzzer: "
                  "hard kill mid-dispatch, restart recovers the orphaned "
@@ -333,6 +354,14 @@ def _workload_args(kind: str, out: str, ckpt: str | None,
         args = ["entropy", "--n", "50", "--deg", "1.5", "--num-rep", "1",
                 "--lmbd-max", "0.3", "--lmbd-step", "0.1",
                 "--max-sweeps", "200", "--eps", "1e-5", "--seed", "1",
+                "--out", out]
+    elif kind == "stream":
+        # bounded out-of-core run: 3 chunks, live churn every step — the
+        # schedule is pure in its args, so the fault-free oracle and every
+        # requeued episode chain derive the same mutations
+        args = ["stream", "--n", "160", "--dmin", "2", "--steps", "10",
+                "--churn-rate", "2.0", "--churn-seed", "3",
+                "--chunks", "3", "--replicas", "32", "--seed", "0",
                 "--out", out]
     else:
         raise ValueError(f"unknown workload {kind!r}")

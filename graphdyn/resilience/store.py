@@ -99,6 +99,7 @@ JOURNAL_OPS = (
     "supervise.start", "supervise.restart", "supervise.quarantine",
     "serve.submit", "serve.done", "serve.refuse", "serve.requeue",
     "serve.evict", "serve.quarantine",
+    "stream.churn",
 )
 
 _VERSION_RE = re.compile(r"\.v(\d+)\.npz$")
@@ -341,6 +342,10 @@ def validate_journal(path: str) -> tuple[list[dict], list[str]]:
         "serve.requeue": ("job", "tenant", "requeues", "reason"),
         "serve.evict": ("job", "tenant", "requeues"),
         "serve.quarantine": ("job", "tenant", "site", "crashes"),
+        # the streamed rollout's churn chapter (:mod:`graphdyn.ops
+        # .streamed`): every APPLIED mutation batch is recorded, so a
+        # requeued run replays the identical churn from the journal alone
+        "stream.churn": ("step", "seq", "adds", "drops"),
     }
     for i, ev in enumerate(events):
         kind = ev.get("ev")

@@ -23,6 +23,14 @@ job reaches the device, from the committed byte models alone:
   relabel is an isomorphism), so only the fused formula above prices
   them — a model below the program's real resident set is how a shared
   worker OOMs, the exact failure admission exists to prevent;
+- :func:`graphdyn.obs.memband.streamed_state_bytes` — for
+  ``solver='streamed'`` jobs: the out-of-core rollout
+  (:mod:`graphdyn.ops.streamed`) keeps only two chunks device-resident,
+  so the model prices the per-chunk working set at the smallest chunk
+  count that fits the budget — the route that turns "refused: oversized"
+  into "admitted: streamed" (declared ``edges`` required, ``dmax``
+  optional for the single-hub feasibility floor; both re-validated by
+  the worker against the built graph before dispatch);
 - the device memory budget — the plugin's reported ``bytes_limit``
   (:func:`graphdyn.obs.memband.device_memory_stats`) when a device can
   speak for itself, else the ``GRAPHDYN_SERVE_HBM_BUDGET`` env override,
@@ -121,12 +129,62 @@ def admit(spec: dict, *, key: str = "") -> AdmissionDecision:
                 False, "", f"malformed shape: n={n} d={d} replicas={R}",
                 0, budget)
         solver = str(spec.get("solver", "fused"))
-        if solver not in ("fused", "bucketed"):
+        if solver not in ("fused", "bucketed", "streamed"):
             return AdmissionDecision(
                 False, "", f"unknown solver {spec.get('solver')!r} "
-                "(this service runs the fused annealer and the bucketed "
-                "rollout)", 0, budget)
+                "(this service runs the fused annealer, the bucketed "
+                "rollout, and the streamed rollout)", 0, budget)
         W = -(-R // WORD)
+        if solver == "streamed":
+            # the out-of-core ENGINE: only two chunks of the graph are
+            # device-resident at once (:mod:`graphdyn.ops.streamed`), so
+            # the model prices the per-chunk working set at the smallest
+            # chunk count that fits — a shape the resident models refuse
+            # is ADMITTED here as long as host RAM holds the tables. The
+            # worker re-validates the declared edges/dmax against the
+            # built graph before any dispatch (DeclaredShapeMismatch).
+            from graphdyn.obs.memband import (
+                streamed_chunk_count,
+                streamed_min_bytes,
+                streamed_state_bytes,
+            )
+
+            n_edges = spec.get("edges")
+            if n_edges is None:
+                return AdmissionDecision(
+                    False, "",
+                    "streamed solver requires a declared edge count "
+                    "('edges'): the per-chunk byte model has no other "
+                    "static input", 0, budget)
+            n_edges = int(n_edges)
+            if n_edges < 0 or n_edges > n * (n - 1) // 2:
+                return AdmissionDecision(
+                    False, "", f"malformed shape: edges={n_edges} "
+                    f"(simple graph on n={n} nodes)", 0, budget)
+            dmax = int(spec.get("dmax", min(n - 1, n_edges)))
+            if not 0 <= dmax <= n - 1:
+                return AdmissionDecision(
+                    False, "", f"malformed shape: dmax={dmax} (simple "
+                    f"graph on n={n} nodes)", 0, budget)
+            floor = 2 * streamed_min_bytes(dmax, W)
+            if floor > budget:
+                return AdmissionDecision(
+                    False, "",
+                    f"modeled streamed floor {floor} B (a single-node "
+                    f"chunk holding the declared dmax={dmax} hub, double-"
+                    f"buffered) exceeds the device budget {budget} B — "
+                    "no chunking can stream this shape", floor, budget)
+            chunks = streamed_chunk_count(n, W, n_edges, budget)
+            if chunks is None:
+                return AdmissionDecision(
+                    False, "",
+                    f"modeled streamed resident set "
+                    f"{streamed_state_bytes(n, W, n_edges, max(n, 1))} B "
+                    f"at one-node chunks still exceeds the device budget "
+                    f"{budget} B (n={n}, edges={n_edges}, replicas={R})",
+                    streamed_state_bytes(n, W, n_edges, max(n, 1)), budget)
+            model = streamed_state_bytes(n, W, n_edges, chunks)
+            return AdmissionDecision(True, "streamed", None, model, budget)
         if solver == "bucketed":
             # the edge-proportional ENGINE: the worker builds a power-law
             # graph, lays it out in degree buckets, and runs the

@@ -39,7 +39,7 @@ def graph_key(spec: dict) -> tuple:
     solver = str(spec.get("solver", "fused"))
     key = (solver, int(spec["n"]), int(spec["d"]), int(spec["graph_seed"]),
            str(spec["rule"]), str(spec["tie"]))
-    if solver == "bucketed":
+    if solver in ("bucketed", "streamed"):
         key += (float(spec.get("gamma", 2.5)),)
     return key
 
@@ -108,7 +108,22 @@ class BucketCache:
 
         from graphdyn import obs
 
-        if str(spec.get("solver", "fused")) == "bucketed":
+        solver = str(spec.get("solver", "fused"))
+        if solver == "streamed":
+            # the out-of-core engine caches only the GRAPH: the chunk
+            # plan depends on the job's replica word count (W sets the
+            # slab bytes), so the worker builds it per job against the
+            # live device budget — the graph build is the heavy part
+            from graphdyn.graphs import powerlaw_graph
+
+            with obs.timed("serve.tables_build", n=int(spec["n"]),
+                           d=int(spec["d"])):
+                g = powerlaw_graph(
+                    int(spec["n"]), gamma=float(spec.get("gamma", 2.5)),
+                    dmin=int(spec["d"]), seed=int(spec["graph_seed"]))
+                return g, None
+
+        if solver == "bucketed":
             # the edge-proportional engine's "tables" are the graph plus
             # its degree-bucket layout: a power-law realization (d = dmin,
             # seeded) laid out by degree_buckets — no coloring, no LUT

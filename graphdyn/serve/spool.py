@@ -79,6 +79,11 @@ SPEC_DEFAULTS: dict = {
     "edges": None,
     "degree_cv": 0.0,
     "gamma": 2.5,
+    # streamed-solver declaration: the worst hub degree, input to the
+    # single-node-chunk feasibility floor (solver='streamed'; optional —
+    # admission defaults to the min(n-1, edges) worst case). Worker-
+    # validated against the built graph like 'edges'.
+    "dmax": None,
 }
 
 

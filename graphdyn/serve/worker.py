@@ -223,6 +223,9 @@ class Worker:
                     # the edge-proportional engine (admission priced THIS
                     # program): validate + roll the bucketed kernel
                     payload = self._run_bucketed(spec, g, tables)
+                elif kernel == "streamed":
+                    # the out-of-core engine: validate + stream chunks
+                    payload = self._run_streamed(spec, g)
                 else:
                     res = fused_anneal(
                         g, cfg, n_replicas=int(spec["replicas"]),
@@ -272,6 +275,60 @@ class Worker:
             # graftlint: disable-next-line=GD004  host observable, exact sum
             "m_end": s.astype(np.float64).mean(axis=1),
             "steps": np.asarray(int(spec["max_sweeps"])),
+        }
+
+    def _run_streamed(self, spec: dict, g) -> dict:
+        """One ``solver='streamed'`` job: re-validate the declared
+        edges/dmax against the BUILT graph (the admitted per-chunk model
+        must cover what runs — :class:`DeclaredShapeMismatch` refuses an
+        under-declared job before any device work), chunk the graph
+        against the live device budget, and stream the rollout — the
+        route that runs the shapes the resident engines refuse."""
+        import numpy as np
+
+        from graphdyn.ops.packed import WORD, pack_spins, unpack_spins
+        from graphdyn.ops.streamed import (
+            build_stream_plan,
+            streamed_rollout,
+        )
+        from graphdyn.serve.admission import device_budget_bytes
+
+        n_edges = int(spec["edges"])
+        if g.num_edges > n_edges:
+            raise DeclaredShapeMismatch(
+                f"declared edges={n_edges} but the built graph has "
+                f"{g.num_edges}: the job was under-priced at admission — "
+                "resubmit with the real edge count")
+        declared_dmax = spec.get("dmax")
+        if declared_dmax is not None and g.dmax > int(declared_dmax):
+            raise DeclaredShapeMismatch(
+                f"declared dmax={int(declared_dmax)} but the built graph "
+                f"has dmax={g.dmax}: the admitted feasibility floor was "
+                "under-priced — resubmit with the real hub degree")
+        R = int(spec["replicas"])
+        W = -(-R // WORD)
+        budget = device_budget_bytes()
+        try:
+            plan = build_stream_plan(
+                g, W=W, device_budget_bytes=budget)
+        except ValueError as e:
+            # a hub the byte budget cannot hold even alone: the floor
+            # check at admission was under-declared
+            raise DeclaredShapeMismatch(str(e)) from e
+        rng = np.random.default_rng(int(spec["seed"]))
+        s0 = (2 * rng.integers(0, 2, size=(R, g.n)) - 1).astype(np.int8)
+        stats: dict = {}
+        out = streamed_rollout(
+            g, pack_spins(s0), int(spec["max_sweeps"]),
+            rule=str(spec["rule"]), tie=str(spec["tie"]), plan=plan,
+            stats_out=stats)
+        s = unpack_spins(out, R)
+        return {
+            "conf": s,
+            # graftlint: disable-next-line=GD004  host observable, exact sum
+            "m_end": s.astype(np.float64).mean(axis=1),
+            "steps": np.asarray(int(spec["max_sweeps"])),
+            "chunks": np.asarray(int(stats.get("chunks", plan.K))),
         }
 
     # -- ladder rungs ------------------------------------------------------

@@ -337,6 +337,29 @@ else:
     det = row["powerlaw_rate_detail"]
     assert det["rrg_padded_rate"] > 0 and det["rrg_over_bucketed_x"] > 0
     assert det["hub_degree"] > 0 and det["table_entries"] > 0
+# the out-of-core streamed rows: the overlapped chunk-gather rate on an
+# adjacency exceeding the clamped device budget, and the live edge-churn
+# rate with the rollout still advancing — measured positive with the
+# forced-synchronous A/B detail, or an explicit null + reason — NEVER 0.0
+assert "stream_rate" in row, "stream_rate row absent"
+str_r = row["stream_rate"]
+if str_r is None:
+    assert row.get("stream_rate_skipped_reason"), \
+        "null stream_rate needs stream_rate_skipped_reason"
+else:
+    assert str_r > 0, f"stream_rate must be > 0 or null+reason: {str_r}"
+    det = row["stream_rate_detail"]
+    assert det["sync_rate"] > 0 and det["chunks"] >= 2, det
+    assert det["device_budget_bytes"] < det["resident_model_bytes"], det
+assert "churn_rate" in row, "churn_rate row absent"
+chr_r = row["churn_rate"]
+if chr_r is None:
+    assert row.get("churn_rate_skipped_reason"), \
+        "null churn_rate needs churn_rate_skipped_reason"
+else:
+    assert chr_r > 0, f"churn_rate must be > 0 or null+reason: {chr_r}"
+    det = row["churn_rate_detail"]
+    assert det["applied_mutations"] > 0 and det["spin_update_rate"] > 0, det
 # the serve rows: multi-tenant bucket hit rate and end-to-end job
 # latency through the real worker — measured positive, or an explicit
 # null + reason — NEVER 0.0 (the same null-or-positive contract)

@@ -144,6 +144,30 @@ def test_bench_smoke_emits_one_json_line():
         # the whole point of the layout: resident table bytes follow E,
         # not n·dmax — the bucketed table must beat the padded one
         assert det["table_entries"] < det["padded_entries"]
+    # the out-of-core streamed rows: overlapped chunk-gather rate on an
+    # adjacency exceeding the clamped budget (with the forced-synchronous
+    # A/B leg in the detail) and the live edge-churn rate with the
+    # rollout still advancing — null-or-positive, never 0.0
+    assert "stream_rate" in row
+    if row["stream_rate"] is None:
+        assert row["stream_rate_skipped_reason"]
+    else:
+        assert row["stream_rate"] > 0
+        det = row["stream_rate_detail"]
+        assert det["sync_rate"] > 0
+        # the row only exists in the streaming regime: the plan must have
+        # chunked under a budget strictly below the resident model
+        assert det["chunks"] >= 2
+        assert det["device_budget_bytes"] < det["resident_model_bytes"]
+        assert 0.0 <= det["overlap_frac"] <= 1.0
+    assert "churn_rate" in row
+    if row["churn_rate"] is None:
+        assert row["churn_rate_skipped_reason"]
+    else:
+        assert row["churn_rate"] > 0
+        det = row["churn_rate_detail"]
+        assert det["applied_mutations"] > 0
+        assert det["spin_update_rate"] > 0
     # the device-memory column: a positive peak, or an explicit null +
     # reason (CPU: no usable memory_stats) — never silently absent,
     # never a fake 0 (graphdyn.obs.memband.peak_hbm_bytes)

@@ -344,6 +344,47 @@ class TestFromEdgelist:
         g = from_edgelist(e)
         assert g.n == 4 and g.num_edges == 2
 
+    def test_out_of_range_and_negative_ids_always_rejected(self):
+        from graphdyn.graphs import from_edgelist
+
+        with pytest.raises(ValueError, match=r"outside \[0, 4\)") as ei:
+            from_edgelist([(0, 1), (2, 7)], n=4)
+        assert "row(s) [1]" in str(ei.value)      # pointed at the input row
+        with pytest.raises(ValueError, match="negative node id"):
+            from_edgelist([(0, 1), (-2, 3)])      # inferred n
+        with pytest.raises(ValueError, match=r"outside \[0, 4\)"):
+            from_edgelist([(0, 1), (-2, 3)], n=4)  # explicit n, same error
+
+    def test_strict_rejects_self_loops_naming_rows(self):
+        from graphdyn.graphs import from_edgelist
+
+        with pytest.raises(ValueError, match="self-loop") as ei:
+            from_edgelist([(0, 1), (2, 2), (3, 3)], n=4, strict=True)
+        msg = str(ei.value)
+        assert "2 self-loop(s)" in msg and "row(s) [1, 2]" in msg
+        assert "strict=False" in msg              # the remedy is named
+
+    def test_strict_rejects_duplicates_either_orientation(self):
+        from graphdyn.graphs import from_edgelist
+
+        with pytest.raises(ValueError, match="duplicate") as ei:
+            from_edgelist([(0, 1), (2, 3), (1, 0)], n=4, strict=True)
+        assert "[[0, 1]]" in str(ei.value)        # the duplicated pair
+        with pytest.raises(ValueError, match="duplicate"):
+            from_edgelist([(0, 1), (0, 1)], n=2, strict=True)
+
+    def test_strict_round_trip_on_simple_graphs(self):
+        from graphdyn.graphs import from_edgelist, powerlaw_graph
+
+        # the documented contract: any simple Graph's edge list passes
+        # strict and reproduces the tables exactly
+        for g in (random_regular_graph(60, 3, seed=4),
+                  powerlaw_graph(90, gamma=2.3, dmin=2, seed=5)):
+            h = from_edgelist(g.edges, n=g.n, strict=True)
+            assert np.array_equal(h.nbr, g.nbr)
+            assert np.array_equal(h.deg, g.deg)
+            assert np.array_equal(h.edges, g.edges)
+
 
 class TestPowerlawGraph:
     def test_validation(self):

@@ -569,9 +569,71 @@ def test_halo_hub_split_layout_and_controls():
     assert plain.hubs is None
     t2 = build_halo_tables(g, plain)
     assert t2.n_hubs == 0 and t2.hub_ring_words == 0
-    # the int8 SA halo layout refuses hub-split partitions explicitly
-    with pytest.raises(NotImplementedError, match="hub"):
-        sa_halo_cols(tables, np.zeros((2, g.n), np.int8))
+    # the int8 SA halo layout replicates every hub's spin into EVERY
+    # shard's hub columns (the vertex-cut invariant) and round-trips
+    s = (2 * np.random.default_rng(8).integers(0, 2, size=(3, g.n)) - 1) \
+        .astype(np.int8)
+    cols = sa_halo_cols(tables, s)
+    view = cols.reshape(3, tables.P, tables.n_rows)
+    h0 = tables.hub_row0
+    for p in range(tables.P):
+        np.testing.assert_array_equal(
+            view[:, p, h0:h0 + tables.n_hubs], s[:, tables.hub_global])
+    np.testing.assert_array_equal(sa_halo_uncols(tables, cols), s)
+
+
+@pytest.mark.parametrize("P", [2, 4])
+def test_sa_halo_hub_split_bit_parity(P):
+    """The sharded SA chain over a hub-split partition is bit-identical
+    to the unsharded solver — in PRNG mode AND under injected streams.
+    The load-bearing step is proposal propagation: a hub flip must land
+    in the replicated hub columns of EVERY shard before the candidate
+    rollout reads any of them, and the injected stream is sized so many
+    in-run proposals actually hit hubs (asserted, not hoped)."""
+    from graphdyn.models.sa import simulated_annealing
+    from graphdyn.parallel.sa_sharded import sa_sharded
+
+    from graphdyn.graphs import powerlaw_graph
+
+    n, R = 96, 8
+    g = powerlaw_graph(n, gamma=2.2, dmin=2, seed=5)
+    thr = int(np.sort(g.deg)[-4])
+    hubs = np.flatnonzero(g.deg >= thr)
+    part = partition_graph(g, P, seed=0, hub_threshold=thr)
+    assert part.hubs is not None and part.hubs.size > 0
+    cfg = SAConfig()
+    mesh = _mesh(8 // P, P)
+
+    # PRNG mode: chains run to convergence or timeout
+    ref = simulated_annealing(g, cfg, n_replicas=R, seed=11,
+                              max_steps=4000, layout="padded")
+    got = sa_sharded(g, cfg, mesh=mesh, n_replicas=R, seed=11,
+                     max_steps=4000, node_mode="halo", partition=part)
+    np.testing.assert_array_equal(got.s, ref.s)
+    np.testing.assert_array_equal(got.num_steps, ref.num_steps)
+    np.testing.assert_array_equal(got.m_final, ref.m_final)
+
+    # injected streams: the proposal sequence provably exercises hubs
+    rng = np.random.default_rng(2)
+    L = 512
+    kw = dict(
+        s0=(2 * rng.integers(0, 2, size=(R, n)) - 1).astype(np.int8),
+        proposals=rng.integers(0, n, size=(R, L)).astype(np.int32),
+        uniforms=rng.random(size=(R, L)),
+        max_steps=L,
+    )
+    ref = simulated_annealing(g, cfg, n_replicas=R, seed=0,
+                              layout="padded", **kw)
+    hub_props = sum(
+        int(np.isin(kw["proposals"][r, :int(ref.num_steps[r])], hubs).sum())
+        for r in range(R)
+    )
+    assert hub_props > 10, "stream never proposed a hub — dead test"
+    got = sa_sharded(g, cfg, mesh=mesh, n_replicas=R, seed=0,
+                     node_mode="halo", partition=part, **kw)
+    np.testing.assert_array_equal(got.s, ref.s)
+    np.testing.assert_array_equal(got.num_steps, ref.num_steps)
+    np.testing.assert_array_equal(got.m_final, ref.m_final)
 
 
 def test_partition_hub_threshold_validation():

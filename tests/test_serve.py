@@ -517,3 +517,53 @@ def test_worker_refuses_underdeclared_bucketed_job(tmp_path):
     rec = spool.load(job)
     assert rec["state"] == REFUSED, rec
     assert "under-priced" in rec["reason"]
+
+
+def test_streamed_twin_admitted_where_resident_twin_refused(tmp_path,
+                                                            monkeypatch):
+    """The ISSUE-19 admission story: under a clamped device budget the
+    SAME declared graph shape is refused on the resident bucketed engine
+    (modeled bytes in the reason) but admitted as ``solver='streamed'``
+    and settles DONE through the worker — the out-of-core route deletes
+    the device-memory cliff instead of re-pricing it."""
+    from graphdyn.graphs import powerlaw_graph
+    from graphdyn.obs.memband import (
+        bucketed_state_bytes,
+        bucketed_table_entries_bound,
+        streamed_min_bytes,
+    )
+
+    n = 512
+    g = powerlaw_graph(n, gamma=2.5, dmin=2, seed=0)
+    E, dmax = int(g.edges.shape[0]), int(g.deg.max())
+    resident = bucketed_state_bytes(n, 1, bucketed_table_entries_bound(n, E))
+    budget = max(3 * resident // 4, 4 * streamed_min_bytes(dmax, 1))
+    assert budget < resident                     # the clamp actually bites
+    monkeypatch.setenv("GRAPHDYN_SERVE_HBM_BUDGET", str(budget))
+
+    shape = {"n": n, "d": 2, "gamma": 2.5, "edges": E, "replicas": 32,
+             "max_sweeps": 4}
+    refused = admit(normalize_spec({**shape, "solver": "bucketed"}))
+    assert not refused.admitted
+    assert f"{refused.model_bytes} B" in refused.reason
+    assert refused.model_bytes == resident > budget
+
+    admitted = admit(normalize_spec(
+        {**shape, "solver": "streamed", "dmax": dmax}))
+    assert admitted.admitted and admitted.kernel == "streamed"
+    assert admitted.model_bytes <= budget
+
+    spool = Spool(str(tmp_path / "serve"))
+    bad = spool.submit({**shape, "solver": "bucketed"}, tenant="t1")
+    good = spool.submit({**shape, "solver": "streamed", "dmax": dmax},
+                        tenant="t1")
+    assert Worker(spool).run_until_drained() == 2
+    rec_bad = spool.load(bad)
+    assert rec_bad["state"] == REFUSED, rec_bad
+    assert f"{resident} B" in rec_bad["reason"]
+    rec_good = spool.load(good)
+    assert rec_good["state"] == DONE, rec_good
+    out = np.load(rec_good["result"])
+    assert out["conf"].shape == (32, n)
+    assert set(np.unique(out["conf"])) <= {-1, 1}
+    assert int(out["chunks"]) >= 2               # it really streamed

@@ -1,0 +1,380 @@
+"""Out-of-core streamed rollout tests (ISSUE 19): bit-parity against the
+resident kernels across the rule × tie × graph-family × chunking matrix,
+stream-plan construction and its refusals, live edge churn against a
+piecewise resident oracle, preemption/resume with journal-alone churn
+replay (a tampered past schedule must not matter), the SA
+``layout='streamed'`` route, and the CLI ``stream`` subcommand."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from graphdyn.config import DynamicsConfig, SAConfig
+from graphdyn.graphs import from_edgelist, powerlaw_graph, random_regular_graph
+from graphdyn.models.sa import sa_ensemble, simulated_annealing
+from graphdyn.ops.bucketed import bucketed_rollout_global
+from graphdyn.ops.packed import pack_spins, packed_rollout, unpack_spins
+from graphdyn.ops.streamed import (
+    ChurnBatch,
+    build_stream_plan,
+    chunk_device_bytes,
+    plan_device_bytes,
+    seeded_churn,
+    streamed_rollout,
+)
+from graphdyn.resilience import (
+    FaultPlan,
+    FaultSpec,
+    ShutdownRequested,
+    graceful_shutdown,
+)
+from graphdyn.resilience.store import journal_path_for, validate_journal
+from graphdyn.utils.io import Checkpoint
+
+
+def _graph(kind, n, seed):
+    if kind == "rrg":
+        return random_regular_graph(n, 3, seed=seed)
+    return powerlaw_graph(n, gamma=2.3, dmin=2, seed=seed)
+
+
+def _sp0(n, R, seed):
+    rng = np.random.default_rng(seed)
+    s0 = (2 * rng.integers(0, 2, size=(R, n)) - 1).astype(np.int8)
+    return pack_spins(s0)
+
+
+# ---------------------------------------------------------------------------
+# bit-parity vs the resident kernels
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["rrg", "powerlaw"])
+@pytest.mark.parametrize("rule,tie", [
+    ("majority", "stay"), ("majority", "change"),
+    ("minority", "stay"), ("minority", "change"),
+])
+@pytest.mark.parametrize("K", [1, 3])
+def test_streamed_matches_resident_kernels(kind, rule, tie, K):
+    g = _graph(kind, 80, seed=4)
+    sp = _sp0(g.n, 32, seed=11)
+    got = streamed_rollout(g, sp, 3, rule=rule, tie=tie, n_chunks=K)
+    ref = np.asarray(packed_rollout(g.nbr, g.deg, sp, 3, rule, tie))
+    np.testing.assert_array_equal(got, ref)
+    ref_b = np.asarray(bucketed_rollout_global(g, sp, 3, rule, tie))
+    np.testing.assert_array_equal(got, ref_b)
+
+
+def test_streamed_budget_mode_parity_and_modeled_peak():
+    g = powerlaw_graph(256, gamma=2.3, dmin=2, seed=7)
+    sp = _sp0(g.n, 64, seed=3)                    # W = 2
+    W = sp.shape[1]
+    resident = chunk_device_bytes(g.n, g.n, int(g.nbr.shape[1]), W)
+    budget = resident // 3
+    plan = build_stream_plan(g, W=W, device_budget_bytes=budget)
+    assert plan.K >= 2                            # actually streaming
+    # every node owned exactly once, and the double-buffer peak honors
+    # the budget the plan was packed against
+    owned = np.sort(np.concatenate([c.nodes for c in plan.chunks]))
+    np.testing.assert_array_equal(owned, np.arange(g.n))
+    np.testing.assert_array_equal(
+        plan.chunk_of[plan.chunks[1].nodes], 1)
+    assert plan_device_bytes(plan, W) <= budget
+    got = streamed_rollout(g, sp, 4, plan=plan)
+    ref = np.asarray(packed_rollout(g.nbr, g.deg, sp, 4))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_streamed_prefetch_depth_is_parity_neutral_and_stats_report():
+    g = _graph("powerlaw", 160, seed=9)
+    sp = _sp0(g.n, 32, seed=1)
+    outs, stats = {}, {}
+    for depth in (0, 2):
+        stats[depth] = {}
+        outs[depth] = streamed_rollout(
+            g, sp, 4, n_chunks=4, prefetch_depth=depth,
+            stats_out=stats[depth])
+    np.testing.assert_array_equal(outs[0], outs[2])
+    for depth in (0, 2):
+        st = stats[depth]
+        assert st["steps"] == 4 and st["chunks"] == 4
+        assert st["h2d_bytes"] > 0 and st["d2h_bytes"] > 0
+        assert 0.0 <= st["overlap_frac"] <= 1.0
+        assert st["mutations"] == 0
+
+
+def test_build_stream_plan_refusals():
+    g = random_regular_graph(32, 3, seed=0)
+    with pytest.raises(ValueError, match="exactly one"):
+        build_stream_plan(g, W=1)
+    with pytest.raises(ValueError, match="exactly one"):
+        build_stream_plan(g, W=1, n_chunks=2, device_budget_bytes=10**6)
+    with pytest.raises(ValueError, match="n_chunks"):
+        build_stream_plan(g, W=1, n_chunks=0)
+    with pytest.raises(ValueError, match="n_chunks"):
+        build_stream_plan(g, W=1, n_chunks=g.n + 1)
+    # infeasible budget names the offending node, not a generic overflow
+    with pytest.raises(ValueError, match="cannot be streamed"):
+        build_stream_plan(g, W=1, device_budget_bytes=64)
+
+
+def test_streamed_rejects_mismatched_state():
+    g = random_regular_graph(16, 3, seed=0)
+    with pytest.raises(ValueError, match="uint32"):
+        streamed_rollout(g, np.zeros((g.n + 1, 1), np.uint32), 1, n_chunks=2)
+
+
+# ---------------------------------------------------------------------------
+# live edge churn vs a piecewise resident oracle
+# ---------------------------------------------------------------------------
+
+
+def _churn_oracle(g, sp, steps, schedule, rule="majority", tie="stay"):
+    """Independent reference: maintain the live adjacency as python sets
+    (the same drops-then-adds idempotent filter semantics) and advance one
+    resident ``packed_rollout`` step per synchronous step."""
+    n = g.n
+    sets = [set(g.nbr[i, : g.deg[i]].astype(int).tolist()) for i in range(n)]
+    applied = 0
+    sp = np.asarray(sp, np.uint32)
+    seq = 0
+    for t in range(steps):
+        while seq < len(schedule) and schedule[seq].step <= t:
+            b = schedule[seq]
+            for u, v in np.asarray(b.drops, np.int64).reshape(-1, 2):
+                u, v = int(u), int(v)
+                if u == v or v not in sets[u]:
+                    continue
+                sets[u].discard(v)
+                sets[v].discard(u)
+                applied += 1
+            for u, v in np.asarray(b.adds, np.int64).reshape(-1, 2):
+                u, v = int(u), int(v)
+                if u == v or v in sets[u]:
+                    continue
+                sets[u].add(v)
+                sets[v].add(u)
+                applied += 1
+            seq += 1
+        edges = np.asarray(
+            [(u, v) for u in range(n) for v in sorted(sets[u]) if u < v],
+            np.int64).reshape(-1, 2)
+        g_t = from_edgelist(edges, n=n)
+        sp = np.asarray(packed_rollout(g_t.nbr, g_t.deg, sp, 1, rule, tie))
+    return sp, applied
+
+
+def test_churn_matches_piecewise_resident_oracle():
+    g = random_regular_graph(64, 3, seed=2)
+    sp = _sp0(g.n, 32, seed=5)
+    schedule = seeded_churn(g.n, 6, rate=8.0, seed=13)
+    assert schedule                               # non-vacuous
+    ref, applied = _churn_oracle(g, sp, 6, schedule)
+    stats = {}
+    got = streamed_rollout(g, sp, 6, n_chunks=3, churn=schedule,
+                           stats_out=stats)
+    np.testing.assert_array_equal(got, ref)
+    assert stats["mutations"] == applied and applied > 0
+
+
+def test_seeded_churn_is_pure_in_its_arguments():
+    a = seeded_churn(50, 5, rate=4.0, seed=3)
+    b = seeded_churn(50, 5, rate=4.0, seed=3)
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert x.step == y.step
+        np.testing.assert_array_equal(x.adds, y.adds)
+        np.testing.assert_array_equal(x.drops, y.drops)
+
+
+# ---------------------------------------------------------------------------
+# preemption / resume — the journal-alone replay contract
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.faultinject
+def test_streamed_preempt_checkpoints_and_resumes_bit_exact(tmp_path):
+    g = random_regular_graph(48, 3, seed=1)
+    sp = _sp0(g.n, 32, seed=8)
+    kw = dict(n_chunks=3, seed=0)
+    base = streamed_rollout(g, sp, 8, **kw)
+    ck = str(tmp_path / "ck")
+    with graceful_shutdown():
+        # the 'signal' action delivers a shutdown request exactly as the
+        # SIGTERM handler would — deterministically, at step boundary 2
+        with FaultPlan([FaultSpec("chunk.boundary", "signal", at=2)]):
+            with pytest.raises(ShutdownRequested):
+                streamed_rollout(g, sp, 8, **kw, checkpoint_path=ck,
+                                 checkpoint_interval_s=1e9)
+    arrays, meta = Checkpoint(ck).load()
+    assert meta["kind"] == "streamed_rollout"
+    assert int(np.asarray(arrays["t"])) == 2      # no older than one step
+    res = streamed_rollout(g, sp, 8, **kw, checkpoint_path=ck,
+                           checkpoint_interval_s=1e9)
+    np.testing.assert_array_equal(base, res)
+    assert not os.path.exists(ck + ".npz")        # done: checkpoint removed
+
+
+@pytest.mark.faultinject
+def test_streamed_resume_replays_churn_from_journal_alone(tmp_path):
+    """A requeued run's past comes from the ``stream.churn`` journal, NOT
+    the schedule argument: resuming with a tampered past schedule still
+    completes bit-exact to the fault-free run (and the journal validates
+    clean)."""
+    g = random_regular_graph(64, 3, seed=6)
+    sp = _sp0(g.n, 32, seed=2)
+    steps = 8
+    schedule = seeded_churn(g.n, steps, rate=10.0, seed=21)
+    base = streamed_rollout(g, sp, steps, n_chunks=3, churn=schedule)
+
+    ck = str(tmp_path / "ck")
+    with graceful_shutdown():
+        with FaultPlan([FaultSpec("chunk.boundary", "signal", at=3)]):
+            with pytest.raises(ShutdownRequested):
+                streamed_rollout(g, sp, steps, n_chunks=3, churn=schedule,
+                                 checkpoint_path=ck,
+                                 checkpoint_interval_s=1e9)
+    arrays, _ = Checkpoint(ck).load()
+    t0 = int(np.asarray(arrays["t"]))
+    assert t0 == 3
+
+    jpath = journal_path_for(ck)
+    events, problems = validate_journal(jpath)
+    assert problems == []
+    churn_past = [ev for ev in events
+                  if ev.get("op") == "stream.churn" and ev["step"] < t0]
+    assert churn_past                             # journaled past exists
+
+    # tamper every already-applied batch: same (step, count) so the seq
+    # cursor aligns, completely different edges — the journal, not this
+    # schedule, must drive the replayed past
+    rng = np.random.default_rng(99)
+    tampered = [
+        ChurnBatch(step=b.step,
+                   adds=rng.integers(0, g.n, size=b.adds.shape,
+                                     dtype=np.int64),
+                   drops=rng.integers(0, g.n, size=b.drops.shape,
+                                      dtype=np.int64))
+        if b.step < t0 else b
+        for b in schedule
+    ]
+    res = streamed_rollout(g, sp, steps, n_chunks=3, churn=tampered,
+                           checkpoint_path=ck, checkpoint_interval_s=1e9)
+    np.testing.assert_array_equal(base, res)
+    _, problems = validate_journal(jpath)
+    assert problems == []
+
+
+# ---------------------------------------------------------------------------
+# overlap evidence — the A/B hiding claim lives in a slow test only
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_prefetch_hides_half_the_gather_time():
+    """At shapes where the device step does real work, the depth-2
+    prefetch lane must hide >= 50% of the host gather/upload time that the
+    depth-0 leg exposes (the ISSUE-19 acceptance A/B)."""
+    g = powerlaw_graph(65536, gamma=2.2, dmin=2, seed=0)
+    sp = _sp0(g.n, 1024, seed=0)                  # W = 32
+    stats0, stats2 = {}, {}
+    streamed_rollout(g, sp, 3, n_chunks=16, prefetch_depth=0,
+                     stats_out=stats0)
+    streamed_rollout(g, sp, 3, n_chunks=16, prefetch_depth=2,
+                     stats_out=stats2)
+    assert stats0["build_s"] > 0
+    assert stats2["overlap_frac"] >= 0.5, (
+        f"prefetch hid only {stats2['overlap_frac']:.1%} of "
+        f"{stats2['build_s']:.3f}s gather time (sync leg: "
+        f"{stats0['build_s']:.3f}s)"
+    )
+
+
+# ---------------------------------------------------------------------------
+# SA layout='streamed' — same chain law through the out-of-core engine
+# ---------------------------------------------------------------------------
+
+
+def _sa_setup(n=48, d=3, R=3, L=300, seed=5):
+    g = random_regular_graph(n, d, seed=seed)
+    rng = np.random.default_rng(seed + 1)
+    s0 = (2 * rng.integers(0, 2, size=(R, n)) - 1).astype(np.int8)
+    proposals = rng.integers(0, n, size=(R, L)).astype(np.int32)
+    uniforms = rng.random(size=(R, L))
+    return g, s0, proposals, uniforms
+
+
+def test_sa_streamed_layout_bit_parity():
+    cfg = SAConfig(dynamics=DynamicsConfig(p=2, c=1))
+    g, s0, proposals, uniforms = _sa_setup()
+    kw = dict(s0=s0, proposals=proposals, uniforms=uniforms)
+    r_str = simulated_annealing(g, cfg, **kw, layout="streamed",
+                                stream_chunks=3)
+    r_pad = simulated_annealing(g, cfg, **kw, layout="padded")
+    r_cpu = simulated_annealing(g, cfg, **kw, backend="cpu")
+    for ref in (r_pad, r_cpu):
+        np.testing.assert_array_equal(r_str.s, ref.s)
+        np.testing.assert_array_equal(r_str.num_steps, ref.num_steps)
+        np.testing.assert_array_equal(r_str.m_final, ref.m_final)
+
+
+def test_sa_streamed_layout_refusals():
+    cfg = SAConfig(dynamics=DynamicsConfig(p=1, c=1))
+    g = random_regular_graph(20, 3, seed=0)
+    with pytest.raises(ValueError, match="out-of-core"):
+        simulated_annealing(g, cfg, layout="streamed", backend="cpu")
+    with pytest.raises(ValueError, match="checkpointed SA chains"):
+        simulated_annealing(g, cfg, layout="streamed", checkpoint_path="/tmp/x")
+    with pytest.raises(ValueError, match="rollout_mode='full'"):
+        simulated_annealing(g, cfg, layout="streamed",
+                            rollout_mode="lightcone")
+
+
+def test_sa_ensemble_streamed_matches_padded_serial():
+    cfg = SAConfig(dynamics=DynamicsConfig(p=1, c=1))
+    kw = dict(n_stat=2, seed=4, max_steps=40)     # sentinel-bounded chains
+    r_str = sa_ensemble(32, 3, cfg, **kw, layout="streamed",
+                        stream_chunks=3)
+    r_pad = sa_ensemble(32, 3, cfg, **kw, layout="padded", group_size=0)
+    np.testing.assert_array_equal(r_str.conf, r_pad.conf)
+    np.testing.assert_array_equal(r_str.num_steps, r_pad.num_steps)
+    np.testing.assert_array_equal(r_str.m_final, r_pad.m_final)
+    np.testing.assert_array_equal(r_str.graphs, r_pad.graphs)
+    with pytest.raises(ValueError, match="group_size"):
+        sa_ensemble(32, 3, cfg, **kw, layout="streamed", group_size=2)
+
+
+# ---------------------------------------------------------------------------
+# CLI stream subcommand
+# ---------------------------------------------------------------------------
+
+
+def test_cli_stream_subcommand_runs_and_saves(tmp_path, capsys):
+    from graphdyn.cli import main
+
+    out = str(tmp_path / "res.npz")
+    rc = main([
+        "stream", "--n", "96", "--gamma", "2.5", "--steps", "4",
+        "--replicas", "8", "--chunks", "3", "--churn-rate", "4.0",
+        "--churn-seed", "1", "--seed", "0", "--out", out,
+    ])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert payload["solver"] == "stream" and payload["chunks"] == 3
+    assert payload["h2d_bytes"] > 0 and payload["mutations"] > 0
+    assert -1.0 <= payload["m_end_mean"] <= 1.0
+    with np.load(out) as z:
+        assert z["conf"].shape == (8, 96)
+        assert set(np.unique(z["conf"])) <= {-1, 1}
+        assert z["m_end"].shape == (8,)
+    # the CLI leg is itself engine-parity: rebuild its exact run and
+    # compare against the resident kernel end state
+    g = powerlaw_graph(96, gamma=2.5, dmin=2, seed=0)
+    rng = np.random.default_rng(0)
+    s0 = (2 * rng.integers(0, 2, size=(8, 96)) - 1).astype(np.int8)
+    schedule = seeded_churn(96, 4, rate=4.0, seed=1)
+    ref, _ = _churn_oracle(g, pack_spins(s0), 4, schedule)
+    with np.load(out) as z:
+        np.testing.assert_array_equal(z["conf"], unpack_spins(ref, 8))
