@@ -815,6 +815,206 @@ def churn_rate_row(smoke: bool, *, n=None, R=None, steps=None,
     }
 
 
+def stream_shard_scaling_row(smoke: bool, *, n_per=None, R=None,
+                             steps=None, iters=None):
+    """Weak scaling of the sharded streamed engine
+    (``graphdyn.parallel.stream``): FIXED bytes per shard — each of P
+    shards owns ``n_per`` power-law nodes and streams them under the SAME
+    per-shard device budget (1/4 of the P=1 resident model, so every
+    shard MUST chunk), P ∈ {1, 2, 4, 8} capped at the device pool;
+    efficiency = rate(P) / (P · rate(1)). The P=1 leg is the unsharded
+    ``streamed_rollout`` on the identical budget — exactly the
+    ``partition=`` path's identity — so the column prices the ppermute
+    exchange + shard bookkeeping and nothing else. Fewer than 2 devices
+    emits null + reason, never 0.0."""
+    import jax
+
+    from benchmarks.common import draw_u32
+    from graphdyn import obs
+    from graphdyn.graphs import (
+        degree_buckets,
+        partition_graph,
+        powerlaw_graph,
+    )
+    from graphdyn.obs import memband
+    from graphdyn.ops.streamed import build_stream_plan, streamed_rollout
+    from graphdyn.parallel.mesh import make_mesh
+    from graphdyn.parallel.stream import sharded_streamed_rollout
+
+    # ONE device pool for every leg (same discipline as halo_weak_scaling):
+    # mixing platforms across P would bench hardware, not the exchange
+    pool = jax.devices()
+    if len(pool) < 2:
+        try:
+            cpu = jax.devices("cpu")
+        except RuntimeError:
+            cpu = []
+        if len(cpu) >= 2:
+            pool = cpu
+    if len(pool) < 2:
+        reason = (
+            f"sharded stream scaling needs >= 2 devices on one platform "
+            f"(have {len(pool)}); on CPU force a simulated host mesh: "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+        return {
+            "stream_shard_efficiency": None,
+            "stream_shard_efficiency_skipped_reason": reason,
+        }
+    avail = len(pool)
+
+    defaults = (512, 128, 5, 2) if smoke else (8192, 512, 8, 2)
+    n_per = n_per if n_per is not None else defaults[0]
+    R = R if R is not None else defaults[1]
+    steps = steps if steps is not None else defaults[2]
+    iters = iters if iters is not None else defaults[3]
+    W = R // 32
+
+    # the per-shard budget is FIXED from the P=1 graph's resident model:
+    # every P leg hands each shard the same bytes, so each shard's chunk
+    # run stays ~constant and the efficiency column isolates the exchange
+    g1 = powerlaw_graph(n_per, gamma=2.2, dmin=2, seed=0)
+    resident = int(memband.bucketed_state_bytes(
+        n_per, W, int(degree_buckets(g1).table_entries)))
+    base_budget = resident // 4
+
+    rates: dict[str, float] = {}
+    chunks_by_p: dict[str, int] = {}
+    for Pn in (1, 2, 4, 8):
+        if Pn > avail:
+            break
+        g = powerlaw_graph(Pn * n_per, gamma=2.2, dmin=2, seed=0)
+        # the single-node feasibility floor is per-graph: the widest row
+        # must fit one device, double-buffered (same clamp as stream_rate)
+        budget = max(base_budget,
+                     2 * int(memband.streamed_min_bytes(
+                         int(g.deg.max()), W)))
+        sp = np.asarray(draw_u32(0, (g.n, W)))
+        stats: dict = {}
+        if Pn == 1:
+            plan = build_stream_plan(g, W=W, device_budget_bytes=budget)
+            streamed_rollout(g, sp, 1, plan=plan)  # warm
+            with obs.timed("bench.stream_shard", P=Pn) as sw:
+                for _ in range(iters):
+                    streamed_rollout(g, sp, steps, plan=plan,
+                                     stats_out=stats)
+        else:
+            part = partition_graph(g, Pn, seed=0)
+            mesh = make_mesh((Pn,), ("node",), devices=pool[:Pn])
+            sharded_streamed_rollout(g, sp, 1, n_shards=Pn,
+                                     device_budget_bytes=budget,
+                                     partition=part, mesh=mesh)  # warm
+            with obs.timed("bench.stream_shard", P=Pn) as sw:
+                for _ in range(iters):
+                    sharded_streamed_rollout(
+                        g, sp, steps, n_shards=Pn,
+                        device_budget_bytes=budget, partition=part,
+                        mesh=mesh, stats_out=stats)
+        rates[str(Pn)] = g.n * R * steps * iters / sw.wall_s
+        chunks_by_p[str(Pn)] = int(stats.get("chunks", 0))
+        obs.gauge("ops.stream_shard.rate", rates[str(Pn)], P=Pn, n=g.n,
+                  R=R)
+        _mark(f"stream shard scaling P={Pn}: n={g.n} "
+              f"rate {rates[str(Pn)]:.3e}")
+    p_max = max(int(k) for k in rates)
+    return {
+        "stream_shard_efficiency": rates[str(p_max)] / (p_max * rates["1"]),
+        "stream_shard_rate_by_shards": rates,
+        "stream_shard_workload": {
+            "n_per_shard": n_per, "gamma": 2.2, "dmin": 2, "R": R,
+            "steps": steps, "iters": iters, "P_max": p_max,
+            "budget_per_shard_bytes": base_budget,
+            "chunks_by_shards": chunks_by_p,
+            "platform": pool[0].platform,
+        },
+    }
+
+
+def churn_repartition_rate_row(smoke: bool, *, n=None, R=None, steps=None,
+                               churn_per_step=None):
+    """Live churn-driven repartition through the SHARDED streamed engine
+    (``graphdyn.parallel.stream``): a seeded high-rate mutation schedule
+    pushes nodes across the hub threshold while P=2 shards keep
+    advancing — promotions become vertex-cut hubs (and fallen hubs
+    demote) at chunk boundaries, with only the touched chunks and the
+    exchange schedule rebuilt. The row is applied mutations per second
+    over the mutation + rebuild wall clock (plan build excluded) with
+    repartition live; the detail carries the repartition and
+    rebuilt-chunk counts as proof the re-layout actually fired. Fewer
+    than 2 devices emits null + reason, never 0.0."""
+    import jax
+
+    from benchmarks.common import draw_u32
+    from graphdyn import obs
+    from graphdyn.graphs import powerlaw_graph
+    from graphdyn.ops.streamed import seeded_churn
+    from graphdyn.parallel.mesh import make_mesh
+    from graphdyn.parallel.stream import sharded_streamed_rollout
+
+    pool = jax.devices()
+    if len(pool) < 2:
+        try:
+            cpu = jax.devices("cpu")
+        except RuntimeError:
+            cpu = []
+        if len(cpu) >= 2:
+            pool = cpu
+    if len(pool) < 2:
+        reason = (
+            f"sharded churn repartition needs >= 2 devices on one "
+            f"platform (have {len(pool)}); on CPU force a simulated host "
+            "mesh: XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+        return {
+            "churn_repartition_rate": None,
+            "churn_repartition_rate_skipped_reason": reason,
+        }
+
+    defaults = (1024, 128, 6, 32.0) if smoke else (16384, 512, 12, 512.0)
+    n = n if n is not None else defaults[0]
+    R = R if R is not None else defaults[1]
+    steps = steps if steps is not None else defaults[2]
+    churn_per_step = (churn_per_step if churn_per_step is not None
+                      else defaults[3])
+    W = R // 32
+
+    g = powerlaw_graph(n, gamma=2.2, dmin=2, seed=0)
+    # a threshold straddled by the degree tail: churn at this rate pushes
+    # nodes across it in both directions, so the drive exercises promote
+    # AND demote repartitions (counts land in the detail)
+    thr = max(int(g.deg.max()) // 2, 4)
+    schedule = seeded_churn(n, steps, rate=churn_per_step, seed=7)
+    mesh = make_mesh((2,), ("node",), devices=pool[:2])
+    sp = np.asarray(draw_u32(0, (n, W)))
+    stats: dict = {}
+    with obs.timed("bench.churn_repartition", n=n) as sw:
+        sharded_streamed_rollout(g, sp, steps, n_shards=2, n_chunks=4,
+                                 hub_threshold=thr, mesh=mesh,
+                                 churn=schedule, stats_out=stats)
+    applied = int(stats.get("mutations", 0))
+    wall = max(sw.wall_s - float(stats.get("build_s", 0.0)), 1e-9)
+    rate = applied / wall
+    obs.gauge("ops.stream_shard.churn_rate", rate, n=n, applied=applied,
+              repartitions=int(stats.get("repartitions", 0)))
+    _mark(f"churn repartition rate: n={n} applied={applied} "
+          f"repartitions={stats.get('repartitions', 0)} "
+          f"rate {rate:.3e}/s")
+    return {
+        "churn_repartition_rate": rate,
+        "churn_repartition_rate_detail": {
+            "applied_mutations": applied,
+            "repartitions": int(stats.get("repartitions", 0)),
+            "chunks_rebuilt": int(stats.get("chunks_rebuilt", 0)),
+            "scheduled_batches": len(schedule),
+            "spin_update_rate": n * R * steps / sw.wall_s,
+            "hub_threshold": thr,
+            "shards": 2,
+            "workload": {"n": n, "R": R, "steps": steps,
+                         "churn_per_step": churn_per_step, "seed": 7},
+        },
+    }
+
+
 def tta_rows(smoke: bool):
     """Time-to-target-magnetization A/B (ROADMAP item 3): device steps
     until the rolled-out end-state magnetization first reaches the target,
@@ -1415,6 +1615,26 @@ def main():
             "churn_rate": None,
             "churn_rate_skipped_reason":
                 f"churn drive failed: {str(e)[:150]}",
+        })
+    _mark("sharded streamed weak scaling (stream_shard_scaling)")
+    try:
+        extra.update(stream_shard_scaling_row(args.smoke))
+    except Exception as e:  # noqa: BLE001 — optional row, never silent
+        _mark(f"stream shard scaling row failed: {str(e)[:150]}")
+        extra.update({
+            "stream_shard_efficiency": None,
+            "stream_shard_efficiency_skipped_reason":
+                f"sharded stream scaling failed: {str(e)[:150]}",
+        })
+    _mark("churn-driven live repartition (churn_repartition_rate)")
+    try:
+        extra.update(churn_repartition_rate_row(args.smoke))
+    except Exception as e:  # noqa: BLE001 — optional row, never silent
+        _mark(f"churn repartition rate row failed: {str(e)[:150]}")
+        extra.update({
+            "churn_repartition_rate": None,
+            "churn_repartition_rate_skipped_reason":
+                f"sharded churn repartition drive failed: {str(e)[:150]}",
         })
     _mark("time-to-target search A/B (tta_tempering / tta_chromatic)")
     try:

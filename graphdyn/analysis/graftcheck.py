@@ -459,6 +459,33 @@ def _build_streamed_chunk(n: int = 256, W: int = 4, n_chunks: int = 3):
     return lower_streamed_chunk(plan.chunks[-1], W=W)
 
 
+def _build_streamed_halo(n: int = 200):
+    from graphdyn.graphs import partition_graph, powerlaw_graph
+    from graphdyn.parallel.mesh import device_pool, make_mesh
+    from graphdyn.parallel.stream import lower_stream_exchange
+
+    # the composed streamed x sharded exchange program (the per-step slab
+    # the chunk walk hands the mesh): like halo_rollout it only EXISTS at
+    # P >= 2, so this entry needs two devices. The canonical graph is a
+    # hub-split power-law partition — hubs vertex-cut at threshold 12 —
+    # so the fingerprint pins BOTH collective legs: the hub bit-plane
+    # ring and one collective-permute slab per schedule offset, with the
+    # previous hub state donated into the carry. The regression this
+    # ledger row exists to catch is the exchange silently deoptimizing
+    # into a full-state all-gather (GD013).
+    try:
+        devices = device_pool(2)
+    except RuntimeError as e:
+        raise UnsupportedEntry(
+            f"streamed_halo needs a 2-device mesh: {e} (force a simulated "
+            "host platform: XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+        ) from e
+    mesh = make_mesh((2,), ("node",), devices=devices[:2])
+    g = powerlaw_graph(n, gamma=2.3, dmin=2, seed=0)
+    part = partition_graph(g, 2, seed=0, hub_threshold=12)
+    return lower_stream_exchange(mesh, g, part, W=4)
+
+
 def _temper_config():
     from graphdyn.config import DynamicsConfig, SAConfig
 
@@ -526,6 +553,16 @@ ENTRIES: dict[str, EntrySpec] = {
         _build_halo_rollout, donates=True,
         canon="2-device node mesh, RRG n=128 d=3, P=2 partition, W=4, "
               "steps=2",
+    ),
+    # the composed streamed x sharded exchange (PR 20): boundary words +
+    # hub bit-plane partial popcounts riding the ppermute slab / hub-ring
+    # schedule between chunk walks — donates=True pins the hub carry,
+    # and the op-category band pins "collective-permute only, never an
+    # all-gather" for the composed engine's per-step device program
+    "streamed_halo": EntrySpec(
+        _build_streamed_halo, donates=True,
+        canon="2-device node mesh, power-law n=200 gamma=2.3 dmin=2 "
+              "seed=0, P=2 hub-split partition (threshold 12), W=4",
     ),
     # the swap-move program: the while-count band pins "ONE Metropolis
     # while-loop then the swap as straight-line ops" (a host round-trip or

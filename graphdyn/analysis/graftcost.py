@@ -369,6 +369,17 @@ COST_ENTRIES: dict[str, CostEntrySpec] = {
         (48, 64, 96), 80, {**_LINEAR, "collective_bytes": 1.0}),
     "halo_rollout": CostEntrySpec(
         (96, 128, 192), 160, {**_LINEAR, "collective_bytes": 1.0}),
+    # the composed streamed x sharded exchange step (PR 20): one chunk
+    # boundary's ppermute slab + hub bit-plane ring over the seeded
+    # power-law family (P=2, hub_threshold=12, W=4). Calibration starts
+    # at n=192: below that the fixed threshold leaves almost no hubs and
+    # the program is constant-dominated (96->128 is FLAT, then the hub
+    # count knees) — from 192 up the slab/hub structure tracks n and the
+    # measured exponents sit at 0.95..1.03, with the same seeded
+    # realization jitter allowance as the bucketed / streamed families
+    "streamed_halo": CostEntrySpec(
+        (192, 256, 512), 384, {**_LINEAR, "collective_bytes": 1.0},
+        residual_tol=0.25),
     # same intercept-dominated shape as sa_group_loop (the ladder's swap
     # machinery is K-, not n-, extensive)
     "tempering_ladder": CostEntrySpec(

@@ -333,6 +333,21 @@ def build_parser() -> argparse.ArgumentParser:
              "overlap A/B baseline)",
     )
     strm.add_argument(
+        "--shards", type=int, default=1, metavar="P",
+        help="shard the chunk walk over P devices (parallel/stream): each "
+             "shard owns a part-major chunk run and streams it on its own "
+             "prefetch lane; boundary words + hub partials ride the halo "
+             "ppermute/ring schedule; --chunks / --device-budget apply PER "
+             "SHARD (bit-exact to --shards 1 at any P)",
+    )
+    strm.add_argument(
+        "--hub-threshold", type=int, default=None, metavar="D",
+        help="with --shards >= 2: vertex-cut replicate nodes of degree >= "
+             "D, and let churn re-partition live (a churned node crossing "
+             "D is promoted to a hub at the chunk boundary, journaled as "
+             "stream.repartition)",
+    )
+    strm.add_argument(
         "--churn-rate", type=float, default=0.0, metavar="R",
         help="live edge churn: Poisson(R/2) adds + drops per step, applied "
              "at chunk boundaries with incremental table rebuild "
@@ -880,11 +895,12 @@ def _run(args) -> int:
                 "pass --sharded as well (the per-repetition driver has no "
                 "node axis to shard)"
             )
-        if args.sharded and args.layout not in ("auto", "padded"):
+        if args.sharded and args.layout not in ("auto", "padded", "streamed"):
             raise SystemExit(
                 f"--layout {args.layout} selects a per-repetition driver "
-                "layout; the mesh solver shards the padded node axis "
-                "(drop --sharded, or --layout auto/padded)"
+                "layout; the mesh solver shards the padded node axis or "
+                "streams part-major chunk runs (drop --sharded, or "
+                "--layout auto/padded/streamed)"
             )
         if args.sharded:
             import jax
@@ -913,7 +929,9 @@ def _run(args) -> int:
                         f"--shards {args.shards} > {n_dev} visible devices"
                     )
                 node_shards = args.shards
-                if node_shards >= 2:
+                if node_shards >= 2 and args.layout != "streamed":
+                    # layout='streamed' runs its own halo composition
+                    # inside the sharded streamed engine
                     node_mode = "halo"
             # lightcone needs whole replicas per device (replica-only mesh);
             # full mode splits the node axis when it can
@@ -940,6 +958,8 @@ def _run(args) -> int:
                 rollout_mode=args.rollout_mode,
                 node_mode=node_mode,
                 chunk_steps=args.chunk_steps,
+                layout="streamed" if args.layout == "streamed" else "padded",
+                stream_chunks=args.stream_chunks,
             )
             if args.out:
                 save_results_npz(
@@ -989,27 +1009,55 @@ def _run(args) -> int:
                               seed=args.churn_seed)
                  if args.churn_rate > 0 else None)
         stats: dict = {}
-        sp_end = streamed_rollout(
-            g, pack_spins(s0), args.steps,
-            rule=args.rule, tie=args.tie,
-            n_chunks=None if args.device_budget is not None else args.chunks,
-            device_budget_bytes=args.device_budget,
-            prefetch_depth=args.prefetch_depth, churn=churn,
-            checkpoint_path=args.checkpoint,
-            checkpoint_interval_s=args.checkpoint_interval,
-            seed=args.seed, stats_out=stats,
-        )
+        if args.shards < 1:
+            raise SystemExit("--shards must be >= 1")
+        if args.shards >= 2:
+            import jax
+
+            from graphdyn.parallel.stream import sharded_streamed_rollout
+
+            n_dev = len(jax.devices())
+            if args.shards > n_dev:
+                raise SystemExit(
+                    f"--shards {args.shards} > {n_dev} visible devices"
+                )
+            sp_end = sharded_streamed_rollout(
+                g, pack_spins(s0), args.steps, n_shards=args.shards,
+                rule=args.rule, tie=args.tie,
+                n_chunks=(None if args.device_budget is not None
+                          else args.chunks),
+                device_budget_bytes=args.device_budget,
+                hub_threshold=args.hub_threshold,
+                prefetch_depth=args.prefetch_depth, churn=churn,
+                checkpoint_path=args.checkpoint,
+                checkpoint_interval_s=args.checkpoint_interval,
+                seed=args.seed, stats_out=stats,
+            )
+        else:
+            sp_end = streamed_rollout(
+                g, pack_spins(s0), args.steps,
+                rule=args.rule, tie=args.tie,
+                n_chunks=(None if args.device_budget is not None
+                          else args.chunks),
+                device_budget_bytes=args.device_budget,
+                prefetch_depth=args.prefetch_depth, churn=churn,
+                checkpoint_path=args.checkpoint,
+                checkpoint_interval_s=args.checkpoint_interval,
+                seed=args.seed, stats_out=stats,
+            )
         s_end = unpack_spins(sp_end, args.replicas)
         m_end = s_end.astype(np.float64).sum(axis=1) / args.n  # graftlint: disable=GD004  host observable, exact sum
         if args.out:
             save_results_npz(args.out, conf=s_end, m_end=m_end)
         print(json.dumps({
             "solver": "stream", "n": args.n, "steps": args.steps,
+            "shards": args.shards,
             "chunks": stats.get("chunks"),
             "overlap_frac": stats.get("overlap_frac"),
             "h2d_bytes": stats.get("h2d_bytes"),
             "d2h_bytes": stats.get("d2h_bytes"),
             "mutations": stats.get("mutations"),
+            "repartitions": stats.get("repartitions"),
             "m_end_mean": float(m_end.mean()),
             "out": args.out,
         }))

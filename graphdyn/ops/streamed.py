@@ -183,38 +183,31 @@ def _build_chunk(nodes: np.ndarray, adj: list[np.ndarray]) -> StreamChunk:
     )
 
 
-def build_stream_plan(graph: Graph, *, W: int, n_chunks: int | None = None,
-                      device_budget_bytes: int | None = None,
-                      adj: list[np.ndarray] | None = None) -> StreamPlan:
-    """Partition the node axis into host-resident chunks.
-
-    Nodes are walked in :func:`graphdyn.graphs.degree_buckets` order
-    (degree-ascending) so each chunk's power-of-two padded width is tight
-    — the same layout economics as the bucketed kernel, per chunk.
-
-    Exactly one of ``n_chunks`` (fixed chunk count, contiguous equal
-    slices) or ``device_budget_bytes`` must be given. Budget mode packs
-    greedily: a chunk closes when its modeled bytes
-    (:func:`chunk_device_bytes`, using the conservative slab bound
-    ``M ≤ C + Σdeg``) would exceed **half** the budget — two chunks are
-    resident at once under double-buffered prefetch. Raises
-    ``ValueError`` when even a single node cannot fit (admission performs
-    the same feasibility check up front).
-    """
+def _split_stream_groups(order: np.ndarray, adj: list[np.ndarray], *,
+                         W: int, n_chunks: int | None = None,
+                         device_budget_bytes: int | None = None,
+                         n_total: int | None = None) -> list[np.ndarray]:
+    """The chunk-grouping walk shared by the single-device plan and the
+    per-shard runs of the sharded plan: split ``order`` (degree-ascending
+    node ids) into contiguous groups, either ``n_chunks`` equal slices or
+    greedily packed against half of ``device_budget_bytes`` (two chunks
+    resident at once under double-buffered prefetch). ``n_total`` only
+    shapes the ``n_chunks`` range error message."""
     if (n_chunks is None) == (device_budget_bytes is None):
         raise ValueError(
             "pass exactly one of n_chunks or device_budget_bytes"
         )
-    if adj is None:
-        adj = _adjacency_lists(graph)
-    order = degree_buckets(graph).order
+    order = np.asarray(order, np.int64)
+    if n_total is None:
+        n_total = order.size
     groups: list[np.ndarray] = []
     if n_chunks is not None:
-        if not 1 <= n_chunks <= max(graph.n, 1):
+        if not 1 <= n_chunks <= max(n_total, 1):
             raise ValueError(
-                f"n_chunks must be in [1, {graph.n}], got {n_chunks}"
+                f"n_chunks must be in [1, {n_total}], got {n_chunks}"
             )
-        groups = [g for g in np.array_split(order, n_chunks) if g.size]
+        parts = min(n_chunks, max(order.size, 1))
+        groups = [g for g in np.array_split(order, parts) if g.size]
     else:
         half = device_budget_bytes // 2
         cur: list[int] = []
@@ -241,6 +234,50 @@ def build_stream_plan(graph: Graph, *, W: int, n_chunks: int | None = None,
             deg_sum += d
         if cur:
             groups.append(np.asarray(cur, np.int64))
+    return groups
+
+
+def build_stream_plan(graph: Graph, *, W: int, n_chunks: int | None = None,
+                      device_budget_bytes: int | None = None,
+                      adj: list[np.ndarray] | None = None,
+                      partition=None):
+    """Partition the node axis into host-resident chunks.
+
+    Nodes are walked in :func:`graphdyn.graphs.degree_buckets` order
+    (degree-ascending) so each chunk's power-of-two padded width is tight
+    — the same layout economics as the bucketed kernel, per chunk.
+
+    Exactly one of ``n_chunks`` (fixed chunk count, contiguous equal
+    slices) or ``device_budget_bytes`` must be given. Budget mode packs
+    greedily: a chunk closes when its modeled bytes
+    (:func:`chunk_device_bytes`, using the conservative slab bound
+    ``M ≤ C + Σdeg``) would exceed **half** the budget — two chunks are
+    resident at once under double-buffered prefetch. Raises
+    ``ValueError`` when even a single node cannot fit (admission performs
+    the same feasibility check up front).
+
+    ``partition=`` (a :class:`graphdyn.graphs.Partition`) switches to the
+    SHARDED plan: each of P shards owns a part-major contiguous run of
+    chunks (its owned non-hub nodes, degree-ascending; hubs stay
+    vertex-cut replicated) and ``n_chunks``/``device_budget_bytes`` apply
+    PER SHARD. Returns a
+    :class:`graphdyn.parallel.stream.ShardStreamPlan` — the layout
+    :func:`graphdyn.parallel.stream.sharded_streamed_rollout` walks.
+    """
+    if partition is not None:
+        from graphdyn.parallel.stream import build_shard_stream_plan
+
+        return build_shard_stream_plan(
+            graph, W=W, partition=partition, n_chunks=n_chunks,
+            device_budget_bytes=device_budget_bytes, adj=adj,
+        )
+    if adj is None:
+        adj = _adjacency_lists(graph)
+    order = degree_buckets(graph).order
+    groups = _split_stream_groups(
+        order, adj, W=W, n_chunks=n_chunks,
+        device_budget_bytes=device_budget_bytes, n_total=graph.n,
+    )
     chunks = tuple(_build_chunk(g, adj) for g in groups)
     chunk_of = np.empty(graph.n, np.int32)
     for k, ch in enumerate(chunks):

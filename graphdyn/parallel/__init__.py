@@ -17,3 +17,10 @@ from graphdyn.parallel.halo import (  # noqa: F401
     make_halo_rollout,
 )
 from graphdyn.parallel.sa_sharded import make_sharded_sa_solver, sa_sharded  # noqa: F401
+from graphdyn.parallel.stream import (  # noqa: F401
+    ShardChunk,
+    ShardStreamPlan,
+    build_shard_stream_plan,
+    make_stream_exchange,
+    sharded_streamed_rollout,
+)

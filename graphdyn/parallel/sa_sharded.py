@@ -578,6 +578,9 @@ def sa_sharded(
     lc_tables=None,
     node_mode: str = "gather",
     partition=None,
+    layout: str = "padded",
+    stream_chunks: int = 4,
+    hub_threshold: int | None = None,
 ) -> SAResult:
     """Run batched SA chains to completion over a device mesh.
 
@@ -617,6 +620,16 @@ def sa_sharded(
     this path). Chains, snapshots, and the resume contract are identical
     to the gather mode (snapshots store the unpadded GLOBAL state, so runs
     resume across node modes, mesh shapes, and shard counts — tested).
+
+    ``layout='streamed'`` (ISSUE 20) is the out-of-core composition: the
+    chain is host-stepped exactly like the unsharded ``layout='streamed'``
+    route, with every candidate end-sum computed by
+    :func:`graphdyn.parallel.stream.sharded_streamed_rollout` — each of
+    the mesh's ``node_axis`` shards walks its own part-major chunk run
+    (``stream_chunks`` per shard) while boundary words + hub partials
+    (``hub_threshold``) ride the halo collectives. Bit-identical chains
+    to ``layout='padded'`` under injected streams; no chunked-chain
+    resume (refuses ``checkpoint_path``).
     """
     config = config or SAConfig()
     n = graph.n
@@ -632,6 +645,50 @@ def sa_sharded(
     node_shards = int(mesh.shape[node_axis])
     np_dt = np.float32 if dtype == jnp.float32 else np.float64  # graftlint: disable=GD004  dtype mirror for host results
     t_dt = np.int64 if jax.config.jax_enable_x64 else np.int32
+
+    if layout not in ("padded", "streamed"):
+        raise ValueError(
+            f"layout must be 'padded' or 'streamed', got {layout!r} "
+            "(degree-bucketed layouts relabel nodes — use the unsharded "
+            "solver's layout='bucketed')"
+        )
+    if layout == "streamed":
+        # the out-of-core composition (ISSUE 20): the chain is
+        # host-stepped exactly like the unsharded layout='streamed'
+        # route, with every candidate end-sum computed by the SHARDED
+        # streamed engine — P prefetch lanes walking part-major chunk
+        # runs, boundary words + hub partials on the halo collectives
+        if rollout_mode != "full":
+            raise ValueError(
+                "layout='streamed' pages state through host RAM; "
+                "rollout_mode='lightcone' caches device-resident "
+                "trajectories — use rollout_mode='full'"
+            )
+        if checkpoint_path is not None:
+            raise ValueError(
+                "layout='streamed' has no chunked-chain resume (the "
+                "chain is host-stepped; the streamed rollout's own "
+                "checkpoints cover serve jobs, not this chain) — use "
+                "layout='padded' for checkpointed SA chains"
+            )
+        if lc_tables is not None:
+            raise ValueError("lc_tables requires rollout_mode='lightcone'")
+        if node_mode != "gather":
+            raise ValueError(
+                "layout='streamed' runs its own halo composition inside "
+                "the streamed engine — drop node_mode='halo'"
+            )
+        if partition is not None and partition.P != node_shards:
+            raise ValueError(
+                f"partition has P={partition.P} parts but the mesh "
+                f"{node_axis!r} axis has size {node_shards}"
+            )
+        return _sa_sharded_streamed(
+            graph, config, prep, mesh=mesh, node_axis=node_axis,
+            node_shards=node_shards, dtype=dtype, np_dt=np_dt,
+            stream_chunks=stream_chunks, hub_threshold=hub_threshold,
+            partition=partition,
+        )
 
     if rollout_mode not in ("full", "lightcone"):
         raise ValueError(
@@ -949,4 +1006,89 @@ def sa_sharded(
         mag_reached=mag,
         num_steps=np.asarray(state[4])[:R],
         m_final=np.asarray(state[5])[:R],
+    )
+
+
+def _sa_sharded_streamed(
+    graph, config, prep, *, mesh, node_axis, node_shards, dtype, np_dt,
+    stream_chunks, hub_threshold, partition,
+):
+    """``layout='streamed'`` under ``sa_sharded``: the SAME serial
+    Metropolis chain law as :func:`graphdyn.models.sa._sa_streamed`, with
+    every candidate end-sum computed by the SHARDED out-of-core engine
+    (:func:`graphdyn.parallel.stream.sharded_streamed_rollout`) — P
+    prefetch lanes walking part-major chunk runs, boundary words + hub
+    partials riding the halo collectives. Bit-parity with
+    ``layout='padded'`` (sharded or not) is structural: the sharded
+    streamed engine is bit-exact to the packed kernel, and the proposal
+    draw + Metropolis/anneal arithmetic are literally the same shared
+    helpers on the same dtype. Node labeling is the caller's throughout."""
+    from graphdyn.graphs import partition_graph
+    from graphdyn.models.sa import (
+        draw_sa_proposal as _draw,
+        metropolis_anneal_update as _update,
+    )
+    from graphdyn.ops.packed import WORD, pack_spins, unpack_spins
+    from graphdyn.parallel.stream import sharded_streamed_rollout
+
+    n = graph.n
+    dyn = config.dynamics
+    rollout = dyn.p + dyn.c - 1
+    (R, seed, s0, a0, b0, proposals, uniforms,
+     max_steps, stream_len, injected) = prep
+    W = -(-R // WORD)
+    if partition is None:
+        partition = partition_graph(
+            graph, node_shards, seed=seed or 0, hub_threshold=hub_threshold,
+        )
+
+    def end_sums(s_batch):
+        out = sharded_streamed_rollout(
+            graph, pack_spins(np.asarray(s_batch)), rollout,
+            n_shards=node_shards, rule=dyn.rule, tie=dyn.tie,
+            n_chunks=stream_chunks, hub_threshold=hub_threshold,
+            partition=partition, mesh=mesh, node_axis=node_axis,
+        )
+        return jnp.asarray(unpack_spins(out, R).astype(np.int32).sum(axis=1))
+
+    s = jnp.asarray(s0)
+    a_v = jnp.asarray(a0.astype(np_dt))
+    b_v = jnp.asarray(b0.astype(np_dt))
+    dt = a_v.dtype
+    key = jax.vmap(jax.random.PRNGKey)(
+        np.arange(R, dtype=np.uint32) + np.uint32(seed))
+    sum_end = end_sums(s0)
+    m0 = sum_end.astype(dt) / n
+    t = jnp.zeros((R,), jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+    m_final = m0
+    active = m0 < 1.0
+    par_a = jnp.asarray(np_dt(config.par_a))
+    par_b = jnp.asarray(np_dt(config.par_b))
+    a_cap = jnp.asarray(np_dt(config.a_cap_frac * n))
+    b_cap = jnp.asarray(np_dt(config.b_cap_frac * n))
+    prop_j = jnp.asarray(proposals)
+    unif_j = jnp.asarray(uniforms.astype(np_dt))
+    ridx = jnp.arange(R, dtype=jnp.int32)
+    # graftlint: disable-next-line=GD015  streamed layout: state pages through host RAM between proposals, so the chain is host-stepped by design — the per-step readback IS the chunk boundary; layout='padded' keeps the fused on-device annealer
+    while bool(jnp.any(active)):
+        i, u = _draw(
+            key, t, prop_j, unif_j,
+            injected=injected, stream_len=stream_len, n=n, dt=dt,
+        )
+        s_i = s[ridx, i].astype(jnp.int32)
+        s_flip = s.at[ridx, i].set((-s_i).astype(jnp.int8))
+        sum_end_flip = end_sums(s_flip)
+        do, sum_end, a_v, b_v, t, m_final, active = _update(
+            active, a_v, b_v, t, m_final, sum_end, sum_end_flip, s_i, u,
+            par_a=par_a, par_b=par_b, a_cap=a_cap, b_cap=b_cap,
+            max_steps=max_steps, n=n,
+        )
+        s = jnp.where(do[:, None], s_flip, s)
+    s_final = np.asarray(s)
+    mag = s_final.astype(np.float64).sum(axis=1) / n  # graftlint: disable=GD004  host observable, exact sum
+    return SAResult(
+        s=s_final,
+        mag_reached=mag.astype(np_dt),
+        num_steps=np.asarray(t),
+        m_final=np.asarray(m_final),
     )

@@ -132,6 +132,10 @@ class Episode:
     expect: int = EX_TEMPFAIL
     pre: str | None = None          # "truncate_current" | "nuke_primary"
     extra_args: tuple = ()
+    #: subcommand flags appended AFTER the workload's own (argparse is
+    #: last-wins, so an episode can override a workload default — e.g.
+    #: requeue onto a shrunk ``--shards``)
+    post_args: tuple = ()
 
 
 @dataclasses.dataclass
@@ -224,6 +228,21 @@ def _plan_episodes(name: str, rng: np.random.Generator) -> list[Episode]:
             Episode(specs=[{"site": "chunk.boundary", "action": "signal",
                             "at": int(rng.integers(2, 5))}]),
             Episode(specs=[], expect=EX_OK),
+        ]
+    if name == "stream_shard_requeue":
+        # kill the SHARDED streamed run at a chunk boundary mid-churn,
+        # then requeue onto a SHRUNK shard count: the snapshot + journal
+        # alone must reproduce the exact global state — the requeued
+        # process replays the journaled mutations, re-partitions the
+        # replayed graph fresh at the new shard count (layout
+        # independence makes any partition bit-exact), and the surviving
+        # journal keeps the full churn + repartition story across both
+        # processes
+        return [
+            Episode(specs=[{"site": "chunk.boundary", "action": "signal",
+                            "at": int(rng.integers(2, 5))}]),
+            Episode(specs=[], expect=EX_OK,
+                    post_args=("--shards", "2")),
         ]
     if name == "deadline_preempt":
         # the preemption is the --deadline timer taking the SIGTERM path
@@ -322,6 +341,14 @@ SCENARIOS: dict[str, Scenario] = {
                  "from the journal alone (the schedule past the resume "
                  "point is never re-trusted)",
                  require_ops=("save", "load", "stream.churn")),
+        Scenario("stream_shard_requeue", "stream_shard",
+                 "sharded streamed rollout with churn-driven live "
+                 "repartition: preempted at a chunk boundary mid-churn, "
+                 "requeued onto a SHRUNK shard count — the journal alone "
+                 "replays the mutations and the repartition story "
+                 "bit-exactly at the new partition",
+                 require_ops=("save", "load", "stream.churn",
+                              "stream.repartition")),
         Scenario("serve_kill_requeue", "serve",
                  "multi-tenant serve spool under the schedule fuzzer: "
                  "hard kill mid-dispatch, restart recovers the orphaned "
@@ -362,6 +389,18 @@ def _workload_args(kind: str, out: str, ckpt: str | None,
         args = ["stream", "--n", "160", "--dmin", "2", "--steps", "10",
                 "--churn-rate", "2.0", "--churn-seed", "3",
                 "--chunks", "3", "--replicas", "32", "--seed", "0",
+                "--out", out]
+    elif kind == "stream_shard":
+        # the SHARDED streamed run with churn-driven repartition live:
+        # the (threshold, churn) pair is pinned where the seeded schedule
+        # provably crosses the hub threshold in BOTH directions over the
+        # full run (one promotion + two demotions at these exact args),
+        # so the journal always carries stream.repartition next to
+        # stream.churn whichever episode the decision lands in
+        args = ["stream", "--n", "160", "--gamma", "2.3", "--dmin", "2",
+                "--steps", "5", "--churn-rate", "12.0",
+                "--churn-seed", "25", "--chunks", "2", "--replicas", "32",
+                "--seed", "0", "--shards", "4", "--hub-threshold", "17",
                 "--out", out]
     else:
         raise ValueError(f"unknown workload {kind!r}")
@@ -529,6 +568,22 @@ def run_scenario(name: str, seed: int, root: str,
         return _run_race_prefetch(scn, seed, root)
     if scn.mode == "serve":
         return _run_serve_kill_requeue(scn, seed, root)
+    if scn.workload == "stream_shard":
+        # the sharded workload needs a real multi-device mesh; a 1-device
+        # process (standalone soak without the forced host platform —
+        # main() forces it, a library caller may not) skips with a
+        # visible reason instead of failing on an environment limit
+        import jax
+
+        if len(jax.devices()) < 2:
+            return {
+                "scenario": name, "seed": seed, "workload": scn.workload,
+                "episodes": [], "journal_ops": [], "problems": [],
+                "ok": True,
+                "skipped": "needs >= 2 devices on one platform (force "
+                           "XLA_FLAGS="
+                           "--xla_force_host_platform_device_count=8)",
+            }
     rng = np.random.default_rng(seed)
     episodes = _plan_episodes(name, rng)
     workdir = os.path.join(root, name, f"seed{seed}")
@@ -552,9 +607,11 @@ def run_scenario(name: str, seed: int, root: str,
             [_faults.FaultSpec(**s) for s in ep.specs], seed=plan_seed)
             if ep.specs else contextlib.nullcontext())
         with plan:
-            rc = _run_cli(list(ep.extra_args) + args, cwd)
+            rc = _run_cli(list(ep.extra_args) + args + list(ep.post_args),
+                          cwd)
         ep_log.append({"episode": i, "rc": rc, "specs": ep.specs,
-                       "pre": ep.pre, "extra_args": list(ep.extra_args)})
+                       "pre": ep.pre, "extra_args": list(ep.extra_args),
+                       "post_args": list(ep.post_args)})
         early = rc == EX_OK and ep.expect == EX_TEMPFAIL
         if early:
             # a randomized schedule may plan its kill past the work that
@@ -1350,6 +1407,14 @@ def main(argv=None) -> int:
             print(f"{s.name:18s} [{s.workload}"
                   f"{', mirror' if s.mirror else ''}] {s.summary}")
         return 0
+    # the sharded-stream scenario needs a multi-device mesh: force the
+    # simulated host platform BEFORE jax initializes (main() runs before
+    # any workload imports jax), so the standalone soakcheck exercises
+    # the same matrix the 8-device test harness does
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
     names = args.scenarios.split(",") if args.scenarios else None
     if names:
         unknown = [n for n in names if n not in SCENARIOS]
@@ -1369,6 +1434,8 @@ def main(argv=None) -> int:
             status = "ok" if r["ok"] else "FAIL"
             print(f"{r['scenario']:18s} seed={r['seed']} "
                   f"episodes={len(r['episodes'])} {status}")
+            if r.get("skipped"):
+                print(f"    skipped: {r['skipped']}")
             for p in r["problems"]:
                 print(f"    {p}")
         print(f"soak: {report['scenarios']} scenario(s) x "

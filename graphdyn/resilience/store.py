@@ -99,7 +99,7 @@ JOURNAL_OPS = (
     "supervise.start", "supervise.restart", "supervise.quarantine",
     "serve.submit", "serve.done", "serve.refuse", "serve.requeue",
     "serve.evict", "serve.quarantine",
-    "stream.churn",
+    "stream.churn", "stream.repartition",
 )
 
 _VERSION_RE = re.compile(r"\.v(\d+)\.npz$")
@@ -346,6 +346,12 @@ def validate_journal(path: str) -> tuple[list[dict], list[str]]:
         # .streamed`): every APPLIED mutation batch is recorded, so a
         # requeued run replays the identical churn from the journal alone
         "stream.churn": ("step", "seq", "adds", "drops"),
+        # the sharded streamed engine's churn-driven repartition
+        # (:mod:`graphdyn.parallel.stream`): hub promotions/demotions
+        # decided at a chunk boundary — deterministic given the churn
+        # history, journaled so replay re-derives the identical layout
+        # even on a different shard count
+        "stream.repartition": ("step", "seq", "promotes", "demotes"),
     }
     for i, ev in enumerate(events):
         kind = ev.get("ev")

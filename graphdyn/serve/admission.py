@@ -174,16 +174,49 @@ def admit(spec: dict, *, key: str = "") -> AdmissionDecision:
                     f"chunk holding the declared dmax={dmax} hub, double-"
                     f"buffered) exceeds the device budget {budget} B — "
                     "no chunking can stream this shape", floor, budget)
-            chunks = streamed_chunk_count(n, W, n_edges, budget)
+            shards = spec.get("shards", 1)
+            try:
+                shards = int(shards)
+            except (TypeError, ValueError):
+                return AdmissionDecision(
+                    False, "", f"malformed shards declaration "
+                    f"{spec.get('shards')!r} (want an int >= 1)", 0, budget)
+            if shards < 1:
+                return AdmissionDecision(
+                    False, "", f"malformed shards declaration "
+                    f"shards={shards} (want an int >= 1)", 0, budget)
+            if shards > 1:
+                try:
+                    import jax
+
+                    n_dev = len(jax.devices())
+                except Exception:  # noqa: BLE001 — no backend = 1 device
+                    n_dev = 1
+                if shards > n_dev:
+                    return AdmissionDecision(
+                        False, "",
+                        f"declared shards={shards} but this worker has "
+                        f"{n_dev} devices — the sharded streamed engine "
+                        "needs one device per shard", 0, budget)
+            # the PER-SHARD byte model (ISSUE 20): each of the S shards
+            # owns ~n/S nodes and ~edges/S adjacency, chunked against ITS
+            # device's budget — so the admission frontier scales ~S× with
+            # the shard count. The single-node floor stays GLOBAL: hubs
+            # are vertex-cut replicated, but a non-hub chunk must still
+            # hold its widest row on one device.
+            n_p = -(-n // shards)
+            e_p = -(-n_edges // shards)
+            chunks = streamed_chunk_count(n_p, W, e_p, budget)
             if chunks is None:
                 return AdmissionDecision(
                     False, "",
-                    f"modeled streamed resident set "
-                    f"{streamed_state_bytes(n, W, n_edges, max(n, 1))} B "
+                    f"modeled per-shard streamed resident set "
+                    f"{streamed_state_bytes(n_p, W, e_p, max(n_p, 1))} B "
                     f"at one-node chunks still exceeds the device budget "
-                    f"{budget} B (n={n}, edges={n_edges}, replicas={R})",
-                    streamed_state_bytes(n, W, n_edges, max(n, 1)), budget)
-            model = streamed_state_bytes(n, W, n_edges, chunks)
+                    f"{budget} B (n={n}, edges={n_edges}, replicas={R}, "
+                    f"shards={shards})",
+                    streamed_state_bytes(n_p, W, e_p, max(n_p, 1)), budget)
+            model = streamed_state_bytes(n_p, W, e_p, chunks)
             return AdmissionDecision(True, "streamed", None, model, budget)
         if solver == "bucketed":
             # the edge-proportional ENGINE: the worker builds a power-law

@@ -84,6 +84,13 @@ SPEC_DEFAULTS: dict = {
     # admission defaults to the min(n-1, edges) worst case). Worker-
     # validated against the built graph like 'edges'.
     "dmax": None,
+    # streamed-solver shard count: the job is priced by the PER-SHARD
+    # streamed_state_bytes model (each of S shards owns ~n/S nodes and
+    # ~edges/S adjacency against its own device budget, so the admission
+    # frontier scales ~S×); the worker re-validates the built shard
+    # plan's double-buffered peak against that promise before any device
+    # work, and refuses declarations exceeding the worker's device count.
+    "shards": 1,
 }
 
 

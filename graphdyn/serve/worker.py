@@ -308,27 +308,77 @@ class Worker:
         R = int(spec["replicas"])
         W = -(-R // WORD)
         budget = device_budget_bytes()
-        try:
-            plan = build_stream_plan(
-                g, W=W, device_budget_bytes=budget)
-        except ValueError as e:
-            # a hub the byte budget cannot hold even alone: the floor
-            # check at admission was under-declared
-            raise DeclaredShapeMismatch(str(e)) from e
+        shards = int(spec.get("shards", 1))
+        if shards < 1:
+            raise DeclaredShapeMismatch(
+                f"malformed shards declaration shards={shards} "
+                "(want an int >= 1)")
         rng = np.random.default_rng(int(spec["seed"]))
         s0 = (2 * rng.integers(0, 2, size=(R, g.n)) - 1).astype(np.int8)
         stats: dict = {}
-        out = streamed_rollout(
-            g, pack_spins(s0), int(spec["max_sweeps"]),
-            rule=str(spec["rule"]), tie=str(spec["tie"]), plan=plan,
-            stats_out=stats)
+        if shards > 1:
+            # the sharded composition (ISSUE 20): the job was PRICED by
+            # the per-shard streamed_state_bytes model, so re-validate
+            # that the built plan actually fits that promise — a built
+            # shard whose double-buffered chunk peak exceeds the
+            # per-device budget means the declaration under-priced the
+            # job (refuse before any device work, PR-18 bucketed pattern)
+            import jax
+
+            from graphdyn.graphs import partition_graph
+            from graphdyn.parallel.stream import (
+                build_shard_stream_plan,
+                shard_plan_device_bytes,
+                sharded_streamed_rollout,
+            )
+
+            n_dev = len(jax.devices())
+            if shards > n_dev:
+                raise DeclaredShapeMismatch(
+                    f"declared shards={shards} but this worker has "
+                    f"{n_dev} devices — the sharded streamed engine "
+                    "needs one device per shard")
+            partition = partition_graph(g, shards, seed=int(spec["seed"]))
+            try:
+                plan = build_shard_stream_plan(
+                    g, W=W, partition=partition,
+                    device_budget_bytes=budget)
+            except ValueError as e:
+                raise DeclaredShapeMismatch(str(e)) from e
+            if shard_plan_device_bytes(plan, W) > budget:
+                raise DeclaredShapeMismatch(
+                    f"built shard plan peaks at "
+                    f"{shard_plan_device_bytes(plan, W)} B per device, "
+                    f"over the {budget} B budget the per-shard model "
+                    "admitted — resubmit with the real shape")
+            out = sharded_streamed_rollout(
+                g, pack_spins(s0), int(spec["max_sweeps"]),
+                n_shards=shards, rule=str(spec["rule"]),
+                tie=str(spec["tie"]), device_budget_bytes=budget,
+                partition=partition, seed=int(spec["seed"]),
+                stats_out=stats)
+            chunks = stats.get("chunks", plan.K)
+        else:
+            try:
+                plan = build_stream_plan(
+                    g, W=W, device_budget_bytes=budget)
+            except ValueError as e:
+                # a hub the byte budget cannot hold even alone: the floor
+                # check at admission was under-declared
+                raise DeclaredShapeMismatch(str(e)) from e
+            out = streamed_rollout(
+                g, pack_spins(s0), int(spec["max_sweeps"]),
+                rule=str(spec["rule"]), tie=str(spec["tie"]), plan=plan,
+                stats_out=stats)
+            chunks = stats.get("chunks", plan.K)
         s = unpack_spins(out, R)
         return {
             "conf": s,
             # graftlint: disable-next-line=GD004  host observable, exact sum
             "m_end": s.astype(np.float64).mean(axis=1),
             "steps": np.asarray(int(spec["max_sweeps"])),
-            "chunks": np.asarray(int(stats.get("chunks", plan.K))),
+            "chunks": np.asarray(int(chunks)),
+            "shards": np.asarray(int(shards)),
         }
 
     # -- ladder rungs ------------------------------------------------------

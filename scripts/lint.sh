@@ -360,6 +360,32 @@ else:
     assert chr_r > 0, f"churn_rate must be > 0 or null+reason: {chr_r}"
     det = row["churn_rate_detail"]
     assert det["applied_mutations"] > 0 and det["spin_update_rate"] > 0, det
+# the sharded streamed rows (PR 20): the composed chunk-walk x exchange
+# engine's weak-scaling efficiency and the churn-driven live-repartition
+# drive — measured positive, or an explicit null + reason — NEVER 0.0
+assert "stream_shard_efficiency" in row, "stream_shard_efficiency absent"
+sse = row["stream_shard_efficiency"]
+if sse is None:
+    assert row.get("stream_shard_efficiency_skipped_reason"), \
+        "null stream_shard_efficiency needs its skipped_reason"
+    print("benchcheck: stream_shard_efficiency skipped:",
+          row["stream_shard_efficiency_skipped_reason"])
+else:
+    assert sse > 0, f"stream_shard_efficiency > 0 or null+reason: {sse}"
+    assert row.get("stream_shard_rate_by_shards", {}).get("1", 0) > 0, \
+        "measured stream_shard row needs a positive P=1 rate"
+assert "churn_repartition_rate" in row, "churn_repartition_rate absent"
+crr = row["churn_repartition_rate"]
+if crr is None:
+    assert row.get("churn_repartition_rate_skipped_reason"), \
+        "null churn_repartition_rate needs its skipped_reason"
+    print("benchcheck: churn_repartition_rate skipped:",
+          row["churn_repartition_rate_skipped_reason"])
+else:
+    assert crr > 0, \
+        f"churn_repartition_rate must be > 0 or null+reason: {crr}"
+    det = row["churn_repartition_rate_detail"]
+    assert det["applied_mutations"] > 0 and det["spin_update_rate"] > 0, det
 # the serve rows: multi-tenant bucket hit rate and end-to-end job
 # latency through the real worker — measured positive, or an explicit
 # null + reason — NEVER 0.0 (the same null-or-positive contract)

@@ -64,6 +64,20 @@ bands are provisional until one does):
    the blessed ``fused_vmem_bytes`` GB102 ratio against the compiler's
    scoped-vmem charge from item 6(b) — all three updates in the same
    reviewed PR as the band re-centering.
+8. Sharded streaming on real chips: step 1's full bench run measures
+   ``stream_shard_scaling`` (fixed nodes/shard, P ∈ {1,2,4,8}, fixed
+   per-shard budget so every leg actually streams) and
+   ``churn_repartition_rate`` for the first time on hardware where the
+   P legs do not share two host cores — the CPU smoke efficiency is an
+   honesty check only. Compare the per-shard streamed rate against the
+   single-chip ``stream_rate`` row: the gap is the exchange tax of the
+   composed engine (ppermute slab + hub ring riding the chunk walk),
+   and the per-shard ``stream.overlap_util`` gauges say whether the
+   prefetch still hides the H2D seam once the ICI exchange shares the
+   step. A weak-scaling efficiency well below the resident
+   ``halo_weak_efficiency`` at the same P means the chunk-boundary
+   exchange is serializing against the prefetch — file it against the
+   slab schedule, not the partitioner.
 """
 
 from __future__ import annotations

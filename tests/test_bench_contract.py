@@ -168,6 +168,26 @@ def test_bench_smoke_emits_one_json_line():
         det = row["churn_rate_detail"]
         assert det["applied_mutations"] > 0
         assert det["spin_update_rate"] > 0
+    # the sharded streamed rows (PR 20): weak-scaling efficiency of the
+    # composed chunk-walk × halo-exchange engine, and the live
+    # churn-driven repartition drive — null-or-positive, never 0.0
+    assert "stream_shard_efficiency" in row
+    if row["stream_shard_efficiency"] is None:
+        assert row["stream_shard_efficiency_skipped_reason"]
+    else:
+        assert row["stream_shard_efficiency"] > 0
+        rates = row["stream_shard_rate_by_shards"]
+        assert rates["1"] > 0
+        assert all(v > 0 for v in rates.values())
+    assert "churn_repartition_rate" in row
+    if row["churn_repartition_rate"] is None:
+        assert row["churn_repartition_rate_skipped_reason"]
+    else:
+        assert row["churn_repartition_rate"] > 0
+        det = row["churn_repartition_rate_detail"]
+        assert det["applied_mutations"] > 0
+        assert det["spin_update_rate"] > 0
+        assert det["shards"] == 2
     # the device-memory column: a positive peak, or an explicit null +
     # reason (CPU: no usable memory_stats) — never silently absent,
     # never a fake 0 (graphdyn.obs.memband.peak_hbm_bytes)

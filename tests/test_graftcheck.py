@@ -582,3 +582,42 @@ class TestAliasSanitizer:
         )
         with alias_sanitizer():
             entropy_grid(24, np.asarray([1.0]), cfg, seed=0, group_size=2)
+
+
+# ---------------------------------------------------------------------------
+# the composed streamed x sharded exchange entry (PR 20)
+# ---------------------------------------------------------------------------
+
+
+def test_streamed_halo_fingerprint_structure(live_fps):
+    """The composed engine's per-step exchange program: the donated hub
+    carry survives compilation, collectives are present (the hub
+    bit-plane ring + the ppermute slab schedule), and the program never
+    deoptimizes into a full-state gather — the GD013 contract restated
+    at the HLO level."""
+    fp = live_fps["streamed_halo"]
+    assert "unsupported" not in fp, fp
+    assert fp["donated_params"], "the hub carry must stay donated"
+    assert fp["op_categories"].get("collective", 0) > 0
+    txt = gc.lower_entry("streamed_halo").compile().as_text()
+    assert "collective-permute" in txt or "collective_permute" in txt
+    assert "all-gather" not in txt and "all_gather" not in txt
+    assert "all-reduce" not in txt and "all_reduce" not in txt
+
+
+def test_streamed_halo_unsupported_on_one_device(monkeypatch):
+    """A 1-device process cannot lower the P=2 composed program: the
+    entry raises UnsupportedEntry with the force-8-devices hint, and the
+    collector records a skip-with-reason — never a silent absence."""
+    import graphdyn.parallel.mesh as mesh_mod
+
+    def no_pool(k):
+        raise RuntimeError(f"need {k} devices, have 1")
+
+    monkeypatch.setattr(mesh_mod, "device_pool", no_pool)
+    with pytest.raises(gc.UnsupportedEntry,
+                       match="xla_force_host_platform_device_count"):
+        gc.lower_entry("streamed_halo")
+    fps = gc.collect_fingerprints(["streamed_halo"])
+    assert "xla_force_host_platform_device_count" in \
+        fps["streamed_halo"]["unsupported"]

@@ -567,3 +567,74 @@ def test_streamed_twin_admitted_where_resident_twin_refused(tmp_path,
     assert out["conf"].shape == (32, n)
     assert set(np.unique(out["conf"])) <= {-1, 1}
     assert int(out["chunks"]) >= 2               # it really streamed
+
+
+def test_sharded_streamed_job_end_to_end(tmp_path, monkeypatch):
+    """The ISSUE-20 serve story: a ``solver='streamed'`` job declaring
+    ``shards`` is priced by the PER-SHARD byte model (the admission
+    frontier scales ~S×: the sharded declaration admits under a budget
+    the single-shard model refuses), the worker runs the sharded
+    composition, and the result is bit-identical to the same job run
+    unsharded — plus the refusal rungs: malformed shards, and a shard
+    count beyond the worker's devices."""
+    from graphdyn.graphs import powerlaw_graph
+    from graphdyn.obs.memband import streamed_state_bytes
+
+    n = 512
+    g = powerlaw_graph(n, gamma=2.5, dmin=2, seed=0)
+    E, dmax = int(g.edges.shape[0]), int(g.deg.max())
+    shape = {"n": n, "d": 2, "gamma": 2.5, "edges": E, "dmax": dmax,
+             "replicas": 32, "max_sweeps": 4, "solver": "streamed"}
+
+    one = admit(normalize_spec(shape))
+    two = admit(normalize_spec({**shape, "shards": 2}))
+    assert one.admitted and two.admitted
+    # the per-shard model prices ~n/S nodes and ~edges/S adjacency
+    assert two.model_bytes < one.model_bytes
+    assert two.model_bytes == streamed_state_bytes(
+        -(-n // 2), 1, -(-E // 2),
+        __import__("graphdyn.obs.memband", fromlist=["streamed_chunk_count"]
+                   ).streamed_chunk_count(
+            -(-n // 2), 1, -(-E // 2), two.budget_bytes))
+
+    # refusal rungs (admission, before any spool traffic)
+    assert not admit(normalize_spec({**shape, "shards": 0})).admitted
+    assert not admit(normalize_spec({**shape, "shards": "many"})).admitted
+    over = admit(normalize_spec({**shape, "shards": 99}))
+    assert not over.admitted and "devices" in over.reason
+
+    spool = Spool(str(tmp_path / "serve"))
+    solo = spool.submit(shape, tenant="t1")
+    duo = spool.submit({**shape, "shards": 2}, tenant="t1")
+    assert Worker(spool).run_until_drained() == 2
+    rec_solo, rec_duo = spool.load(solo), spool.load(duo)
+    assert rec_solo["state"] == DONE, rec_solo
+    assert rec_duo["state"] == DONE, rec_duo
+    out_solo = np.load(rec_solo["result"])
+    out_duo = np.load(rec_duo["result"])
+    assert int(out_duo["shards"]) == 2
+    # the sharded engine is bit-exact: same spec -> identical spins
+    np.testing.assert_array_equal(out_duo["conf"], out_solo["conf"])
+
+
+def test_worker_refuses_streamed_shards_beyond_devices(tmp_path):
+    """A shards declaration that slipped past admission (e.g. admitted on
+    a bigger host) is re-validated by the worker against ITS device count
+    and refused before any device work."""
+    from unittest import mock
+
+    from graphdyn.graphs import powerlaw_graph
+
+    n = 128
+    g = powerlaw_graph(n, gamma=2.5, dmin=2, seed=0)
+    spec = {"n": n, "d": 2, "gamma": 2.5,
+            "edges": int(g.edges.shape[0]), "dmax": int(g.deg.max()),
+            "replicas": 32,
+            "max_sweeps": 4, "solver": "streamed", "shards": 2}
+    spool = Spool(str(tmp_path / "serve"))
+    job = spool.submit(spec, tenant="t1")
+    with mock.patch("jax.devices", return_value=[object()]):
+        assert Worker(spool).run_until_drained() == 1
+    rec = spool.load(job)
+    assert rec["state"] == REFUSED, rec
+    assert "devices" in rec["reason"]

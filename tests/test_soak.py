@@ -27,7 +27,11 @@ def test_scenario_catalogue_shape():
             "truncated_read", "torn_write", "requeue_storm",
             "hang_detect", "deadline_preempt",
             "crash_loop_quarantine", "race_mirror_exit",
-            "race_prefetch_close"} <= set(SCENARIOS)
+            "race_prefetch_close", "stream_shard_requeue"} <= set(SCENARIOS)
+    # the sharded-stream requeue story must assert the live-repartition
+    # journal evidence, not just the churn replay
+    assert {"stream.churn", "stream.repartition"} <= set(
+        SCENARIOS["stream_shard_requeue"].require_ops)
     assert SCENARIOS["mirror_failover"].mirror
     assert SCENARIOS["hang_detect"].mode == "hang"
     assert SCENARIOS["crash_loop_quarantine"].mode == "crash_loop"
@@ -70,6 +74,12 @@ def test_bounded_soak_matrix_is_green(tmp_path):
         assert "supervise.restart" in r["journal_ops"], r
     for r in by_name["crash_loop_quarantine"]:
         assert "supervise.quarantine" in r["journal_ops"], r
+    # the sharded requeue really changed shard count AND saw the live
+    # repartition (this harness forces 8 devices, so never the skip path)
+    for r in by_name["stream_shard_requeue"]:
+        assert not r.get("skipped"), r
+        assert "stream.repartition" in r["journal_ops"], r
+        assert list(r["episodes"][-1]["post_args"]) == ["--shards", "2"], r
 
 
 def test_soak_cli_list_and_unknown_scenario(capsys):

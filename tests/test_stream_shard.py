@@ -1,0 +1,459 @@
+"""Sharded out-of-core streaming (ISSUE 20): the chunk walk × halo
+exchange composition. The contract: bit-exact to BOTH the single-device
+streamed kernel and the resident halo kernel at P ∈ {1, 2, 4} on RRG and
+power-law (hub-split) graphs; chunk ownership is part-major (every shard
+owns its partition segment exactly once, hubs vertex-cut replicated and
+never chunked); churn-driven hub promotion/demotion repartitions live at
+the chunk boundary and journals the decision (``stream.repartition``) so
+a preempted run requeued onto a DIFFERENT shard count replays bit-exactly
+from the journal alone; the shard-mapped exchange body ships only
+``ppermute`` traffic (no all-gather — graftlint GD013, ledger-pinned by
+the graftcheck ``streamed_halo`` row)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from graphdyn.graphs import (
+    partition_graph,
+    powerlaw_graph,
+    random_regular_graph,
+)
+from graphdyn.ops.packed import pack_spins, packed_rollout
+from graphdyn.ops.streamed import (
+    ChurnBatch,
+    build_stream_plan,
+    seeded_churn,
+    streamed_rollout,
+)
+from graphdyn.parallel.halo import halo_rollout
+from graphdyn.parallel.mesh import device_pool, make_mesh
+from graphdyn.parallel.stream import (
+    ShardStreamPlan,
+    build_shard_stream_plan,
+    lower_stream_exchange,
+    make_stream_exchange,
+    shard_plan_device_bytes,
+    sharded_streamed_rollout,
+)
+from graphdyn.resilience import FaultPlan
+from graphdyn.resilience.faults import FaultSpec, InjectedPreemption
+from graphdyn.resilience.store import journal_path_for, validate_journal
+
+THR = 12    # hub threshold for the power-law cases
+
+
+def _graph(kind, n=200, seed=5):
+    if kind == "rrg":
+        return random_regular_graph(n, 3, seed=seed)
+    return powerlaw_graph(n, gamma=2.3, dmin=2, seed=seed)
+
+
+def _sp0(n, R, seed):
+    rng = np.random.default_rng(seed)
+    return pack_spins(
+        (2 * rng.integers(0, 2, size=(R, n)) - 1).astype(np.int8))
+
+
+def _churn_with_repartition(g, steps=5, seed=3):
+    """Background random churn plus two targeted batches: one pushes a
+    near-threshold node over THR (hub promotion), one strips an original
+    hub below THR (demotion) — so the repartition leg actually fires."""
+    deg = g.deg.astype(int)
+    v = int(np.argmax((deg < THR) & (deg >= THR - 6)))
+    others = [u for u in range(g.n) if u != v][: (THR - deg[v]) + 4]
+    adds = np.array([[v, u] for u in others], np.int64)
+    hub = int(np.argmax(deg))
+    nbrs = g.nbr[hub, : deg[hub]].astype(np.int64)
+    drops = np.array(
+        [[hub, int(u)] for u in nbrs[: deg[hub] - THR + 3]], np.int64)
+    empty = np.empty((0, 2), np.int64)
+    return sorted(
+        seeded_churn(g.n, steps, rate=6.0, seed=seed)
+        + [ChurnBatch(step=1, adds=adds, drops=empty),
+           ChurnBatch(step=3, adds=empty, drops=drops)],
+        key=lambda b: b.step)
+
+
+# ---------------------------------------------------------------------------
+# bit-parity: composed engine vs streamed kernel vs resident halo kernel
+# ---------------------------------------------------------------------------
+
+
+# tier-1 keeps one leg per distinct program family (P=1 dispatch
+# identity, P=2 hubless, P=2 hub-split); the remaining grid combos are
+# the same compiled programs at more devices and ride the slow tier
+@pytest.mark.parametrize("P,kind", [
+    (1, "rrg"),
+    (2, "rrg"),
+    (2, "powerlaw"),
+    pytest.param(1, "powerlaw", marks=pytest.mark.slow),
+    pytest.param(4, "rrg", marks=pytest.mark.slow),
+    pytest.param(4, "powerlaw", marks=pytest.mark.slow),
+])
+def test_sharded_streamed_matches_both_engines(kind, P):
+    g = _graph(kind)
+    sp = _sp0(g.n, 32, seed=11)
+    thr = THR if kind == "powerlaw" else None
+    got = sharded_streamed_rollout(
+        g, sp, 3, n_shards=P, n_chunks=3, hub_threshold=thr)
+    ref_s = streamed_rollout(g, sp, 3, rule="majority", tie="stay",
+                             n_chunks=3)
+    np.testing.assert_array_equal(got, ref_s)
+    if P >= 2:
+        part = partition_graph(g, P, seed=0, hub_threshold=thr)
+        ref_h = np.asarray(halo_rollout(
+            g.nbr, g.deg, sp, 3, partition=part))
+        np.testing.assert_array_equal(got, ref_h)
+    else:
+        ref_p = np.asarray(packed_rollout(
+            g.nbr, g.deg, sp, 3, "majority", "stay"))
+        np.testing.assert_array_equal(got, ref_p)
+
+
+@pytest.mark.parametrize("rule,tie", [
+    ("majority", "change"),
+    pytest.param("minority", "stay", marks=pytest.mark.slow),
+])
+def test_sharded_streamed_rule_tie_matrix(rule, tie):
+    g = _graph("powerlaw")
+    sp = _sp0(g.n, 32, seed=7)
+    got = sharded_streamed_rollout(
+        g, sp, 3, n_shards=2, n_chunks=2, hub_threshold=THR,
+        rule=rule, tie=tie)
+    ref = streamed_rollout(g, sp, 3, rule=rule, tie=tie, n_chunks=2)
+    np.testing.assert_array_equal(got, ref)
+
+
+# ---------------------------------------------------------------------------
+# plan structure: part-major chunk ownership, per-shard budget
+# ---------------------------------------------------------------------------
+
+
+def test_shard_plan_partitions_chunks_part_major():
+    g = _graph("powerlaw", n=300)
+    part = partition_graph(g, 4, seed=0, hub_threshold=THR)
+    plan = build_stream_plan(g, W=2, n_chunks=3, partition=part)
+    assert isinstance(plan, ShardStreamPlan)
+    assert plan.P == 4 and plan.K >= 4
+    hubs = set(part.hubs.tolist())
+    seen = []
+    for p, chunks in enumerate(plan.shard_chunks):
+        owned = set(
+            part.order[part.offsets[p]:part.offsets[p + 1]].tolist())
+        mine = np.concatenate([c.nodes for c in chunks]) if chunks else \
+            np.empty(0, np.int64)
+        # every chunked node is owned by THIS shard, never a hub
+        assert set(mine.tolist()) == owned
+        assert not hubs.intersection(mine.tolist())
+        seen.append(mine)
+    # global coverage: each non-hub node chunked exactly once
+    allc = np.sort(np.concatenate(seen))
+    np.testing.assert_array_equal(allc, np.sort(part.order))
+
+
+def test_shard_plan_budget_mode_is_per_shard():
+    g = _graph("powerlaw", n=300)
+    part = partition_graph(g, 2, seed=0, hub_threshold=THR)
+    # a budget small enough to force several chunks per shard
+    tight = build_shard_stream_plan(
+        g, W=2, partition=part, device_budget_bytes=8_000)
+    assert all(len(cs) >= 2 for cs in tight.shard_chunks)
+    assert shard_plan_device_bytes(tight, 2) <= 8_000
+    sp = _sp0(g.n, 64, seed=1)
+    got = sharded_streamed_rollout(
+        g, sp, 2, n_shards=2, device_budget_bytes=8_000,
+        hub_threshold=THR, partition=part)
+    ref = streamed_rollout(g, sp, 2, rule="majority", tie="stay",
+                           n_chunks=2)
+    np.testing.assert_array_equal(got, ref)
+
+
+# ---------------------------------------------------------------------------
+# churn + live repartition
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def churn_oracle():
+    """The fault-free single-device reference for the pinned churn
+    schedule — shared across the repartition-parity and requeue tests
+    (identical workload, one oracle computation per module)."""
+    g = _graph("powerlaw")
+    sp = _sp0(g.n, 32, seed=2)
+    churn = _churn_with_repartition(g)
+    ref = streamed_rollout(g, sp, 5, rule="majority", tie="stay",
+                           n_chunks=3, churn=churn)
+    return g, sp, churn, np.asarray(ref)
+
+
+@pytest.mark.parametrize("P", [
+    2, pytest.param(4, marks=pytest.mark.slow),
+])
+def test_churn_repartition_bit_exact(P, churn_oracle):
+    g, sp, churn, ref = churn_oracle
+    stats = {}
+    got = sharded_streamed_rollout(
+        g, sp, 5, n_shards=P, n_chunks=3, hub_threshold=THR,
+        churn=churn, stats_out=stats)
+    np.testing.assert_array_equal(got, ref)
+    # both the promotion and the demotion boundary actually repartitioned,
+    # and the incremental rebuild touched a strict subset of all chunk
+    # builds a full rebuild-per-boundary would have done
+    assert stats["repartitions"] >= 2
+    assert stats["mutations"] > 0
+    assert stats["chunks_rebuilt"] >= stats["chunks"]
+
+
+def test_churn_without_threshold_never_repartitions():
+    g = _graph("rrg")
+    sp = _sp0(g.n, 32, seed=2)
+    churn = seeded_churn(g.n, 4, rate=6.0, seed=9)
+    ref = streamed_rollout(g, sp, 4, rule="majority", tie="stay",
+                           n_chunks=3, churn=churn)
+    stats = {}
+    got = sharded_streamed_rollout(
+        g, sp, 4, n_shards=2, n_chunks=3, churn=churn, stats_out=stats)
+    np.testing.assert_array_equal(got, ref)
+    assert stats["repartitions"] == 0 and stats["mutations"] > 0
+
+
+# ---------------------------------------------------------------------------
+# preempt / requeue onto a different shard count: journal-alone replay
+# ---------------------------------------------------------------------------
+
+
+# the shrink direction (4 -> 2) is the soak matrix's CLI story
+# (`stream_shard_requeue`), so tier-1 keeps the grow direction here
+@pytest.mark.parametrize("p_before,p_after", [
+    (2, 4), pytest.param(4, 2, marks=pytest.mark.slow),
+])
+def test_requeue_across_shard_count_bit_exact(tmp_path, p_before, p_after,
+                                              churn_oracle):
+    g, sp, churn, ref = churn_oracle
+    ck = str(tmp_path / "run.ckpt")
+    with pytest.raises(InjectedPreemption):
+        with FaultPlan([FaultSpec("chunk.boundary", "preempt", at=4)]):
+            sharded_streamed_rollout(
+                g, sp, 5, n_shards=p_before, n_chunks=3,
+                hub_threshold=THR, churn=churn, checkpoint_path=ck,
+                checkpoint_interval_s=0.0)
+    # requeue onto a DIFFERENT shard count: the snapshot is global and
+    # the journal replays the churn history, so the resumed run is
+    # bit-exact to the fault-free oracle
+    got = sharded_streamed_rollout(
+        g, sp, 5, n_shards=p_after, n_chunks=3, hub_threshold=THR,
+        churn=churn, checkpoint_path=ck)
+    np.testing.assert_array_equal(got, ref)
+    jp = journal_path_for(ck)
+    ops = {json.loads(l).get("op") for l in open(jp)}
+    assert "stream.churn" in ops and "stream.repartition" in ops
+    _, problems = validate_journal(jp)
+    assert problems == []
+
+
+def test_resume_onto_streamed_single_device(tmp_path):
+    """The checkpoint identity matches the single-device streamed engine
+    (global snapshot, same fingerprint), so a sharded run's checkpoint
+    resumes under plain ``streamed_rollout`` too — engine portability,
+    not just shard-count portability."""
+    g = _graph("rrg")
+    sp = _sp0(g.n, 32, seed=6)
+    ref = streamed_rollout(g, sp, 6, rule="majority", tie="stay",
+                           n_chunks=3)
+    ck = str(tmp_path / "run.ckpt")
+    with pytest.raises(InjectedPreemption):
+        with FaultPlan([FaultSpec("chunk.boundary", "preempt", at=5)]):
+            sharded_streamed_rollout(
+                g, sp, 6, n_shards=2, n_chunks=3, checkpoint_path=ck,
+                checkpoint_interval_s=0.0)
+    got = streamed_rollout(g, sp, 6, rule="majority", tie="stay",
+                           n_chunks=3, checkpoint_path=ck)
+    np.testing.assert_array_equal(got, ref)
+
+
+# ---------------------------------------------------------------------------
+# the exchange program: ppermute-only body, donated carry
+# ---------------------------------------------------------------------------
+
+
+def test_exchange_program_is_ppermute_only():
+    g = _graph("powerlaw", n=300)
+    part = partition_graph(g, 2, seed=0, hub_threshold=THR)
+    mesh = make_mesh((2,), ("node",), devices=device_pool(2))
+    lowered = lower_stream_exchange(
+        mesh, g, part, W=2, rule="majority", tie="stay",
+        node_axis="node")
+    txt = lowered.as_text()
+    assert "collective_permute" in txt
+    assert "all_gather" not in txt
+    assert "all_reduce" not in txt
+
+
+def test_exchange_requires_something_to_exchange():
+    from graphdyn.parallel.halo import build_halo_tables
+
+    # one hubless part: no schedule, no hubs -> nothing to build
+    g = _graph("rrg", n=40)
+    part = partition_graph(g, 1, seed=0)
+    tables = build_halo_tables(g, part)
+    mesh = make_mesh((1,), ("node",), devices=device_pool(1))
+    with pytest.raises(ValueError, match="nothing to exchange"):
+        make_stream_exchange(mesh, tables)
+
+
+# ---------------------------------------------------------------------------
+# driver surface: stats, gauges, refusals
+# ---------------------------------------------------------------------------
+
+
+def test_stats_and_per_shard_overlap(tmp_path):
+    from graphdyn import obs
+    from graphdyn.obs.recorder import read_ledger
+
+    g = _graph("rrg")
+    sp = _sp0(g.n, 32, seed=1)
+    ledger = str(tmp_path / "obs.jsonl")
+    stats = {}
+    with obs.recording(ledger):
+        sharded_streamed_rollout(
+            g, sp, 2, n_shards=2, n_chunks=3, stats_out=stats)
+    assert stats["shards"] == 2 and stats["steps"] == 2
+    assert stats["chunks"] == 6
+    assert len(stats["per_shard_overlap"]) == 2
+    assert stats["h2d_bytes"] > 0 and stats["d2h_bytes"] > 0
+    events, _ = read_ledger(ledger)
+    gauges = [e for e in events
+              if e.get("ev") == "gauge"
+              and e.get("name") == "stream.overlap_util"]
+    assert {e["attrs"]["shard"] for e in gauges} == {0, 1}
+
+
+def test_driver_refusals():
+    g = _graph("rrg", n=40)
+    sp = _sp0(g.n, 32, seed=1)
+    with pytest.raises(ValueError, match="n_shards"):
+        sharded_streamed_rollout(g, sp, 1, n_shards=0, n_chunks=2)
+    with pytest.raises(ValueError, match="sp must be"):
+        sharded_streamed_rollout(g, sp[:-1], 1, n_shards=2, n_chunks=2)
+    part = partition_graph(g, 2, seed=0)
+    with pytest.raises(ValueError, match="P=2"):
+        sharded_streamed_rollout(
+            g, sp, 1, n_shards=4, n_chunks=2, partition=part)
+    with pytest.raises(ValueError, match="exactly one of"):
+        sharded_streamed_rollout(g, sp, 1, n_shards=2)
+
+
+# ---------------------------------------------------------------------------
+# sa_sharded layout='streamed': the SA route of the composed engine
+# ---------------------------------------------------------------------------
+
+
+def test_sa_sharded_streamed_bit_parity():
+    from graphdyn.config import SAConfig
+    from graphdyn.models.sa import simulated_annealing
+    from graphdyn.parallel.sa_sharded import sa_sharded
+
+    g = random_regular_graph(40, 3, seed=5)
+    rng = np.random.default_rng(6)
+    R, L = 4, 2000
+    s0 = (2 * rng.integers(0, 2, size=(R, g.n)) - 1).astype(np.int8)
+    proposals = rng.integers(0, g.n, size=(R, L)).astype(np.int32)
+    uniforms = rng.random(size=(R, L))
+    cfg = SAConfig()
+    ref = simulated_annealing(
+        g, cfg, s0=s0, proposals=proposals, uniforms=uniforms, max_steps=30)
+    mesh = make_mesh((1, 2), ("replica", "node"),
+                     devices=device_pool(2))
+    got = sa_sharded(
+        g, cfg, mesh=mesh, s0=s0, proposals=proposals, uniforms=uniforms,
+        max_steps=30, layout="streamed", stream_chunks=2)
+    np.testing.assert_array_equal(got.s, ref.s)
+    np.testing.assert_array_equal(got.num_steps, ref.num_steps)
+    np.testing.assert_array_equal(got.m_final, ref.m_final)
+
+
+def test_sa_sharded_streamed_refusals():
+    from graphdyn.config import SAConfig
+    from graphdyn.parallel.sa_sharded import sa_sharded
+
+    g = random_regular_graph(40, 3, seed=5)
+    mesh = make_mesh((1, 2), ("replica", "node"), devices=device_pool(2))
+    kw = dict(mesh=mesh, n_replicas=2, seed=0, max_steps=5)
+    with pytest.raises(ValueError, match="layout must be"):
+        sa_sharded(g, SAConfig(), layout="bucketed", **kw)
+    with pytest.raises(ValueError, match="chunked-chain resume"):
+        sa_sharded(g, SAConfig(), layout="streamed",
+                   checkpoint_path="/tmp/x.ckpt", **kw)
+    with pytest.raises(ValueError, match="rollout_mode='full'"):
+        sa_sharded(g, SAConfig(), layout="streamed",
+                   rollout_mode="lightcone", **kw)
+    with pytest.raises(ValueError, match="halo composition"):
+        sa_sharded(g, SAConfig(), layout="streamed",
+                   node_mode="halo", **kw)
+
+
+# ---------------------------------------------------------------------------
+# bench row contract
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_bench_stream_shard_scaling_contract():
+    """The measured path (this harness forces 8 devices): per-P rates,
+    P=1 = the unsharded streamed program on the same per-shard budget,
+    and a positive efficiency. Slow tier: lint.sh's benchcheck runs the
+    same row in the real smoke document; tier-1 keeps the null-reason
+    contract below."""
+    import bench
+
+    row = bench.stream_shard_scaling_row(True, n_per=96, R=64, steps=3,
+                                         iters=1)
+    assert row["stream_shard_efficiency"] > 0
+    rates = row["stream_shard_rate_by_shards"]
+    assert set(rates) == {"1", "2", "4", "8"}
+    assert all(v > 0 for v in rates.values())
+    assert row["stream_shard_workload"]["P_max"] == 8
+    assert row["stream_shard_workload"]["budget_per_shard_bytes"] > 0
+
+
+def test_bench_stream_shard_rows_null_reason_single_device(monkeypatch):
+    """Fewer than 2 devices -> null + reason on BOTH sharded rows, never
+    0.0 (the benchcheck contract)."""
+    import bench
+
+    import jax
+
+    real_devices = jax.devices
+
+    def one_device(*args):
+        return real_devices()[:1]
+
+    monkeypatch.setattr(jax, "devices", one_device)
+    row = bench.stream_shard_scaling_row(True)
+    assert row["stream_shard_efficiency"] is None
+    assert ">= 2 devices" in row["stream_shard_efficiency_skipped_reason"]
+    row = bench.churn_repartition_rate_row(True)
+    assert row["churn_repartition_rate"] is None
+    assert ">= 2 devices" in row["churn_repartition_rate_skipped_reason"]
+
+
+@pytest.mark.slow
+def test_bench_churn_repartition_rate_contract():
+    """The measured path: a positive applied-mutations rate with the
+    dynamics never stalled, and the repartition counters wired through
+    from the sharded engine's stats. Slow tier, same reasoning as the
+    scaling contract above."""
+    import bench
+
+    row = bench.churn_repartition_rate_row(True, n=192, R=64, steps=5,
+                                           churn_per_step=24.0)
+    assert row["churn_repartition_rate"] > 0
+    det = row["churn_repartition_rate_detail"]
+    assert det["applied_mutations"] > 0
+    assert det["spin_update_rate"] > 0
+    assert det["shards"] == 2
+    assert det["repartitions"] >= 0
+    assert det["chunks_rebuilt"] >= 0
